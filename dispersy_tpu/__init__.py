@@ -1,0 +1,30 @@
+"""dispersy-tpu: a TPU-native re-design of the Dispersy epidemic overlay.
+
+Dispersy (reference: ``lfdversluis/dispersy``) is a decentralized,
+NAT-traversing epidemic message-synchronization overlay: peers discover each
+other via a random walk (``dispersy-introduction-request/-response`` +
+``dispersy-puncture``) and reconcile message stores via Bloom-filter sync
+(``Community.dispersy_claim_sync_bloom_filter``).
+
+This package recasts that overlay as a massively batched JAX simulation:
+
+- every peer is a row of a device-sharded ``PeerState`` pytree,
+- one ``pjit``-compiled ``step`` function advances *all* peers one walker
+  interval at a time,
+- UDP delivery becomes :mod:`dispersy_tpu.ops.inbox` (sort-by-receiver
+  scatter into bounded inboxes — the ``JaxSimEndpoint`` seam),
+- Bloom filters become packed-uint32 bit kernels (:mod:`dispersy_tpu.ops.bloom`),
+- the SQLite ``sync`` table becomes a sorted fixed-capacity ring store
+  (:mod:`dispersy_tpu.ops.store`),
+- the ``Community`` subclass API survives at the rim
+  (:mod:`dispersy_tpu.community`) and compiles policy declarations down to
+  static kernel configuration.
+
+See ``SURVEY.md`` for the reference's layer map and the provenance caveat
+(the reference mount was empty during the survey; citations are
+symbol-level).
+"""
+
+__version__ = "0.1.0"
+
+from dispersy_tpu.config import CommunityConfig  # noqa: F401

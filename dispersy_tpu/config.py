@@ -1,0 +1,147 @@
+"""Static simulation configuration.
+
+The reference has no config system: tunables are class attributes on
+``Community`` overridden by subclasses (reference: ``community.py`` —
+``dispersy_sync_bloom_filter_error_rate``, ``dispersy_sync_response_limit``,
+``dispersy_walker_interval``-style properties; see SURVEY.md §5.6).  Here the
+same knobs live in one frozen, hashable dataclass so they can be passed as a
+static argument to ``jit`` and vmapped over a community axis.
+
+All *times* are simulated seconds; one simulation round == one walker
+interval (reference: ~5 s between ``Dispersy._take_step`` calls per
+community).  All *sizes* are records/bits, chosen so every array in the hot
+step has a static shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+# Sentinel for "empty slot" in uint32 record fields: sorts after every real
+# global_time, so ascending sort pushes holes to the end of the store ring.
+EMPTY_U32 = 0xFFFFFFFF
+# Sentinel peer index for "no peer" in int32 index fields.
+NO_PEER = -1
+
+# Candidate categories (reference: candidate.py WalkCandidate tracks separate
+# walk/stumble/intro timestamps; categories drive the walk split).
+CAT_NONE = 0
+CAT_WALKED = 1
+CAT_STUMBLED = 2
+CAT_INTRODUCED = 3
+
+
+def bloom_size_for(error_rate: float, capacity: int) -> tuple[int, int]:
+    """(n_bits, n_hashes) for a Bloom filter with the given design point.
+
+    Mirrors the reference's constructor-from-(error_rate, capacity)
+    (reference: bloomfilter.py ``BloomFilter.__init__``): standard formulas
+    m = -n·ln(p)/ln(2)^2, k = m/n·ln(2); n_bits rounded up to a multiple of
+    32 so the bitset packs exactly into uint32 words.
+    """
+    if not (0.0 < error_rate < 1.0):
+        raise ValueError(f"error_rate must be in (0,1), got {error_rate}")
+    if capacity <= 0:
+        raise ValueError(f"capacity must be positive, got {capacity}")
+    m = -capacity * math.log(error_rate) / (math.log(2) ** 2)
+    n_bits = int(math.ceil(m / 32.0)) * 32
+    k = max(1, int(round(n_bits / capacity * math.log(2))))
+    return n_bits, k
+
+
+@dataclasses.dataclass(frozen=True)
+class CommunityConfig:
+    """All static knobs for one simulated community.
+
+    Field defaults mirror the reference's protocol constants (BASELINE.md
+    table; symbol-level citations in each comment).
+    """
+
+    # ---- population ----
+    n_peers: int = 1024
+    n_trackers: int = 2  # bootstrap peers, indices [0, n_trackers)
+    #   (reference: bootstrap.py tracker list -> BootstrapCandidate)
+
+    # ---- walker (reference: community.py walker task + candidate.py) ----
+    walk_interval: float = 5.0          # seconds per round / per step
+    walk_timeout: float = 10.5          # IntroductionRequestCache.timeout_delay
+    walk_lifetime: float = 57.5         # WalkCandidate walk/stumble lifetime
+    intro_lifetime: float = 27.5        # lifetime of introduced candidates
+    eligibility_delay: float = 27.5     # min age before re-walking a candidate
+    # Category split for dispersy_get_walk_candidate (reference:
+    # community.py; ≈49.75% walked / 24.875% stumbled / 24.875% introduced /
+    # 0.5% bootstrap).
+    p_revisit_walked: float = 0.4975
+    p_stumbled: float = 0.24875
+    p_introduced: float = 0.24875
+    p_bootstrap: float = 0.005
+    k_candidates: int = 16              # candidate-table slots per peer
+    walker_enabled: bool = True         # dispersy_enable_candidate_walker
+
+    # ---- bloom sync (reference: community.py dispersy_claim_sync_bloom_filter,
+    #      bloomfilter.py; bloom sized to fit one ~1500B UDP payload) ----
+    sync_enabled: bool = True           # dispersy_enable_bloom_filter_sync
+    bloom_error_rate: float = 0.01      # dispersy_sync_bloom_filter_error_rate
+    bloom_capacity: int = 256           # entries per sync slice / bloom
+    response_budget: int = 16           # records per sync response
+    #   (reference: dispersy_sync_response_limit ≈ 5 KB / packet size)
+
+    # ---- message store (reference: the SQLite `sync` table;
+    #      UNIQUE(community, member, global_time)) ----
+    msg_capacity: int = 256             # store ring slots per peer
+    request_inbox: int = 4              # intro-requests processed per peer/round
+    msg_inbox: int = 64                 # sync records accepted per peer/round
+
+    # ---- clock (reference: community.py claim_global_time /
+    #      dispersy_acceptable_global_time_range) ----
+    acceptable_global_time_range: int = 10000
+
+    # ---- environment / fault model (reference: failure handling *is* the
+    #      protocol — candidate timeouts, walk timeouts; SURVEY.md §5.3) ----
+    churn_rate: float = 0.0             # fraction of peers replaced per round
+    packet_loss: float = 0.0            # Bernoulli drop per logical packet
+
+    # ---- permissions (reference: timeline.py; bounded table of authorized
+    #      members — real overlays authorize a handful of members) ----
+    timeline_enabled: bool = False
+    k_authorized: int = 16              # authorized-member slots per peer
+    n_meta: int = 8                     # distinct user meta-message ids
+
+    # ------------------------------------------------------------------
+    @property
+    def bloom_bits(self) -> int:
+        return bloom_size_for(self.bloom_error_rate, self.bloom_capacity)[0]
+
+    @property
+    def bloom_hashes(self) -> int:
+        return bloom_size_for(self.bloom_error_rate, self.bloom_capacity)[1]
+
+    @property
+    def bloom_words(self) -> int:
+        return self.bloom_bits // 32
+
+    @property
+    def walk_lifetime_rounds(self) -> float:
+        return self.walk_lifetime / self.walk_interval
+
+    @property
+    def intro_lifetime_rounds(self) -> float:
+        return self.intro_lifetime / self.walk_interval
+
+    @property
+    def eligibility_delay_rounds(self) -> float:
+        return self.eligibility_delay / self.walk_interval
+
+    def __post_init__(self) -> None:
+        if self.n_peers <= 0:
+            raise ValueError("n_peers must be positive")
+        if not (0 <= self.n_trackers <= self.n_peers):
+            raise ValueError("n_trackers must be in [0, n_peers]")
+        p = (self.p_revisit_walked + self.p_stumbled + self.p_introduced
+             + self.p_bootstrap)
+        if abs(p - 1.0) > 1e-6:
+            raise ValueError(f"walk category probabilities sum to {p}, not 1")
+
+    def replace(self, **kw) -> "CommunityConfig":
+        return dataclasses.replace(self, **kw)

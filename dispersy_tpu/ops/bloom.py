@@ -1,0 +1,79 @@
+"""Packed-uint32 Bloom filter kernels.
+
+TPU-native replacement for the reference's pure-Python ``BloomFilter``
+(reference: bloomfilter.py — ``BloomFilter.add / __contains__ / bytes``,
+sized to fit one UDP payload, double hashing).  The bitset is a ``uint32[W]``
+word array per filter; building scatters into a dense boolean bit vector and
+packs it, querying gathers words and tests bits — both shapes are static so
+the whole thing fuses under jit/vmap.
+
+Double-hashing scheme: bit_j = (h1 + j·h2) mod n_bits with h2 forced odd,
+h1/h2 drawn from seeded :func:`dispersy_tpu.ops.hashing.hash_u32` streams.
+The CPU oracle (:mod:`dispersy_tpu.oracle.bloom`) mirrors this bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dispersy_tpu.ops.hashing import BLOOM_SEED_1, BLOOM_SEED_2, hash_u32
+
+
+def probe_bits(item_hash: jnp.ndarray, n_bits: int, n_hashes: int) -> jnp.ndarray:
+    """Bit indices probed for an item: shape ``item_hash.shape + (n_hashes,)``.
+
+    uint32 arithmetic throughout; h2 is forced odd so successive probes do not
+    collapse when h2 would be 0 (and cycle through all residues when n_bits is
+    a power of two).
+    """
+    h = item_hash.astype(jnp.uint32)
+    h1 = hash_u32(h, BLOOM_SEED_1)
+    h2 = hash_u32(h, BLOOM_SEED_2) | jnp.uint32(1)
+    j = jnp.arange(n_hashes, dtype=jnp.uint32)
+    idx = (h1[..., None] + j * h2[..., None]) % jnp.uint32(n_bits)
+    return idx.astype(jnp.int32)
+
+
+def bloom_build(item_hashes: jnp.ndarray, mask: jnp.ndarray,
+                n_bits: int, n_hashes: int) -> jnp.ndarray:
+    """Build one packed filter from ``[M]`` item hashes under a validity mask.
+
+    Returns ``uint32[n_bits // 32]``.  Masked-out items are routed to an
+    out-of-range index and dropped by the scatter, so the shape stays static
+    (the reference loops ``BloomFilter.add`` over the sync-slice SELECT; here
+    the slice mask plays that role).
+    """
+    assert n_bits % 32 == 0, "n_bits must pack into uint32 words"
+    idx = probe_bits(item_hashes, n_bits, n_hashes)          # [M, k]
+    idx = jnp.where(mask[..., None], idx, n_bits)            # park masked items
+    dense = jnp.zeros((n_bits,), jnp.bool_).at[idx.reshape(-1)].set(
+        True, mode="drop")
+    return pack_bits(dense)
+
+
+def pack_bits(dense: jnp.ndarray) -> jnp.ndarray:
+    """bool[n_bits] -> uint32[n_bits//32], bit i of word w == bit 32w+i."""
+    w = dense.reshape(-1, 32).astype(jnp.uint32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return (w << shifts).sum(axis=-1, dtype=jnp.uint32)
+
+
+def unpack_bits(words: jnp.ndarray) -> jnp.ndarray:
+    """uint32[W] -> bool[32·W] (inverse of :func:`pack_bits`)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return (((words[..., None] >> shifts) & 1) > 0).reshape(*words.shape[:-1], -1)
+
+
+def bloom_query(words: jnp.ndarray, item_hashes: jnp.ndarray,
+                n_bits: int, n_hashes: int) -> jnp.ndarray:
+    """Membership test: ``words`` uint32[W], ``item_hashes`` [...] -> bool[...].
+
+    Reference: ``BloomFilter.__contains__``.  True means *possibly present*
+    (standard Bloom semantics: false positives at the configured error rate,
+    never false negatives).
+    """
+    idx = probe_bits(item_hashes, n_bits, n_hashes)          # [..., k]
+    word = idx >> 5
+    bit = (idx & 31).astype(jnp.uint32)
+    present = (words[word] >> bit) & jnp.uint32(1)
+    return jnp.all(present == 1, axis=-1)

@@ -1,0 +1,66 @@
+"""Deterministic uint32 hashing shared by the TPU kernels and the CPU oracle.
+
+The reference Bloom filter derives its k hash functions from sha1/md5 digests
+of the packet bytes (reference: bloomfilter.py — double hashing over a
+cryptographic digest).  The simulation has no packet bytes — a message is a
+packed record of uint32 fields — so we use a murmur3-style finalizer over the
+record fields instead.  What matters for fidelity is the *distribution*
+(uniform, independent per seed), not the exact digest family; conformance is
+checked by false-positive-rate tests against the pure-Python oracle
+(:mod:`dispersy_tpu.oracle.bloom`), which implements the identical mixing so
+TPU and oracle agree bit-for-bit.
+
+All functions operate on uint32 and wrap mod 2^32.  They are written so the
+same expressions run under jax.numpy (wrapping uint32 arrays) and are
+mirrored with explicit ``& 0xFFFFFFFF`` masks in the oracle.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+GOLDEN = 0x9E3779B9
+_C1 = 0x85EBCA6B
+_C2 = 0xC2B2AE35
+
+# Domain-separation seeds for the two Bloom double-hashing streams.
+BLOOM_SEED_1 = 0x8F1BBCDC
+BLOOM_SEED_2 = 0xCA62C1D6
+
+
+def fmix32(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 32-bit finalizer: a bijective avalanche mix on uint32."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(_C1)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(_C2)
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash_u32(x: jnp.ndarray, seed: int) -> jnp.ndarray:
+    """Seeded hash of a uint32 value."""
+    return fmix32(x.astype(jnp.uint32) ^ fmix32(jnp.uint32(seed)))
+
+
+def combine(h: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Fold value ``v`` into running hash ``h`` (boost::hash_combine-style)."""
+    h = h.astype(jnp.uint32)
+    return h ^ (fmix32(v) + jnp.uint32(GOLDEN) + (h << 6) + (h >> 2))
+
+
+def record_hash(member: jnp.ndarray, global_time: jnp.ndarray,
+                meta: jnp.ndarray, payload: jnp.ndarray) -> jnp.ndarray:
+    """Hash of one sync record — the simulation analogue of the packet sha1.
+
+    The reference identifies a packet by its full binary (and dedups the sync
+    table on UNIQUE(community, member, global_time)); here a record is the
+    4-tuple (member, global_time, meta, payload) and this hash is its identity
+    for Bloom-filter membership.
+    """
+    h = fmix32(member.astype(jnp.uint32))
+    h = combine(h, global_time.astype(jnp.uint32))
+    h = combine(h, meta.astype(jnp.uint32))
+    h = combine(h, payload.astype(jnp.uint32))
+    return h

@@ -1,0 +1,9 @@
+"""Pure-Python CPU oracle of the simulation semantics.
+
+The reference's deepest invariants are encoded as behavioral tests
+(reference: tests/debugcommunity/ — ``DebugCommunity`` + ``DebugNode`` drive
+real stacks on loopback).  The rebuild's analogue is this package: a slow,
+obvious, dict-and-loop implementation of the *same semantics* as the TPU
+kernels, used by the test suite to check the kernels bit-for-bit (bloom) and
+trace-for-trace (sync rounds) at tiny N.
+"""

@@ -1,0 +1,79 @@
+"""Pure-Python Bloom filter oracle — bit-for-bit mirror of ops/bloom.py.
+
+Plays the role the reference's ``bloomfilter.py`` plays for its tests
+(reference: tests/test_bloomfilter.py — false-positive rate + round-trip):
+an independent, obviously-correct implementation the TPU kernel is checked
+against.  Every arithmetic step mirrors :mod:`dispersy_tpu.ops.hashing` /
+:mod:`dispersy_tpu.ops.bloom` with explicit ``& 0xFFFFFFFF`` masking.
+"""
+
+from __future__ import annotations
+
+M32 = 0xFFFFFFFF
+GOLDEN = 0x9E3779B9
+_C1 = 0x85EBCA6B
+_C2 = 0xC2B2AE35
+BLOOM_SEED_1 = 0x8F1BBCDC
+BLOOM_SEED_2 = 0xCA62C1D6
+
+
+def fmix32(x: int) -> int:
+    x &= M32
+    x ^= x >> 16
+    x = (x * _C1) & M32
+    x ^= x >> 13
+    x = (x * _C2) & M32
+    x ^= x >> 16
+    return x
+
+
+def hash_u32(x: int, seed: int) -> int:
+    return fmix32((x & M32) ^ fmix32(seed))
+
+
+def combine(h: int, v: int) -> int:
+    h &= M32
+    return (h ^ ((fmix32(v) + GOLDEN + ((h << 6) & M32) + (h >> 2)) & M32)) & M32
+
+
+def record_hash(member: int, global_time: int, meta: int, payload: int) -> int:
+    h = fmix32(member)
+    h = combine(h, global_time)
+    h = combine(h, meta)
+    h = combine(h, payload)
+    return h
+
+
+def probe_bits(item_hash: int, n_bits: int, n_hashes: int) -> list[int]:
+    h1 = hash_u32(item_hash, BLOOM_SEED_1)
+    h2 = hash_u32(item_hash, BLOOM_SEED_2) | 1
+    return [((h1 + j * h2) & M32) % n_bits for j in range(n_hashes)]
+
+
+class OracleBloom:
+    """Mirror of the packed-uint32 filter; reference: bloomfilter.py BloomFilter."""
+
+    def __init__(self, n_bits: int, n_hashes: int) -> None:
+        assert n_bits % 32 == 0
+        self.n_bits = n_bits
+        self.n_hashes = n_hashes
+        self.bits = [False] * n_bits
+
+    def add(self, item_hash: int) -> None:
+        for b in probe_bits(item_hash, self.n_bits, self.n_hashes):
+            self.bits[b] = True
+
+    def __contains__(self, item_hash: int) -> bool:
+        return all(self.bits[b]
+                   for b in probe_bits(item_hash, self.n_bits, self.n_hashes))
+
+    def words(self) -> list[int]:
+        """Packed uint32 words, same layout as ops.bloom.pack_bits."""
+        out = []
+        for w in range(self.n_bits // 32):
+            word = 0
+            for i in range(32):
+                if self.bits[32 * w + i]:
+                    word |= 1 << i
+            out.append(word)
+        return out

@@ -1,0 +1,126 @@
+"""PeerState: the entire overlay as one device-sharded pytree.
+
+Everything the reference keeps in Python objects + SQLite becomes dense array
+state here (SURVEY.md §7 stage 1):
+
+- the candidate dict per community (reference: candidate.py ``WalkCandidate``
+  with walk/stumble/intro timestamps) -> fixed ``k_candidates`` slots per
+  peer holding a peer index + three timestamps.  A slot's *category* is
+  derived from which timestamps are still within their lifetimes (walked >
+  stumbled > introduced, mirroring ``WalkCandidate.get_category``), so no
+  separate category field can go stale.
+- the SQLite ``sync`` table (reference: dispersydatabase.py — columns
+  community, member, global_time, meta_message, packet, undone;
+  UNIQUE(community, member, global_time)) -> a fixed-capacity ring of packed
+  uint32 records per peer, kept sorted by (global_time, member, meta,
+  payload); empty slots hold the ``EMPTY_U32`` sentinel so they sort last.
+- the walk ``RequestCache`` entry (reference: requestcache.py
+  ``IntroductionRequestCache``, ~10.5 s timeout) -> one outstanding walk
+  target + timestamp per peer.
+- ``DispersyStatistics`` counters (reference: statistics.py) -> uint32
+  counter columns.
+
+The peer axis (leading axis of every array) is the sharding axis: shard it
+over a ``jax.sharding.Mesh`` and the whole step runs SPMD with XLA inserting
+the collectives at the delivery kernel's sort/scatter.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from dispersy_tpu.config import EMPTY_U32, NO_PEER, CommunityConfig
+
+NEVER = -1.0e9  # "timestamp never happened" for float32 sim-seconds fields
+
+
+@struct.dataclass
+class Stats:
+    """Per-peer counters; reference: statistics.py DispersyStatistics."""
+    walk_success: jnp.ndarray     # u32[N] intro-responses received in time
+    walk_fail: jnp.ndarray        # u32[N] walk timeouts
+    msgs_stored: jnp.ndarray      # u32[N] new records inserted into store
+    msgs_dropped: jnp.ndarray     # u32[N] records dropped (inbox/store full)
+    requests_dropped: jnp.ndarray  # u32[N] intro-requests dropped (inbox full)
+    punctures: jnp.ndarray        # u32[N] punctures sent (as introduced peer)
+
+
+@struct.dataclass
+class PeerState:
+    # ---- liveness / identity ----
+    alive: jnp.ndarray        # bool[N]
+    is_tracker: jnp.ndarray   # bool[N]  bootstrap peers (tool/tracker.py role)
+    session: jnp.ndarray      # u32[N]   bumped on churn rejoin
+    global_time: jnp.ndarray  # u32[N]   Lamport clock (community.py claim_global_time)
+
+    # ---- candidate table [N, K] ----
+    cand_peer: jnp.ndarray         # i32, NO_PEER = empty
+    cand_last_walk: jnp.ndarray    # f32 sim-seconds of last successful walk to it
+    cand_last_stumble: jnp.ndarray  # f32 last time it contacted us
+    cand_last_intro: jnp.ndarray   # f32 last time it was introduced to us
+
+    # ---- message store [N, M], sorted by (gt, member, meta, payload) ----
+    store_gt: jnp.ndarray      # u32, EMPTY_U32 = hole
+    store_member: jnp.ndarray  # u32
+    store_meta: jnp.ndarray    # u32
+    store_payload: jnp.ndarray  # u32
+    store_flags: jnp.ndarray   # u32 bit0 = undone (sync table's `undone` column)
+
+    # ---- outstanding walk (requestcache.py IntroductionRequestCache) ----
+    pending_target: jnp.ndarray  # i32[N], NO_PEER = none outstanding
+    pending_since: jnp.ndarray   # f32[N]
+
+    # ---- timeline (timeline.py; bounded authorized-member table) ----
+    auth_member: jnp.ndarray     # u32[N, A], EMPTY_U32 = empty slot
+    auth_grant_gt: jnp.ndarray   # u32[N, A] global_time of the authorize
+    auth_meta_mask: jnp.ndarray  # u32[N, A] bitmask over meta ids (permit perm)
+
+    stats: Stats
+    key: jnp.ndarray          # uint32[2] threefry key for this community
+    time: jnp.ndarray         # f32 scalar, sim-seconds (round * walk_interval)
+
+
+FLAG_UNDONE = 1
+
+
+def init_stats(n: int) -> Stats:
+    z = jnp.zeros((n,), jnp.uint32)
+    return Stats(walk_success=z, walk_fail=z, msgs_stored=z, msgs_dropped=z,
+                 requests_dropped=z, punctures=z)
+
+
+def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
+    """Fresh overlay: everyone alive, empty stores, empty candidate tables.
+
+    Mirrors the reference's cold start (Dispersy.start + load_community with
+    an empty database): peers know only the bootstrap trackers, which the
+    walker reaches via its 0.5% bootstrap branch.
+    """
+    n, k, m, a = (config.n_peers, config.k_candidates, config.msg_capacity,
+                  config.k_authorized)
+    never = jnp.full((n, k), NEVER, jnp.float32)
+    return PeerState(
+        alive=jnp.ones((n,), bool),
+        is_tracker=jnp.arange(n) < config.n_trackers,
+        session=jnp.zeros((n,), jnp.uint32),
+        global_time=jnp.ones((n,), jnp.uint32),
+        cand_peer=jnp.full((n, k), NO_PEER, jnp.int32),
+        cand_last_walk=never,
+        cand_last_stumble=never,
+        cand_last_intro=never,
+        store_gt=jnp.full((n, m), EMPTY_U32, jnp.uint32),
+        store_member=jnp.full((n, m), EMPTY_U32, jnp.uint32),
+        store_meta=jnp.full((n, m), EMPTY_U32, jnp.uint32),
+        store_payload=jnp.full((n, m), EMPTY_U32, jnp.uint32),
+        store_flags=jnp.zeros((n, m), jnp.uint32),
+        pending_target=jnp.full((n,), NO_PEER, jnp.int32),
+        pending_since=jnp.full((n,), NEVER, jnp.float32),
+        auth_member=jnp.full((n, a), EMPTY_U32, jnp.uint32),
+        auth_grant_gt=jnp.zeros((n, a), jnp.uint32),
+        auth_meta_mask=jnp.zeros((n, a), jnp.uint32),
+        stats=init_stats(n),
+        key=jax.random.key_data(key) if key.dtype != jnp.uint32 else key,
+        time=jnp.float32(0.0),
+    )

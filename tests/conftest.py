@@ -1,0 +1,22 @@
+"""Test environment: force an 8-virtual-device CPU mesh before jax imports.
+
+Multi-chip TPU hardware is not available in CI; sharding correctness is
+tested on a virtual CPU mesh (mirrors how the driver's dryrun_multichip
+validates the pjit path).  The assignment is unconditional: the suite's
+sharding tests require exactly this topology, so a pre-set JAX_PLATFORMS
+(e.g. the TPU tunnel backend) must not leak in.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Persistent compile cache: JAX CPU first-compiles dominate test wall-clock.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,98 @@
+"""Bloom kernel vs pure-Python oracle — bit-for-bit + statistical checks.
+
+Mirrors the reference's test_bloomfilter.py themes: round-trip serialization,
+membership, false-positive rate (SURVEY.md §4).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from dispersy_tpu.config import bloom_size_for
+from dispersy_tpu.ops import bloom as jb
+from dispersy_tpu.ops import hashing as jh
+from dispersy_tpu.oracle import bloom as ob
+
+
+def test_hashing_matches_oracle():
+    rng = np.random.default_rng(0)
+    xs = rng.integers(0, 2**32, size=256, dtype=np.uint32)
+    got = np.asarray(jh.fmix32(jnp.asarray(xs)))
+    want = np.array([ob.fmix32(int(x)) for x in xs], dtype=np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+    got = np.asarray(jh.hash_u32(jnp.asarray(xs), 12345))
+    want = np.array([ob.hash_u32(int(x), 12345) for x in xs], dtype=np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_record_hash_matches_oracle():
+    rng = np.random.default_rng(1)
+    m = rng.integers(0, 2**20, size=128, dtype=np.uint32)
+    gt = rng.integers(0, 2**31, size=128, dtype=np.uint32)
+    meta = rng.integers(0, 32, size=128, dtype=np.uint32)
+    pay = rng.integers(0, 2**32, size=128, dtype=np.uint32)
+    got = np.asarray(jh.record_hash(*map(jnp.asarray, (m, gt, meta, pay))))
+    want = np.array([ob.record_hash(int(a), int(b), int(c), int(d))
+                     for a, b, c, d in zip(m, gt, meta, pay)], dtype=np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_build_matches_oracle_words():
+    n_bits, k = bloom_size_for(0.01, 64)
+    rng = np.random.default_rng(2)
+    items = rng.integers(0, 2**32, size=80, dtype=np.uint32)
+    mask = rng.random(80) < 0.8
+
+    words = np.asarray(jb.bloom_build(jnp.asarray(items), jnp.asarray(mask),
+                                      n_bits, k))
+    oracle = ob.OracleBloom(n_bits, k)
+    for it, ok in zip(items, mask):
+        if ok:
+            oracle.add(int(it))
+    np.testing.assert_array_equal(words, np.array(oracle.words(), np.uint32))
+
+
+def test_query_no_false_negatives_and_oracle_agreement():
+    n_bits, k = bloom_size_for(0.01, 128)
+    rng = np.random.default_rng(3)
+    added = rng.integers(0, 2**32, size=128, dtype=np.uint32)
+    probes = rng.integers(0, 2**32, size=512, dtype=np.uint32)
+
+    words = jb.bloom_build(jnp.asarray(added), jnp.ones(128, bool), n_bits, k)
+    got_added = np.asarray(jb.bloom_query(words, jnp.asarray(added), n_bits, k))
+    assert got_added.all(), "bloom must never produce false negatives"
+
+    oracle = ob.OracleBloom(n_bits, k)
+    for it in added:
+        oracle.add(int(it))
+    got = np.asarray(jb.bloom_query(words, jnp.asarray(probes), n_bits, k))
+    want = np.array([int(p) in oracle for p in probes])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_false_positive_rate_near_design_point():
+    n_bits, k = bloom_size_for(0.01, 256)
+    rng = np.random.default_rng(4)
+    added = rng.integers(0, 2**32, size=256, dtype=np.uint32)
+    fresh = rng.integers(0, 2**32, size=20000, dtype=np.uint32)
+    words = jb.bloom_build(jnp.asarray(added), jnp.ones(256, bool), n_bits, k)
+    fp = float(np.asarray(
+        jb.bloom_query(words, jnp.asarray(fresh), n_bits, k)).mean())
+    # design error rate 0.01; allow generous slack for sampling noise
+    assert fp < 0.03, fp
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(5)
+    dense = rng.random(1024) < 0.3
+    words = jb.pack_bits(jnp.asarray(dense))
+    back = np.asarray(jb.unpack_bits(words))
+    np.testing.assert_array_equal(back, dense)
+
+
+def test_masked_items_are_excluded():
+    n_bits, k = bloom_size_for(0.01, 32)
+    items = jnp.arange(10, dtype=jnp.uint32)
+    mask = jnp.zeros(10, bool)
+    words = jb.bloom_build(items, mask, n_bits, k)
+    assert int(jnp.sum(words)) == 0

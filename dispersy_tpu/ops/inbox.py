@@ -1,0 +1,79 @@
+"""The delivery kernel: UDP datagrams as a sort-by-receiver scatter.
+
+This is the single most important porting seam (SURVEY.md §5.8): the
+reference's ``Endpoint`` hands raw UDP datagrams to
+``Dispersy.on_incoming_packets`` (reference: endpoint.py
+``StandaloneEndpoint`` select() loop; dispersy.py ``on_incoming_packets``).
+The simulation replaces the socket with an *edge list*: every logical packet
+this round is a (destination, payload-columns) row, and delivery is
+
+    stable sort by destination  ->  rank within destination group
+    ->  bounded scatter into a [N, B] inbox, slots >= B dropped.
+
+Dropping on overflow is deliberate fidelity, not a limitation: UDP has no
+delivery guarantee and the reference's 65k recv buffer drops bursts the same
+way (modeled, counted, never an error).  Packet loss is the caller's
+Bernoulli mask on ``valid``.
+
+Under a sharded peer axis the ``lax.sort`` + scatter lower to XLA
+all-to-all/collective-permute over ICI — exactly where the reference's
+UDP fan-out sat.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax.numpy as jnp
+from jax import lax
+
+
+class Delivery(NamedTuple):
+    inbox: tuple          # tuple of [N, B] arrays, one per payload column
+    inbox_valid: jnp.ndarray  # bool[N, B]
+    n_dropped: jnp.ndarray    # i32[N] packets lost to inbox overflow per dest
+
+
+def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
+            valid: jnp.ndarray, n_peers: int, inbox_size: int) -> Delivery:
+    """Deliver an edge list of logical packets into per-peer inboxes.
+
+    ``dst``: i32[E] destination peer of each packet (any value for invalid
+    rows).  ``cols``: payload columns, each [E].  ``valid``: bool[E] —
+    packets already lost (loss mask, dead sender) are simply invalid.
+
+    Delivery order within one destination is edge-list order (lax.sort is
+    stable), so the oracle can reproduce inboxes exactly.
+    """
+    e = dst.shape[0]
+    # Invalid packets park at key n_peers: sorted past every real peer, and
+    # their scatter index lands out of range -> dropped by mode="drop".
+    # Out-of-range destinations (including NO_PEER = -1 from a walker with
+    # no target) are undeliverable, not an error — park them too; a negative
+    # index must never reach the scatter (it would wrap to another inbox).
+    ok = valid & (dst >= 0) & (dst < n_peers)
+    key = jnp.where(ok, dst, n_peers).astype(jnp.int32)
+    pos = jnp.arange(e, dtype=jnp.int32)  # carries stability through sort
+    sorted_ops = lax.sort((key, pos) + tuple(cols), dimension=0, num_keys=2)
+    skey, _ = sorted_ops[0], sorted_ops[1]
+    scols = sorted_ops[2:]
+
+    # Rank within destination group = index - first index of that key.
+    first = jnp.searchsorted(skey, skey, side="left").astype(jnp.int32)
+    slot = jnp.arange(e, dtype=jnp.int32) - first
+    keep = (skey < n_peers) & (slot < inbox_size)
+    flat = jnp.where(keep, skey * inbox_size + slot, n_peers * inbox_size)
+
+    inbox = tuple(
+        jnp.zeros((n_peers * inbox_size,), c.dtype)
+        .at[flat].set(c, mode="drop")
+        .reshape(n_peers, inbox_size)
+        for c in scols)
+    inbox_valid = (jnp.zeros((n_peers * inbox_size,), bool)
+                   .at[flat].set(True, mode="drop")
+                   .reshape(n_peers, inbox_size))
+    overflow = (skey < n_peers) & (slot >= inbox_size)
+    n_dropped = (jnp.zeros((n_peers,), jnp.int32)
+                 .at[jnp.where(overflow, skey, n_peers)]
+                 .add(1, mode="drop"))
+    return Delivery(inbox=inbox, inbox_valid=inbox_valid, n_dropped=n_dropped)

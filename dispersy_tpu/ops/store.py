@@ -1,0 +1,194 @@
+"""Message-store kernels: the SQLite ``sync`` table as a sorted ring.
+
+The reference persists every sync-distributed message in one SQLite table
+(reference: dispersydatabase.py — ``sync(community, member, global_time,
+meta_message, packet, undone)`` with UNIQUE(community, member, global_time))
+and serves Bloom-sync slices with ``SELECT ... WHERE global_time BETWEEN ?
+AND ?`` (reference: community.py ``dispersy_claim_sync_bloom_filter`` and the
+``on_introduction_request`` sync responder).
+
+TPU-native recast: each peer owns ``msg_capacity`` record slots, four uint32
+columns (global_time, member, meta, payload) + flags, kept sorted
+lexicographically by (global_time, member, meta, payload) with ``EMPTY_U32``
+holes at the end.  Sorted order gives us:
+
+- O(log M) slice selection via searchsorted (the BETWEEN query),
+- dedup on UNIQUE(member, global_time) as an adjacent-equal test after a
+  merge sort (the INSERT OR IGNORE),
+- deterministic iteration order for bloom construction.
+
+All functions are batched over the leading peer axis and shape-static, so
+they fuse into the round step under jit and shard over the peer axis.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dispersy_tpu.config import EMPTY_U32
+
+_EMPTY = np.uint32(EMPTY_U32)
+
+
+class StoreCols(NamedTuple):
+    """One peer-store (or record batch): uint32 columns, same shape."""
+    gt: jnp.ndarray
+    member: jnp.ndarray
+    meta: jnp.ndarray
+    payload: jnp.ndarray
+    flags: jnp.ndarray
+
+    @property
+    def valid(self) -> jnp.ndarray:
+        return self.gt != _EMPTY
+
+
+def empty_records(shape) -> StoreCols:
+    e = jnp.full(shape, _EMPTY, jnp.uint32)
+    return StoreCols(gt=e, member=e, meta=e, payload=e,
+                     flags=jnp.zeros(shape, jnp.uint32))
+
+
+def count_valid(gt: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum((gt != _EMPTY).astype(jnp.int32), axis=-1)
+
+
+class InsertResult(NamedTuple):
+    store: StoreCols
+    n_inserted: jnp.ndarray  # i32[N] new records now in the store
+    n_dropped: jnp.ndarray   # i32[N] new records lost (dup or overflow)
+    n_evicted: jnp.ndarray   # i32[N] existing records lost to overflow
+
+
+def store_insert(store: StoreCols, new: StoreCols,
+                 new_mask: jnp.ndarray) -> InsertResult:
+    """Merge a batch of records into each peer's sorted store.
+
+    Semantics mirror the reference's store pipeline
+    (reference: dispersy.py ``store_update_forward`` -> INSERT into sync):
+
+    - UNIQUE(member, global_time): among records sharing (gt, member) the
+      *existing* store entry wins (a second message by the same member at the
+      same global_time is dropped — the reference treats that as a conflict
+      and keeps the first-seen packet).
+    - capacity overflow keeps the M records that sort first (lowest
+      global_time) — modeling a full store the way UDP overflow drops
+      packets: counted, never raised.  New records that don't fit are
+      reported in n_dropped; *existing* records bumped out by a
+      lower-global_time arrival are reported in n_evicted.
+
+    ``store``: [N, M] columns; ``new``: [N, B] columns; ``new_mask``: [N, B].
+    """
+    m = store.gt.shape[-1]
+    n_before = count_valid(store.gt)
+    masked = StoreCols(
+        gt=jnp.where(new_mask, new.gt, _EMPTY),
+        member=jnp.where(new_mask, new.member, _EMPTY),
+        meta=jnp.where(new_mask, new.meta, _EMPTY),
+        payload=jnp.where(new_mask, new.payload, _EMPTY),
+        flags=jnp.where(new_mask, new.flags, 0),
+    )
+    # Also guard against EMPTY sentinel gt arriving as a "new" record.
+    n_new_valid = count_valid(masked.gt)
+
+    cat = StoreCols(*(jnp.concatenate([a, b], axis=-1)
+                      for a, b in zip(store, masked)))
+    origin = jnp.concatenate(
+        [jnp.zeros_like(store.gt), jnp.ones_like(masked.gt)], axis=-1)
+
+    # Lexicographic sort; origin as 3rd key makes the existing entry the
+    # first of any (gt, member) duplicate group regardless of its
+    # (meta, payload) relative to the duplicate's.
+    gt, member, origin, meta, payload, flags = lax.sort(
+        (cat.gt, cat.member, origin, cat.meta, cat.payload, cat.flags),
+        dimension=-1, num_keys=5)
+
+    dup = jnp.zeros_like(gt, dtype=bool).at[..., 1:].set(
+        (gt[..., 1:] == gt[..., :-1]) & (member[..., 1:] == member[..., :-1])
+        & (gt[..., 1:] != _EMPTY))
+    gt = jnp.where(dup, _EMPTY, gt)
+    member = jnp.where(dup, _EMPTY, member)
+    meta = jnp.where(dup, _EMPTY, meta)
+    payload = jnp.where(dup, _EMPTY, payload)
+    flags = jnp.where(dup, 0, flags)
+    origin = jnp.where(dup, 0, origin)
+
+    # Compact: killed/hole entries (gt == EMPTY) sort to the end; truncate.
+    gt, member, meta, payload, origin, flags = lax.sort(
+        (gt, member, meta, payload, origin, flags), dimension=-1, num_keys=4)
+    out = StoreCols(gt=gt[..., :m], member=member[..., :m],
+                    meta=meta[..., :m], payload=payload[..., :m],
+                    flags=flags[..., :m])
+    kept = gt[..., :m] != _EMPTY
+    n_inserted = jnp.sum((origin[..., :m] == 1) & kept,
+                         axis=-1).astype(jnp.int32)
+    n_surviving_old = jnp.sum((origin[..., :m] == 0) & kept,
+                              axis=-1).astype(jnp.int32)
+    return InsertResult(store=out, n_inserted=n_inserted,
+                        n_dropped=n_new_valid - n_inserted,
+                        n_evicted=n_before - n_surviving_old)
+
+
+class SyncSlice(NamedTuple):
+    """The sync range advertised in an introduction request.
+
+    Mirrors the reference's IntroductionRequestPayload sync tuple
+    (reference: payload.py — (time_low, time_high, modulo, offset, bloom)).
+    time_high == 0 means "no upper bound" as in the reference.
+    """
+    time_low: jnp.ndarray   # u32[N]
+    time_high: jnp.ndarray  # u32[N]
+    modulo: jnp.ndarray     # u32[N]
+    offset: jnp.ndarray     # u32[N]
+
+
+def slice_mask(gt: jnp.ndarray, s: SyncSlice) -> jnp.ndarray:
+    """[N, M] membership of store entries in an advertised slice."""
+    valid = gt != _EMPTY
+    lo = gt >= s.time_low[..., None]
+    hi = jnp.where((s.time_high == 0)[..., None], True,
+                   gt <= s.time_high[..., None])
+    mod = (gt % jnp.maximum(s.modulo, 1)[..., None]) == s.offset[..., None]
+    return valid & lo & hi & mod
+
+
+def claim_slice_largest(gt: jnp.ndarray, capacity: int) -> SyncSlice:
+    """"Largest" bloom-claim strategy: the most recent ≤capacity entries.
+
+    Reference: community.py ``_dispersy_claim_sync_bloom_filter_largest`` —
+    prefer the newest window of the store, open-ended above (time_high=0)
+    so freshly created messages are covered by the advertised range.
+    time_low aligns to a global_time boundary: every entry with
+    gt >= time_low is inside the slice (the reference likewise never splits
+    one global_time across a slice edge).
+    """
+    n_valid = count_valid(gt)                           # [N]
+    start = jnp.maximum(n_valid - capacity, 0)          # [N]
+    boundary = jnp.take_along_axis(gt, start[..., None], axis=-1)[..., 0]
+    time_low = jnp.where(start == 0, 1, boundary).astype(jnp.uint32)
+    return SyncSlice(time_low=time_low,
+                     time_high=jnp.zeros_like(time_low),
+                     modulo=jnp.ones_like(time_low),
+                     offset=jnp.zeros_like(time_low))
+
+
+def claim_slice_modulo(gt: jnp.ndarray, capacity: int,
+                       round_index: jnp.ndarray) -> SyncSlice:
+    """"Modulo" strategy: stripe the whole store across successive rounds.
+
+    Reference: community.py ``_dispersy_claim_sync_bloom_filter_modulo`` —
+    when the store exceeds one bloom's capacity, advertise the stripe
+    {gt : gt % modulo == offset} with offset cycling per claim, so every
+    entry is eventually covered.
+    """
+    n_valid = count_valid(gt)
+    modulo = jnp.maximum((n_valid + capacity - 1) // capacity, 1)
+    modulo = modulo.astype(jnp.uint32)
+    offset = (round_index.astype(jnp.uint32) % modulo)
+    ones = jnp.ones_like(modulo)
+    return SyncSlice(time_low=ones, time_high=jnp.zeros_like(modulo),
+                     modulo=modulo, offset=offset)

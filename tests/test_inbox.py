@@ -1,0 +1,101 @@
+"""Delivery kernel vs a naive Python post office.
+
+The seam the whole design hangs on (SURVEY.md §5.8): logical packets as an
+edge list, stable per-destination ordering, bounded inboxes with counted
+overflow (UDP drop semantics).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from dispersy_tpu.ops.inbox import deliver
+
+
+def naive_deliver(dst, cols, valid, n_peers, inbox_size):
+    inbox = [[None] * inbox_size for _ in range(n_peers)]
+    ivalid = np.zeros((n_peers, inbox_size), bool)
+    dropped = np.zeros(n_peers, np.int32)
+    fill = [0] * n_peers
+    for e in range(len(dst)):
+        if not valid[e]:
+            continue
+        d = int(dst[e])
+        if fill[d] < inbox_size:
+            inbox[d][fill[d]] = tuple(int(c[e]) for c in cols)
+            ivalid[d, fill[d]] = True
+            fill[d] += 1
+        else:
+            dropped[d] += 1
+    return inbox, ivalid, dropped
+
+
+def check_against_naive(dst, cols, valid, n_peers, inbox_size):
+    got = deliver(jnp.asarray(dst), [jnp.asarray(c) for c in cols],
+                  jnp.asarray(valid), n_peers, inbox_size)
+    want_inbox, want_valid, want_drop = naive_deliver(
+        dst, cols, valid, n_peers, inbox_size)
+    np.testing.assert_array_equal(np.asarray(got.inbox_valid), want_valid)
+    np.testing.assert_array_equal(np.asarray(got.n_dropped), want_drop)
+    for p in range(n_peers):
+        for s in range(inbox_size):
+            if want_valid[p, s]:
+                got_rec = tuple(int(np.asarray(c)[p, s]) for c in got.inbox)
+                assert got_rec == want_inbox[p][s], (p, s)
+
+
+def test_simple_delivery_preserves_order():
+    dst = np.array([2, 0, 2, 1, 2], np.int32)
+    payload = np.array([10, 11, 12, 13, 14], np.uint32)
+    sender = np.array([5, 6, 7, 8, 9], np.uint32)
+    valid = np.ones(5, bool)
+    check_against_naive(dst, [payload, sender], valid, n_peers=4, inbox_size=4)
+
+
+def test_overflow_drops_latest_and_counts():
+    dst = np.zeros(6, np.int32)
+    payload = np.arange(6, dtype=np.uint32)
+    valid = np.ones(6, bool)
+    got = deliver(jnp.asarray(dst), [jnp.asarray(payload)], jnp.asarray(valid),
+                  n_peers=2, inbox_size=3)
+    assert int(got.n_dropped[0]) == 3
+    np.testing.assert_array_equal(np.asarray(got.inbox[0])[0], [0, 1, 2])
+    check_against_naive(dst, [payload], valid, n_peers=2, inbox_size=3)
+
+
+def test_invalid_packets_never_delivered():
+    dst = np.array([0, 0, 1], np.int32)
+    payload = np.array([1, 2, 3], np.uint32)
+    valid = np.array([True, False, True])
+    check_against_naive(dst, [payload], valid, n_peers=2, inbox_size=2)
+
+
+def test_randomized_against_naive():
+    rng = np.random.default_rng(7)
+    for trial in range(5):
+        n_peers = int(rng.integers(1, 40))
+        e = int(rng.integers(1, 300))
+        b = int(rng.integers(1, 6))
+        dst = rng.integers(0, n_peers, size=e).astype(np.int32)
+        cols = [rng.integers(0, 2**32, size=e, dtype=np.uint32)
+                for _ in range(3)]
+        valid = rng.random(e) < 0.8
+        check_against_naive(dst, cols, valid, n_peers, b)
+
+
+def test_out_of_range_destinations_are_dropped():
+    # NO_PEER (-1) and too-large destinations: undeliverable, never wrap.
+    dst = np.array([-1, 99, 1, -3], np.int32)
+    payload = np.array([1, 2, 3, 4], np.uint32)
+    got = deliver(jnp.asarray(dst), [jnp.asarray(payload)],
+                  jnp.ones(4, bool), n_peers=4, inbox_size=2)
+    iv = np.asarray(got.inbox_valid)
+    assert iv.sum() == 1 and iv[1, 0]
+    assert int(np.asarray(got.inbox[0])[1, 0]) == 3
+    assert int(np.asarray(got.n_dropped).sum()) == 0
+
+
+def test_empty_edge_list_and_all_invalid():
+    got = deliver(jnp.zeros((4,), jnp.int32), [jnp.zeros((4,), jnp.uint32)],
+                  jnp.zeros((4,), bool), n_peers=3, inbox_size=2)
+    assert not bool(np.asarray(got.inbox_valid).any())
+    assert int(np.asarray(got.n_dropped).sum()) == 0

@@ -1,0 +1,173 @@
+"""Store kernel semantics: sorted ring, UNIQUE dedup, slice selection.
+
+Mirrors the reference's sync-table invariants (dispersydatabase.py schema +
+test_sync.py themes): UNIQUE(member, global_time), BETWEEN-style slice
+queries, largest/modulo claim strategies.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from dispersy_tpu.config import EMPTY_U32
+from dispersy_tpu.ops import store as st
+
+
+def mk_store(rows, cap=None):
+    """rows: list (per peer) of lists of (gt, member, meta, payload) tuples.
+
+    cap: store slots; defaults to 8 (or the longest row if larger) so the
+    capacity is not accidentally the row length.
+    """
+    m = max(8, *(len(r) for r in rows)) if cap is None else cap
+    assert all(len(r) <= m for r in rows)
+    n = len(rows)
+    cols = [np.full((n, m), EMPTY_U32, np.uint32) for _ in range(4)]
+    flags = np.zeros((n, m), np.uint32)
+    for i, r in enumerate(rows):
+        for j, rec in enumerate(sorted(r)):
+            for c in range(4):
+                cols[c][i, j] = rec[c]
+    return st.StoreCols(*(jnp.asarray(c) for c in cols), jnp.asarray(flags))
+
+
+def store_as_sets(s: st.StoreCols):
+    gt = np.asarray(s.gt)
+    out = []
+    for i in range(gt.shape[0]):
+        row = set()
+        for j in range(gt.shape[1]):
+            if gt[i, j] != EMPTY_U32:
+                row.add((int(np.asarray(s.gt)[i, j]),
+                         int(np.asarray(s.member)[i, j]),
+                         int(np.asarray(s.meta)[i, j]),
+                         int(np.asarray(s.payload)[i, j])))
+        out.append(row)
+    return out
+
+
+def test_insert_basic_and_sorted():
+    store = mk_store([[(5, 1, 0, 100), (9, 2, 0, 101)], []])
+    new = mk_store([[(7, 3, 0, 102)], [(3, 1, 0, 103)]])
+    res = st.store_insert(store, new, new.valid)
+    assert store_as_sets(res.store) == [
+        {(5, 1, 0, 100), (7, 3, 0, 102), (9, 2, 0, 101)},
+        {(3, 1, 0, 103)}]
+    np.testing.assert_array_equal(np.asarray(res.n_inserted), [1, 1])
+    np.testing.assert_array_equal(np.asarray(res.n_dropped), [0, 0])
+    gt0 = np.asarray(res.store.gt)[0]
+    assert list(gt0[:3]) == [5, 7, 9]  # sorted ascending
+
+
+def test_insert_dedup_unique_member_gt():
+    # Same (member, gt) with different payload: existing entry must win
+    # (reference: UNIQUE(community, member, global_time) keeps first packet).
+    store = mk_store([[(5, 1, 0, 100)]])
+    new = mk_store([[(5, 1, 0, 999), (5, 2, 0, 200)]])
+    res = st.store_insert(store, new, new.valid)
+    assert store_as_sets(res.store) == [{(5, 1, 0, 100), (5, 2, 0, 200)}]
+    assert int(res.n_inserted[0]) == 1
+    assert int(res.n_dropped[0]) == 1
+
+
+def test_insert_dedup_existing_wins_even_when_new_sorts_lower():
+    # Regression: new record with same (gt, member) but smaller payload must
+    # NOT replace the existing one.
+    store = mk_store([[(5, 1, 0, 100)]])
+    new = mk_store([[(5, 1, 0, 50)]])
+    res = st.store_insert(store, new, new.valid)
+    assert store_as_sets(res.store) == [{(5, 1, 0, 100)}]
+    assert int(res.n_inserted[0]) == 0 and int(res.n_dropped[0]) == 1
+
+
+def test_insert_eviction_is_counted():
+    # Full store; a lower-gt arrival bumps out the highest-gt existing record.
+    store = mk_store([[(1, 1, 0, 0), (2, 2, 0, 0), (3, 3, 0, 0), (4, 4, 0, 0)]],
+                     cap=4)
+    new = mk_store([[(0, 9, 0, 0)]], cap=1)
+    res = st.store_insert(store, new, new.valid)
+    assert store_as_sets(res.store) == [{(0, 9, 0, 0), (1, 1, 0, 0),
+                                         (2, 2, 0, 0), (3, 3, 0, 0)}]
+    assert int(res.n_inserted[0]) == 1
+    assert int(res.n_dropped[0]) == 0
+    assert int(res.n_evicted[0]) == 1
+
+
+def test_insert_dedup_within_new_batch():
+    store = mk_store([[]])
+    new = mk_store([[(4, 7, 0, 1), (4, 7, 0, 1), (4, 7, 1, 2)]])
+    res = st.store_insert(store, new, new.valid)
+    # all three share (gt=4, member=7): exactly one survives
+    sets = store_as_sets(res.store)
+    assert len(sets[0]) == 1
+    assert int(res.n_inserted[0]) == 1
+    assert int(res.n_dropped[0]) == 2
+
+
+def test_insert_overflow_drops_and_counts():
+    cap = 4
+    store = mk_store([[(1, 1, 0, 0), (2, 2, 0, 0), (3, 3, 0, 0), (4, 4, 0, 0)]],
+                     cap=cap)
+    assert store.gt.shape[-1] == cap
+    new = mk_store([[(5, 5, 0, 0), (6, 6, 0, 0)]], cap=2)
+    # pad new to same dims is fine; store full -> both dropped (highest gt)
+    res = st.store_insert(store, new, new.valid)
+    assert store_as_sets(res.store)[0] == {(1, 1, 0, 0), (2, 2, 0, 0),
+                                          (3, 3, 0, 0), (4, 4, 0, 0)}
+    assert int(res.n_inserted[0]) == 0
+    assert int(res.n_dropped[0]) == 2
+
+
+def test_masked_new_records_ignored():
+    store = mk_store([[(1, 1, 0, 0)]])
+    new = mk_store([[(2, 2, 0, 0)]])
+    res = st.store_insert(store, new, jnp.zeros_like(new.valid))
+    assert store_as_sets(res.store) == [{(1, 1, 0, 0)}]
+    assert int(res.n_inserted[0]) == 0 and int(res.n_dropped[0]) == 0
+
+
+def test_claim_slice_largest():
+    # peer 0: 6 entries, capacity 4 -> slice starts at 3rd-smallest gt
+    store = mk_store([[(1, 1, 0, 0), (2, 1, 0, 0), (3, 1, 0, 0),
+                       (4, 1, 0, 0), (5, 1, 0, 0), (6, 1, 0, 0)],
+                      [(7, 1, 0, 0)]])
+    s = st.claim_slice_largest(store.gt, capacity=4)
+    np.testing.assert_array_equal(np.asarray(s.time_low), [3, 1])
+    np.testing.assert_array_equal(np.asarray(s.time_high), [0, 0])
+    mask = np.asarray(st.slice_mask(store.gt, s))
+    assert mask[0].sum() == 4  # entries 3..6
+    assert mask[1].sum() == 1
+
+
+def test_claim_slice_largest_empty_store():
+    store = mk_store([[], []])
+    s = st.claim_slice_largest(store.gt, capacity=4)
+    np.testing.assert_array_equal(np.asarray(s.time_low), [1, 1])
+    assert np.asarray(st.slice_mask(store.gt, s)).sum() == 0
+
+
+def test_claim_slice_modulo_covers_everything():
+    recs = [(g, 1, 0, 0) for g in range(1, 13)]
+    store = mk_store([recs])
+    covered = set()
+    modulo_seen = None
+    for rnd in range(8):
+        s = st.claim_slice_modulo(store.gt, capacity=4,
+                                  round_index=jnp.asarray([rnd]))
+        modulo_seen = int(s.modulo[0])
+        mask = np.asarray(st.slice_mask(store.gt, s))[0]
+        assert mask.sum() <= 5  # ~capacity per stripe
+        for j, b in enumerate(mask):
+            if b:
+                covered.add(int(np.asarray(store.gt)[0, j]))
+    assert modulo_seen == 3  # ceil(12/4)
+    assert covered == set(range(1, 13))  # all stripes visited over rounds
+
+
+def test_slice_mask_time_high_bound():
+    store = mk_store([[(2, 1, 0, 0), (5, 1, 0, 0), (9, 1, 0, 0)]])
+    s = st.SyncSlice(time_low=jnp.asarray([3], jnp.uint32),
+                     time_high=jnp.asarray([8], jnp.uint32),
+                     modulo=jnp.asarray([1], jnp.uint32),
+                     offset=jnp.asarray([0], jnp.uint32))
+    mask = np.asarray(st.slice_mask(store.gt, s))[0]
+    assert list(mask[:3]) == [False, True, False] and not mask[3:].any()

@@ -82,6 +82,8 @@ class CommunityConfig:
     # ---- bloom sync (reference: community.py dispersy_claim_sync_bloom_filter,
     #      bloomfilter.py; bloom sized to fit one ~1500B UDP payload) ----
     sync_enabled: bool = True           # dispersy_enable_bloom_filter_sync
+    sync_strategy: str = "largest"      # "largest" | "modulo" claim strategy
+    #   (reference: _dispersy_claim_sync_bloom_filter_largest / _modulo)
     bloom_error_rate: float = 0.01      # dispersy_sync_bloom_filter_error_rate
     bloom_capacity: int = 256           # entries per sync slice / bloom
     response_budget: int = 16           # records per sync response
@@ -90,7 +92,15 @@ class CommunityConfig:
     # ---- message store (reference: the SQLite `sync` table;
     #      UNIQUE(community, member, global_time)) ----
     msg_capacity: int = 256             # store ring slots per peer
-    request_inbox: int = 4              # intro-requests processed per peer/round
+    request_inbox: int = 8              # intro-requests processed per peer/round
+    tracker_inbox: int = 512            # intro-requests a *tracker* serves/round
+    #   (reference: tool/tracker.py runs dedicated high-capacity introduction
+    #    servers; a flash-crowd of bootstrapping peers is their design load.
+    #    Size this near n_peers/n_trackers for cold flash-crowd starts: an
+    #    undersized tracker leaves the overlay storm-locked — everyone
+    #    bootstraps, drops, and removes candidates forever.  The tracker
+    #    inbox is a compact [n_trackers, tracker_inbox] array, so large
+    #    values are cheap.)
     msg_inbox: int = 64                 # sync records accepted per peer/round
 
     # ---- clock (reference: community.py claim_global_time /

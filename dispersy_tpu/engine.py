@@ -1,0 +1,534 @@
+"""The round engine: one walker interval for every peer, fused under jit.
+
+This module is the TPU-native replacement for the reference's entire runtime
+loop — ``Dispersy._take_step`` (walker tick), ``Dispersy.on_incoming_packets``
+-> ``_on_batch_cache`` (receive pipeline) and ``store_update_forward``
+(persistence + forwarding), reference: dispersy.py / community.py — recast as
+one pure function
+
+    step(state: PeerState, cfg: CommunityConfig) -> PeerState
+
+advancing *all* peers one walk interval.  Where the reference interleaves
+threads (endpoint recv thread -> reactor) and timers, the rebuild is
+round-synchronous: every logical packet sent in round t is delivered (or
+lost) in round t.  The full 3-hop walk exchange
+(introduction-request -> introduction-response + puncture-request ->
+puncture) is fused into a single round; walk timeouts therefore resolve at
+the end of the round instead of 10.5 s later.  SURVEY.md §7 stage 9 covers
+this class of divergence: per-round *distributions* (candidate categories,
+coverage curves) are the fidelity contract, not wall-clock offsets.
+
+Phases (each a bounded-shape kernel; see the ops modules they compose):
+
+  0. churn       — Bernoulli rebirth mask (config #4's 5%/round), modeling a
+                   process restart with wiped disk.
+  1. walk send   — ``dispersy_get_walk_candidate`` sampling + the
+                   introduction-request edge list, with the Bloom sync
+                   payload piggybacked (``dispersy_claim_sync_bloom_filter``).
+  2. request rx  — bounded request inboxes; stumble bookkeeping; third-peer
+                   introduction pick; response/puncture edge lists; the sync
+                   responder's missing-record selection under the response
+                   budget.  Trackers (reference: tool/tracker.py — dedicated
+                   introduction servers that never walk and never sync) run
+                   a separate high-capacity path: a compact
+                   [n_trackers, tracker_inbox] request inbox and a
+                   recent-contact ring in their candidate rows.
+  3. response rx — walked/introduced bookkeeping, walk success/fail stats.
+  4. puncture    — puncture-request -> puncture hop, stumble on the target.
+  5. sync insert — delivered records merge into each store
+                   (INSERT-with-UNIQUE semantics), global-time fold.
+
+Packet loss applies independently to every logical packet (the caller's
+``packet_loss``), as UDP would.  Every stochastic draw is a counter-based
+hash (:mod:`dispersy_tpu.ops.rng`) so the pure-Python oracle
+(:mod:`dispersy_tpu.oracle.sim`) replays rounds bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from dispersy_tpu.config import EMPTY_U32, NO_PEER, CommunityConfig
+from dispersy_tpu.ops import bloom, candidates as cand, inbox, rng, store as st
+from dispersy_tpu.ops.hashing import record_hash
+from dispersy_tpu.state import NEVER, PeerState
+
+# Loss-draw salt blocks: one disjoint block per packet kind so every logical
+# packet flips an independent Bernoulli coin.  Within a block, the normal
+# path salts from 0 and the tracker path from _TRACKER_SALT.
+_LOSS_REQUEST = 0 << 16
+_LOSS_RESPONSE = 1 << 16
+_LOSS_PUNCTURE_REQ = 2 << 16
+_LOSS_PUNCTURE = 3 << 16
+_LOSS_SYNC = 4 << 16
+_TRACKER_SALT = 1 << 15
+_TRACKER_INTRO_SALT = 1 << 20
+
+
+def _lost(seed, rnd, edge_peer, salt_base, salt, p_loss: float):
+    if p_loss <= 0.0:
+        return jnp.zeros(jnp.broadcast_shapes(
+            jnp.shape(edge_peer), jnp.shape(salt)), bool)
+    u = rng.rand_uniform(seed, rnd, edge_peer, rng.P_LOSS,
+                         jnp.asarray(salt) + salt_base)
+    return u < p_loss
+
+
+def _tab(state: PeerState) -> cand.CandTable:
+    return cand.CandTable(peer=state.cand_peer,
+                          last_walk=state.cand_last_walk,
+                          last_stumble=state.cand_last_stumble,
+                          last_intro=state.cand_last_intro)
+
+
+def _store(state: PeerState) -> st.StoreCols:
+    return st.StoreCols(gt=state.store_gt, member=state.store_member,
+                        meta=state.store_meta, payload=state.store_payload,
+                        flags=state.store_flags)
+
+
+def _fold_gt(own_gt: jnp.ndarray, seen_gt: jnp.ndarray, seen_valid: jnp.ndarray,
+             rng_range: int) -> jnp.ndarray:
+    """Lamport fold: max over acceptable observed global times.
+
+    Reference: community.py ``update_global_time`` raises the local clock to
+    any higher observed global_time, while ``dispersy_acceptable_global_time``
+    rejects values more than ``acceptable_global_time_range`` above the local
+    clock (clock-jump defense) — those observations are ignored entirely.
+    """
+    acceptable = seen_valid & (seen_gt <= own_gt[:, None] + jnp.uint32(rng_range))
+    best = jnp.max(jnp.where(acceptable, seen_gt, 0), axis=1)
+    return jnp.maximum(own_gt, best)
+
+
+@functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
+def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
+    """Advance every peer one walker interval (~5 simulated seconds)."""
+    n, t = cfg.n_peers, cfg.n_trackers
+    idx = jnp.arange(n, dtype=jnp.int32)
+    seed = rng.fold_seed(state.key)
+    rnd = state.round_index
+    now = state.time
+    stats = state.stats
+
+    # ---- phase 0: churn -------------------------------------------------
+    # A churned peer restarts with a wiped disk: empty store, empty
+    # candidate table, reset clock.  Trackers never churn (the reference's
+    # bootstrap infrastructure is long-lived).
+    if cfg.churn_rate > 0.0:
+        reborn = state.alive & ~state.is_tracker & (
+            rng.rand_uniform(seed, rnd, idx, rng.P_CHURN) < cfg.churn_rate)
+        r1 = reborn[:, None]
+        tab = cand.CandTable(
+            peer=jnp.where(r1, NO_PEER, state.cand_peer),
+            last_walk=jnp.where(r1, NEVER, state.cand_last_walk),
+            last_stumble=jnp.where(r1, NEVER, state.cand_last_stumble),
+            last_intro=jnp.where(r1, NEVER, state.cand_last_intro))
+        stc = _store(state)
+        stc = st.StoreCols(
+            gt=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.gt),
+            member=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.member),
+            meta=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.meta),
+            payload=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.payload),
+            flags=jnp.where(r1, jnp.uint32(0), stc.flags))
+        global_time = jnp.where(reborn, jnp.uint32(1), state.global_time)
+        session = state.session + reborn.astype(jnp.uint32)
+    else:
+        tab, stc = _tab(state), _store(state)
+        global_time, session = state.global_time, state.session
+
+    alive = state.alive
+
+    # ---- phase 1: walker send ------------------------------------------
+    # dispersy_get_walk_candidate + create_introduction_request.  Trackers
+    # never walk (reference: TrackerCommunity disables the candidate
+    # walker — it stays connected purely through inbound requests).
+    if cfg.walker_enabled:
+        target = cand.sample_walk_target(tab, now, cfg, seed, rnd, idx)
+        target = jnp.where(alive & ~state.is_tracker, target, NO_PEER)
+    else:
+        target = jnp.full((n,), NO_PEER, jnp.int32)
+
+    if cfg.sync_enabled:
+        # dispersy_claim_sync_bloom_filter: pick a store slice, fill a bloom.
+        if cfg.sync_strategy == "modulo":
+            sl = st.claim_slice_modulo(stc.gt, cfg.bloom_capacity, rnd)
+        else:
+            sl = st.claim_slice_largest(stc.gt, cfg.bloom_capacity)
+        in_slice = st.slice_mask(stc.gt, sl)                         # [N, M]
+        rec_h = record_hash(stc.member, stc.gt, stc.meta, stc.payload)
+        my_bloom = jax.vmap(
+            lambda h, m: bloom.bloom_build(h, m, cfg.bloom_bits,
+                                           cfg.bloom_hashes))(rec_h, in_slice)
+    else:
+        zu = jnp.zeros((n,), jnp.uint32)
+        sl = st.SyncSlice(time_low=zu, time_high=zu, modulo=zu, offset=zu)
+        my_bloom = jnp.zeros((n, cfg.bloom_words), jnp.uint32)
+
+    req_lost = _lost(seed, rnd, idx, _LOSS_REQUEST, 0, cfg.packet_loss)
+    send_ok = alive & (target != NO_PEER) & ~req_lost
+    to_tracker = (target >= 0) & (target < t)
+
+    # Normal-peer request inbox: [N, R] with the full sync payload.
+    req = inbox.deliver(
+        dst=target,
+        cols=[idx.astype(jnp.uint32), sl.time_low, sl.time_high, sl.modulo,
+              sl.offset, global_time, my_bloom],
+        valid=send_ok & ~to_tracker, n_peers=n, inbox_size=cfg.request_inbox)
+    (rq_src, rq_tlow, rq_thigh, rq_mod, rq_off, rq_gt, rq_bloom) = req.inbox
+    rq_ok = req.inbox_valid & alive[:, None]                 # [N, R]
+    rq_src_i = jnp.where(rq_ok, rq_src.astype(jnp.int32), NO_PEER)
+    stats = stats.replace(
+        requests_dropped=stats.requests_dropped
+        + req.n_dropped.astype(jnp.uint32))
+
+    # ---- phase 2: request processing at the responder ------------------
+    # on_introduction_request: stumble the requester, pick a third peer,
+    # send introduction-response + puncture-request, serve the sync slice.
+    r = cfg.request_inbox
+    tab = cand.upsert_many(
+        tab, upd_peer=rq_src_i,
+        upd_kind=jnp.full((n, r), cand.KIND_STUMBLE, jnp.int32),
+        upd_valid=rq_ok, now=now, self_idx=idx, n_trackers=t)
+    global_time = _fold_gt(global_time, rq_gt, rq_ok,
+                           cfg.acceptable_global_time_range)
+
+    # ---- phase 2t: the tracker fast path -------------------------------
+    if t > 0:
+        rt = cfg.tracker_inbox
+        k = cfg.k_candidates
+        tidx = jnp.arange(t, dtype=jnp.int32)
+        treq = inbox.deliver(
+            dst=target, cols=[idx.astype(jnp.uint32), global_time],
+            valid=send_ok & to_tracker, n_peers=t, inbox_size=rt)
+        tq_src, tq_gt = treq.inbox                           # [T, Rt]
+        tq_ok = treq.inbox_valid & alive[:t][:, None]
+        tq_src_i = jnp.where(tq_ok, tq_src.astype(jnp.int32), NO_PEER)
+
+        # Recent-contact ring in the tracker's candidate rows: up to K
+        # stumbles per round land in rotating unique slots (a tracker's
+        # candidate set is just "whoever knocked recently" — reference:
+        # TrackerCommunity keeps no long-lived state per community).
+        kr = min(rt, k)
+        slot = ((rnd * jnp.uint32(rt) + jnp.arange(kr, dtype=jnp.uint32))
+                % jnp.uint32(k)).astype(jnp.int32)           # unique [kr]
+        slot_b = jnp.broadcast_to(slot[None, :], (t, kr))
+        ring_ok = tq_ok[:, :kr]
+        ring_src = tq_src_i[:, :kr]
+        trows = tidx[:, None]
+
+        # Dedup across rounds: a returning requester's stale ring entry is
+        # cleared before the new one lands, so no peer holds two slots (and
+        # a doubled introduction probability).
+        stale = jnp.any((tab.peer[:t][:, :, None] == ring_src[:, None, :])
+                        & ring_ok[:, None, :], axis=-1)       # [T, K]
+        tab = cand.CandTable(
+            peer=tab.peer.at[:t].set(
+                jnp.where(stale, NO_PEER, tab.peer[:t])),
+            last_walk=tab.last_walk.at[:t].set(
+                jnp.where(stale, NEVER, tab.last_walk[:t])),
+            last_stumble=tab.last_stumble.at[:t].set(
+                jnp.where(stale, NEVER, tab.last_stumble[:t])),
+            last_intro=tab.last_intro.at[:t].set(
+                jnp.where(stale, NEVER, tab.last_intro[:t])))
+
+        def ring_write(full, vals, ok):
+            cur = jnp.take_along_axis(full[:t], slot_b, axis=1)
+            return full.at[trows, slot_b].set(jnp.where(ok, vals, cur))
+
+        tab = cand.CandTable(
+            peer=ring_write(tab.peer, ring_src, ring_ok),
+            last_walk=ring_write(tab.last_walk,
+                                 jnp.full((t, kr), NEVER, jnp.float32), ring_ok),
+            last_stumble=ring_write(tab.last_stumble,
+                                    jnp.full((t, kr), now, jnp.float32), ring_ok),
+            last_intro=ring_write(tab.last_intro,
+                                  jnp.full((t, kr), NEVER, jnp.float32), ring_ok))
+
+        ttab = cand.CandTable(peer=tab.peer[:t], last_walk=tab.last_walk[:t],
+                              last_stumble=tab.last_stumble[:t],
+                              last_intro=tab.last_intro[:t])
+        intro_ring = cand.sample_introductions(
+            ttab, now, cfg, seed, rnd, tidx, exclude=tq_src_i,
+            salt_base=_TRACKER_INTRO_SALT)                   # [T, Rt]
+        # Under a bootstrap flash-crowd the tracker's richest candidate pool
+        # is this round's own inbox: introduce requester s to another
+        # requester j != s (both just proved their addresses by knocking).
+        # Falls back to the ring pick when the chosen slot is empty.  This is
+        # what keeps introductions *diverse* — a K-slot ring alone funnels
+        # thousands of bootstrappers onto K peers and melts their inboxes.
+        s_ix = jnp.arange(rt, dtype=jnp.uint32)[None, :]
+        j = ((s_ix + 1 + rng.rand_u32(seed, rnd, tidx[:, None], rng.P_INTRO,
+                                      s_ix + _TRACKER_INTRO_SALT + (1 << 18))
+              % jnp.uint32(max(rt - 1, 1))) % jnp.uint32(rt)).astype(jnp.int32)
+        intro_inbox = jnp.take_along_axis(tq_src_i, j, axis=1)
+        intro_inbox = jnp.where(intro_inbox == tq_src_i, NO_PEER, intro_inbox)
+        intro_t = jnp.where(intro_inbox != NO_PEER, intro_inbox, intro_ring)
+        global_time = global_time.at[:t].set(
+            _fold_gt(global_time[:t], tq_gt, tq_ok,
+                     cfg.acceptable_global_time_range))
+        stats = stats.replace(
+            requests_dropped=stats.requests_dropped.at[:t].add(
+                treq.n_dropped.astype(jnp.uint32)))
+    else:
+        rt = 0
+
+    intro = cand.sample_introductions(tab, now, cfg, seed, rnd, idx,
+                                      exclude=rq_src_i)       # [N, R]
+
+    # introduction-response edges: responder -> requester, introducing C.
+    salt_r = jnp.arange(r)[None, :]
+    resp_lost = _lost(seed, rnd, idx[:, None], _LOSS_RESPONSE, salt_r,
+                      cfg.packet_loss)
+    resp_dst = [rq_src_i.reshape(-1)]
+    resp_from = [jnp.broadcast_to(idx[:, None].astype(jnp.uint32),
+                                  (n, r)).reshape(-1)]
+    resp_intro = [intro.reshape(-1).astype(jnp.uint32)]
+    resp_gt = [jnp.broadcast_to(global_time[:, None], (n, r)).reshape(-1)]
+    resp_valid = [(rq_ok & ~resp_lost).reshape(-1)]
+
+    # puncture-request edges: responder -> C, naming the requester.
+    pr_lost = _lost(seed, rnd, idx[:, None], _LOSS_PUNCTURE_REQ, salt_r,
+                    cfg.packet_loss)
+    pr_dst = [intro.reshape(-1)]
+    pr_target = [rq_src_i.reshape(-1).astype(jnp.uint32)]
+    pr_valid = [(rq_ok & (intro != NO_PEER) & ~pr_lost).reshape(-1)]
+
+    if t > 0:
+        salt_rt = jnp.arange(rt)[None, :] + _TRACKER_SALT
+        tresp_lost = _lost(seed, rnd, tidx[:, None], _LOSS_RESPONSE, salt_rt,
+                           cfg.packet_loss)
+        resp_dst.append(tq_src_i.reshape(-1))
+        resp_from.append(jnp.broadcast_to(
+            tidx[:, None].astype(jnp.uint32), (t, rt)).reshape(-1))
+        resp_intro.append(intro_t.reshape(-1).astype(jnp.uint32))
+        resp_gt.append(jnp.broadcast_to(
+            global_time[:t][:, None], (t, rt)).reshape(-1))
+        resp_valid.append((tq_ok & ~tresp_lost).reshape(-1))
+
+        tpr_lost = _lost(seed, rnd, tidx[:, None], _LOSS_PUNCTURE_REQ, salt_rt,
+                         cfg.packet_loss)
+        pr_dst.append(intro_t.reshape(-1))
+        pr_target.append(tq_src_i.reshape(-1).astype(jnp.uint32))
+        pr_valid.append((tq_ok & (intro_t != NO_PEER) & ~tpr_lost).reshape(-1))
+
+    resp = inbox.deliver(
+        dst=jnp.concatenate(resp_dst),
+        cols=[jnp.concatenate(resp_from), jnp.concatenate(resp_intro),
+              jnp.concatenate(resp_gt)],
+        valid=jnp.concatenate(resp_valid), n_peers=n, inbox_size=1)
+    rs_from, rs_intro, rs_gt = resp.inbox                     # [N, 1] each
+    rs_ok = resp.inbox_valid & alive[:, None]
+
+    punc_req = inbox.deliver(
+        dst=jnp.concatenate(pr_dst), cols=[jnp.concatenate(pr_target)],
+        valid=jnp.concatenate(pr_valid), n_peers=n,
+        inbox_size=cfg.request_inbox)
+    (pq_target,) = punc_req.inbox                             # [N, P]
+    pq_ok = punc_req.inbox_valid & alive[:, None]
+    stats = stats.replace(
+        punctures=stats.punctures
+        + jnp.sum(pq_ok, axis=1).astype(jnp.uint32),
+        # Puncture-path inbox overflow is a real (modeled) loss too.
+        requests_dropped=stats.requests_dropped
+        + punc_req.n_dropped.astype(jnp.uint32))
+
+    # ---- phase 4: puncture hop (C -> requester) ------------------------
+    p = cfg.request_inbox
+    salt_p = jnp.arange(p)[None, :]
+    pu_lost = _lost(seed, rnd, idx[:, None], _LOSS_PUNCTURE, salt_p,
+                    cfg.packet_loss)
+    pu_valid = (pq_ok & ~pu_lost).reshape(-1)
+    punc = inbox.deliver(
+        dst=pq_target.reshape(-1).astype(jnp.int32),
+        cols=[jnp.broadcast_to(idx[:, None].astype(jnp.uint32),
+                               (n, p)).reshape(-1)],
+        valid=pu_valid, n_peers=n, inbox_size=cfg.request_inbox)
+    (pu_from,) = punc.inbox
+    pu_ok = punc.inbox_valid & alive[:, None]
+    stats = stats.replace(
+        requests_dropped=stats.requests_dropped
+        + punc.n_dropped.astype(jnp.uint32))
+
+    # ---- phase 3: response processing at the requester -----------------
+    # on_introduction_response: mark the responder walked, the introduced
+    # peer introduced; success/failure accounting.  Fused-round timeout: a
+    # request that got no response this round is a failed walk, and the
+    # stale candidate is dropped (IntroductionRequestCache.on_timeout).
+    got_resp = rs_ok[:, 0]
+    walked = jnp.where(got_resp, rs_from[:, 0].astype(jnp.int32), NO_PEER)
+    introduced = jnp.where(got_resp, rs_intro[:, 0].astype(jnp.int32), NO_PEER)
+    upd_peer = jnp.concatenate(
+        [walked[:, None], introduced[:, None],
+         jnp.where(pu_ok, pu_from.astype(jnp.int32), NO_PEER)], axis=1)
+    upd_kind = jnp.concatenate(
+        [jnp.full((n, 1), cand.KIND_WALK, jnp.int32),
+         jnp.full((n, 1), cand.KIND_INTRO, jnp.int32),
+         jnp.full((n, p), cand.KIND_STUMBLE, jnp.int32)], axis=1)
+    tab = cand.upsert_many(tab, upd_peer, upd_kind,
+                           upd_valid=upd_peer != NO_PEER, now=now,
+                           self_idx=idx, n_trackers=t)
+    global_time = _fold_gt(global_time, rs_gt, rs_ok,
+                           cfg.acceptable_global_time_range)
+
+    walked_ok = alive & (target != NO_PEER)
+    failed = walked_ok & ~got_resp
+    tab = cand.remove(tab, target, failed)
+    stats = stats.replace(
+        walk_success=stats.walk_success
+        + (walked_ok & got_resp).astype(jnp.uint32),
+        walk_fail=stats.walk_fail + failed.astype(jnp.uint32))
+
+    # ---- phase 2b/5: sync responder + store insert ---------------------
+    if cfg.sync_enabled:
+        b = cfg.response_budget
+        rec_h2 = record_hash(stc.member, stc.gt, stc.meta, stc.payload)
+        dsts, gts, members, metas, payloads, valids = [], [], [], [], [], []
+        rows = idx[:, None]
+        for s in range(r):
+            sl_s = st.SyncSlice(time_low=rq_tlow[:, s], time_high=rq_thigh[:, s],
+                                modulo=rq_mod[:, s], offset=rq_off[:, s])
+            in_sl = st.slice_mask(stc.gt, sl_s)                   # [N, M]
+            present = jax.vmap(bloom.bloom_query, in_axes=(0, 0, None, None))(
+                rq_bloom[:, s], rec_h2, cfg.bloom_bits, cfg.bloom_hashes)
+            missing = in_sl & ~present & rq_ok[:, s:s + 1]
+            # First `b` missing records in (global_time, …) order — the
+            # store is sorted, mirroring the responder's ORDER BY
+            # global_time under dispersy_sync_response_limit.
+            rank = jnp.cumsum(missing.astype(jnp.int32), axis=1) - 1
+            slot = jnp.where(missing & (rank < b), rank, b)
+
+            def compact(col, fill):
+                return (jnp.full((n, b + 1), fill, col.dtype)
+                        .at[rows, slot].set(col)[:, :b])
+            sel_valid = compact(missing, False)
+            sync_lost = _lost(seed, rnd, idx[:, None], _LOSS_SYNC,
+                              jnp.arange(b)[None, :] + s * b, cfg.packet_loss)
+            dsts.append(jnp.broadcast_to(rq_src_i[:, s:s + 1], (n, b)))
+            gts.append(compact(stc.gt, EMPTY_U32))
+            members.append(compact(stc.member, EMPTY_U32))
+            metas.append(compact(stc.meta, EMPTY_U32))
+            payloads.append(compact(stc.payload, EMPTY_U32))
+            valids.append(sel_valid & ~sync_lost)
+        sync = inbox.deliver(
+            dst=jnp.concatenate(dsts, axis=1).reshape(-1),
+            cols=[jnp.concatenate(c, axis=1).reshape(-1)
+                  for c in (gts, members, metas, payloads)],
+            valid=jnp.concatenate(valids, axis=1).reshape(-1),
+            n_peers=n, inbox_size=cfg.msg_inbox)
+        sy_gt, sy_member, sy_meta, sy_payload = sync.inbox        # [N, B]
+        sy_ok = sync.inbox_valid & alive[:, None]
+        # Clock-jump defense before the store accepts anything.
+        acceptable = sy_gt <= global_time[:, None] + jnp.uint32(
+            cfg.acceptable_global_time_range)
+        sy_ok = sy_ok & acceptable
+        ins = st.store_insert(
+            stc,
+            st.StoreCols(gt=sy_gt, member=sy_member, meta=sy_meta,
+                         payload=sy_payload,
+                         flags=jnp.zeros_like(sy_gt)),
+            new_mask=sy_ok)
+        stc = ins.store
+        global_time = _fold_gt(global_time, sy_gt, sy_ok,
+                               cfg.acceptable_global_time_range)
+        stats = stats.replace(
+            msgs_stored=stats.msgs_stored + ins.n_inserted.astype(jnp.uint32),
+            msgs_dropped=stats.msgs_dropped
+            + ins.n_dropped.astype(jnp.uint32)
+            + ins.n_evicted.astype(jnp.uint32)
+            + sync.n_dropped.astype(jnp.uint32))
+
+    # ---- wrap up --------------------------------------------------------
+    return state.replace(
+        alive=alive, session=session, global_time=global_time,
+        cand_peer=tab.peer, cand_last_walk=tab.last_walk,
+        cand_last_stumble=tab.last_stumble, cand_last_intro=tab.last_intro,
+        store_gt=stc.gt, store_member=stc.member, store_meta=stc.meta,
+        store_payload=stc.payload, store_flags=stc.flags,
+        stats=stats,
+        time=now + jnp.float32(cfg.walk_interval),
+        round_index=rnd + jnp.uint32(1),
+    )
+
+
+def create_messages(state: PeerState, cfg: CommunityConfig,
+                    author_mask: jnp.ndarray, meta: int,
+                    payload: jnp.ndarray) -> PeerState:
+    """Application send: each masked peer authors one sync-distributed record.
+
+    Mirrors ``Community.create_<message>`` for a FullSyncDistribution meta
+    (reference: message.py ``Message.impl`` + community.py
+    ``claim_global_time``): the author claims global_time+1, signs (identity
+    is the peer index in simulation), and stores locally; epidemic spread
+    then happens through the Bloom-sync rounds.
+    """
+    gt_new = state.global_time + jnp.uint32(1)
+    new = st.StoreCols(
+        gt=gt_new[:, None],
+        member=jnp.arange(cfg.n_peers, dtype=jnp.uint32)[:, None],
+        meta=jnp.full((cfg.n_peers, 1), meta, jnp.uint32),
+        payload=jnp.asarray(payload, jnp.uint32).reshape(cfg.n_peers, 1),
+        flags=jnp.zeros((cfg.n_peers, 1), jnp.uint32))
+    ins = st.store_insert(_store(state), new, author_mask[:, None])
+    return state.replace(
+        store_gt=ins.store.gt, store_member=ins.store.member,
+        store_meta=ins.store.meta, store_payload=ins.store.payload,
+        store_flags=ins.store.flags,
+        global_time=jnp.where(author_mask, gt_new, state.global_time),
+        stats=state.stats.replace(
+            msgs_stored=state.stats.msgs_stored
+            + ins.n_inserted.astype(jnp.uint32)))
+
+
+def seed_overlay(state: PeerState, cfg: CommunityConfig,
+                 degree: int) -> PeerState:
+    """Pre-seed every peer's candidate table with random walked neighbors.
+
+    The driver's configs #2/#3 prescribe a warm overlay ("Erdős–Rényi
+    overlay", "static overlay") rather than a cold flash-crowd bootstrap;
+    this plays the role of a persisted candidate file handed to a restarted
+    peer.  Entries are stamped walked-and-immediately-eligible.
+    """
+    assert degree <= cfg.k_candidates
+    n, t = cfg.n_peers, cfg.n_trackers
+    assert n - t > 1, "need at least two non-tracker peers to seed an overlay"
+    seed = rng.fold_seed(state.key)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    j = jnp.arange(degree)[None, :]
+    # Neighbors are drawn from [n_trackers, n): trackers must never enter the
+    # walk categories (see ops/candidates.upsert_many).
+    span = jnp.uint32(n - t)
+    nbr = t + (rng.rand_u32(seed, jnp.uint32(0xE1), idx[:, None], rng.P_GOSSIP, j)
+               % span).astype(jnp.int32)
+    nbr = jnp.where(nbr == idx[:, None],
+                    t + (nbr - t + 1) % span.astype(jnp.int32), nbr)
+    eligible_at = jnp.float32(0.0) - jnp.float32(cfg.eligibility_delay)
+    pad = cfg.k_candidates - degree
+    return state.replace(
+        cand_peer=jnp.concatenate(
+            [nbr, jnp.full((n, pad), NO_PEER, jnp.int32)], axis=1),
+        cand_last_walk=jnp.concatenate(
+            [jnp.full((n, degree), eligible_at, jnp.float32),
+             jnp.full((n, pad), NEVER, jnp.float32)], axis=1),
+        cand_last_stumble=jnp.full((n, cfg.k_candidates), NEVER, jnp.float32),
+        cand_last_intro=jnp.full((n, cfg.k_candidates), NEVER, jnp.float32))
+
+
+def coverage(state: PeerState, member: int, gt: int, meta: int,
+             payload: int) -> jnp.ndarray:
+    """Fraction of alive non-tracker peers whose store holds one record.
+
+    The driver's convergence metric (BASELINE.md: rounds-to-99%-coverage).
+    Trackers are excluded: they are pure introduction servers and never
+    sync (reference: tool/tracker.py TrackerCommunity).
+    """
+    hit = ((state.store_gt == jnp.uint32(gt))
+           & (state.store_member == jnp.uint32(member))
+           & (state.store_meta == jnp.uint32(meta))
+           & (state.store_payload == jnp.uint32(payload)))
+    syncing = state.alive & ~state.is_tracker
+    has = jnp.any(hit, axis=1) & syncing
+    return jnp.sum(has) / jnp.maximum(jnp.sum(syncing), 1)

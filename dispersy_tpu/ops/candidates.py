@@ -1,0 +1,243 @@
+"""Candidate-table kernels: peer bookkeeping + walk-target sampling.
+
+The reference keeps one ``WalkCandidate`` object per known address with three
+activity timestamps and derives a *category* from which are still fresh
+(reference: candidate.py — ``WalkCandidate.walk/.stumble/.intro``,
+``get_category``: walked if walked within ~57.5 s, stumbled within ~57.5 s,
+intro within ~27.5 s; ``is_eligible_for_walk`` additionally requires the last
+walk to be older than the ~27.5 s eligibility delay).  The category drives
+``Community.dispersy_get_walk_candidate``'s split (≈49.75% walked / 24.875%
+stumbled / 24.875% introduced / 0.5% bootstrap) and
+``dispersy_get_introduce_candidate``'s third-peer pick.
+
+TPU recast: a fixed ``[N, K]`` slot table per peer (peer index + the three
+timestamps); category is *derived* from timestamps each round so it can never
+go stale; upserts are a short static loop of vectorized scatter steps (U is a
+small compile-time constant); sampling uses hashed per-slot priorities so the
+oracle replays choices bit-for-bit.  Unlike the reference's unbounded dict,
+the table evicts the least-recently-active slot on overflow — bounded state
+is the price of static shapes, and K is a config knob
+(``CommunityConfig.k_candidates``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from dispersy_tpu.config import (CAT_INTRODUCED, CAT_NONE, CAT_STUMBLED,
+                                 CAT_WALKED, NO_PEER, CommunityConfig)
+from dispersy_tpu.ops import rng
+
+# Update kinds for upsert_many (which timestamp an observation refreshes).
+KIND_WALK = 0     # we walked to it and got a response
+KIND_STUMBLE = 1  # it contacted us (intro request / puncture)
+KIND_INTRO = 2    # a third party introduced it to us
+_NEVER = -1.0e9
+
+
+class CandTable(NamedTuple):
+    """[N, K] candidate slots; ``peer == NO_PEER`` marks an empty slot."""
+    peer: jnp.ndarray          # i32[N, K]
+    last_walk: jnp.ndarray     # f32[N, K]
+    last_stumble: jnp.ndarray  # f32[N, K]
+    last_intro: jnp.ndarray    # f32[N, K]
+
+
+def categories(tab: CandTable, now: jnp.ndarray,
+               cfg: CommunityConfig) -> jnp.ndarray:
+    """Per-slot category, derived from timestamp freshness.
+
+    Precedence walked > stumbled > introduced mirrors
+    ``WalkCandidate.get_category``; a slot whose every timestamp has expired
+    is CAT_NONE (the reference would have garbage-collected the candidate).
+    """
+    occupied = tab.peer != NO_PEER
+    walked = occupied & (now - tab.last_walk < cfg.walk_lifetime)
+    stumbled = occupied & (now - tab.last_stumble < cfg.walk_lifetime)
+    intro = occupied & (now - tab.last_intro < cfg.intro_lifetime)
+    return jnp.where(
+        walked, CAT_WALKED,
+        jnp.where(stumbled, CAT_STUMBLED,
+                  jnp.where(intro, CAT_INTRODUCED, CAT_NONE)))
+
+
+def is_eligible(tab: CandTable, cats: jnp.ndarray, now: jnp.ndarray,
+                cfg: CommunityConfig) -> jnp.ndarray:
+    """``WalkCandidate.is_eligible_for_walk``: fresh category + walk cooldown."""
+    cooled = now - tab.last_walk >= cfg.eligibility_delay
+    return (cats != CAT_NONE) & cooled
+
+
+def _activity(tab: CandTable) -> jnp.ndarray:
+    """Most recent activity per slot; empty slots -> -inf so they evict first."""
+    act = jnp.maximum(tab.last_walk,
+                      jnp.maximum(tab.last_stumble, tab.last_intro))
+    return jnp.where(tab.peer == NO_PEER, _NEVER * 2.0, act)
+
+
+def upsert_many(tab: CandTable, upd_peer: jnp.ndarray, upd_kind: jnp.ndarray,
+                upd_valid: jnp.ndarray, now: jnp.ndarray,
+                self_idx: jnp.ndarray, n_trackers: int = 0) -> CandTable:
+    """Apply ``[N, U]`` candidate observations to the ``[N, K]`` table.
+
+    Semantics per update (mirroring WalkCandidate bookkeeping):
+    - existing entry for that peer -> refresh the kind's timestamp;
+    - otherwise insert into the least-recently-active slot (empty slots
+      first), resetting the other timestamps to never;
+    - updates naming the owner itself are ignored (the reference never keeps
+      itself as a candidate);
+    - updates naming a tracker are ignored: bootstrap peers live outside the
+      walk categories (reference: candidate.py ``BootstrapCandidate`` is kept
+      separate from the ``_candidates`` dict and only reached through the
+      walker's 0.5% bootstrap branch) — otherwise every bootstrap walk would
+      promote the tracker into the ~49.75% revisit pool and the whole overlay
+      would collapse onto it.
+
+    U is static and small (a handful of observations per peer per round), so
+    this unrolls into U vectorized scatter steps; duplicates within one batch
+    resolve sequentially, exactly like the oracle's Python loop.
+    """
+    u = upd_peer.shape[-1]
+    upd_valid = (upd_valid & (upd_peer != NO_PEER)
+                 & (upd_peer != self_idx[:, None])
+                 & (upd_peer >= n_trackers))
+
+    def body(i, t: CandTable) -> CandTable:
+        p = lax.dynamic_index_in_dim(upd_peer, i, axis=1)        # [N, 1]
+        kind = lax.dynamic_index_in_dim(upd_kind, i, axis=1)     # [N, 1]
+        ok = lax.dynamic_index_in_dim(upd_valid, i, axis=1)      # [N, 1]
+        match = (t.peer == p) & ok                               # [N, K]
+        have = jnp.any(match, axis=1, keepdims=True)             # [N, 1]
+        # Insertion target: least-recently-active slot (ties -> lowest index).
+        victim = jnp.argmin(_activity(t), axis=1)                # [N]
+        insert = (jnp.arange(t.peer.shape[1]) == victim[:, None]) & ok & ~have
+        hit = match | insert
+        new_peer = jnp.where(hit, jnp.where(insert, p, t.peer), t.peer)
+
+        def stamp(ts, k, reset):
+            fresh = hit & (kind == k)
+            cleared = jnp.where(insert & reset, _NEVER, ts)
+            return jnp.where(fresh, now, cleared)
+
+        return CandTable(
+            peer=new_peer,
+            last_walk=stamp(t.last_walk, KIND_WALK, True),
+            last_stumble=stamp(t.last_stumble, KIND_STUMBLE, True),
+            last_intro=stamp(t.last_intro, KIND_INTRO, True),
+        )
+
+    return lax.fori_loop(0, u, body, tab) if u > 0 else tab
+
+
+def remove(tab: CandTable, peer: jnp.ndarray, valid: jnp.ndarray) -> CandTable:
+    """Drop one candidate per row (walk-timeout eviction).
+
+    Reference: the walk-timeout path treats the candidate as obsolete
+    (requestcache.py ``IntroductionRequestCache.on_timeout``).
+    """
+    kill = (tab.peer == peer[:, None]) & valid[:, None]
+    return CandTable(
+        peer=jnp.where(kill, NO_PEER, tab.peer),
+        last_walk=jnp.where(kill, _NEVER, tab.last_walk),
+        last_stumble=jnp.where(kill, _NEVER, tab.last_stumble),
+        last_intro=jnp.where(kill, _NEVER, tab.last_intro),
+    )
+
+
+def _pick_by_priority(mask: jnp.ndarray, prio: jnp.ndarray) -> jnp.ndarray:
+    """Index of the max-priority True slot per row; -1 if none.
+
+    Mask occupies the MSB (prio keeps 31 bits) so every True slot outranks
+    every False slot without needing 64-bit arithmetic (x64 is off).
+    """
+    score = (prio >> jnp.uint32(1)) | (mask.astype(jnp.uint32) << jnp.uint32(31))
+    best = jnp.argmax(score, axis=1)
+    any_ = jnp.any(mask, axis=1)
+    return jnp.where(any_, best, -1)
+
+
+def sample_walk_target(tab: CandTable, now: jnp.ndarray, cfg: CommunityConfig,
+                       seed: jnp.ndarray, round_index: jnp.ndarray,
+                       self_idx: jnp.ndarray) -> jnp.ndarray:
+    """One walk destination per peer: ``dispersy_get_walk_candidate``.
+
+    Category chosen by threshold on one uniform draw (≈49.75 / 24.875 /
+    24.875 / 0.5 split from the reference); an empty choice falls through by
+    rotating from the chosen category in (walked, stumbled, introduced,
+    bootstrap) cyclic order — e.g. an empty "introduced" pick tries
+    bootstrap, then walked, then stumbled.  Slot choice
+    within a category is by hashed per-slot priority (uniform over eligible
+    slots, oracle-replayable).  Returns i32[N], NO_PEER where no target
+    exists (no eligible candidates and no trackers).
+    """
+    n, k = tab.peer.shape
+    cats = categories(tab, now, cfg)
+    elig = is_eligible(tab, cats, now, cfg)
+    prio = rng.rand_u32(seed, round_index, self_idx[:, None], rng.P_SLOT,
+                        jnp.arange(k)[None, :])
+
+    picks = []
+    for cat in (CAT_WALKED, CAT_STUMBLED, CAT_INTRODUCED):
+        slot = _pick_by_priority(elig & (cats == cat), prio)
+        picks.append(jnp.where(slot >= 0,
+                               jnp.take_along_axis(
+                                   tab.peer, jnp.maximum(slot, 0)[:, None],
+                                   axis=1)[:, 0],
+                               NO_PEER))
+    # Bootstrap: a random tracker (indices [0, n_trackers)), never self.
+    if cfg.n_trackers > 0:
+        t = rng.rand_u32(seed, round_index, self_idx, rng.P_BOOTSTRAP) \
+            % jnp.uint32(cfg.n_trackers)
+        t = t.astype(jnp.int32)
+        t = jnp.where(t == self_idx, (t + 1) % cfg.n_trackers, t)
+        boot = jnp.where(t == self_idx, NO_PEER, t)
+    else:
+        boot = jnp.full((n,), NO_PEER, jnp.int32)
+    picks.append(boot)
+
+    r = rng.rand_uniform(seed, round_index, self_idx, rng.P_CATEGORY)
+    c0 = jnp.where(
+        r < cfg.p_revisit_walked, 0,
+        jnp.where(r < cfg.p_revisit_walked + cfg.p_stumbled, 1,
+                  jnp.where(r < 1.0 - cfg.p_bootstrap, 2, 3)))
+    stacked = jnp.stack(picks, axis=0)                      # [4, N]
+    order = (c0[None, :] + jnp.arange(4)[:, None]) % 4      # fallback rotation
+    rotated = jnp.take_along_axis(stacked, order, axis=0)   # [4, N]
+    avail = rotated != NO_PEER
+    first = jnp.argmax(avail, axis=0)
+    target = jnp.take_along_axis(rotated, first[None, :], axis=0)[0]
+    return jnp.where(jnp.any(avail, axis=0), target, NO_PEER).astype(jnp.int32)
+
+
+def sample_introductions(tab: CandTable, now: jnp.ndarray, cfg: CommunityConfig,
+                         seed: jnp.ndarray, round_index: jnp.ndarray,
+                         self_idx: jnp.ndarray, exclude: jnp.ndarray,
+                         salt_base: int = 0) -> jnp.ndarray:
+    """Third-peer picks for a batch of introduction responses.
+
+    ``dispersy_get_introduce_candidate``: a uniformly random *verified*
+    candidate (walked or stumbled — one whose address the responder has
+    directly confirmed), excluding the requester.  ``exclude`` is [N, S]
+    (one requester per handled request slot); returns i32[N, S] with NO_PEER
+    where the responder knows nobody else (the reference then sends a
+    response carrying no introduction).  Draws for different slots use
+    disjoint salts so they are independent.
+    """
+    n, k = tab.peer.shape
+    s = exclude.shape[1]
+    cats = categories(tab, now, cfg)
+    verified = (cats == CAT_WALKED) | (cats == CAT_STUMBLED)     # [N, K]
+    mask = verified[:, None, :] & (tab.peer[:, None, :] != exclude[:, :, None])
+    salt = (jnp.arange(s)[:, None] * jnp.uint32(k)
+            + jnp.arange(k)[None, :] + jnp.uint32(salt_base))    # [S, K]
+    prio = rng.rand_u32(seed, round_index, self_idx[:, None, None],
+                        rng.P_INTRO, salt[None, :, :])           # [N, S, K]
+    score = (prio >> jnp.uint32(1)) | (mask.astype(jnp.uint32) << jnp.uint32(31))
+    best = jnp.argmax(score, axis=-1)                            # [N, S]
+    pick = jnp.take_along_axis(tab.peer[:, None, :], best[:, :, None],
+                               axis=-1)[..., 0]
+    pick = jnp.where(jnp.any(mask, axis=-1), pick, NO_PEER)
+    return pick.astype(jnp.int32)

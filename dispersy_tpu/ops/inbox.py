@@ -39,8 +39,10 @@ def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
     """Deliver an edge list of logical packets into per-peer inboxes.
 
     ``dst``: i32[E] destination peer of each packet (any value for invalid
-    rows).  ``cols``: payload columns, each [E].  ``valid``: bool[E] —
-    packets already lost (loss mask, dead sender) are simply invalid.
+    rows).  ``cols``: payload columns, each [E, ...] (trailing dims allowed —
+    e.g. the Bloom word vector riding an introduction request).  ``valid``:
+    bool[E] — packets already lost (loss mask, dead sender) are simply
+    invalid.
 
     Delivery order within one destination is edge-list order (lax.sort is
     stable), so the oracle can reproduce inboxes exactly.
@@ -54,9 +56,10 @@ def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
     ok = valid & (dst >= 0) & (dst < n_peers)
     key = jnp.where(ok, dst, n_peers).astype(jnp.int32)
     pos = jnp.arange(e, dtype=jnp.int32)  # carries stability through sort
-    sorted_ops = lax.sort((key, pos) + tuple(cols), dimension=0, num_keys=2)
-    skey, _ = sorted_ops[0], sorted_ops[1]
-    scols = sorted_ops[2:]
+    skey, spos = lax.sort((key, pos), dimension=0, num_keys=2)
+    # Only (key, pos) ride the sort; payload columns follow via one gather —
+    # this is what lets columns carry trailing dims.
+    scols = tuple(jnp.take(c, spos, axis=0) for c in cols)
 
     # Rank within destination group = index - first index of that key.
     first = jnp.searchsorted(skey, skey, side="left").astype(jnp.int32)
@@ -65,9 +68,9 @@ def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
     flat = jnp.where(keep, skey * inbox_size + slot, n_peers * inbox_size)
 
     inbox = tuple(
-        jnp.zeros((n_peers * inbox_size,), c.dtype)
+        jnp.zeros((n_peers * inbox_size,) + c.shape[1:], c.dtype)
         .at[flat].set(c, mode="drop")
-        .reshape(n_peers, inbox_size)
+        .reshape((n_peers, inbox_size) + c.shape[1:])
         for c in scols)
     inbox_valid = (jnp.zeros((n_peers * inbox_size,), bool)
                    .at[flat].set(True, mode="drop")

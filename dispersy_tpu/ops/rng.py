@@ -1,0 +1,59 @@
+"""Counter-based deterministic randomness for the simulation.
+
+The reference draws from Python's global ``random.random()`` wherever it
+needs chance (reference: community.py ``dispersy_get_walk_candidate`` category
+split, ``dispersy_get_introduce_candidate`` third-peer pick).  The rebuild
+cannot reproduce that draw *order* (everything is batched), and SURVEY.md §7
+stage 9 explicitly licenses the divergence: only the *distributions* must
+match, verified by convergence curves.
+
+What the rebuild adds on top is **bit-exact reproducibility between the TPU
+kernels and the CPU oracle**: every stochastic choice is a pure function of
+
+    (seed, round_index, peer, purpose[, salt])
+
+mixed through the same murmur3-style finalizer as the Bloom hashes
+(:mod:`dispersy_tpu.ops.hashing`), so the pure-Python oracle
+(:mod:`dispersy_tpu.oracle.sim`) replays the identical choices without
+jax — the property the trace-equality tests (driver config #1) rely on.
+``jax.random`` is deliberately *not* used on the hot path: threefry is ~10×
+the ALU work per draw and impossible to mirror in ten lines of Python.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dispersy_tpu.ops.hashing import combine, fmix32
+
+# Purpose tags: domain separation between independent random streams.
+P_CATEGORY = 1   # walk-category draw (walked/stumbled/introduced/bootstrap)
+P_SLOT = 2       # which eligible candidate slot to walk to
+P_INTRO = 3      # which verified candidate to introduce (third peer)
+P_BOOTSTRAP = 4  # which tracker to bootstrap from
+P_CHURN = 5      # does this peer churn out this round
+P_LOSS = 6       # per-packet Bernoulli loss
+P_GOSSIP = 7     # forwarding fan-out choice (CommunityDestination)
+P_EVICT = 8      # tie-breaks in candidate eviction
+
+
+def fold_seed(key: jnp.ndarray) -> jnp.ndarray:
+    """uint32[2] state key -> one uint32 stream seed."""
+    return combine(fmix32(key[..., 0]), key[..., 1])
+
+
+def rand_u32(seed: jnp.ndarray, round_index: jnp.ndarray, peer: jnp.ndarray,
+             purpose: int, salt: jnp.ndarray | int = 0) -> jnp.ndarray:
+    """Deterministic uint32 draw; broadcasts over peer/salt shapes."""
+    h = combine(jnp.asarray(seed, jnp.uint32), jnp.asarray(round_index, jnp.uint32))
+    h = combine(h, jnp.uint32(purpose))
+    h = combine(h, jnp.asarray(peer, jnp.uint32))
+    return combine(h, jnp.asarray(salt, jnp.uint32))
+
+
+def rand_uniform(seed, round_index, peer, purpose: int, salt=0) -> jnp.ndarray:
+    """float32 in [0, 1) from the same counter stream."""
+    u = rand_u32(seed, round_index, peer, purpose, salt)
+    # 24-bit mantissa path: exact in float32, matches the oracle's
+    # (u >> 8) / 2**24 arithmetic bit-for-bit.
+    return (u >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))

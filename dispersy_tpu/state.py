@@ -80,15 +80,21 @@ class PeerState:
     stats: Stats
     key: jnp.ndarray          # uint32[2] threefry key for this community
     time: jnp.ndarray         # f32 scalar, sim-seconds (round * walk_interval)
+    round_index: jnp.ndarray  # u32 scalar; exact round counter (time is
+    #                           derived f32 and would lose integer precision
+    #                           past ~2^23 rounds)
 
 
 FLAG_UNDONE = 1
 
 
 def init_stats(n: int) -> Stats:
-    z = jnp.zeros((n,), jnp.uint32)
-    return Stats(walk_success=z, walk_fail=z, msgs_stored=z, msgs_dropped=z,
-                 requests_dropped=z, punctures=z)
+    # Six distinct buffers on purpose: aliased arrays break donation
+    # (Execute() rejects the same buffer donated twice).
+    def z():
+        return jnp.zeros((n,), jnp.uint32)
+    return Stats(walk_success=z(), walk_fail=z(), msgs_stored=z(),
+                 msgs_dropped=z(), requests_dropped=z(), punctures=z())
 
 
 def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
@@ -100,16 +106,18 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
     """
     n, k, m, a = (config.n_peers, config.k_candidates, config.msg_capacity,
                   config.k_authorized)
-    never = jnp.full((n, k), NEVER, jnp.float32)
+
+    def never():  # distinct buffers: aliasing breaks donation
+        return jnp.full((n, k), NEVER, jnp.float32)
     return PeerState(
         alive=jnp.ones((n,), bool),
         is_tracker=jnp.arange(n) < config.n_trackers,
         session=jnp.zeros((n,), jnp.uint32),
         global_time=jnp.ones((n,), jnp.uint32),
         cand_peer=jnp.full((n, k), NO_PEER, jnp.int32),
-        cand_last_walk=never,
-        cand_last_stumble=never,
-        cand_last_intro=never,
+        cand_last_walk=never(),
+        cand_last_stumble=never(),
+        cand_last_intro=never(),
         store_gt=jnp.full((n, m), EMPTY_U32, jnp.uint32),
         store_member=jnp.full((n, m), EMPTY_U32, jnp.uint32),
         store_meta=jnp.full((n, m), EMPTY_U32, jnp.uint32),
@@ -123,4 +131,5 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         stats=init_stats(n),
         key=jax.random.key_data(key) if key.dtype != jnp.uint32 else key,
         time=jnp.float32(0.0),
+        round_index=jnp.uint32(0),
     )

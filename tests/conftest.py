@@ -20,3 +20,11 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon TPU-tunnel sitecustomize registers its backend at interpreter
+# start and *prepends* "axon," to jax_platforms, so the env var alone is not
+# enough — override the live config too.  Tests must run on the virtual
+# 8-device CPU mesh regardless of the tunnel being present.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,80 @@
+"""Headline benchmark: simulated sync rounds/sec (BASELINE.md north star).
+
+Runs the full fused round — walker (introduction-request/response/puncture)
++ Bloom-filter sync + store merge — for as many peers as the local device
+can hold, and reports steady-state rounds/sec.  The north-star target
+(driver-defined, BASELINE.json) is >=10,000 rounds/sec at 1M peers on a
+v5e-8; ``vs_baseline`` is measured rounds/sec over that 10k bar, scaled by
+the fraction of 1M peers actually simulated (so partial-population runs
+don't overstate).
+
+Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from dispersy_tpu import engine
+from dispersy_tpu.config import CommunityConfig
+from dispersy_tpu.state import init_state
+
+NORTH_STAR_ROUNDS_PER_SEC = 10_000.0
+NORTH_STAR_PEERS = 1_000_000
+
+
+def pick_config() -> CommunityConfig:
+    platform = jax.devices()[0].platform
+    if platform == "tpu":
+        # Config #3-shaped load (Bloom-sync with a real backlog) at the
+        # largest population one chip holds comfortably.
+        n = 1 << 20  # 1,048,576 peers
+        return CommunityConfig(
+            n_peers=n, n_trackers=8, k_candidates=16, msg_capacity=48,
+            bloom_capacity=48, request_inbox=4, tracker_inbox=1024,
+            response_budget=8, churn_rate=0.0)
+    # CPU fallback (no TPU attached): same shape, small population.
+    return CommunityConfig(
+        n_peers=1 << 14, n_trackers=4, k_candidates=16, msg_capacity=64,
+        bloom_capacity=64, request_inbox=4, tracker_inbox=256,
+        response_budget=8, churn_rate=0.0)
+
+
+def main() -> None:
+    cfg = pick_config()
+    state = init_state(cfg, jax.random.PRNGKey(0))
+    state = engine.seed_overlay(state, cfg, degree=8)
+    authors = jnp.arange(cfg.n_peers) % 64 == 63
+    state = engine.create_messages(
+        state, cfg, author_mask=authors, meta=1,
+        payload=jnp.arange(cfg.n_peers, dtype=jnp.uint32))
+
+    # Warmup: compile + populate stores so the timed rounds do real sync work.
+    for _ in range(3):
+        state = engine.step(state, cfg)
+    jax.block_until_ready(state)
+
+    n_rounds = 30 if jax.devices()[0].platform == "tpu" else 10
+    t0 = time.perf_counter()
+    for _ in range(n_rounds):
+        state = engine.step(state, cfg)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    rounds_per_sec = n_rounds / dt
+    scale = min(1.0, cfg.n_peers / NORTH_STAR_PEERS)
+    print(json.dumps({
+        "metric": f"sync_rounds_per_sec_{cfg.n_peers}_peers",
+        "value": round(rounds_per_sec, 3),
+        "unit": "rounds/s",
+        "vs_baseline": round(rounds_per_sec * scale / NORTH_STAR_ROUNDS_PER_SEC,
+                             4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

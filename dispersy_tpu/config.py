@@ -101,7 +101,9 @@ class CommunityConfig:
     #    bootstraps, drops, and removes candidates forever.  The tracker
     #    inbox is a compact [n_trackers, tracker_inbox] array, so large
     #    values are cheap.)
-    msg_inbox: int = 64                 # sync records accepted per peer/round
+    # Sync intake needs no separate inbox knob: records flow back only
+    # along the request edge, so per-round intake is exactly
+    # request-count x response_budget by construction.
 
     # ---- clock (reference: community.py claim_global_time /
     #      dispersy_acceptable_global_time_range) ----

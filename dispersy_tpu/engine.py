@@ -160,9 +160,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             sl = st.claim_slice_largest(stc.gt, cfg.bloom_capacity)
         in_slice = st.slice_mask(stc.gt, sl)                         # [N, M]
         rec_h = record_hash(stc.member, stc.gt, stc.meta, stc.payload)
-        my_bloom = jax.vmap(
-            lambda h, m: bloom.bloom_build(h, m, cfg.bloom_bits,
-                                           cfg.bloom_hashes))(rec_h, in_slice)
+        my_bloom = bloom.bloom_build(rec_h, in_slice, cfg.bloom_bits,
+                                     cfg.bloom_hashes)
     else:
         zu = jnp.zeros((n,), jnp.uint32)
         sl = st.SyncSlice(time_low=zu, time_high=zu, modulo=zu, offset=zu)
@@ -279,18 +278,14 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     intro = cand.sample_introductions(tab, now, cfg, seed, rnd, idx,
                                       exclude=rq_src_i)       # [N, R]
 
-    # introduction-response edges: responder -> requester, introducing C.
-    salt_r = jnp.arange(r)[None, :]
-    resp_lost = _lost(seed, rnd, idx[:, None], _LOSS_RESPONSE, salt_r,
-                      cfg.packet_loss)
-    resp_dst = [rq_src_i.reshape(-1)]
-    resp_from = [jnp.broadcast_to(idx[:, None].astype(jnp.uint32),
-                                  (n, r)).reshape(-1)]
-    resp_intro = [intro.reshape(-1).astype(jnp.uint32)]
-    resp_gt = [jnp.broadcast_to(global_time[:, None], (n, r)).reshape(-1)]
-    resp_valid = [(rq_ok & ~resp_lost).reshape(-1)]
+    # Introduction responses are NOT re-routed through a second global sort:
+    # the responder's per-slot replies (intro pick, clock) sit where the
+    # request landed, and each requester fetches its reply by receipt
+    # (``edge_slot``) — a pure gather.  This mirrors the reference, where a
+    # response is unicast straight back to the requester's socket address.
 
     # puncture-request edges: responder -> C, naming the requester.
+    salt_r = jnp.arange(r)[None, :]
     pr_lost = _lost(seed, rnd, idx[:, None], _LOSS_PUNCTURE_REQ, salt_r,
                     cfg.packet_loss)
     pr_dst = [intro.reshape(-1)]
@@ -299,29 +294,11 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
 
     if t > 0:
         salt_rt = jnp.arange(rt)[None, :] + _TRACKER_SALT
-        tresp_lost = _lost(seed, rnd, tidx[:, None], _LOSS_RESPONSE, salt_rt,
-                           cfg.packet_loss)
-        resp_dst.append(tq_src_i.reshape(-1))
-        resp_from.append(jnp.broadcast_to(
-            tidx[:, None].astype(jnp.uint32), (t, rt)).reshape(-1))
-        resp_intro.append(intro_t.reshape(-1).astype(jnp.uint32))
-        resp_gt.append(jnp.broadcast_to(
-            global_time[:t][:, None], (t, rt)).reshape(-1))
-        resp_valid.append((tq_ok & ~tresp_lost).reshape(-1))
-
         tpr_lost = _lost(seed, rnd, tidx[:, None], _LOSS_PUNCTURE_REQ, salt_rt,
                          cfg.packet_loss)
         pr_dst.append(intro_t.reshape(-1))
         pr_target.append(tq_src_i.reshape(-1).astype(jnp.uint32))
         pr_valid.append((tq_ok & (intro_t != NO_PEER) & ~tpr_lost).reshape(-1))
-
-    resp = inbox.deliver(
-        dst=jnp.concatenate(resp_dst),
-        cols=[jnp.concatenate(resp_from), jnp.concatenate(resp_intro),
-              jnp.concatenate(resp_gt)],
-        valid=jnp.concatenate(resp_valid), n_peers=n, inbox_size=1)
-    rs_from, rs_intro, rs_gt = resp.inbox                     # [N, 1] each
-    rs_ok = resp.inbox_valid & alive[:, None]
 
     punc_req = inbox.deliver(
         dst=jnp.concatenate(pr_dst), cols=[jnp.concatenate(pr_target)],
@@ -358,9 +335,26 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     # peer introduced; success/failure accounting.  Fused-round timeout: a
     # request that got no response this round is a failed walk, and the
     # stale candidate is dropped (IntroductionRequestCache.on_timeout).
-    got_resp = rs_ok[:, 0]
-    walked = jnp.where(got_resp, rs_from[:, 0].astype(jnp.int32), NO_PEER)
-    introduced = jnp.where(got_resp, rs_intro[:, 0].astype(jnp.int32), NO_PEER)
+    # Reply pickup by receipt: requester r's reply sits at slot
+    # edge_slot[r] of its target's per-slot reply table.
+    tgt = jnp.maximum(target, 0)
+    slot_n = jnp.maximum(req.edge_slot, 0)
+    got_n = (req.edge_slot >= 0) & rq_ok[tgt, slot_n]
+    intro_n = intro[tgt, slot_n]
+    if t > 0:
+        slot_t = jnp.maximum(treq.edge_slot, 0)
+        tgt_t = jnp.minimum(tgt, t - 1)
+        got_t = (treq.edge_slot >= 0) & tq_ok[tgt_t, slot_t]
+        got_raw = jnp.where(to_tracker, got_t, got_n)
+        intro_pick = jnp.where(to_tracker, intro_t[tgt_t, slot_t], intro_n)
+    else:
+        got_raw, intro_pick = got_n, intro_n
+    resp_lost = _lost(seed, rnd, idx, _LOSS_RESPONSE, 0, cfg.packet_loss)
+    got_resp = got_raw & ~resp_lost & alive
+    walked = jnp.where(got_resp, target, NO_PEER)
+    introduced = jnp.where(got_resp, intro_pick, NO_PEER)
+    rs_gt = global_time[tgt][:, None]                         # responder clock
+    rs_ok = got_resp[:, None]
     upd_peer = jnp.concatenate(
         [walked[:, None], introduced[:, None],
          jnp.where(pu_ok, pu_from.astype(jnp.int32), NO_PEER)], axis=1)
@@ -383,17 +377,22 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         walk_fail=stats.walk_fail + failed.astype(jnp.uint32))
 
     # ---- phase 2b/5: sync responder + store insert ---------------------
+    # The responder fills a per-request-slot *outbox* of up to
+    # ``response_budget`` records the requester provably lacks; the
+    # requester then fetches its own outbox row by receipt — sync records
+    # only ever flow back along the request edge (as in the reference,
+    # where sync packets are unicast to the introduction-request sender).
     if cfg.sync_enabled:
         b = cfg.response_budget
         rec_h2 = record_hash(stc.member, stc.gt, stc.meta, stc.payload)
-        dsts, gts, members, metas, payloads, valids = [], [], [], [], [], []
+        gts, members, metas, payloads, valids = [], [], [], [], []
         rows = idx[:, None]
         for s in range(r):
             sl_s = st.SyncSlice(time_low=rq_tlow[:, s], time_high=rq_thigh[:, s],
                                 modulo=rq_mod[:, s], offset=rq_off[:, s])
             in_sl = st.slice_mask(stc.gt, sl_s)                   # [N, M]
-            present = jax.vmap(bloom.bloom_query, in_axes=(0, 0, None, None))(
-                rq_bloom[:, s], rec_h2, cfg.bloom_bits, cfg.bloom_hashes)
+            present = bloom.bloom_query(rq_bloom[:, s], rec_h2,
+                                        cfg.bloom_bits, cfg.bloom_hashes)
             missing = in_sl & ~present & rq_ok[:, s:s + 1]
             # First `b` missing records in (global_time, …) order — the
             # store is sorted, mirroring the responder's ORDER BY
@@ -404,23 +403,21 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             def compact(col, fill):
                 return (jnp.full((n, b + 1), fill, col.dtype)
                         .at[rows, slot].set(col)[:, :b])
-            sel_valid = compact(missing, False)
-            sync_lost = _lost(seed, rnd, idx[:, None], _LOSS_SYNC,
-                              jnp.arange(b)[None, :] + s * b, cfg.packet_loss)
-            dsts.append(jnp.broadcast_to(rq_src_i[:, s:s + 1], (n, b)))
             gts.append(compact(stc.gt, EMPTY_U32))
             members.append(compact(stc.member, EMPTY_U32))
             metas.append(compact(stc.meta, EMPTY_U32))
             payloads.append(compact(stc.payload, EMPTY_U32))
-            valids.append(sel_valid & ~sync_lost)
-        sync = inbox.deliver(
-            dst=jnp.concatenate(dsts, axis=1).reshape(-1),
-            cols=[jnp.concatenate(c, axis=1).reshape(-1)
-                  for c in (gts, members, metas, payloads)],
-            valid=jnp.concatenate(valids, axis=1).reshape(-1),
-            n_peers=n, inbox_size=cfg.msg_inbox)
-        sy_gt, sy_member, sy_meta, sy_payload = sync.inbox        # [N, B]
-        sy_ok = sync.inbox_valid & alive[:, None]
+            valids.append(compact(missing, False))
+        obox = [jnp.stack(c, axis=1) for c in (gts, members, metas, payloads)]
+        obox_ok = jnp.stack(valids, axis=1)                       # [N, R, b]
+
+        # Requester pickup by receipt + per-record Bernoulli loss.
+        sy_gt, sy_member, sy_meta, sy_payload = (
+            c[tgt, slot_n] for c in obox)                         # [N, b]
+        sync_lost = _lost(seed, rnd, idx[:, None], _LOSS_SYNC,
+                          jnp.arange(b)[None, :], cfg.packet_loss)
+        sy_ok = (obox_ok[tgt, slot_n] & (req.edge_slot >= 0)[:, None]
+                 & alive[:, None] & ~sync_lost)
         # Clock-jump defense before the store accepts anything.
         acceptable = sy_gt <= global_time[:, None] + jnp.uint32(
             cfg.acceptable_global_time_range)
@@ -438,8 +435,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             msgs_stored=stats.msgs_stored + ins.n_inserted.astype(jnp.uint32),
             msgs_dropped=stats.msgs_dropped
             + ins.n_dropped.astype(jnp.uint32)
-            + ins.n_evicted.astype(jnp.uint32)
-            + sync.n_dropped.astype(jnp.uint32))
+            + ins.n_evicted.astype(jnp.uint32))
 
     # ---- wrap up --------------------------------------------------------
     return state.replace(

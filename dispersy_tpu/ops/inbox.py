@@ -32,6 +32,7 @@ class Delivery(NamedTuple):
     inbox: tuple          # tuple of [N, B] arrays, one per payload column
     inbox_valid: jnp.ndarray  # bool[N, B]
     n_dropped: jnp.ndarray    # i32[N] packets lost to inbox overflow per dest
+    edge_slot: jnp.ndarray    # i32[E] slot each edge landed in, -1 if dropped
 
 
 def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
@@ -46,6 +47,13 @@ def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
 
     Delivery order within one destination is edge-list order (lax.sort is
     stable), so the oracle can reproduce inboxes exactly.
+
+    ``edge_slot`` is the *receipt*: the inbox slot each edge landed in (or -1
+    for dropped/invalid).  It lets the sender later fetch a per-slot reply
+    from the destination by pure gather — request/response round trips
+    (introduction response, sync records) need no second global sort, which
+    also mirrors the reference: responses are unicast back to the socket
+    address the request came from, never re-routed.
     """
     e = dst.shape[0]
     # Invalid packets park at key n_peers: sorted past every real peer, and
@@ -61,9 +69,14 @@ def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
     # this is what lets columns carry trailing dims.
     scols = tuple(jnp.take(c, spos, axis=0) for c in cols)
 
-    # Rank within destination group = index - first index of that key.
-    first = jnp.searchsorted(skey, skey, side="left").astype(jnp.int32)
-    slot = jnp.arange(e, dtype=jnp.int32) - first
+    # Rank within destination group = index - first index of that key, with
+    # the group starts found by a cummax scan (a searchsorted here would be
+    # E·log E serialized gathers on TPU; the scan is a handful of passes).
+    iota = jnp.arange(e, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), skey[1:] != skey[:-1]])
+    first = lax.cummax(jnp.where(is_start, iota, 0))
+    slot = iota - first
     keep = (skey < n_peers) & (slot < inbox_size)
     flat = jnp.where(keep, skey * inbox_size + slot, n_peers * inbox_size)
 
@@ -79,4 +92,7 @@ def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
     n_dropped = (jnp.zeros((n_peers,), jnp.int32)
                  .at[jnp.where(overflow, skey, n_peers)]
                  .add(1, mode="drop"))
-    return Delivery(inbox=inbox, inbox_valid=inbox_valid, n_dropped=n_dropped)
+    edge_slot = (jnp.zeros((e,), jnp.int32)
+                 .at[spos].set(jnp.where(keep, slot, -1)))
+    return Delivery(inbox=inbox, inbox_valid=inbox_valid, n_dropped=n_dropped,
+                    edge_slot=edge_slot)

@@ -1,0 +1,2 @@
+from dispersy_tpu.parallel.mesh import (  # noqa: F401
+    PEER_AXIS, make_mesh, shard_state, state_sharding)

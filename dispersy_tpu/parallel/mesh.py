@@ -1,0 +1,72 @@
+"""Device-mesh sharding for the peer axis.
+
+The reference scales by running one OS process per peer over real UDP
+networks (reference: endpoint.py ``StandaloneEndpoint``; tool/scenarioscript.py
+drives DAS4-cluster deployments) — its "distributed backend" is hand-rolled
+datagrams, no NCCL/MPI (SURVEY.md §5.8).  The TPU rebuild's distribution
+model is SPMD instead: the leading *peer axis* of every ``PeerState`` array
+is sharded over a 1-D ``jax.sharding.Mesh``, the whole round ``step`` runs
+under jit on that sharded state, and XLA inserts the collectives where data
+crosses shards:
+
+- the delivery kernel's global ``lax.sort`` by destination
+  (:mod:`dispersy_tpu.ops.inbox`) lowers to an all-to-all style exchange over
+  ICI — exactly where the reference's UDP fan-out sat;
+- everything else in the step (bloom build/query, store merge, candidate
+  bookkeeping) is embarrassingly row-parallel and stays shard-local.
+
+No TP/PP is warranted: the model is 1M+ independent peer rows, so
+peer-sharding *is* the data parallelism (SURVEY.md §2, "Parallelism
+strategies").  Multi-host: the same mesh spans hosts via
+``jax.distributed.initialize``; DCN traffic only occurs inside the one sort,
+at the round boundary — matching the design rule that cross-slice hops ride
+DCN once per round.
+
+Caveat (virtual CPU meshes only): XLA's in-process CPU communicator can
+deadlock when several async-dispatched sharded executions overlap — call
+``jax.block_until_ready`` between steps when looping on a
+``xla_force_host_platform_device_count`` mesh.  Real TPU streams order
+collectives correctly and need no such serialization.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dispersy_tpu.state import PeerState
+
+PEER_AXIS = "peers"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` available devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} present")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (PEER_AXIS,))
+
+
+def state_sharding(state: PeerState, mesh: Mesh, n_peers: int):
+    """A ``PeerState``-shaped pytree of NamedShardings.
+
+    Every leaf whose leading dimension is the peer axis is sharded over the
+    mesh; scalars and the RNG key are replicated.  The peer axis is
+    recognized by its length, so ``n_peers`` must differ from the small
+    fixed dims (the uint32[2] key — guaranteed for any real population).
+    """
+    def spec(leaf):
+        if leaf.ndim >= 1 and leaf.shape[0] == n_peers:
+            return NamedSharding(mesh, P(PEER_AXIS, *([None] * (leaf.ndim - 1))))
+        return NamedSharding(mesh, P())
+    return jax.tree.map(spec, state)
+
+
+def shard_state(state: PeerState, mesh: Mesh, n_peers: int) -> PeerState:
+    """Place ``state`` on the mesh, peer axis sharded, scalars replicated."""
+    return jax.device_put(state, state_sharding(state, mesh, n_peers))

@@ -16,7 +16,7 @@ from dispersy_tpu.config import CommunityConfig
 
 BASE = CommunityConfig(n_peers=64, n_trackers=2, msg_capacity=32,
                        bloom_capacity=32, k_candidates=8, tracker_inbox=16,
-                       msg_inbox=16, response_budget=8)
+                       response_budget=8)
 
 
 def run(cfg, rounds, seed=0, author=None):

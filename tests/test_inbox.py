@@ -15,27 +15,30 @@ def naive_deliver(dst, cols, valid, n_peers, inbox_size):
     inbox = [[None] * inbox_size for _ in range(n_peers)]
     ivalid = np.zeros((n_peers, inbox_size), bool)
     dropped = np.zeros(n_peers, np.int32)
+    edge_slot = np.full(len(dst), -1, np.int32)
     fill = [0] * n_peers
     for e in range(len(dst)):
-        if not valid[e]:
+        if not valid[e] or not (0 <= int(dst[e]) < n_peers):
             continue
         d = int(dst[e])
         if fill[d] < inbox_size:
             inbox[d][fill[d]] = tuple(int(c[e]) for c in cols)
             ivalid[d, fill[d]] = True
+            edge_slot[e] = fill[d]
             fill[d] += 1
         else:
             dropped[d] += 1
-    return inbox, ivalid, dropped
+    return inbox, ivalid, dropped, edge_slot
 
 
 def check_against_naive(dst, cols, valid, n_peers, inbox_size):
     got = deliver(jnp.asarray(dst), [jnp.asarray(c) for c in cols],
                   jnp.asarray(valid), n_peers, inbox_size)
-    want_inbox, want_valid, want_drop = naive_deliver(
+    want_inbox, want_valid, want_drop, want_slot = naive_deliver(
         dst, cols, valid, n_peers, inbox_size)
     np.testing.assert_array_equal(np.asarray(got.inbox_valid), want_valid)
     np.testing.assert_array_equal(np.asarray(got.n_dropped), want_drop)
+    np.testing.assert_array_equal(np.asarray(got.edge_slot), want_slot)
     for p in range(n_peers):
         for s in range(inbox_size):
             if want_valid[p, s]:
@@ -92,6 +95,7 @@ def test_out_of_range_destinations_are_dropped():
     assert iv.sum() == 1 and iv[1, 0]
     assert int(np.asarray(got.inbox[0])[1, 0]) == 3
     assert int(np.asarray(got.n_dropped).sum()) == 0
+    np.testing.assert_array_equal(np.asarray(got.edge_slot), [-1, -1, 0, -1])
 
 
 def test_empty_edge_list_and_all_invalid():

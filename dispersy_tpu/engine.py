@@ -170,12 +170,16 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     req_lost = _lost(seed, rnd, idx, _LOSS_REQUEST, 0, cfg.packet_loss)
     send_ok = alive & (target != NO_PEER) & ~req_lost
     to_tracker = (target >= 0) & (target < t)
+    # Every request packet carries the sender's clock *as of round start*:
+    # the tracker delivery below must not read a clock already raised by
+    # this round's incoming requests (fused-round causality).
+    gt_at_send = global_time
 
     # Normal-peer request inbox: [N, R] with the full sync payload.
     req = inbox.deliver(
         dst=target,
         cols=[idx.astype(jnp.uint32), sl.time_low, sl.time_high, sl.modulo,
-              sl.offset, global_time, my_bloom],
+              sl.offset, gt_at_send, my_bloom],
         valid=send_ok & ~to_tracker, n_peers=n, inbox_size=cfg.request_inbox)
     (rq_src, rq_tlow, rq_thigh, rq_mod, rq_off, rq_gt, rq_bloom) = req.inbox
     rq_ok = req.inbox_valid & alive[:, None]                 # [N, R]
@@ -201,7 +205,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         k = cfg.k_candidates
         tidx = jnp.arange(t, dtype=jnp.int32)
         treq = inbox.deliver(
-            dst=target, cols=[idx.astype(jnp.uint32), global_time],
+            dst=target, cols=[idx.astype(jnp.uint32), gt_at_send],
             valid=send_ok & to_tracker, n_peers=t, inbox_size=rt)
         tq_src, tq_gt = treq.inbox                           # [T, Rt]
         tq_ok = treq.inbox_valid & alive[:t][:, None]
@@ -501,13 +505,20 @@ def seed_overlay(state: PeerState, cfg: CommunityConfig,
                % span).astype(jnp.int32)
     nbr = jnp.where(nbr == idx[:, None],
                     t + (nbr - t + 1) % span.astype(jnp.int32), nbr)
+    # One slot per neighbor: the candidate table is keyed by peer (the
+    # reference's dict is keyed by address), so a duplicate draw becomes an
+    # empty slot instead of two entries for one peer.
+    dup = jnp.any(nbr[:, :, None] == jnp.where(
+        jnp.arange(degree)[None, :] < jnp.arange(degree)[:, None],
+        nbr[:, None, :], NO_PEER), axis=-1)
+    nbr = jnp.where(dup, NO_PEER, nbr)
     eligible_at = jnp.float32(0.0) - jnp.float32(cfg.eligibility_delay)
     pad = cfg.k_candidates - degree
     return state.replace(
         cand_peer=jnp.concatenate(
             [nbr, jnp.full((n, pad), NO_PEER, jnp.int32)], axis=1),
         cand_last_walk=jnp.concatenate(
-            [jnp.full((n, degree), eligible_at, jnp.float32),
+            [jnp.where(nbr == NO_PEER, jnp.float32(NEVER), eligible_at),
              jnp.full((n, pad), NEVER, jnp.float32)], axis=1),
         cand_last_stumble=jnp.full((n, cfg.k_candidates), NEVER, jnp.float32),
         cand_last_intro=jnp.full((n, cfg.k_candidates), NEVER, jnp.float32))

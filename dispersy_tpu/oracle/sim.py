@@ -1,0 +1,646 @@
+"""Pure-Python CPU oracle of one full simulation round.
+
+The rebuild's answer to the reference's in-process behavioral test harness
+(reference: tests/dispersytestclass.py ``DispersyTestFunc`` drives real
+stacks on loopback; tests/debugcommunity/node.py ``DebugNode`` hand-crafts
+packets and asserts on what comes back): a slow, loop-and-list
+implementation of the *same semantics* as :func:`dispersy_tpu.engine.step`,
+replayable **bit-for-bit** because every stochastic draw in the engine is a
+counter-based hash of (seed, round, peer, purpose, salt) — see
+:mod:`dispersy_tpu.ops.rng`.
+
+The trace-equality tests (driver config #1: tiny-N sync vs CPU reference)
+step this oracle and the jitted engine side by side and require identical
+state arrays after every round.  Divergence in any field — a candidate
+timestamp, a stats counter, one store record — fails the suite, which is
+what makes the TPU kernels trustworthy at 1M peers where nothing is
+inspectable by eye.
+
+Float32 discipline: candidate timestamps and sim-time are float32 on
+device, so every time comparison here goes through ``np.float32`` exactly
+once per arithmetic step, mirroring the engine's dtype flow.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dispersy_tpu.config import EMPTY_U32, NO_PEER, CommunityConfig
+from dispersy_tpu.oracle.bloom import OracleBloom, record_hash
+from dispersy_tpu.ops import rng as _jrng
+
+M32 = 0xFFFFFFFF
+NEVER = np.float32(-1.0e9)
+_NEVER_ACT = np.float32(-2.0e9)
+
+# Mirrors of the engine's loss-salt blocks (engine.py module constants).
+_LOSS_REQUEST = 0 << 16
+_LOSS_RESPONSE = 1 << 16
+_LOSS_PUNCTURE_REQ = 2 << 16
+_LOSS_PUNCTURE = 3 << 16
+_LOSS_SYNC = 4 << 16
+_TRACKER_SALT = 1 << 15
+_TRACKER_INTRO_SALT = 1 << 20
+
+# Purpose tags (ops/rng.py).
+P_CATEGORY, P_SLOT, P_INTRO, P_BOOTSTRAP = 1, 2, 3, 4
+P_CHURN, P_LOSS, P_GOSSIP = 5, 6, 7
+
+KIND_WALK, KIND_STUMBLE, KIND_INTRO = 0, 1, 2
+CAT_NONE, CAT_WALKED, CAT_STUMBLED, CAT_INTRODUCED = 0, 1, 2, 3
+
+
+def _fmix32(x: int) -> int:
+    x &= M32
+    x ^= x >> 16
+    x = (x * 0x85EBCA6B) & M32
+    x ^= x >> 13
+    x = (x * 0xC2B2AE35) & M32
+    x ^= x >> 16
+    return x
+
+
+def _combine(h: int, v: int) -> int:
+    h &= M32
+    return (h ^ ((_fmix32(v) + 0x9E3779B9 + ((h << 6) & M32) + (h >> 2)) & M32)) & M32
+
+
+def fold_seed(key0: int, key1: int) -> int:
+    return _combine(_fmix32(key0), key1)
+
+
+def rand_u32(seed: int, rnd: int, peer: int, purpose: int, salt: int = 0) -> int:
+    h = _combine(seed & M32, rnd & M32)
+    h = _combine(h, purpose)
+    h = _combine(h, peer & M32)
+    return _combine(h, salt & M32)
+
+
+def rand_uniform(seed, rnd, peer, purpose, salt=0) -> float:
+    """Exact mirror of ops/rng.rand_uniform's float32 value (which is exact
+    in float64 too: a 24-bit integer scaled by a power of two)."""
+    return (rand_u32(seed, rnd, peer, purpose, salt) >> 8) / float(1 << 24)
+
+
+def _f32(x) -> np.float32:
+    return np.float32(x)
+
+
+class Record:
+    """One sync-table row: (global_time, member, meta, payload, flags)."""
+
+    __slots__ = ("gt", "member", "meta", "payload", "flags")
+
+    def __init__(self, gt, member, meta, payload, flags=0):
+        self.gt, self.member, self.meta = int(gt), int(member), int(meta)
+        self.payload, self.flags = int(payload), int(flags)
+
+    def key(self):
+        return (self.gt, self.member, self.meta, self.payload)
+
+    def hash(self) -> int:
+        return record_hash(self.member, self.gt, self.meta, self.payload)
+
+
+class Slot:
+    """One candidate-table slot (candidate.py WalkCandidate mirror)."""
+
+    __slots__ = ("peer", "walk", "stumble", "intro")
+
+    def __init__(self):
+        self.peer = NO_PEER
+        self.walk = self.stumble = self.intro = NEVER
+
+
+class OraclePeer:
+    def __init__(self, cfg: CommunityConfig):
+        self.alive = True
+        self.session = 0
+        self.global_time = 1
+        self.slots = [Slot() for _ in range(cfg.k_candidates)]
+        self.store: list[Record] = []   # kept sorted by Record.key()
+        # stats
+        self.walk_success = self.walk_fail = 0
+        self.msgs_stored = self.msgs_dropped = 0
+        self.requests_dropped = self.punctures = 0
+
+
+class OracleSim:
+    """Mirror of engine.step at Python speed; usable up to a few hundred peers."""
+
+    def __init__(self, cfg: CommunityConfig, key_data) -> None:
+        self.cfg = cfg
+        self.seed = fold_seed(int(key_data[0]), int(key_data[1]))
+        self.rnd = 0
+        self.now = np.float32(0.0)
+        self.peers = [OraclePeer(cfg) for _ in range(cfg.n_peers)]
+
+    # ---- helpers mirroring ops/candidates.py --------------------------------
+
+    def _category(self, s: Slot) -> int:
+        cfg = self.cfg
+        if s.peer == NO_PEER:
+            return CAT_NONE
+        if _f32(self.now - s.walk) < _f32(cfg.walk_lifetime):
+            return CAT_WALKED
+        if _f32(self.now - s.stumble) < _f32(cfg.walk_lifetime):
+            return CAT_STUMBLED
+        if _f32(self.now - s.intro) < _f32(cfg.intro_lifetime):
+            return CAT_INTRODUCED
+        return CAT_NONE
+
+    def _eligible(self, s: Slot) -> bool:
+        return (self._category(s) != CAT_NONE
+                and _f32(self.now - s.walk) >= _f32(self.cfg.eligibility_delay))
+
+    def _pick_by_priority(self, mask: list[bool], prio: list[int]) -> int:
+        """argmax of (prio >> 1 | mask << 31), first max on ties."""
+        best, best_score = -1, -1
+        for i, (m, p) in enumerate(zip(mask, prio)):
+            score = (p >> 1) | ((1 << 31) if m else 0)
+            if score > best_score:
+                best, best_score = i, score
+        return best if any(mask) else -1
+
+    def _upsert(self, owner: int, peer: int, kind: int) -> None:
+        """upsert_many semantics for a single observation."""
+        cfg = self.cfg
+        if peer == NO_PEER or peer == owner or peer < cfg.n_trackers:
+            return
+        slots = self.peers[owner].slots
+        # engine's upsert_many stamps EVERY slot matching the peer (there is
+        # at most one by invariant, but mirror the kernel exactly)
+        matches = [s for s in slots if s.peer == peer]
+        if not matches:
+            # least-recently-active victim, ties -> lowest index
+            def activity(s: Slot) -> np.float32:
+                if s.peer == NO_PEER:
+                    return _NEVER_ACT
+                return max(s.walk, s.stumble, s.intro)
+            victim = min(slots, key=lambda s: (activity(s),))
+            # min with ties -> first occurrence matches argmin
+            victim.peer = peer
+            victim.walk = victim.stumble = victim.intro = NEVER
+            matches = [victim]
+        for target in matches:
+            if kind == KIND_WALK:
+                target.walk = self.now
+            elif kind == KIND_STUMBLE:
+                target.stumble = self.now
+            else:
+                target.intro = self.now
+
+    def _remove(self, owner: int, peer: int) -> None:
+        for s in self.peers[owner].slots:
+            if s.peer == peer:
+                s.peer = NO_PEER
+                s.walk = s.stumble = s.intro = NEVER
+
+    def _sample_walk_target(self, i: int) -> int:
+        cfg = self.cfg
+        slots = self.peers[i].slots
+        k = cfg.k_candidates
+        prio = [rand_u32(self.seed, self.rnd, i, P_SLOT, j) for j in range(k)]
+        elig = [self._eligible(s) for s in slots]
+        cats = [self._category(s) for s in slots]
+        picks = []
+        for cat in (CAT_WALKED, CAT_STUMBLED, CAT_INTRODUCED):
+            mask = [e and c == cat for e, c in zip(elig, cats)]
+            j = self._pick_by_priority(mask, prio)
+            picks.append(slots[j].peer if j >= 0 else NO_PEER)
+        if cfg.n_trackers > 0:
+            tdraw = rand_u32(self.seed, self.rnd, i, P_BOOTSTRAP) % cfg.n_trackers
+            if tdraw == i:
+                tdraw = (tdraw + 1) % cfg.n_trackers
+            picks.append(NO_PEER if tdraw == i else tdraw)
+        else:
+            picks.append(NO_PEER)
+        r = rand_uniform(self.seed, self.rnd, i, P_CATEGORY)
+        if r < np.float32(cfg.p_revisit_walked):
+            c0 = 0
+        elif r < np.float32(cfg.p_revisit_walked + cfg.p_stumbled):
+            c0 = 1
+        elif r < np.float32(1.0 - cfg.p_bootstrap):
+            c0 = 2
+        else:
+            c0 = 3
+        for off in range(4):
+            p = picks[(c0 + off) % 4]
+            if p != NO_PEER:
+                return p
+        return NO_PEER
+
+    def _sample_intro(self, owner: int, slots: list[Slot], s_ix: int,
+                      exclude: int, salt_base: int) -> int:
+        """sample_introductions for one (owner, request-slot)."""
+        k = len(slots)
+        mask, prio = [], []
+        for j, s in enumerate(slots):
+            cat = self._category(s)
+            ok = (cat in (CAT_WALKED, CAT_STUMBLED)) and s.peer != exclude
+            mask.append(ok)
+            prio.append(rand_u32(self.seed, self.rnd, owner, P_INTRO,
+                                 s_ix * k + j + salt_base))
+        j = self._pick_by_priority(mask, prio)
+        return slots[j].peer if j >= 0 else NO_PEER
+
+    def _lost(self, peer: int, salt_base: int, salt: int) -> bool:
+        if self.cfg.packet_loss <= 0.0:
+            return False
+        u = rand_uniform(self.seed, self.rnd, peer, P_LOSS, salt + salt_base)
+        return u < np.float32(self.cfg.packet_loss)
+
+    # ---- store (ops/store.py mirror) ----------------------------------------
+
+    def _store_insert(self, owner: int, batch: list[Record],
+                      count_drops: bool = True) -> None:
+        """store_insert semantics: merge-sort, UNIQUE(member, gt) with the
+        existing entry winning, capacity keeps lowest-sorting records.
+
+        ``count_drops=False`` mirrors engine.create_messages, which folds
+        only n_inserted into the stats (an author's own insert never counts
+        as a drop there)."""
+        p = self.peers[owner]
+        m = self.cfg.msg_capacity
+        n_before = len(p.store)
+        n_new_valid = len(batch)
+        # (record_key, origin); stable sort by (gt, member, origin, meta, payload)
+        rows = ([(r, 0) for r in p.store] + [(r, 1) for r in batch])
+        rows.sort(key=lambda ro: (ro[0].gt, ro[0].member, ro[1],
+                                  ro[0].meta, ro[0].payload))
+        kept: list[tuple[Record, int]] = []
+        for r, o in rows:
+            if kept and kept[-1][0].gt == r.gt and kept[-1][0].member == r.member:
+                continue  # duplicate (gt, member): first (existing) wins
+            kept.append((r, o))
+        kept = kept[:m]
+        p.store = [r for r, _ in kept]
+        n_inserted = sum(1 for _, o in kept if o == 1)
+        n_surviving_old = sum(1 for _, o in kept if o == 0)
+        p.msgs_stored += n_inserted
+        if count_drops:
+            p.msgs_dropped += ((n_new_valid - n_inserted)
+                               + (n_before - n_surviving_old))
+
+    def _claim_slice(self, owner: int):
+        """(time_low, time_high, modulo, offset) — claim_slice_largest/_modulo."""
+        cfg = self.cfg
+        store = self.peers[owner].store
+        if cfg.sync_strategy == "modulo":
+            n_valid = len(store)
+            modulo = max((n_valid + cfg.bloom_capacity - 1) // cfg.bloom_capacity, 1)
+            return 1, 0, modulo, self.rnd % modulo
+        start = max(len(store) - cfg.bloom_capacity, 0)
+        if start == 0:
+            time_low = 1
+        else:
+            time_low = store[start].gt
+        return time_low, 0, 1, 0
+
+    def _in_slice(self, r: Record, sl) -> bool:
+        tlow, thigh, mod, off = sl
+        if r.gt < tlow:
+            return False
+        if thigh != 0 and r.gt > thigh:
+            return False
+        return (r.gt % max(mod, 1)) == off
+
+    def _fold_gt(self, owner: int, seen: list[int]) -> None:
+        p = self.peers[owner]
+        rng_range = self.cfg.acceptable_global_time_range
+        acceptable = [g for g in seen if g <= p.global_time + rng_range]
+        if acceptable:
+            p.global_time = max(p.global_time, max(acceptable))
+
+    # ---- setup mirrors ------------------------------------------------------
+
+    def create_messages(self, author_mask, meta: int, payload) -> None:
+        """engine.create_messages mirror."""
+        for i, p in enumerate(self.peers):
+            if not author_mask[i]:
+                continue
+            gt = p.global_time + 1
+            self._store_insert(i, [Record(gt, i, meta, int(payload[i]))],
+                               count_drops=False)
+            p.global_time = gt
+
+    def seed_overlay(self, degree: int) -> None:
+        """engine.seed_overlay mirror."""
+        cfg = self.cfg
+        t = cfg.n_trackers
+        span = cfg.n_peers - t
+        eligible_at = _f32(np.float32(0.0) - np.float32(cfg.eligibility_delay))
+        for i, p in enumerate(self.peers):
+            seen: set[int] = set()
+            for j in range(degree):
+                nbr = t + rand_u32(self.seed, 0xE1, i, P_GOSSIP, j) % span
+                if nbr == i:
+                    nbr = t + (nbr - t + 1) % span
+                if nbr in seen:   # one slot per neighbor (engine dedup)
+                    continue
+                seen.add(nbr)
+                s = p.slots[j]
+                s.peer = nbr
+                s.walk = eligible_at
+                s.stumble = s.intro = NEVER
+
+    # ---- the round ----------------------------------------------------------
+
+    def step(self) -> None:
+        cfg = self.cfg
+        n, t = cfg.n_peers, cfg.n_trackers
+        r = cfg.request_inbox
+        rt = cfg.tracker_inbox
+        seed, rnd = self.seed, self.rnd
+
+        # phase 0: churn
+        if cfg.churn_rate > 0.0:
+            for i, p in enumerate(self.peers):
+                if (p.alive and i >= t
+                        and rand_uniform(seed, rnd, i, P_CHURN)
+                        < np.float32(cfg.churn_rate)):
+                    p.slots = [Slot() for _ in range(cfg.k_candidates)]
+                    p.store = []
+                    p.global_time = 1
+                    p.session += 1
+
+        # phase 1: walker send + sync claim
+        targets = [NO_PEER] * n
+        if cfg.walker_enabled:
+            for i, p in enumerate(self.peers):
+                if p.alive and i >= t:
+                    targets[i] = self._sample_walk_target(i)
+
+        slices, blooms = [None] * n, [None] * n
+        if cfg.sync_enabled:
+            for i, p in enumerate(self.peers):
+                sl = self._claim_slice(i)
+                bloom = OracleBloom(cfg.bloom_bits, cfg.bloom_hashes)
+                for rec in p.store:
+                    if self._in_slice(rec, sl):
+                        bloom.add(rec.hash())
+                slices[i], blooms[i] = sl, bloom
+
+        send_ok = [False] * n
+        for i in range(n):
+            send_ok[i] = (self.peers[i].alive and targets[i] != NO_PEER
+                          and not self._lost(i, _LOSS_REQUEST, 0))
+
+        # request delivery (normal peers): edge order = sender order
+        req_inbox: list[list[int]] = [[] for _ in range(n)]   # sender ids
+        req_slot = [-1] * n                                    # sender's receipt
+        for i in range(n):
+            d = targets[i]
+            if send_ok[i] and not (0 <= d < t):
+                if len(req_inbox[d]) < r:
+                    req_slot[i] = len(req_inbox[d])
+                    req_inbox[d].append(i)
+                else:
+                    self.peers[d].requests_dropped += 1
+        # rq_ok also requires the *receiver* alive
+        rq_ok = [[self.peers[d].alive for _ in box]
+                 for d, box in enumerate(req_inbox)]
+
+        # snapshot sender clocks as they rode the request packet
+        req_gt = {i: self.peers[i].global_time for i in range(n)}
+
+        # phase 2: stumble + clock fold at the responder
+        for d in range(n):
+            for s_ix, src in enumerate(req_inbox[d]):
+                if rq_ok[d][s_ix]:
+                    self._upsert(d, src, KIND_STUMBLE)
+            self._fold_gt(d, [req_gt[src] for s_ix, src in enumerate(req_inbox[d])
+                              if rq_ok[d][s_ix]])
+
+        # phase 2t: tracker fast path
+        tq_inbox: list[list[int]] = [[] for _ in range(t)]
+        tq_slot = [-1] * n
+        intro_t: list[list[int]] = [[] for _ in range(t)]
+        if t > 0:
+            for i in range(n):
+                d = targets[i]
+                if send_ok[i] and 0 <= d < t:
+                    if len(tq_inbox[d]) < rt:
+                        tq_slot[i] = len(tq_inbox[d])
+                        tq_inbox[d].append(i)
+                    else:
+                        self.peers[d].requests_dropped += 1
+            tq_ok = [[self.peers[d].alive for _ in box]
+                     for d, box in enumerate(tq_inbox)]
+            k = cfg.k_candidates
+            kr = min(rt, k)
+            for d in range(t):
+                ring_slots = [((rnd * rt + j) % k) for j in range(kr)]
+                ring_src = [tq_inbox[d][j] if j < len(tq_inbox[d]) and tq_ok[d][j]
+                            else NO_PEER for j in range(kr)]
+                # stale clearing: returning requester's old entry wiped first
+                fresh = {s for s in ring_src if s != NO_PEER}
+                for s in self.peers[d].slots:
+                    if s.peer in fresh:
+                        s.peer = NO_PEER
+                        s.walk = s.stumble = s.intro = NEVER
+                for slot_ix, src in zip(ring_slots, ring_src):
+                    if src != NO_PEER:
+                        s = self.peers[d].slots[slot_ix]
+                        s.peer = src
+                        s.walk = s.intro = NEVER
+                        s.stumble = self.now
+                # introduction picks for each served request
+                for s_ix, src in enumerate(tq_inbox[d]):
+                    ring_pick = self._sample_intro(
+                        d, self.peers[d].slots, s_ix, src, _TRACKER_INTRO_SALT)
+                    if rt > 1:
+                        j = ((s_ix + 1 + rand_u32(seed, rnd, d, P_INTRO,
+                                                  s_ix + _TRACKER_INTRO_SALT
+                                                  + (1 << 18))
+                              % (rt - 1)) % rt)
+                    else:
+                        j = 0
+                    inbox_pick = (tq_inbox[d][j]
+                                  if j < len(tq_inbox[d]) and tq_ok[d][j]
+                                  else NO_PEER)
+                    if inbox_pick == src:
+                        inbox_pick = NO_PEER
+                    intro_t[d].append(inbox_pick if inbox_pick != NO_PEER
+                                      else ring_pick)
+                self._fold_gt(d, [req_gt[src] for s_ix, src in
+                                  enumerate(tq_inbox[d]) if tq_ok[d][s_ix]])
+
+        # introduction picks at normal responders
+        intro: list[list[int]] = [[] for _ in range(n)]
+        for d in range(n):
+            for s_ix, src in enumerate(req_inbox[d]):
+                ex = src if rq_ok[d][s_ix] else NO_PEER
+                intro[d].append(self._sample_intro(
+                    d, self.peers[d].slots, s_ix, ex, 0))
+
+        # puncture-request edges: normal responders (row-major), then trackers
+        pr_edges = []  # (dst=C, named requester A)
+        for d in range(n):
+            for s_ix in range(len(req_inbox[d])):
+                c = intro[d][s_ix]
+                a = req_inbox[d][s_ix]
+                if (rq_ok[d][s_ix] and c != NO_PEER
+                        and not self._lost(d, _LOSS_PUNCTURE_REQ, s_ix)):
+                    pr_edges.append((c, a))
+        for d in range(t):
+            for s_ix in range(len(tq_inbox[d])):
+                c = intro_t[d][s_ix]
+                a = tq_inbox[d][s_ix]
+                if (tq_ok[d][s_ix] and c != NO_PEER
+                        and not self._lost(d, _LOSS_PUNCTURE_REQ,
+                                           s_ix + _TRACKER_SALT)):
+                    pr_edges.append((c, a))
+        punc_req_inbox: list[list[int]] = [[] for _ in range(n)]
+        for c, a in pr_edges:
+            if 0 <= c < n:
+                if len(punc_req_inbox[c]) < r:
+                    punc_req_inbox[c].append(a)
+                else:
+                    self.peers[c].requests_dropped += 1
+        pq_ok = [[self.peers[c].alive for _ in box]
+                 for c, box in enumerate(punc_req_inbox)]
+        for c in range(n):
+            self.peers[c].punctures += sum(pq_ok[c])
+
+        # phase 4: puncture hop C -> A
+        pu_edges = []
+        for c in range(n):
+            for s_ix, a in enumerate(punc_req_inbox[c]):
+                if pq_ok[c][s_ix] and not self._lost(c, _LOSS_PUNCTURE, s_ix):
+                    pu_edges.append((a, c))
+        punc_inbox: list[list[int]] = [[] for _ in range(n)]
+        for a, c in pu_edges:
+            if 0 <= a < n:
+                if len(punc_inbox[a]) < r:
+                    punc_inbox[a].append(c)
+                else:
+                    self.peers[a].requests_dropped += 1
+        pu_ok = [[self.peers[a].alive for _ in box]
+                 for a, box in enumerate(punc_inbox)]
+
+        # phase 3: response pickup by receipt
+        got_resp = [False] * n
+        introduced = [NO_PEER] * n
+        resp_gt = [0] * n
+        for i in range(n):
+            d = targets[i]
+            if 0 <= d < t:
+                sl = tq_slot[i]
+                got = sl >= 0 and tq_ok[d][sl]
+                pick = intro_t[d][sl] if got else NO_PEER
+            else:
+                sl = req_slot[i]
+                got = sl >= 0 and rq_ok[d][sl] if d >= 0 else False
+                pick = intro[d][sl] if got else NO_PEER
+            got = (got and not self._lost(i, _LOSS_RESPONSE, 0)
+                   and self.peers[i].alive)
+            got_resp[i] = got
+            introduced[i] = pick if got else NO_PEER
+            resp_gt[i] = self.peers[d].global_time if d >= 0 else 0
+
+        for i in range(n):
+            if got_resp[i]:
+                self._upsert(i, targets[i], KIND_WALK)
+            if introduced[i] != NO_PEER:
+                self._upsert(i, introduced[i], KIND_INTRO)
+            for s_ix, c in enumerate(punc_inbox[i]):
+                if pu_ok[i][s_ix]:
+                    self._upsert(i, c, KIND_STUMBLE)
+            if got_resp[i]:
+                self._fold_gt(i, [resp_gt[i]])
+            walked_ok = self.peers[i].alive and targets[i] != NO_PEER
+            if walked_ok and got_resp[i]:
+                self.peers[i].walk_success += 1
+            elif walked_ok:
+                self.peers[i].walk_fail += 1
+                self._remove(i, targets[i])
+
+        # phase 2b/5: sync responder outbox + requester pickup
+        if cfg.sync_enabled:
+            b = cfg.response_budget
+            outbox: dict[tuple[int, int], list[Record]] = {}
+            for d in range(n):
+                for s_ix, src in enumerate(req_inbox[d]):
+                    sel: list[Record] = []
+                    if rq_ok[d][s_ix]:
+                        sl, bl = slices[src], blooms[src]
+                        for rec in self.peers[d].store:
+                            if len(sel) >= b:
+                                break
+                            if self._in_slice(rec, sl) and rec.hash() not in bl:
+                                sel.append(rec)
+                    outbox[(d, s_ix)] = sel
+            for i in range(n):
+                d = targets[i]
+                sl_ix = req_slot[i]
+                if sl_ix < 0 or not self.peers[i].alive:
+                    continue
+                recs = outbox.get((d, sl_ix), [])
+                batch = []
+                for j, rec in enumerate(recs):
+                    if self._lost(i, _LOSS_SYNC, j):
+                        continue
+                    if rec.gt <= (self.peers[i].global_time
+                                  + cfg.acceptable_global_time_range):
+                        batch.append(Record(rec.gt, rec.member, rec.meta,
+                                            rec.payload, rec.flags))
+                if batch:
+                    self._store_insert(i, batch)
+                    self._fold_gt(i, [rec.gt for rec in batch])
+
+        self.now = _f32(self.now + np.float32(cfg.walk_interval))
+        self.rnd += 1
+
+    # ---- comparison ---------------------------------------------------------
+
+    def state_arrays(self) -> dict:
+        """Dense arrays shaped like PeerState for trace-equality asserts."""
+        cfg = self.cfg
+        n, k, m = cfg.n_peers, cfg.k_candidates, cfg.msg_capacity
+        out = {
+            "alive": np.array([p.alive for p in self.peers]),
+            "session": np.array([p.session for p in self.peers], np.uint32),
+            "global_time": np.array([p.global_time for p in self.peers],
+                                    np.uint32),
+            "cand_peer": np.full((n, k), NO_PEER, np.int32),
+            "cand_last_walk": np.full((n, k), NEVER, np.float32),
+            "cand_last_stumble": np.full((n, k), NEVER, np.float32),
+            "cand_last_intro": np.full((n, k), NEVER, np.float32),
+            "store_gt": np.full((n, m), EMPTY_U32, np.uint32),
+            "store_member": np.full((n, m), EMPTY_U32, np.uint32),
+            "store_meta": np.full((n, m), EMPTY_U32, np.uint32),
+            "store_payload": np.full((n, m), EMPTY_U32, np.uint32),
+            "store_flags": np.zeros((n, m), np.uint32),
+            "walk_success": np.array([p.walk_success for p in self.peers],
+                                     np.uint32),
+            "walk_fail": np.array([p.walk_fail for p in self.peers], np.uint32),
+            "msgs_stored": np.array([p.msgs_stored for p in self.peers],
+                                    np.uint32),
+            "msgs_dropped": np.array([p.msgs_dropped for p in self.peers],
+                                     np.uint32),
+            "requests_dropped": np.array([p.requests_dropped
+                                          for p in self.peers], np.uint32),
+            "punctures": np.array([p.punctures for p in self.peers], np.uint32),
+        }
+        for i, p in enumerate(self.peers):
+            for j, s in enumerate(p.slots):
+                out["cand_peer"][i, j] = s.peer
+                out["cand_last_walk"][i, j] = s.walk
+                out["cand_last_stumble"][i, j] = s.stumble
+                out["cand_last_intro"][i, j] = s.intro
+            for j, rec in enumerate(p.store):
+                out["store_gt"][i, j] = rec.gt
+                out["store_member"][i, j] = rec.member
+                out["store_meta"][i, j] = rec.meta
+                out["store_payload"][i, j] = rec.payload
+                out["store_flags"][i, j] = rec.flags
+        return out
+
+
+def _self_test_rng():
+    """The oracle's rand mirrors ops/rng bit-for-bit (import-time cheap check)."""
+    import jax.numpy as jnp
+    s = fold_seed(123, 456)
+    js = _jrng.fold_seed(jnp.array([123, 456], jnp.uint32))
+    assert int(js) == s, (int(js), s)

@@ -1,0 +1,97 @@
+"""Trace equality: the jitted TPU engine vs the pure-Python oracle.
+
+Driver config #1's shape (tiny-N sync checked against a CPU reference):
+every field of PeerState must match the oracle bit-for-bit after every
+round, across walker, sync, loss, churn, and tracker paths.  This is the
+rebuild's deepest invariant — the reference encodes its equivalents as
+pairwise protocol tests over real loopback stacks (reference:
+tests/dispersytestclass.py, tests/debugcommunity/node.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispersy_tpu import engine as E
+from dispersy_tpu import state as S
+from dispersy_tpu.config import CommunityConfig
+from dispersy_tpu.oracle import sim as O
+
+BASE = CommunityConfig(n_peers=32, n_trackers=2, msg_capacity=32,
+                       bloom_capacity=16, k_candidates=8, request_inbox=4,
+                       tracker_inbox=8, response_budget=4)
+
+FIELDS = ["alive", "session", "global_time",
+          "cand_peer", "cand_last_walk", "cand_last_stumble", "cand_last_intro",
+          "store_gt", "store_member", "store_meta", "store_payload",
+          "store_flags"]
+STAT_FIELDS = ["walk_success", "walk_fail", "msgs_stored", "msgs_dropped",
+               "requests_dropped", "punctures"]
+
+
+def assert_match(state, oracle, rnd):
+    want = oracle.state_arrays()
+    for f in FIELDS:
+        got = np.asarray(getattr(state, f))
+        np.testing.assert_array_equal(got, want[f],
+                                      err_msg=f"round {rnd}: field {f}")
+    for f in STAT_FIELDS:
+        got = np.asarray(getattr(state.stats, f))
+        np.testing.assert_array_equal(got, want[f],
+                                      err_msg=f"round {rnd}: stat {f}")
+
+
+def run_both(cfg, rounds, seed=0, author=None, warm=None):
+    key = jax.random.PRNGKey(seed)
+    state = S.init_state(cfg, key)
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    if warm is not None:
+        state = E.seed_overlay(state, cfg, degree=warm)
+        oracle.seed_overlay(degree=warm)
+    if author is not None:
+        mask = np.arange(cfg.n_peers) == author
+        payload = np.full(cfg.n_peers, 42, np.uint32)
+        state = E.create_messages(state, cfg, jnp.asarray(mask), meta=1,
+                                  payload=jnp.asarray(payload))
+        oracle.create_messages(mask, meta=1, payload=payload)
+        assert_match(state, oracle, "setup")
+    for rnd in range(rounds):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+
+
+def test_rng_mirror():
+    O._self_test_rng()
+    # spot-check a few full draws
+    import dispersy_tpu.ops.rng as R
+    seed = O.fold_seed(7, 9)
+    jseed = R.fold_seed(jnp.array([7, 9], jnp.uint32))
+    for peer in (0, 3, 31):
+        for purpose in (O.P_SLOT, O.P_LOSS):
+            for salt in (0, 5, 1 << 20):
+                assert O.rand_u32(seed, 4, peer, purpose, salt) == int(
+                    R.rand_u32(jseed, jnp.uint32(4), jnp.uint32(peer),
+                               purpose, jnp.uint32(salt)))
+                assert O.rand_uniform(seed, 4, peer, purpose, salt) == float(
+                    R.rand_uniform(jseed, jnp.uint32(4), jnp.uint32(peer),
+                                   purpose, jnp.uint32(salt)))
+
+
+def test_trace_walker_cold_start():
+    run_both(BASE.replace(sync_enabled=False), rounds=12)
+
+
+def test_trace_full_sync_with_loss():
+    run_both(BASE.replace(packet_loss=0.15), rounds=12, author=5)
+
+
+def test_trace_churn_warm_overlay_modulo():
+    cfg = BASE.replace(churn_rate=0.08, sync_strategy="modulo", n_trackers=2)
+    run_both(cfg, rounds=12, author=7, warm=4)
+
+
+@pytest.mark.slow
+def test_trace_long_convergence():
+    run_both(BASE, rounds=40, author=3)

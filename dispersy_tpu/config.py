@@ -105,6 +105,14 @@ class CommunityConfig:
     # along the request edge, so per-round intake is exactly
     # request-count x response_budget by construction.
 
+    # ---- push forwarding (reference: dispersy.py store_update_forward ->
+    #      _forward: every freshly accepted/created sync message is pushed
+    #      to `node_count` random verified candidates, per
+    #      destination.py CommunityDestination(node_count=10)) ----
+    forward_fanout: int = 3             # candidates pushed to per record batch
+    forward_buffer: int = 4             # fresh records buffered per peer/round
+    push_inbox: int = 16                # pushed records accepted per peer/round
+
     # ---- clock (reference: community.py claim_global_time /
     #      dispersy_acceptable_global_time_range) ----
     acceptable_global_time_range: int = 10000
@@ -154,6 +162,8 @@ class CommunityConfig:
              + self.p_bootstrap)
         if abs(p - 1.0) > 1e-6:
             raise ValueError(f"walk category probabilities sum to {p}, not 1")
+        if self.forward_fanout > self.k_candidates:
+            raise ValueError("forward_fanout cannot exceed k_candidates")
 
     def replace(self, **kw) -> "CommunityConfig":
         return dataclasses.replace(self, **kw)

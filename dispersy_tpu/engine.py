@@ -64,6 +64,7 @@ _LOSS_RESPONSE = 1 << 16
 _LOSS_PUNCTURE_REQ = 2 << 16
 _LOSS_PUNCTURE = 3 << 16
 _LOSS_SYNC = 4 << 16
+_LOSS_FORWARD = 5 << 16
 _TRACKER_SALT = 1 << 15
 _TRACKER_INTRO_SALT = 1 << 20
 
@@ -134,10 +135,15 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             meta=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.meta),
             payload=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.payload),
             flags=jnp.where(r1, jnp.uint32(0), stc.flags))
+        fwd = tuple(jnp.where(r1, jnp.uint32(EMPTY_U32), c) for c in
+                    (state.fwd_gt, state.fwd_member, state.fwd_meta,
+                     state.fwd_payload))
         global_time = jnp.where(reborn, jnp.uint32(1), state.global_time)
         session = state.session + reborn.astype(jnp.uint32)
     else:
         tab, stc = _tab(state), _store(state)
+        fwd = (state.fwd_gt, state.fwd_member, state.fwd_meta,
+               state.fwd_payload)
         global_time, session = state.global_time, state.session
 
     alive = state.alive
@@ -166,6 +172,45 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         zu = jnp.zeros((n,), jnp.uint32)
         sl = st.SyncSlice(time_low=zu, time_high=zu, modulo=zu, offset=zu)
         my_bloom = jnp.zeros((n, cfg.bloom_words), jnp.uint32)
+
+    # ---- phase 1f: push forwarding (store_update_forward's _forward) ----
+    # Last round's fresh records go to `forward_fanout` distinct verified
+    # candidates — the epidemic *push* on top of Bloom-sync's pull.  One
+    # candidate set per peer per round, shared by the whole batch, exactly
+    # like the reference's per-batch candidate pick.
+    if cfg.forward_fanout > 0:
+        f, c = cfg.forward_buffer, cfg.forward_fanout
+        fwd_targets = cand.sample_forward_targets(tab, now, cfg, seed, rnd,
+                                                  idx)          # [N, C]
+        fwd_gt, fwd_member, fwd_meta, fwd_payload = fwd
+        have_rec = (fwd_gt != jnp.uint32(EMPTY_U32))[:, :, None]  # [N, F, 1]
+        tgt_ok = (fwd_targets != NO_PEER)[:, None, :]             # [N, 1, C]
+        fc_salt = (jnp.arange(f)[:, None] * c
+                   + jnp.arange(c)[None, :])[None, :, :]          # [1, F, C]
+        push_lost = _lost(seed, rnd, idx[:, None, None], _LOSS_FORWARD,
+                          fc_salt, cfg.packet_loss)
+        push_valid = (alive[:, None, None] & have_rec & tgt_ok & ~push_lost)
+        push_dst = jnp.broadcast_to(fwd_targets[:, None, :], (n, f, c))
+
+        def bcast(col):
+            return jnp.broadcast_to(col[:, :, None], (n, f, c)).reshape(-1)
+        push = inbox.deliver(
+            dst=push_dst.reshape(-1),
+            cols=[bcast(fwd_gt), bcast(fwd_member), bcast(fwd_meta),
+                  bcast(fwd_payload)],
+            valid=push_valid.reshape(-1), n_peers=n,
+            inbox_size=cfg.push_inbox)
+        ph_gt, ph_member, ph_meta, ph_payload = push.inbox       # [N, P]
+        ph_ok = push.inbox_valid & alive[:, None]
+        stats = stats.replace(
+            msgs_forwarded=stats.msgs_forwarded
+            + jnp.sum(push_valid, axis=(1, 2)).astype(jnp.uint32),
+            msgs_dropped=stats.msgs_dropped
+            + push.n_dropped.astype(jnp.uint32))
+    else:
+        p0 = jnp.zeros((n, 0), jnp.uint32)
+        ph_gt = ph_member = ph_meta = ph_payload = p0
+        ph_ok = jnp.zeros((n, 0), bool)
 
     req_lost = _lost(seed, rnd, idx, _LOSS_REQUEST, 0, cfg.packet_loss)
     send_ok = alive & (target != NO_PEER) & ~req_lost
@@ -422,24 +467,66 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                           jnp.arange(b)[None, :], cfg.packet_loss)
         sy_ok = (obox_ok[tgt, slot_n] & (req.edge_slot >= 0)[:, None]
                  & alive[:, None] & ~sync_lost)
+    else:
+        s0 = jnp.zeros((n, 0), jnp.uint32)
+        sy_gt = sy_member = sy_meta = sy_payload = s0
+        sy_ok = jnp.zeros((n, 0), bool)
+
+    # ---- phase 5: combined intake (sync pull + push) -> store ----------
+    # One batch per round: sync records first, then pushed records, in
+    # delivery order — mirroring the reference's _on_batch_cache handling
+    # one grouped batch per meta per window.
+    in_gt = jnp.concatenate([sy_gt, ph_gt], axis=1)               # [N, B]
+    in_member = jnp.concatenate([sy_member, ph_member], axis=1)
+    in_meta = jnp.concatenate([sy_meta, ph_meta], axis=1)
+    in_payload = jnp.concatenate([sy_payload, ph_payload], axis=1)
+    in_ok = jnp.concatenate([sy_ok, ph_ok], axis=1)
+    bb = in_gt.shape[1]
+    if bb > 0:
         # Clock-jump defense before the store accepts anything.
-        acceptable = sy_gt <= global_time[:, None] + jnp.uint32(
-            cfg.acceptable_global_time_range)
-        sy_ok = sy_ok & acceptable
+        in_ok = in_ok & (in_gt <= global_time[:, None] + jnp.uint32(
+            cfg.acceptable_global_time_range))
+        # Freshness (drives next round's forward batch): not already in the
+        # store on the UNIQUE(member, global_time) identity, and not a
+        # duplicate of an earlier record in this same batch.
+        in_store = jnp.any(
+            (stc.gt[:, None, :] == in_gt[:, :, None])
+            & (stc.member[:, None, :] == in_member[:, :, None]), axis=-1)
+        earlier = jnp.arange(bb)[None, :] < jnp.arange(bb)[:, None]  # [B, B]
+        dup_in_batch = jnp.any(
+            (in_gt[:, :, None] == in_gt[:, None, :])
+            & (in_member[:, :, None] == in_member[:, None, :])
+            & in_ok[:, None, :] & earlier[None, :, :], axis=-1)
+        fresh = in_ok & ~in_store & ~dup_in_batch                 # [N, B]
+
         ins = st.store_insert(
             stc,
-            st.StoreCols(gt=sy_gt, member=sy_member, meta=sy_meta,
-                         payload=sy_payload,
-                         flags=jnp.zeros_like(sy_gt)),
-            new_mask=sy_ok)
+            st.StoreCols(gt=in_gt, member=in_member, meta=in_meta,
+                         payload=in_payload, flags=jnp.zeros_like(in_gt)),
+            new_mask=in_ok)
         stc = ins.store
-        global_time = _fold_gt(global_time, sy_gt, sy_ok,
+        global_time = _fold_gt(global_time, in_gt, in_ok,
                                cfg.acceptable_global_time_range)
         stats = stats.replace(
             msgs_stored=stats.msgs_stored + ins.n_inserted.astype(jnp.uint32),
             msgs_dropped=stats.msgs_dropped
             + ins.n_dropped.astype(jnp.uint32)
             + ins.n_evicted.astype(jnp.uint32))
+
+        # Next round's forward batch = first F fresh records of this batch.
+        fb = cfg.forward_buffer
+        rank = jnp.cumsum(fresh.astype(jnp.int32), axis=1) - 1
+        fslot = jnp.where(fresh & (rank < fb), rank, fb)
+        rows_all = idx[:, None]
+
+        def fcompact(col):
+            return (jnp.full((n, fb + 1), EMPTY_U32, jnp.uint32)
+                    .at[rows_all, fslot].set(col)[:, :fb])
+        fwd = (fcompact(in_gt), fcompact(in_member), fcompact(in_meta),
+               fcompact(in_payload))
+    else:
+        e0 = jnp.full((n, cfg.forward_buffer), EMPTY_U32, jnp.uint32)
+        fwd = (e0, e0, e0, e0)
 
     # ---- wrap up --------------------------------------------------------
     return state.replace(
@@ -448,6 +535,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         cand_last_stumble=tab.last_stumble, cand_last_intro=tab.last_intro,
         store_gt=stc.gt, store_member=stc.member, store_meta=stc.meta,
         store_payload=stc.payload, store_flags=stc.flags,
+        fwd_gt=fwd[0], fwd_member=fwd[1], fwd_meta=fwd[2], fwd_payload=fwd[3],
         stats=stats,
         time=now + jnp.float32(cfg.walk_interval),
         round_index=rnd + jnp.uint32(1),
@@ -473,10 +561,24 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
         payload=jnp.asarray(payload, jnp.uint32).reshape(cfg.n_peers, 1),
         flags=jnp.zeros((cfg.n_peers, 1), jnp.uint32))
     ins = st.store_insert(_store(state), new, author_mask[:, None])
+    # A created record also enters the forward batch (the reference calls
+    # store_update_forward on create — forward=True pushes it immediately).
+    fslot = st.count_valid(state.fwd_gt)                       # first free slot
+    can_buf = author_mask & (fslot < cfg.forward_buffer)
+    rows = jnp.arange(cfg.n_peers)
+    put = (jnp.minimum(fslot, cfg.forward_buffer - 1),)
+
+    def buf(cur, val):
+        return cur.at[rows, put[0]].set(
+            jnp.where(can_buf, val, cur[rows, put[0]]))
     return state.replace(
         store_gt=ins.store.gt, store_member=ins.store.member,
         store_meta=ins.store.meta, store_payload=ins.store.payload,
         store_flags=ins.store.flags,
+        fwd_gt=buf(state.fwd_gt, new.gt[:, 0]),
+        fwd_member=buf(state.fwd_member, new.member[:, 0]),
+        fwd_meta=buf(state.fwd_meta, new.meta[:, 0]),
+        fwd_payload=buf(state.fwd_payload, new.payload[:, 0]),
         global_time=jnp.where(author_mask, gt_new, state.global_time),
         stats=state.stats.replace(
             msgs_stored=state.stats.msgs_stored

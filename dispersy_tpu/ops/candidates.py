@@ -212,6 +212,33 @@ def sample_walk_target(tab: CandTable, now: jnp.ndarray, cfg: CommunityConfig,
     return jnp.where(jnp.any(avail, axis=0), target, NO_PEER).astype(jnp.int32)
 
 
+def sample_forward_targets(tab: CandTable, now: jnp.ndarray,
+                           cfg: CommunityConfig, seed: jnp.ndarray,
+                           round_index: jnp.ndarray,
+                           self_idx: jnp.ndarray) -> jnp.ndarray:
+    """``forward_fanout`` distinct verified candidates per peer: the push
+    targets for this round's forward batch.
+
+    Reference: dispersy.py ``_forward`` picks ``node_count`` random distinct
+    candidates once per message batch (destination.py
+    ``CommunityDestination``).  Top-C of per-slot uniform hash priorities
+    over the verified slots == uniform sampling without replacement.
+    Returns i32[N, C] with NO_PEER filling when fewer candidates exist.
+    """
+    n, k = tab.peer.shape
+    c = cfg.forward_fanout
+    cats = categories(tab, now, cfg)
+    verified = (cats == CAT_WALKED) | (cats == CAT_STUMBLED)     # [N, K]
+    prio = rng.rand_u32(seed, round_index, self_idx[:, None], rng.P_GOSSIP,
+                        jnp.arange(k)[None, :] + jnp.uint32(1 << 8))
+    score = (prio >> jnp.uint32(1)) | (verified.astype(jnp.uint32)
+                                       << jnp.uint32(31))
+    top_scores, top_slots = lax.top_k(score, c)                  # [N, C]
+    picked = jnp.take_along_axis(tab.peer, top_slots, axis=1)
+    ok = (top_scores >> jnp.uint32(31)) == 1                     # was verified
+    return jnp.where(ok, picked, NO_PEER).astype(jnp.int32)
+
+
 def sample_introductions(tab: CandTable, now: jnp.ndarray, cfg: CommunityConfig,
                          seed: jnp.ndarray, round_index: jnp.ndarray,
                          self_idx: jnp.ndarray, exclude: jnp.ndarray,

@@ -39,6 +39,7 @@ _LOSS_RESPONSE = 1 << 16
 _LOSS_PUNCTURE_REQ = 2 << 16
 _LOSS_PUNCTURE = 3 << 16
 _LOSS_SYNC = 4 << 16
+_LOSS_FORWARD = 5 << 16
 _TRACKER_SALT = 1 << 15
 _TRACKER_INTRO_SALT = 1 << 20
 
@@ -119,10 +120,12 @@ class OraclePeer:
         self.global_time = 1
         self.slots = [Slot() for _ in range(cfg.k_candidates)]
         self.store: list[Record] = []   # kept sorted by Record.key()
+        self.fwd: list[Record] = []     # forward batch for next round
         # stats
         self.walk_success = self.walk_fail = 0
         self.msgs_stored = self.msgs_dropped = 0
         self.requests_dropped = self.punctures = 0
+        self.msgs_forwarded = 0
 
 
 class OracleSim:
@@ -322,6 +325,8 @@ class OracleSim:
             gt = p.global_time + 1
             self._store_insert(i, [Record(gt, i, meta, int(payload[i]))],
                                count_drops=False)
+            if len(p.fwd) < self.cfg.forward_buffer:
+                p.fwd.append(Record(gt, i, meta, int(payload[i])))
             p.global_time = gt
 
     def seed_overlay(self, degree: int) -> None:
@@ -361,6 +366,7 @@ class OracleSim:
                         < np.float32(cfg.churn_rate)):
                     p.slots = [Slot() for _ in range(cfg.k_candidates)]
                     p.store = []
+                    p.fwd = []
                     p.global_time = 1
                     p.session += 1
 
@@ -385,6 +391,35 @@ class OracleSim:
         for i in range(n):
             send_ok[i] = (self.peers[i].alive and targets[i] != NO_PEER
                           and not self._lost(i, _LOSS_REQUEST, 0))
+
+        # phase 1f: push forwarding (engine phase 1f — last round's fresh
+        # records to forward_fanout distinct verified candidates, targets
+        # sampled from the pre-stumble candidate table)
+        push_inbox: list[list[Record]] = [[] for _ in range(n)]
+        if cfg.forward_fanout > 0:
+            cc = cfg.forward_fanout
+            k = cfg.k_candidates
+            for i, p in enumerate(self.peers):
+                score = []
+                for j, s in enumerate(p.slots):
+                    ver = self._category(s) in (CAT_WALKED, CAT_STUMBLED)
+                    pr = rand_u32(seed, rnd, i, P_GOSSIP, j + (1 << 8))
+                    score.append(((pr >> 1) | ((1 << 31) if ver else 0), ver))
+                order = sorted(range(k), key=lambda j: (-score[j][0], j))[:cc]
+                tgts = [p.slots[j].peer if score[j][1] else NO_PEER
+                        for j in order]
+                sent = 0
+                for fi, rec in enumerate(p.fwd):
+                    for ci, tc in enumerate(tgts):
+                        if (p.alive and tc != NO_PEER
+                                and not self._lost(i, _LOSS_FORWARD,
+                                                   fi * cc + ci)):
+                            sent += 1
+                            if len(push_inbox[tc]) < cfg.push_inbox:
+                                push_inbox[tc].append(rec)
+                            else:
+                                self.peers[tc].msgs_dropped += 1
+                p.msgs_forwarded += sent
 
         # request delivery (normal peers): edge order = sender order
         req_inbox: list[list[int]] = [[] for _ in range(n)]   # sender ids
@@ -556,10 +591,10 @@ class OracleSim:
                 self.peers[i].walk_fail += 1
                 self._remove(i, targets[i])
 
-        # phase 2b/5: sync responder outbox + requester pickup
+        # phase 2b: sync responder outboxes
+        outbox: dict[tuple[int, int], list[Record]] = {}
         if cfg.sync_enabled:
             b = cfg.response_budget
-            outbox: dict[tuple[int, int], list[Record]] = {}
             for d in range(n):
                 for s_ix, src in enumerate(req_inbox[d]):
                     sel: list[Record] = []
@@ -571,23 +606,37 @@ class OracleSim:
                             if self._in_slice(rec, sl) and rec.hash() not in bl:
                                 sel.append(rec)
                     outbox[(d, s_ix)] = sel
-            for i in range(n):
-                d = targets[i]
-                sl_ix = req_slot[i]
-                if sl_ix < 0 or not self.peers[i].alive:
-                    continue
-                recs = outbox.get((d, sl_ix), [])
-                batch = []
-                for j, rec in enumerate(recs):
-                    if self._lost(i, _LOSS_SYNC, j):
-                        continue
-                    if rec.gt <= (self.peers[i].global_time
-                                  + cfg.acceptable_global_time_range):
-                        batch.append(Record(rec.gt, rec.member, rec.meta,
-                                            rec.payload, rec.flags))
-                if batch:
-                    self._store_insert(i, batch)
-                    self._fold_gt(i, [rec.gt for rec in batch])
+
+        # phase 5: combined intake (sync pull + push) -> store + fwd batch
+        for i in range(n):
+            p = self.peers[i]
+            batch: list[Record] = []
+            if cfg.sync_enabled and p.alive and req_slot[i] >= 0:
+                recs = outbox.get((targets[i], req_slot[i]), [])
+                batch.extend(rec for j, rec in enumerate(recs)
+                             if not self._lost(i, _LOSS_SYNC, j))
+            if p.alive:
+                batch.extend(push_inbox[i])
+            # clock-jump defense (engine: post-walk-fold clock)
+            ok_batch = [rec for rec in batch
+                        if rec.gt <= (p.global_time
+                                      + cfg.acceptable_global_time_range)]
+            # freshness: drives next round's forward batch
+            store_keys = {(r.gt, r.member) for r in p.store}
+            fresh: list[Record] = []
+            seen: set[tuple[int, int]] = set()
+            for rec in ok_batch:
+                k2 = (rec.gt, rec.member)
+                if k2 not in store_keys and k2 not in seen:
+                    fresh.append(rec)
+                seen.add(k2)
+            if ok_batch:
+                self._store_insert(i, [Record(r.gt, r.member, r.meta,
+                                              r.payload, r.flags)
+                                       for r in ok_batch])
+                self._fold_gt(i, [r.gt for r in ok_batch])
+            p.fwd = [Record(r.gt, r.member, r.meta, r.payload, r.flags)
+                     for r in fresh[:cfg.forward_buffer]]
 
         self.now = _f32(self.now + np.float32(cfg.walk_interval))
         self.rnd += 1
@@ -612,6 +661,14 @@ class OracleSim:
             "store_meta": np.full((n, m), EMPTY_U32, np.uint32),
             "store_payload": np.full((n, m), EMPTY_U32, np.uint32),
             "store_flags": np.zeros((n, m), np.uint32),
+            "fwd_gt": np.full((n, cfg.forward_buffer), EMPTY_U32, np.uint32),
+            "fwd_member": np.full((n, cfg.forward_buffer), EMPTY_U32,
+                                  np.uint32),
+            "fwd_meta": np.full((n, cfg.forward_buffer), EMPTY_U32, np.uint32),
+            "fwd_payload": np.full((n, cfg.forward_buffer), EMPTY_U32,
+                                   np.uint32),
+            "msgs_forwarded": np.array([p.msgs_forwarded for p in self.peers],
+                                       np.uint32),
             "walk_success": np.array([p.walk_success for p in self.peers],
                                      np.uint32),
             "walk_fail": np.array([p.walk_fail for p in self.peers], np.uint32),
@@ -635,6 +692,11 @@ class OracleSim:
                 out["store_meta"][i, j] = rec.meta
                 out["store_payload"][i, j] = rec.payload
                 out["store_flags"][i, j] = rec.flags
+            for j, rec in enumerate(p.fwd):
+                out["fwd_gt"][i, j] = rec.gt
+                out["fwd_member"][i, j] = rec.member
+                out["fwd_meta"][i, j] = rec.meta
+                out["fwd_payload"][i, j] = rec.payload
         return out
 
 

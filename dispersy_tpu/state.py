@@ -45,6 +45,7 @@ class Stats:
     msgs_dropped: jnp.ndarray     # u32[N] records dropped (inbox/store full)
     requests_dropped: jnp.ndarray  # u32[N] intro-requests dropped (inbox full)
     punctures: jnp.ndarray        # u32[N] punctures sent (as introduced peer)
+    msgs_forwarded: jnp.ndarray   # u32[N] push-forward packets sent
 
 
 @struct.dataclass
@@ -72,6 +73,15 @@ class PeerState:
     pending_target: jnp.ndarray  # i32[N], NO_PEER = none outstanding
     pending_since: jnp.ndarray   # f32[N]
 
+    # ---- forward buffer [N, F]: records to push next round -------------
+    # (reference: dispersy.py store_update_forward -> _forward sends each
+    #  freshly accepted/created sync message to `node_count` candidates,
+    #  per CommunityDestination; EMPTY_U32 gt marks an empty slot)
+    fwd_gt: jnp.ndarray       # u32
+    fwd_member: jnp.ndarray   # u32
+    fwd_meta: jnp.ndarray     # u32
+    fwd_payload: jnp.ndarray  # u32
+
     # ---- timeline (timeline.py; bounded authorized-member table) ----
     auth_member: jnp.ndarray     # u32[N, A], EMPTY_U32 = empty slot
     auth_grant_gt: jnp.ndarray   # u32[N, A] global_time of the authorize
@@ -94,7 +104,8 @@ def init_stats(n: int) -> Stats:
     def z():
         return jnp.zeros((n,), jnp.uint32)
     return Stats(walk_success=z(), walk_fail=z(), msgs_stored=z(),
-                 msgs_dropped=z(), requests_dropped=z(), punctures=z())
+                 msgs_dropped=z(), requests_dropped=z(), punctures=z(),
+                 msgs_forwarded=z())
 
 
 def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
@@ -106,6 +117,7 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
     """
     n, k, m, a = (config.n_peers, config.k_candidates, config.msg_capacity,
                   config.k_authorized)
+    f = config.forward_buffer
 
     def never():  # distinct buffers: aliasing breaks donation
         return jnp.full((n, k), NEVER, jnp.float32)
@@ -125,6 +137,10 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         store_flags=jnp.zeros((n, m), jnp.uint32),
         pending_target=jnp.full((n,), NO_PEER, jnp.int32),
         pending_since=jnp.full((n,), NEVER, jnp.float32),
+        fwd_gt=jnp.full((n, f), EMPTY_U32, jnp.uint32),
+        fwd_member=jnp.full((n, f), EMPTY_U32, jnp.uint32),
+        fwd_meta=jnp.full((n, f), EMPTY_U32, jnp.uint32),
+        fwd_payload=jnp.full((n, f), EMPTY_U32, jnp.uint32),
         auth_member=jnp.full((n, a), EMPTY_U32, jnp.uint32),
         auth_grant_gt=jnp.zeros((n, a), jnp.uint32),
         auth_meta_mask=jnp.zeros((n, a), jnp.uint32),

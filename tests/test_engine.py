@@ -116,6 +116,28 @@ def test_global_time_propagates():
     assert (gt[2:] >= 2).all(), gt[:10]
 
 
+def test_push_forward_accelerates_broadcast():
+    """The forward path (store_update_forward -> _forward) floods a fresh
+    record ahead of pull-sync repair: convergence must be strictly faster
+    with fanout than without, and forwarded-packet counters must move."""
+    def rounds_to_full(cfg):
+        st = S.init_state(cfg, jax.random.PRNGKey(11))
+        st = E.seed_overlay(st, cfg, degree=6)
+        st = E.create_messages(st, cfg, jnp.arange(cfg.n_peers) == 9,
+                               meta=1, payload=jnp.full(cfg.n_peers, 5))
+        for rnd in range(60):
+            st = E.step(st, cfg)
+            if float(E.coverage(st, member=9, gt=2, meta=1, payload=5)) == 1.0:
+                return rnd + 1, st
+        return 61, st
+
+    slow_rounds, st_slow = rounds_to_full(BASE.replace(forward_fanout=0))
+    fast_rounds, st_fast = rounds_to_full(BASE.replace(forward_fanout=4))
+    assert fast_rounds < slow_rounds, (fast_rounds, slow_rounds)
+    assert int(np.asarray(st_fast.stats.msgs_forwarded).sum()) > 0
+    assert int(np.asarray(st_slow.stats.msgs_forwarded).sum()) == 0
+
+
 def test_modulo_claim_strategy_runs():
     cfg = BASE.replace(sync_strategy="modulo")
     st = run(cfg, 60, author=5)

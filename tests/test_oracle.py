@@ -25,9 +25,9 @@ BASE = CommunityConfig(n_peers=32, n_trackers=2, msg_capacity=32,
 FIELDS = ["alive", "session", "global_time",
           "cand_peer", "cand_last_walk", "cand_last_stumble", "cand_last_intro",
           "store_gt", "store_member", "store_meta", "store_payload",
-          "store_flags"]
+          "store_flags", "fwd_gt", "fwd_member", "fwd_meta", "fwd_payload"]
 STAT_FIELDS = ["walk_success", "walk_fail", "msgs_stored", "msgs_dropped",
-               "requests_dropped", "punctures"]
+               "requests_dropped", "punctures", "msgs_forwarded"]
 
 
 def assert_match(state, oracle, rnd):

@@ -8,44 +8,59 @@ v5e-8; ``vs_baseline`` is measured rounds/sec over that 10k bar, scaled by
 the fraction of 1M peers actually simulated (so partial-population runs
 don't overstate).
 
-Prints exactly one JSON line.
+Always prints exactly ONE JSON line on stdout, whatever the backend does.
+The round-1 driver run died inside TPU backend init (and the backend can
+also *hang*, not just error), so the measurement itself runs in a worker
+subprocess: ``python bench.py --worker`` does the real timing on whatever
+platform JAX resolves; the parent tries the TPU environment first under a
+timeout, then falls back to a scrubbed-environment CPU run, and emits an
+``"error"`` JSON line only if both fail.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
-from dispersy_tpu import engine
-from dispersy_tpu.config import CommunityConfig
-from dispersy_tpu.state import init_state
+from dispersy_tpu.cpuenv import cpu_env
 
 NORTH_STAR_ROUNDS_PER_SEC = 10_000.0
 NORTH_STAR_PEERS = 1_000_000
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+# Generous but bounded: the driver must receive a JSON line even when the
+# TPU tunnel wedges during backend init (observed: >120 s hang).
+TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
+CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
 
 
-def pick_config() -> CommunityConfig:
+def _worker() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from dispersy_tpu import engine
+    from dispersy_tpu.config import CommunityConfig
+    from dispersy_tpu.state import init_state
+
     platform = jax.devices()[0].platform
     if platform == "tpu":
         # Config #3-shaped load (Bloom-sync with a real backlog) at the
         # largest population one chip holds comfortably.
         n = 1 << 20  # 1,048,576 peers
-        return CommunityConfig(
+        cfg = CommunityConfig(
             n_peers=n, n_trackers=8, k_candidates=16, msg_capacity=48,
             bloom_capacity=48, request_inbox=4, tracker_inbox=1024,
             response_budget=8, churn_rate=0.0)
-    # CPU fallback (no TPU attached): same shape, small population.
-    return CommunityConfig(
-        n_peers=1 << 14, n_trackers=4, k_candidates=16, msg_capacity=64,
-        bloom_capacity=64, request_inbox=4, tracker_inbox=256,
-        response_budget=8, churn_rate=0.0)
+    else:
+        # CPU fallback (no TPU attached): same shape, small population.
+        cfg = CommunityConfig(
+            n_peers=1 << 13, n_trackers=4, k_candidates=16, msg_capacity=64,
+            bloom_capacity=64, request_inbox=4, tracker_inbox=256,
+            response_budget=8, churn_rate=0.0)
 
-
-def main() -> None:
-    cfg = pick_config()
     state = init_state(cfg, jax.random.PRNGKey(0))
     state = engine.seed_overlay(state, cfg, degree=8)
     authors = jnp.arange(cfg.n_peers) % 64 == 63
@@ -58,7 +73,7 @@ def main() -> None:
         state = engine.step(state, cfg)
     jax.block_until_ready(state)
 
-    n_rounds = 30 if jax.devices()[0].platform == "tpu" else 10
+    n_rounds = 30 if platform == "tpu" else 10
     t0 = time.perf_counter()
     for _ in range(n_rounds):
         state = engine.step(state, cfg)
@@ -71,10 +86,64 @@ def main() -> None:
         "metric": f"sync_rounds_per_sec_{cfg.n_peers}_peers",
         "value": round(rounds_per_sec, 3),
         "unit": "rounds/s",
-        "vs_baseline": round(rounds_per_sec * scale / NORTH_STAR_ROUNDS_PER_SEC,
-                             4),
+        "vs_baseline": round(
+            rounds_per_sec * scale / NORTH_STAR_ROUNDS_PER_SEC, 4),
+        "platform": platform,
     }))
 
 
+def _try_worker(env: dict, timeout_s: int) -> dict | None:
+    """Run one worker; return its parsed JSON result or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            cwd=_REPO_ROOT, env=env, timeout=timeout_s,
+            capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        print("bench worker timed out", file=sys.stderr)
+        return None
+    sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(out, dict) and "metric" in out:
+            return out
+    return None
+
+
+def main() -> None:
+    # Attempt 1: whatever the ambient environment resolves (the TPU tunnel
+    # when it is up).  Attempt 2: scrubbed CPU environment.
+    result = None
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        result = _try_worker(dict(os.environ), TPU_TIMEOUT_S)
+        if result is not None and result.get("platform") != "tpu":
+            # Ambient env quietly fell back to CPU at a tiny population —
+            # keep it only if the dedicated CPU attempt fails too.
+            cpu_result = result
+            result = None
+        else:
+            cpu_result = None
+    else:
+        cpu_result = None
+    if result is None:
+        result = _try_worker(cpu_env(), CPU_TIMEOUT_S) or cpu_result
+    if result is None:
+        result = {
+            "metric": "sync_rounds_per_sec", "value": 0.0, "unit": "rounds/s",
+            "vs_baseline": 0.0,
+            "error": "all bench workers failed or timed out "
+                     "(TPU backend unavailable and CPU fallback failed)",
+        }
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        _worker()
+    else:
+        main()

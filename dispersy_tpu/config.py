@@ -31,6 +31,27 @@ CAT_WALKED = 1
 CAT_STUMBLED = 2
 CAT_INTRODUCED = 3
 
+# Reserved control meta-message ids (reference: community.py
+# _initialize_meta_messages registers the dispersy-* control messages beside
+# the app's metas; here user metas take ids [0, n_meta) and controls live in
+# a reserved band well above them).  A record's columns are overloaded per
+# meta:
+#   dispersy-authorize / dispersy-revoke: payload = target member,
+#       aux = permit-permission bitmask over user meta ids
+#       (reference: message.py Authorize/RevokePayload carries
+#       [(member, message, permission)] triples; the bitmask is that list,
+#       TPU-packed)
+#   dispersy-undo-own / dispersy-undo-other: payload = target member,
+#       aux = target global_time (reference: payload.py UndoPayload
+#       (member, global_time, packet))
+META_AUTHORIZE = 0xF0
+META_REVOKE = 0xF1
+META_UNDO_OWN = 0xF2
+META_UNDO_OTHER = 0xF3
+# Max user metas: permission bitmasks live in the low bits of a uint32 and
+# bit 31 flags a revoke row in the auth table.
+MAX_USER_META = 24
+
 
 def bloom_size_for(error_rate: float, capacity: int) -> tuple[int, int]:
     """(n_bits, n_hashes) for a Bloom filter with the given design point.
@@ -127,6 +148,18 @@ class CommunityConfig:
     timeline_enabled: bool = False
     k_authorized: int = 16              # authorized-member slots per peer
     n_meta: int = 8                     # distinct user meta-message ids
+    # Bit i set: user meta i is LinearResolution-protected — a record is
+    # accepted only if its author holds the permit permission at the
+    # record's global_time (reference: resolution.py LinearResolution +
+    # timeline.py Timeline.check).  Unset bits are PublicResolution.
+    protected_meta_mask: int = 0
+    # The community founder: implicit holder of every permission, and the
+    # only member whose authorize/revoke/undo-other records are accepted
+    # (reference: community.py master member — the permission root; the
+    # reference walks proof *chains* from it, the rebuild models one
+    # delegation level, which is how real Dispersy overlays used it).
+    # -1 = auto: the first non-tracker peer (index n_trackers).
+    founder_member: int = -1
 
     # ------------------------------------------------------------------
     @property
@@ -153,6 +186,11 @@ class CommunityConfig:
     def eligibility_delay_rounds(self) -> float:
         return self.eligibility_delay / self.walk_interval
 
+    @property
+    def founder(self) -> int:
+        """Resolved founder index (founder_member with -1 defaulted)."""
+        return self.n_trackers if self.founder_member < 0 else self.founder_member
+
     def __post_init__(self) -> None:
         if self.n_peers <= 0:
             raise ValueError("n_peers must be positive")
@@ -164,6 +202,20 @@ class CommunityConfig:
             raise ValueError(f"walk category probabilities sum to {p}, not 1")
         if self.forward_fanout > self.k_candidates:
             raise ValueError("forward_fanout cannot exceed k_candidates")
+        if self.forward_fanout > 0 and (self.forward_buffer < 1
+                                        or self.push_inbox < 1):
+            raise ValueError("forward_fanout > 0 requires forward_buffer >= 1 "
+                             "and push_inbox >= 1")
+        if not (1 <= self.n_meta <= MAX_USER_META):
+            raise ValueError(f"n_meta must be in [1, {MAX_USER_META}]")
+        if self.protected_meta_mask >> self.n_meta:
+            raise ValueError("protected_meta_mask has bits above n_meta")
+        if self.timeline_enabled:
+            f = self.founder
+            if not (self.n_trackers <= f < self.n_peers):
+                raise ValueError("founder_member must be a non-tracker peer")
+            if self.k_authorized < 1:
+                raise ValueError("timeline_enabled requires k_authorized >= 1")
 
     def replace(self, **kw) -> "CommunityConfig":
         return dataclasses.replace(self, **kw)

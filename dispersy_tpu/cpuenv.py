@@ -1,0 +1,41 @@
+"""Scrubbed child-process environments pinned to the CPU backend.
+
+Shared by the driver-facing entry points (``__graft_entry__.py``,
+``bench.py``): both need to run JAX work in a subprocess that cannot be
+hijacked by the axon TPU-tunnel plugin, whose ``sitecustomize`` hook on
+PYTHONPATH *prepends* itself to ``jax_platforms`` and whose backend init
+can hang when the tunnel is half-up (the round-1 driver artifacts recorded
+exactly that: BENCH_r01 rc=1, MULTICHIP_r01 rc=124).
+
+This module must not import jax: it runs in parent processes that may have
+no usable backend at all.
+"""
+
+from __future__ import annotations
+
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def cpu_env(n_devices: int | None = None) -> dict:
+    """An environment forcing the CPU backend, axon hook removed.
+
+    ``n_devices``: if given, request that many virtual CPU devices via
+    ``xla_force_host_platform_device_count`` (any pre-existing count flag is
+    replaced); if None, XLA_FLAGS is left alone.
+    """
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p) or REPO_ROOT
+    if n_devices is not None:
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    # Re-use compile caches across driver invocations.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    return env

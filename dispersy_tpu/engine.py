@@ -51,10 +51,13 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from dispersy_tpu.config import EMPTY_U32, NO_PEER, CommunityConfig
+from dispersy_tpu.config import (EMPTY_U32, META_AUTHORIZE, META_REVOKE,
+                                 META_UNDO_OTHER, META_UNDO_OWN, NO_PEER,
+                                 CommunityConfig)
 from dispersy_tpu.ops import bloom, candidates as cand, inbox, rng, store as st
+from dispersy_tpu.ops import timeline as tl
 from dispersy_tpu.ops.hashing import record_hash
-from dispersy_tpu.state import NEVER, PeerState
+from dispersy_tpu.state import FLAG_UNDONE, NEVER, PeerState
 
 # Loss-draw salt blocks: one disjoint block per packet kind so every logical
 # packet flips an independent Bernoulli coin.  Within a block, the normal
@@ -88,7 +91,12 @@ def _tab(state: PeerState) -> cand.CandTable:
 def _store(state: PeerState) -> st.StoreCols:
     return st.StoreCols(gt=state.store_gt, member=state.store_member,
                         meta=state.store_meta, payload=state.store_payload,
-                        flags=state.store_flags)
+                        aux=state.store_aux, flags=state.store_flags)
+
+
+def _auth(state: PeerState) -> tl.AuthTable:
+    return tl.AuthTable(member=state.auth_member, mask=state.auth_mask,
+                        gt=state.auth_gt)
 
 
 def _fold_gt(own_gt: jnp.ndarray, seen_gt: jnp.ndarray, seen_valid: jnp.ndarray,
@@ -134,16 +142,25 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             member=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.member),
             meta=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.meta),
             payload=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.payload),
+            aux=jnp.where(r1, jnp.uint32(0), stc.aux),
             flags=jnp.where(r1, jnp.uint32(0), stc.flags))
         fwd = tuple(jnp.where(r1, jnp.uint32(EMPTY_U32), c) for c in
                     (state.fwd_gt, state.fwd_member, state.fwd_meta,
-                     state.fwd_payload))
+                     state.fwd_payload, state.fwd_aux))
+        # The auth table is folded from the (wiped) store, so it wipes too:
+        # a reborn peer re-learns permissions as authorize records re-sync
+        # (reference: Timeline is rebuilt from the database on load).
+        auth = tl.AuthTable(
+            member=jnp.where(r1, jnp.uint32(EMPTY_U32), state.auth_member),
+            mask=jnp.where(r1, jnp.uint32(0), state.auth_mask),
+            gt=jnp.where(r1, jnp.uint32(0), state.auth_gt))
         global_time = jnp.where(reborn, jnp.uint32(1), state.global_time)
         session = state.session + reborn.astype(jnp.uint32)
     else:
         tab, stc = _tab(state), _store(state)
         fwd = (state.fwd_gt, state.fwd_member, state.fwd_meta,
-               state.fwd_payload)
+               state.fwd_payload, state.fwd_aux)
+        auth = _auth(state)
         global_time, session = state.global_time, state.session
 
     alive = state.alive
@@ -182,7 +199,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         f, c = cfg.forward_buffer, cfg.forward_fanout
         fwd_targets = cand.sample_forward_targets(tab, now, cfg, seed, rnd,
                                                   idx)          # [N, C]
-        fwd_gt, fwd_member, fwd_meta, fwd_payload = fwd
+        fwd_gt, fwd_member, fwd_meta, fwd_payload, fwd_aux = fwd
         have_rec = (fwd_gt != jnp.uint32(EMPTY_U32))[:, :, None]  # [N, F, 1]
         tgt_ok = (fwd_targets != NO_PEER)[:, None, :]             # [N, 1, C]
         fc_salt = (jnp.arange(f)[:, None] * c
@@ -197,10 +214,10 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         push = inbox.deliver(
             dst=push_dst.reshape(-1),
             cols=[bcast(fwd_gt), bcast(fwd_member), bcast(fwd_meta),
-                  bcast(fwd_payload)],
+                  bcast(fwd_payload), bcast(fwd_aux)],
             valid=push_valid.reshape(-1), n_peers=n,
             inbox_size=cfg.push_inbox)
-        ph_gt, ph_member, ph_meta, ph_payload = push.inbox       # [N, P]
+        ph_gt, ph_member, ph_meta, ph_payload, ph_aux = push.inbox  # [N, P]
         ph_ok = push.inbox_valid & alive[:, None]
         stats = stats.replace(
             msgs_forwarded=stats.msgs_forwarded
@@ -209,7 +226,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             + push.n_dropped.astype(jnp.uint32))
     else:
         p0 = jnp.zeros((n, 0), jnp.uint32)
-        ph_gt = ph_member = ph_meta = ph_payload = p0
+        ph_gt = ph_member = ph_meta = ph_payload = ph_aux = p0
         ph_ok = jnp.zeros((n, 0), bool)
 
     req_lost = _lost(seed, rnd, idx, _LOSS_REQUEST, 0, cfg.packet_loss)
@@ -434,7 +451,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     if cfg.sync_enabled:
         b = cfg.response_budget
         rec_h2 = record_hash(stc.member, stc.gt, stc.meta, stc.payload)
-        gts, members, metas, payloads, valids = [], [], [], [], []
+        gts, members, metas, payloads, auxs, valids = [], [], [], [], [], []
         rows = idx[:, None]
         for s in range(r):
             sl_s = st.SyncSlice(time_low=rq_tlow[:, s], time_high=rq_thigh[:, s],
@@ -456,12 +473,14 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             members.append(compact(stc.member, EMPTY_U32))
             metas.append(compact(stc.meta, EMPTY_U32))
             payloads.append(compact(stc.payload, EMPTY_U32))
+            auxs.append(compact(stc.aux, 0))
             valids.append(compact(missing, False))
-        obox = [jnp.stack(c, axis=1) for c in (gts, members, metas, payloads)]
+        obox = [jnp.stack(c, axis=1)
+                for c in (gts, members, metas, payloads, auxs)]
         obox_ok = jnp.stack(valids, axis=1)                       # [N, R, b]
 
         # Requester pickup by receipt + per-record Bernoulli loss.
-        sy_gt, sy_member, sy_meta, sy_payload = (
+        sy_gt, sy_member, sy_meta, sy_payload, sy_aux = (
             c[tgt, slot_n] for c in obox)                         # [N, b]
         sync_lost = _lost(seed, rnd, idx[:, None], _LOSS_SYNC,
                           jnp.arange(b)[None, :], cfg.packet_loss)
@@ -469,7 +488,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                  & alive[:, None] & ~sync_lost)
     else:
         s0 = jnp.zeros((n, 0), jnp.uint32)
-        sy_gt = sy_member = sy_meta = sy_payload = s0
+        sy_gt = sy_member = sy_meta = sy_payload = sy_aux = s0
         sy_ok = jnp.zeros((n, 0), bool)
 
     # ---- phase 5: combined intake (sync pull + push) -> store ----------
@@ -480,6 +499,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     in_member = jnp.concatenate([sy_member, ph_member], axis=1)
     in_meta = jnp.concatenate([sy_meta, ph_meta], axis=1)
     in_payload = jnp.concatenate([sy_payload, ph_payload], axis=1)
+    in_aux = jnp.concatenate([sy_aux, ph_aux], axis=1)
     in_ok = jnp.concatenate([sy_ok, ph_ok], axis=1)
     bb = in_gt.shape[1]
     if bb > 0:
@@ -497,21 +517,92 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             (in_gt[:, :, None] == in_gt[:, None, :])
             & (in_member[:, :, None] == in_member[:, None, :])
             & in_ok[:, None, :] & earlier[None, :, :], axis=-1)
-        fresh = in_ok & ~in_store & ~dup_in_batch                 # [N, B]
 
+        in_flags = jnp.zeros_like(in_gt)
+        if cfg.timeline_enabled:
+            # The receive pipeline's check step (reference: dispersy.py
+            # _on_batch_cache -> meta.check_callback -> timeline.py
+            # Timeline.check).  Control records carry their own authority
+            # rule; user records with a protected meta need a permit grant.
+            founder = jnp.uint32(cfg.founder)
+            is_auth = in_meta == jnp.uint32(META_AUTHORIZE)
+            is_rev = in_meta == jnp.uint32(META_REVOKE)
+            is_undo_own = in_meta == jnp.uint32(META_UNDO_OWN)
+            is_undo_other = in_meta == jnp.uint32(META_UNDO_OTHER)
+            is_undo = is_undo_own | is_undo_other
+            is_ctrl = is_auth | is_rev | is_undo
+            # authorize/revoke/undo-other: founder-only (one delegation
+            # level — see ops/timeline.py).  undo-own: author undoes itself.
+            ctrl_ok = jnp.where(is_undo_own, in_member == in_payload,
+                                in_member == founder)
+
+            # Fold freshly learned authorize/revoke records FIRST: a grant
+            # and a granted record arriving in one batch must accept (the
+            # reference's batch handler processes authorize metas before
+            # the messages they permit).
+            fresh0 = in_ok & ~in_store & ~dup_in_batch
+            user_bits = jnp.uint32((1 << cfg.n_meta) - 1)
+            fr = tl.fold(auth, target=in_payload, mask=in_aux & user_bits,
+                         gt=in_gt, is_revoke=is_rev,
+                         valid=fresh0 & (is_auth | is_rev) & ctrl_ok)
+            auth = fr.table
+
+            # LinearResolution check against the updated table.
+            prot = jnp.uint32(cfg.protected_meta_mask)
+            shift = jnp.minimum(in_meta, jnp.uint32(31))
+            protected = (((prot >> shift) & 1) == 1) & (in_meta < 32)
+            permitted = tl.check(auth, in_member, in_meta, in_gt,
+                                 cfg.founder)
+            accept = in_ok & jnp.where(
+                is_ctrl, ctrl_ok, jnp.where(protected, permitted, True))
+
+            # Arriving records whose undo is already stored come in
+            # pre-undone (the reference re-marks on re-insert attempts).
+            undo_rows = ((stc.meta == jnp.uint32(META_UNDO_OWN))
+                         | (stc.meta == jnp.uint32(META_UNDO_OTHER)))
+            pre_undone = (in_meta < 32) & jnp.any(
+                undo_rows[:, None, :]
+                & (stc.payload[:, None, :] == in_member[:, :, None])
+                & (stc.aux[:, None, :] == in_gt[:, :, None]), axis=-1)
+            in_flags = jnp.where(pre_undone, jnp.uint32(FLAG_UNDONE),
+                                 jnp.uint32(0))
+            stats = stats.replace(
+                msgs_rejected=stats.msgs_rejected
+                + jnp.sum(in_ok & ~accept, axis=1).astype(jnp.uint32),
+                msgs_dropped=stats.msgs_dropped
+                + fr.n_dropped.astype(jnp.uint32))
+        else:
+            accept = in_ok
+
+        fresh = accept & ~in_store & ~dup_in_batch                # [N, B]
         ins = st.store_insert(
             stc,
             st.StoreCols(gt=in_gt, member=in_member, meta=in_meta,
-                         payload=in_payload, flags=jnp.zeros_like(in_gt)),
-            new_mask=in_ok)
+                         payload=in_payload, aux=in_aux, flags=in_flags),
+            new_mask=accept)
         stc = ins.store
-        global_time = _fold_gt(global_time, in_gt, in_ok,
+        global_time = _fold_gt(global_time, in_gt, accept,
                                cfg.acceptable_global_time_range)
         stats = stats.replace(
             msgs_stored=stats.msgs_stored + ins.n_inserted.astype(jnp.uint32),
             msgs_dropped=stats.msgs_dropped
             + ins.n_dropped.astype(jnp.uint32)
             + ins.n_evicted.astype(jnp.uint32))
+
+        if cfg.timeline_enabled:
+            # Apply this batch's accepted undo records to the (post-insert)
+            # store, so an undo and its target landing together still mark
+            # (reference: community.py on_undo sets the sync row's `undone`).
+            # Control rows are never markable — the reference forbids
+            # undoing dispersy-* metas.
+            batch_undo = accept & is_undo
+            hit = jnp.any(
+                batch_undo[:, None, :]
+                & (stc.member[:, :, None] == in_payload[:, None, :])
+                & (stc.gt[:, :, None] == in_aux[:, None, :]), axis=-1)
+            hit = hit & (stc.meta < 32)
+            stc = stc._replace(flags=jnp.where(
+                hit, stc.flags | jnp.uint32(FLAG_UNDONE), stc.flags))
 
         # Next round's forward batch = first F fresh records of this batch.
         fb = cfg.forward_buffer
@@ -523,10 +614,10 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             return (jnp.full((n, fb + 1), EMPTY_U32, jnp.uint32)
                     .at[rows_all, fslot].set(col)[:, :fb])
         fwd = (fcompact(in_gt), fcompact(in_member), fcompact(in_meta),
-               fcompact(in_payload))
+               fcompact(in_payload), fcompact(in_aux))
     else:
         e0 = jnp.full((n, cfg.forward_buffer), EMPTY_U32, jnp.uint32)
-        fwd = (e0, e0, e0, e0)
+        fwd = (e0, e0, e0, e0, e0)
 
     # ---- wrap up --------------------------------------------------------
     return state.replace(
@@ -534,8 +625,10 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         cand_peer=tab.peer, cand_last_walk=tab.last_walk,
         cand_last_stumble=tab.last_stumble, cand_last_intro=tab.last_intro,
         store_gt=stc.gt, store_member=stc.member, store_meta=stc.meta,
-        store_payload=stc.payload, store_flags=stc.flags,
+        store_payload=stc.payload, store_aux=stc.aux, store_flags=stc.flags,
         fwd_gt=fwd[0], fwd_member=fwd[1], fwd_meta=fwd[2], fwd_payload=fwd[3],
+        fwd_aux=fwd[4],
+        auth_member=auth.member, auth_mask=auth.mask, auth_gt=auth.gt,
         stats=stats,
         time=now + jnp.float32(cfg.walk_interval),
         round_index=rnd + jnp.uint32(1),
@@ -544,7 +637,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
 
 def create_messages(state: PeerState, cfg: CommunityConfig,
                     author_mask: jnp.ndarray, meta: int,
-                    payload: jnp.ndarray) -> PeerState:
+                    payload: jnp.ndarray,
+                    aux: jnp.ndarray | None = None) -> PeerState:
     """Application send: each masked peer authors one sync-distributed record.
 
     Mirrors ``Community.create_<message>`` for a FullSyncDistribution meta
@@ -552,33 +646,81 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
     ``claim_global_time``): the author claims global_time+1, signs (identity
     is the peer index in simulation), and stores locally; epidemic spread
     then happens through the Bloom-sync rounds.
+
+    With ``cfg.timeline_enabled`` the author side of ``Timeline.check`` runs
+    too (the reference refuses to create a message the local timeline would
+    reject): control metas enforce their authority rule, protected metas
+    need a permit grant in the *author's own* table, and accepted
+    authorize/revoke/undo records act on the author's own state immediately
+    (reference: store_update_forward processes a created message locally).
     """
+    n = cfg.n_peers
+    idx = jnp.arange(n, dtype=jnp.uint32)
+    if aux is None:
+        aux = jnp.zeros((n,), jnp.uint32)
+    aux = jnp.asarray(aux, jnp.uint32).reshape(n)
+    payload = jnp.asarray(payload, jnp.uint32).reshape(n)
+    auth = _auth(state)
     gt_new = state.global_time + jnp.uint32(1)
+
+    if cfg.timeline_enabled:
+        if meta in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER):
+            allowed = idx == jnp.uint32(cfg.founder)
+        elif meta == META_UNDO_OWN:
+            allowed = payload == idx
+        elif meta < 32 and (cfg.protected_meta_mask >> meta) & 1:
+            allowed = tl.check(auth, idx[:, None],
+                               jnp.full((n, 1), meta, jnp.uint32),
+                               gt_new[:, None], cfg.founder)[:, 0]
+        else:
+            allowed = jnp.ones((n,), bool)
+        author_mask = author_mask & allowed
+
     new = st.StoreCols(
         gt=gt_new[:, None],
-        member=jnp.arange(cfg.n_peers, dtype=jnp.uint32)[:, None],
-        meta=jnp.full((cfg.n_peers, 1), meta, jnp.uint32),
-        payload=jnp.asarray(payload, jnp.uint32).reshape(cfg.n_peers, 1),
-        flags=jnp.zeros((cfg.n_peers, 1), jnp.uint32))
+        member=idx[:, None],
+        meta=jnp.full((n, 1), meta, jnp.uint32),
+        payload=payload[:, None],
+        aux=aux[:, None],
+        flags=jnp.zeros((n, 1), jnp.uint32))
     ins = st.store_insert(_store(state), new, author_mask[:, None])
+    stc = ins.store
+
+    if cfg.timeline_enabled and meta in (META_AUTHORIZE, META_REVOKE):
+        # The author's own table learns its own grant/revoke at create time.
+        fr = tl.fold(auth, target=payload[:, None],
+                     mask=(aux & jnp.uint32((1 << cfg.n_meta) - 1))[:, None],
+                     gt=gt_new[:, None],
+                     is_revoke=jnp.full((n, 1), meta == META_REVOKE),
+                     valid=author_mask[:, None])
+        auth = fr.table
+    if cfg.timeline_enabled and meta in (META_UNDO_OWN, META_UNDO_OTHER):
+        # Mark the target row in the author's own store immediately.
+        hit = (author_mask[:, None] & (stc.member == payload[:, None])
+               & (stc.gt == aux[:, None]) & (stc.meta < 32))
+        stc = stc._replace(flags=jnp.where(
+            hit, stc.flags | jnp.uint32(FLAG_UNDONE), stc.flags))
+
     # A created record also enters the forward batch (the reference calls
     # store_update_forward on create — forward=True pushes it immediately).
     fslot = st.count_valid(state.fwd_gt)                       # first free slot
     can_buf = author_mask & (fslot < cfg.forward_buffer)
-    rows = jnp.arange(cfg.n_peers)
+    rows = jnp.arange(n)
     put = (jnp.minimum(fslot, cfg.forward_buffer - 1),)
 
     def buf(cur, val):
         return cur.at[rows, put[0]].set(
             jnp.where(can_buf, val, cur[rows, put[0]]))
     return state.replace(
-        store_gt=ins.store.gt, store_member=ins.store.member,
-        store_meta=ins.store.meta, store_payload=ins.store.payload,
-        store_flags=ins.store.flags,
+        store_gt=stc.gt, store_member=stc.member,
+        store_meta=stc.meta, store_payload=stc.payload,
+        store_aux=stc.aux, store_flags=stc.flags,
         fwd_gt=buf(state.fwd_gt, new.gt[:, 0]),
         fwd_member=buf(state.fwd_member, new.member[:, 0]),
         fwd_meta=buf(state.fwd_meta, new.meta[:, 0]),
         fwd_payload=buf(state.fwd_payload, new.payload[:, 0]),
+        fwd_aux=buf(state.fwd_aux, new.aux[:, 0]),
+        auth_member=auth.member, auth_mask=auth.mask, auth_gt=auth.gt,
         global_time=jnp.where(author_mask, gt_new, state.global_time),
         stats=state.stats.replace(
             msgs_stored=state.stats.msgs_stored

@@ -34,7 +34,6 @@ P_BOOTSTRAP = 4  # which tracker to bootstrap from
 P_CHURN = 5      # does this peer churn out this round
 P_LOSS = 6       # per-packet Bernoulli loss
 P_GOSSIP = 7     # forwarding fan-out choice (CommunityDestination)
-P_EVICT = 8      # tie-breaks in candidate eviction
 
 
 def fold_seed(key: jnp.ndarray) -> jnp.ndarray:

@@ -35,11 +35,19 @@ _EMPTY = np.uint32(EMPTY_U32)
 
 
 class StoreCols(NamedTuple):
-    """One peer-store (or record batch): uint32 columns, same shape."""
+    """One peer-store (or record batch): uint32 columns, same shape.
+
+    ``aux`` is the record's second payload word, overloaded per meta
+    (config.py reserved-meta table): permission bitmask for authorize/
+    revoke, target global_time for undo, sequence number for
+    sequence-enabled metas.  ``flags`` is receiver-local derived state
+    (bit 0 = undone) and never travels on the wire.
+    """
     gt: jnp.ndarray
     member: jnp.ndarray
     meta: jnp.ndarray
     payload: jnp.ndarray
+    aux: jnp.ndarray
     flags: jnp.ndarray
 
     @property
@@ -50,6 +58,7 @@ class StoreCols(NamedTuple):
 def empty_records(shape) -> StoreCols:
     e = jnp.full(shape, _EMPTY, jnp.uint32)
     return StoreCols(gt=e, member=e, meta=e, payload=e,
+                     aux=jnp.zeros(shape, jnp.uint32),
                      flags=jnp.zeros(shape, jnp.uint32))
 
 
@@ -90,6 +99,7 @@ def store_insert(store: StoreCols, new: StoreCols,
         member=jnp.where(new_mask, new.member, _EMPTY),
         meta=jnp.where(new_mask, new.meta, _EMPTY),
         payload=jnp.where(new_mask, new.payload, _EMPTY),
+        aux=jnp.where(new_mask, new.aux, 0),
         flags=jnp.where(new_mask, new.flags, 0),
     )
     # Also guard against EMPTY sentinel gt arriving as a "new" record.
@@ -102,10 +112,13 @@ def store_insert(store: StoreCols, new: StoreCols,
 
     # Lexicographic sort; origin as 3rd key makes the existing entry the
     # first of any (gt, member) duplicate group regardless of its
-    # (meta, payload) relative to the duplicate's.
-    gt, member, origin, meta, payload, flags = lax.sort(
-        (cat.gt, cat.member, origin, cat.meta, cat.payload, cat.flags),
-        dimension=-1, num_keys=5)
+    # (meta, payload) relative to the duplicate's.  aux is a key too:
+    # lax.sort is not stable, so two same-keyed records differing only in
+    # aux must still order deterministically for the oracle to replay.
+    gt, member, origin, meta, payload, aux, flags = lax.sort(
+        (cat.gt, cat.member, origin, cat.meta, cat.payload, cat.aux,
+         cat.flags),
+        dimension=-1, num_keys=6)
 
     dup = jnp.zeros_like(gt, dtype=bool).at[..., 1:].set(
         (gt[..., 1:] == gt[..., :-1]) & (member[..., 1:] == member[..., :-1])
@@ -114,15 +127,17 @@ def store_insert(store: StoreCols, new: StoreCols,
     member = jnp.where(dup, _EMPTY, member)
     meta = jnp.where(dup, _EMPTY, meta)
     payload = jnp.where(dup, _EMPTY, payload)
+    aux = jnp.where(dup, 0, aux)
     flags = jnp.where(dup, 0, flags)
     origin = jnp.where(dup, 0, origin)
 
     # Compact: killed/hole entries (gt == EMPTY) sort to the end; truncate.
-    gt, member, meta, payload, origin, flags = lax.sort(
-        (gt, member, meta, payload, origin, flags), dimension=-1, num_keys=4)
+    gt, member, meta, payload, origin, aux, flags = lax.sort(
+        (gt, member, meta, payload, origin, aux, flags), dimension=-1,
+        num_keys=4)
     out = StoreCols(gt=gt[..., :m], member=member[..., :m],
                     meta=meta[..., :m], payload=payload[..., :m],
-                    flags=flags[..., :m])
+                    aux=aux[..., :m], flags=flags[..., :m])
     kept = gt[..., :m] != _EMPTY
     n_inserted = jnp.sum((origin[..., :m] == 1) & kept,
                          axis=-1).astype(jnp.int32)

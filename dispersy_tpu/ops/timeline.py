@@ -1,0 +1,122 @@
+"""Timeline kernels: the permission engine as a bounded grant table.
+
+The reference's ``Timeline`` (reference: timeline.py — ``Timeline.check``,
+``.authorize``, ``.revoke``, ``.get_resolution_policy``) evaluates every
+LinearResolution message against the permission state *at that message's
+global_time*, where the state is folded from ``dispersy-authorize`` /
+``dispersy-revoke`` messages that themselves spread epidemically.  The
+proof-chain machinery (DelayMessageByProof, missing-proof round trips)
+exists to fetch grants that have not arrived yet; in the round-synchronous
+simulation a record whose grant is missing is simply *rejected this round*
+— the store never learns it, so the Bloom exchange keeps offering it and it
+is accepted on a later round once the authorize record has spread.  Same
+fixed point, no delay queue.
+
+TPU recast: each peer holds a bounded ``[A]`` table of grant/revoke rows
+(member, meta-bitmask + revoke flag in bit 31, global_time of the
+authorizing record).  ``check`` is a broadcast-compare over the table;
+``fold`` inserts freshly synced authorize/revoke records.  Rows are never
+merged: the latest-at-or-before-gt row decides, with revoke beating a grant
+at the same global_time (the reference orders equal-time proofs by packet
+and rejects on conflict; a deterministic revoke-wins rule is the simulation
+equivalent).
+
+The founder (``CommunityConfig.founder``) holds every permission implicitly
+and is the root of authority — the rebuild models one delegation level
+(founder authorizes members) rather than arbitrary proof chains; see
+config.py ``founder_member``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from dispersy_tpu.config import EMPTY_U32
+
+# Bit 31 of a table row's mask marks a revoke row.  (Plain int, not a jnp
+# scalar: module import must not touch a JAX backend.)
+REVOKE_BIT = 1 << 31
+
+
+class AuthTable(NamedTuple):
+    """[N, A] grant/revoke rows; ``member == EMPTY_U32`` marks a free slot."""
+    member: jnp.ndarray  # u32[N, A] member the row applies to
+    mask: jnp.ndarray    # u32[N, A] user-meta bitmask; bit 31 = revoke row
+    gt: jnp.ndarray      # u32[N, A] global_time the row takes effect
+
+
+def check(tab: AuthTable, member: jnp.ndarray, meta: jnp.ndarray,
+          gt: jnp.ndarray, founder: int) -> jnp.ndarray:
+    """Is ``member`` permitted to emit ``meta`` at ``gt``?  [N, B] verdicts.
+
+    Mirrors ``Timeline.check`` for the permit permission: the latest
+    grant/revoke row for (member, meta) at global_time <= gt decides;
+    revoke wins a tie at equal global_time; no row at all means not
+    permitted.  The founder is always permitted.
+
+    ``member``/``meta``/``gt`` are [N, B] record fields checked against each
+    receiving peer's own table.
+    """
+    # Clamped shift: control metas (>= 32) never match a mask bit, and a
+    # shift >= the bit width would be undefined in XLA.
+    sh = jnp.minimum(meta, jnp.uint32(31))
+    bit = ((tab.mask[:, None, :] >> sh[:, :, None]) & jnp.uint32(1)
+           & (meta < 32)[:, :, None].astype(jnp.uint32))             # [N,B,A]
+    match = ((tab.member[:, None, :] == member[:, :, None])
+             & (tab.member[:, None, :] != jnp.uint32(EMPTY_U32))
+             & (bit == 1)
+             & (tab.gt[:, None, :] <= gt[:, :, None]))
+    row_gt = jnp.where(match, tab.gt[:, None, :], 0)
+    best = jnp.max(row_gt, axis=-1)                                   # [N, B]
+    at_best = match & (row_gt == best[:, :, None])
+    is_revoke = (tab.mask[:, None, :] & jnp.uint32(REVOKE_BIT)) != 0
+    granted = (jnp.any(at_best & ~is_revoke, axis=-1)
+               & ~jnp.any(at_best & is_revoke, axis=-1)
+               & jnp.any(match, axis=-1))
+    return granted | (member == jnp.uint32(founder))
+
+
+class FoldResult(NamedTuple):
+    table: AuthTable
+    n_dropped: jnp.ndarray  # i32[N] rows lost (table full)
+
+
+def fold(tab: AuthTable, target: jnp.ndarray, mask: jnp.ndarray,
+         gt: jnp.ndarray, is_revoke: jnp.ndarray,
+         valid: jnp.ndarray) -> FoldResult:
+    """Insert [N, B] accepted authorize/revoke records into each table.
+
+    Mirrors ``Timeline.authorize``/``.revoke`` folding stored proof into the
+    permission state.  Idempotent per (member, mask, gt) row — an evicted
+    record that re-syncs after store overflow must not eat a second slot.
+    Overflow drops the new row, counted (bounded state, as everywhere).
+    """
+    n, b = target.shape
+    row_mask = jnp.where(is_revoke, mask | jnp.uint32(REVOKE_BIT),
+                         mask).astype(jnp.uint32)
+
+    def body(i, carry):
+        t, dropped = carry
+        tg = lax.dynamic_index_in_dim(target, i, axis=1)     # [N, 1]
+        mk = lax.dynamic_index_in_dim(row_mask, i, axis=1)
+        g = lax.dynamic_index_in_dim(gt, i, axis=1)
+        ok = lax.dynamic_index_in_dim(valid, i, axis=1)      # [N, 1]
+        dup = jnp.any((t.member == tg) & (t.mask == mk) & (t.gt == g),
+                      axis=1, keepdims=True)
+        want = ok & ~dup
+        free = t.member == jnp.uint32(EMPTY_U32)             # [N, A]
+        slot = jnp.argmax(free, axis=1)                      # first free
+        can = jnp.any(free, axis=1, keepdims=True) & want
+        hit = (jnp.arange(t.member.shape[1]) == slot[:, None]) & can
+        return (AuthTable(
+            member=jnp.where(hit, tg, t.member),
+            mask=jnp.where(hit, mk, t.mask),
+            gt=jnp.where(hit, g, t.gt)),
+            dropped + (want & ~can)[:, 0].astype(jnp.int32))
+
+    init = (tab, jnp.zeros((n,), jnp.int32))
+    t, dropped = lax.fori_loop(0, b, body, init) if b > 0 else init
+    return FoldResult(table=t, n_dropped=dropped)

@@ -25,9 +25,14 @@ from __future__ import annotations
 
 import numpy as np
 
-from dispersy_tpu.config import EMPTY_U32, NO_PEER, CommunityConfig
+from dispersy_tpu.config import (EMPTY_U32, META_AUTHORIZE, META_REVOKE,
+                                 META_UNDO_OTHER, META_UNDO_OWN, NO_PEER,
+                                 CommunityConfig)
 from dispersy_tpu.oracle.bloom import OracleBloom, record_hash
 from dispersy_tpu.ops import rng as _jrng
+
+REVOKE_BIT = 1 << 31
+FLAG_UNDONE = 1
 
 M32 = 0xFFFFFFFF
 NEVER = np.float32(-1.0e9)
@@ -88,19 +93,33 @@ def _f32(x) -> np.float32:
 
 
 class Record:
-    """One sync-table row: (global_time, member, meta, payload, flags)."""
+    """One sync-table row: (global_time, member, meta, payload, aux, flags)."""
 
-    __slots__ = ("gt", "member", "meta", "payload", "flags")
+    __slots__ = ("gt", "member", "meta", "payload", "aux", "flags")
 
-    def __init__(self, gt, member, meta, payload, flags=0):
+    def __init__(self, gt, member, meta, payload, aux=0, flags=0):
         self.gt, self.member, self.meta = int(gt), int(member), int(meta)
-        self.payload, self.flags = int(payload), int(flags)
+        self.payload, self.aux = int(payload), int(aux)
+        self.flags = int(flags)
 
     def key(self):
         return (self.gt, self.member, self.meta, self.payload)
 
+    def copy(self) -> "Record":
+        return Record(self.gt, self.member, self.meta, self.payload,
+                      self.aux, self.flags)
+
     def hash(self) -> int:
         return record_hash(self.member, self.gt, self.meta, self.payload)
+
+
+class AuthRow:
+    """One grant/revoke row (ops/timeline.py AuthTable mirror)."""
+
+    __slots__ = ("member", "mask", "gt")
+
+    def __init__(self, member, mask, gt):
+        self.member, self.mask, self.gt = int(member), int(mask), int(gt)
 
 
 class Slot:
@@ -121,11 +140,12 @@ class OraclePeer:
         self.slots = [Slot() for _ in range(cfg.k_candidates)]
         self.store: list[Record] = []   # kept sorted by Record.key()
         self.fwd: list[Record] = []     # forward batch for next round
+        self.auth: list[AuthRow] = []   # bounded at cfg.k_authorized
         # stats
         self.walk_success = self.walk_fail = 0
         self.msgs_stored = self.msgs_dropped = 0
         self.requests_dropped = self.punctures = 0
-        self.msgs_forwarded = 0
+        self.msgs_forwarded = self.msgs_rejected = 0
 
 
 class OracleSim:
@@ -267,10 +287,11 @@ class OracleSim:
         m = self.cfg.msg_capacity
         n_before = len(p.store)
         n_new_valid = len(batch)
-        # (record_key, origin); stable sort by (gt, member, origin, meta, payload)
+        # (record_key, origin); sort by (gt, member, origin, meta, payload,
+        # aux) — the engine's 6 sort keys
         rows = ([(r, 0) for r in p.store] + [(r, 1) for r in batch])
         rows.sort(key=lambda ro: (ro[0].gt, ro[0].member, ro[1],
-                                  ro[0].meta, ro[0].payload))
+                                  ro[0].meta, ro[0].payload, ro[0].aux))
         kept: list[tuple[Record, int]] = []
         for r, o in rows:
             if kept and kept[-1][0].gt == r.gt and kept[-1][0].member == r.member:
@@ -315,18 +336,88 @@ class OracleSim:
         if acceptable:
             p.global_time = max(p.global_time, max(acceptable))
 
+    # ---- timeline (ops/timeline.py mirror) ----------------------------------
+
+    def _auth_check(self, owner: int, member: int, meta: int, gt: int) -> bool:
+        """tl.check for one record vs one peer's table."""
+        if member == self.cfg.founder:
+            return True
+        if meta >= 32:
+            return False
+        matches = [r for r in self.peers[owner].auth
+                   if r.member == member and ((r.mask >> meta) & 1)
+                   and r.gt <= gt]
+        if not matches:
+            return False
+        best = max(r.gt for r in matches)
+        at_best = [r for r in matches if r.gt == best]
+        grant = any(not (r.mask & REVOKE_BIT) for r in at_best)
+        revoke = any(r.mask & REVOKE_BIT for r in at_best)
+        return grant and not revoke
+
+    def _auth_fold(self, owner: int, target: int, mask: int, gt: int,
+                   is_revoke: bool) -> None:
+        """tl.fold for one accepted authorize/revoke record."""
+        p = self.peers[owner]
+        row_mask = (mask | REVOKE_BIT) if is_revoke else mask
+        for r in p.auth:
+            if r.member == target and r.mask == row_mask and r.gt == gt:
+                return  # idempotent: row already folded
+        if len(p.auth) < self.cfg.k_authorized:
+            p.auth.append(AuthRow(target, row_mask, gt))
+        else:
+            p.msgs_dropped += 1
+
+    def _intake_accept(self, owner: int, rec: Record) -> bool:
+        """The engine's timeline accept mask for one in_ok record.  Pure:
+        the batch's fresh authorize/revoke records must already be folded
+        (the engine folds the whole batch before any check runs)."""
+        cfg = self.cfg
+        if not cfg.timeline_enabled:
+            return True
+        m = rec.meta
+        if m in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER):
+            return rec.member == cfg.founder
+        if m == META_UNDO_OWN:
+            return rec.member == rec.payload
+        if m < 32 and (cfg.protected_meta_mask >> m) & 1:
+            return self._auth_check(owner, rec.member, m, rec.gt)
+        return True
+
     # ---- setup mirrors ------------------------------------------------------
 
-    def create_messages(self, author_mask, meta: int, payload) -> None:
-        """engine.create_messages mirror."""
+    def create_messages(self, author_mask, meta: int, payload,
+                        aux=None) -> None:
+        """engine.create_messages mirror (incl. the timeline author gate)."""
+        cfg = self.cfg
         for i, p in enumerate(self.peers):
             if not author_mask[i]:
                 continue
             gt = p.global_time + 1
-            self._store_insert(i, [Record(gt, i, meta, int(payload[i]))],
-                               count_drops=False)
-            if len(p.fwd) < self.cfg.forward_buffer:
-                p.fwd.append(Record(gt, i, meta, int(payload[i])))
+            av = int(aux[i]) if aux is not None else 0
+            pv = int(payload[i])
+            if cfg.timeline_enabled:
+                if meta in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER):
+                    if i != cfg.founder:
+                        continue
+                elif meta == META_UNDO_OWN:
+                    if pv != i:
+                        continue
+                elif meta < 32 and (cfg.protected_meta_mask >> meta) & 1:
+                    if not self._auth_check(i, i, meta, gt):
+                        continue
+            rec = Record(gt, i, meta, pv, av)
+            self._store_insert(i, [rec], count_drops=False)
+            if cfg.timeline_enabled and meta in (META_AUTHORIZE, META_REVOKE):
+                self._auth_fold(i, pv, av & ((1 << cfg.n_meta) - 1), gt,
+                                meta == META_REVOKE)
+            if cfg.timeline_enabled and meta in (META_UNDO_OWN,
+                                                 META_UNDO_OTHER):
+                for r in p.store:
+                    if r.member == pv and r.gt == av and r.meta < 32:
+                        r.flags |= FLAG_UNDONE
+            if len(p.fwd) < cfg.forward_buffer:
+                p.fwd.append(rec.copy())
             p.global_time = gt
 
     def seed_overlay(self, degree: int) -> None:
@@ -367,6 +458,7 @@ class OracleSim:
                     p.slots = [Slot() for _ in range(cfg.k_candidates)]
                     p.store = []
                     p.fwd = []
+                    p.auth = []
                     p.global_time = 1
                     p.session += 1
 
@@ -610,33 +702,69 @@ class OracleSim:
         # phase 5: combined intake (sync pull + push) -> store + fwd batch
         for i in range(n):
             p = self.peers[i]
+            # On-the-wire records: (gt, member, meta, payload, aux) — flags
+            # are receiver-local and never travel (engine sends 5 columns).
             batch: list[Record] = []
             if cfg.sync_enabled and p.alive and req_slot[i] >= 0:
                 recs = outbox.get((targets[i], req_slot[i]), [])
-                batch.extend(rec for j, rec in enumerate(recs)
+                batch.extend(Record(r.gt, r.member, r.meta, r.payload, r.aux)
+                             for j, r in enumerate(recs)
                              if not self._lost(i, _LOSS_SYNC, j))
             if p.alive:
-                batch.extend(push_inbox[i])
+                batch.extend(Record(r.gt, r.member, r.meta, r.payload, r.aux)
+                             for r in push_inbox[i])
             # clock-jump defense (engine: post-walk-fold clock)
             ok_batch = [rec for rec in batch
                         if rec.gt <= (p.global_time
                                       + cfg.acceptable_global_time_range)]
-            # freshness: drives next round's forward batch
+            # freshness: not stored yet, not a dup of an earlier batch entry
             store_keys = {(r.gt, r.member) for r in p.store}
-            fresh: list[Record] = []
+            fresh0: list[bool] = []
             seen: set[tuple[int, int]] = set()
             for rec in ok_batch:
                 k2 = (rec.gt, rec.member)
-                if k2 not in store_keys and k2 not in seen:
-                    fresh.append(rec)
+                fresh0.append(k2 not in store_keys and k2 not in seen)
                 seen.add(k2)
+            if cfg.timeline_enabled:
+                # Fold the whole batch's fresh authorize/revoke records
+                # before any check runs (engine: tl.fold precedes tl.check).
+                for rec, f0 in zip(ok_batch, fresh0):
+                    if (rec.meta in (META_AUTHORIZE, META_REVOKE) and f0
+                            and rec.member == cfg.founder):
+                        self._auth_fold(i, rec.payload,
+                                        rec.aux & ((1 << cfg.n_meta) - 1),
+                                        rec.gt, rec.meta == META_REVOKE)
+            accept = [self._intake_accept(i, rec) for rec in ok_batch]
+            p.msgs_rejected += sum(1 for a in accept if not a)
+
+            def pre_undone(rec: Record) -> bool:
+                # Control records (meta >= 32) are never markable, matching
+                # the post-insert undo path.
+                return rec.meta < 32 and any(
+                    r.meta in (META_UNDO_OWN, META_UNDO_OTHER)
+                    and r.payload == rec.member and r.aux == rec.gt
+                    for r in p.store)
+            ins_batch = [
+                Record(rec.gt, rec.member, rec.meta, rec.payload, rec.aux,
+                       FLAG_UNDONE if (cfg.timeline_enabled
+                                       and pre_undone(rec)) else 0)
+                for rec, a in zip(ok_batch, accept) if a]
+            fresh = [rec for rec, a, f0 in zip(ok_batch, accept, fresh0)
+                     if a and f0]
             if ok_batch:
-                self._store_insert(i, [Record(r.gt, r.member, r.meta,
-                                              r.payload, r.flags)
-                                       for r in ok_batch])
-                self._fold_gt(i, [r.gt for r in ok_batch])
-            p.fwd = [Record(r.gt, r.member, r.meta, r.payload, r.flags)
-                     for r in fresh[:cfg.forward_buffer]]
+                self._store_insert(i, ins_batch)
+                self._fold_gt(i, [rec.gt for rec, a in zip(ok_batch, accept)
+                                  if a])
+            if cfg.timeline_enabled:
+                # Post-insert: this batch's accepted undo records mark their
+                # targets (now possibly just inserted).
+                for rec, a in zip(ok_batch, accept):
+                    if a and rec.meta in (META_UNDO_OWN, META_UNDO_OTHER):
+                        for r in p.store:
+                            if (r.member == rec.payload and r.gt == rec.aux
+                                    and r.meta < 32):
+                                r.flags |= FLAG_UNDONE
+            p.fwd = [rec.copy() for rec in fresh[:cfg.forward_buffer]]
 
         self.now = _f32(self.now + np.float32(cfg.walk_interval))
         self.rnd += 1
@@ -647,6 +775,7 @@ class OracleSim:
         """Dense arrays shaped like PeerState for trace-equality asserts."""
         cfg = self.cfg
         n, k, m = cfg.n_peers, cfg.k_candidates, cfg.msg_capacity
+        a = cfg.k_authorized
         out = {
             "alive": np.array([p.alive for p in self.peers]),
             "session": np.array([p.session for p in self.peers], np.uint32),
@@ -660,6 +789,7 @@ class OracleSim:
             "store_member": np.full((n, m), EMPTY_U32, np.uint32),
             "store_meta": np.full((n, m), EMPTY_U32, np.uint32),
             "store_payload": np.full((n, m), EMPTY_U32, np.uint32),
+            "store_aux": np.zeros((n, m), np.uint32),
             "store_flags": np.zeros((n, m), np.uint32),
             "fwd_gt": np.full((n, cfg.forward_buffer), EMPTY_U32, np.uint32),
             "fwd_member": np.full((n, cfg.forward_buffer), EMPTY_U32,
@@ -667,8 +797,14 @@ class OracleSim:
             "fwd_meta": np.full((n, cfg.forward_buffer), EMPTY_U32, np.uint32),
             "fwd_payload": np.full((n, cfg.forward_buffer), EMPTY_U32,
                                    np.uint32),
+            "fwd_aux": np.full((n, cfg.forward_buffer), EMPTY_U32, np.uint32),
+            "auth_member": np.full((n, a), EMPTY_U32, np.uint32),
+            "auth_mask": np.zeros((n, a), np.uint32),
+            "auth_gt": np.zeros((n, a), np.uint32),
             "msgs_forwarded": np.array([p.msgs_forwarded for p in self.peers],
                                        np.uint32),
+            "msgs_rejected": np.array([p.msgs_rejected for p in self.peers],
+                                      np.uint32),
             "walk_success": np.array([p.walk_success for p in self.peers],
                                      np.uint32),
             "walk_fail": np.array([p.walk_fail for p in self.peers], np.uint32),
@@ -691,12 +827,18 @@ class OracleSim:
                 out["store_member"][i, j] = rec.member
                 out["store_meta"][i, j] = rec.meta
                 out["store_payload"][i, j] = rec.payload
+                out["store_aux"][i, j] = rec.aux
                 out["store_flags"][i, j] = rec.flags
             for j, rec in enumerate(p.fwd):
                 out["fwd_gt"][i, j] = rec.gt
                 out["fwd_member"][i, j] = rec.member
                 out["fwd_meta"][i, j] = rec.meta
                 out["fwd_payload"][i, j] = rec.payload
+                out["fwd_aux"][i, j] = rec.aux
+            for j, row in enumerate(p.auth):
+                out["auth_member"][i, j] = row.member
+                out["auth_mask"][i, j] = row.mask
+                out["auth_gt"][i, j] = row.gt
         return out
 
 

@@ -60,6 +60,13 @@ def state_sharding(state: PeerState, mesh: Mesh, n_peers: int):
     recognized by its length, so ``n_peers`` must differ from the small
     fixed dims (the uint32[2] key — guaranteed for any real population).
     """
+    if n_peers <= 2:
+        # The peer axis is detected by leading-dim length; n_peers <= 2
+        # collides with fixed dims (the uint32[2] RNG key) and would shard
+        # scalars.  No real population is this small.
+        raise ValueError(f"n_peers={n_peers} is too small to shard "
+                         "unambiguously (collides with fixed-size leaves)")
+
     def spec(leaf):
         if leaf.ndim >= 1 and leaf.shape[0] == n_peers:
             return NamedSharding(mesh, P(PEER_AXIS, *([None] * (leaf.ndim - 1))))

@@ -42,10 +42,13 @@ class Stats:
     walk_success: jnp.ndarray     # u32[N] intro-responses received in time
     walk_fail: jnp.ndarray        # u32[N] walk timeouts
     msgs_stored: jnp.ndarray      # u32[N] new records inserted into store
-    msgs_dropped: jnp.ndarray     # u32[N] records dropped (inbox/store full)
+    msgs_dropped: jnp.ndarray     # u32[N] records dropped (inbox/store/auth full)
     requests_dropped: jnp.ndarray  # u32[N] intro-requests dropped (inbox full)
     punctures: jnp.ndarray        # u32[N] punctures sent (as introduced peer)
     msgs_forwarded: jnp.ndarray   # u32[N] push-forward packets sent
+    msgs_rejected: jnp.ndarray    # u32[N] records refused by Timeline checks
+    #   (reference: statistics.py drop counts from the check pipeline —
+    #    DropMessage outcomes of Timeline.check)
 
 
 @struct.dataclass
@@ -67,11 +70,8 @@ class PeerState:
     store_member: jnp.ndarray  # u32
     store_meta: jnp.ndarray    # u32
     store_payload: jnp.ndarray  # u32
+    store_aux: jnp.ndarray     # u32 second payload word (see StoreCols.aux)
     store_flags: jnp.ndarray   # u32 bit0 = undone (sync table's `undone` column)
-
-    # ---- outstanding walk (requestcache.py IntroductionRequestCache) ----
-    pending_target: jnp.ndarray  # i32[N], NO_PEER = none outstanding
-    pending_since: jnp.ndarray   # f32[N]
 
     # ---- forward buffer [N, F]: records to push next round -------------
     # (reference: dispersy.py store_update_forward -> _forward sends each
@@ -81,11 +81,13 @@ class PeerState:
     fwd_member: jnp.ndarray   # u32
     fwd_meta: jnp.ndarray     # u32
     fwd_payload: jnp.ndarray  # u32
+    fwd_aux: jnp.ndarray      # u32
 
-    # ---- timeline (timeline.py; bounded authorized-member table) ----
+    # ---- timeline (ops/timeline.py AuthTable; folded from stored
+    #      authorize/revoke records, wiped with the store on churn) ----
     auth_member: jnp.ndarray     # u32[N, A], EMPTY_U32 = empty slot
-    auth_grant_gt: jnp.ndarray   # u32[N, A] global_time of the authorize
-    auth_meta_mask: jnp.ndarray  # u32[N, A] bitmask over meta ids (permit perm)
+    auth_mask: jnp.ndarray       # u32[N, A] meta bitmask; bit 31 = revoke row
+    auth_gt: jnp.ndarray         # u32[N, A] global_time the row takes effect
 
     stats: Stats
     key: jnp.ndarray          # uint32[2] threefry key for this community
@@ -105,7 +107,7 @@ def init_stats(n: int) -> Stats:
         return jnp.zeros((n,), jnp.uint32)
     return Stats(walk_success=z(), walk_fail=z(), msgs_stored=z(),
                  msgs_dropped=z(), requests_dropped=z(), punctures=z(),
-                 msgs_forwarded=z())
+                 msgs_forwarded=z(), msgs_rejected=z())
 
 
 def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
@@ -134,16 +136,16 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         store_member=jnp.full((n, m), EMPTY_U32, jnp.uint32),
         store_meta=jnp.full((n, m), EMPTY_U32, jnp.uint32),
         store_payload=jnp.full((n, m), EMPTY_U32, jnp.uint32),
+        store_aux=jnp.zeros((n, m), jnp.uint32),
         store_flags=jnp.zeros((n, m), jnp.uint32),
-        pending_target=jnp.full((n,), NO_PEER, jnp.int32),
-        pending_since=jnp.full((n,), NEVER, jnp.float32),
         fwd_gt=jnp.full((n, f), EMPTY_U32, jnp.uint32),
         fwd_member=jnp.full((n, f), EMPTY_U32, jnp.uint32),
         fwd_meta=jnp.full((n, f), EMPTY_U32, jnp.uint32),
         fwd_payload=jnp.full((n, f), EMPTY_U32, jnp.uint32),
+        fwd_aux=jnp.full((n, f), EMPTY_U32, jnp.uint32),
         auth_member=jnp.full((n, a), EMPTY_U32, jnp.uint32),
-        auth_grant_gt=jnp.zeros((n, a), jnp.uint32),
-        auth_meta_mask=jnp.zeros((n, a), jnp.uint32),
+        auth_mask=jnp.zeros((n, a), jnp.uint32),
+        auth_gt=jnp.zeros((n, a), jnp.uint32),
         stats=init_stats(n),
         key=jax.random.key_data(key) if key.dtype != jnp.uint32 else key,
         time=jnp.float32(0.0),

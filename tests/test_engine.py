@@ -143,3 +143,29 @@ def test_modulo_claim_strategy_runs():
     st = run(cfg, 60, author=5)
     cov = float(E.coverage(st, member=5, gt=2, meta=1, payload=42))
     assert cov > 0.9, cov
+
+
+def test_forward_targets_prefer_verified_unsigned_topk():
+    """The verified flag rides bit 31 of a uint32 score through lax.top_k;
+    a backend treating the score as signed would invert the preference.
+    Verified candidates must always win over unverified ones."""
+    from dispersy_tpu.ops import candidates as C
+    cfg = BASE.replace(forward_fanout=2, k_candidates=8)
+    n, k = 16, cfg.k_candidates
+    # slot 0: stale (unverified), slots 1-2: freshly walked (verified)
+    peer = np.full((n, k), -1, np.int32)
+    walk = np.full((n, k), S.NEVER, np.float32)
+    peer[:, 0] = 50
+    peer[:, 1] = 51
+    peer[:, 2] = 52
+    now = jnp.float32(1000.0)
+    walk[:, 1] = 999.0
+    walk[:, 2] = 999.0
+    tab = C.CandTable(peer=jnp.asarray(peer), last_walk=jnp.asarray(walk),
+                      last_stumble=jnp.full((n, k), S.NEVER, jnp.float32),
+                      last_intro=jnp.full((n, k), S.NEVER, jnp.float32))
+    for rnd in range(20):   # many draws: any signed misorder would surface
+        out = np.asarray(C.sample_forward_targets(
+            tab, now, cfg, jnp.uint32(7), jnp.uint32(rnd),
+            jnp.arange(n, dtype=jnp.int32)))
+        assert set(out.ravel().tolist()) <= {51, 52}, out

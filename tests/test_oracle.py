@@ -25,9 +25,12 @@ BASE = CommunityConfig(n_peers=32, n_trackers=2, msg_capacity=32,
 FIELDS = ["alive", "session", "global_time",
           "cand_peer", "cand_last_walk", "cand_last_stumble", "cand_last_intro",
           "store_gt", "store_member", "store_meta", "store_payload",
-          "store_flags", "fwd_gt", "fwd_member", "fwd_meta", "fwd_payload"]
+          "store_aux", "store_flags",
+          "fwd_gt", "fwd_member", "fwd_meta", "fwd_payload", "fwd_aux",
+          "auth_member", "auth_mask", "auth_gt"]
 STAT_FIELDS = ["walk_success", "walk_fail", "msgs_stored", "msgs_dropped",
-               "requests_dropped", "punctures", "msgs_forwarded"]
+               "requests_dropped", "punctures", "msgs_forwarded",
+               "msgs_rejected"]
 
 
 def assert_match(state, oracle, rnd):

@@ -22,12 +22,14 @@ def mk_store(rows, cap=None):
     assert all(len(r) <= m for r in rows)
     n = len(rows)
     cols = [np.full((n, m), EMPTY_U32, np.uint32) for _ in range(4)]
+    aux = np.zeros((n, m), np.uint32)
     flags = np.zeros((n, m), np.uint32)
     for i, r in enumerate(rows):
         for j, rec in enumerate(sorted(r)):
             for c in range(4):
                 cols[c][i, j] = rec[c]
-    return st.StoreCols(*(jnp.asarray(c) for c in cols), jnp.asarray(flags))
+    return st.StoreCols(*(jnp.asarray(c) for c in cols), jnp.asarray(aux),
+                        jnp.asarray(flags))
 
 
 def store_as_sets(s: st.StoreCols):

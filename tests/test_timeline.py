@@ -1,0 +1,261 @@
+"""Timeline permission engine: kernels + engine/oracle trace equality.
+
+The reference exercises permissions through DebugCommunity's protected
+metas (reference: tests/test_timeline.py, test_undo.py,
+test_dynamicsettings.py — a "protected-full-sync-text" message is rejected
+until the authorize arrives, undo marks rows undone).  Here the same
+scenarios run through the jitted engine and the CPU oracle side by side,
+bit-for-bit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispersy_tpu import engine as E
+from dispersy_tpu import state as S
+from dispersy_tpu.config import (EMPTY_U32, META_AUTHORIZE, META_REVOKE,
+                                 META_UNDO_OTHER, META_UNDO_OWN,
+                                 CommunityConfig)
+from dispersy_tpu.ops import timeline as tl
+from dispersy_tpu.oracle import sim as O
+
+from test_oracle import assert_match
+
+CFG = CommunityConfig(
+    n_peers=24, n_trackers=2, msg_capacity=32, bloom_capacity=16,
+    k_candidates=8, request_inbox=4, tracker_inbox=8, response_budget=4,
+    timeline_enabled=True, protected_meta_mask=0b10, n_meta=8,
+    k_authorized=8)
+FOUNDER = CFG.founder  # == n_trackers == 2
+PROT = 1               # protected user meta (bit 1 of the mask)
+
+
+def mk_table(rows, n=1, a=4):
+    """rows: list of (member, mask, gt) -> AuthTable [n, a] (row 0 filled)."""
+    member = np.full((n, a), EMPTY_U32, np.uint32)
+    mask = np.zeros((n, a), np.uint32)
+    gt = np.zeros((n, a), np.uint32)
+    for j, (m, mk, g) in enumerate(rows):
+        member[0, j], mask[0, j], gt[0, j] = m, mk, g
+    return tl.AuthTable(member=jnp.asarray(member), mask=jnp.asarray(mask),
+                        gt=jnp.asarray(gt))
+
+
+def ck(tab, member, meta, gt, founder=99):
+    out = tl.check(tab, jnp.asarray([[member]], jnp.uint32),
+                   jnp.asarray([[meta]], jnp.uint32),
+                   jnp.asarray([[gt]], jnp.uint32), founder)
+    return bool(out[0, 0])
+
+
+def test_check_grant_and_gt_bounds():
+    tab = mk_table([(7, 1 << PROT, 5)])
+    assert not ck(tab, 7, PROT, 4)     # before the grant takes effect
+    assert ck(tab, 7, PROT, 5)         # at the grant
+    assert ck(tab, 7, PROT, 100)       # after
+    assert not ck(tab, 8, PROT, 100)   # other member
+    assert not ck(tab, 7, PROT + 1, 100)  # other meta
+    assert ck(tab, 99, PROT, 1)        # founder always permitted
+
+
+def test_check_revoke_and_tie():
+    rev = (1 << PROT) | tl.REVOKE_BIT
+    tab = mk_table([(7, 1 << PROT, 5), (7, rev, 9)])
+    assert ck(tab, 7, PROT, 8)         # granted window
+    assert not ck(tab, 7, PROT, 9)     # revoked from gt 9 on
+    assert not ck(tab, 7, PROT, 50)
+    # re-grant after revoke
+    tab2 = mk_table([(7, 1 << PROT, 5), (7, rev, 9), (7, 1 << PROT, 12)])
+    assert ck(tab2, 7, PROT, 12)
+    # tie at identical gt: revoke wins
+    tab3 = mk_table([(7, 1 << PROT, 5), (7, rev, 5)])
+    assert not ck(tab3, 7, PROT, 7)
+
+
+def test_fold_dedup_and_capacity():
+    tab = mk_table([], a=2)
+    args = dict(
+        target=jnp.asarray([[7, 7]], jnp.uint32),
+        mask=jnp.asarray([[2, 2]], jnp.uint32),
+        gt=jnp.asarray([[3, 3]], jnp.uint32),
+        is_revoke=jnp.zeros((1, 2), bool))
+    r1 = tl.fold(tab, valid=jnp.ones((1, 2), bool), **args)
+    # identical rows: second is a dup, only one slot used
+    assert int(jnp.sum(r1.table.member != jnp.uint32(EMPTY_U32))) == 1
+    assert int(r1.n_dropped[0]) == 0
+    # fill the table, then overflow drops and counts
+    r2 = tl.fold(r1.table,
+                 target=jnp.asarray([[8, 9]], jnp.uint32),
+                 mask=jnp.asarray([[2, 2]], jnp.uint32),
+                 gt=jnp.asarray([[4, 5]], jnp.uint32),
+                 is_revoke=jnp.zeros((1, 2), bool),
+                 valid=jnp.ones((1, 2), bool))
+    assert int(jnp.sum(r2.table.member != jnp.uint32(EMPTY_U32))) == 2
+    assert int(r2.n_dropped[0]) == 1
+
+
+def run_both_script(cfg, script, rounds, seed=0, warm=4):
+    """Side-by-side engine/oracle run; script: {round: [(author, meta,
+    payload, aux), ...]} applied before stepping that round."""
+    key = jax.random.PRNGKey(seed)
+    state = S.init_state(cfg, key)
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    if warm:
+        state = E.seed_overlay(state, cfg, degree=warm)
+        oracle.seed_overlay(degree=warm)
+    for rnd in range(rounds):
+        for author, meta, payload, aux in script.get(rnd, []):
+            mask = np.arange(cfg.n_peers) == author
+            pl = np.full(cfg.n_peers, payload, np.uint32)
+            ax = np.full(cfg.n_peers, aux, np.uint32)
+            state = E.create_messages(state, cfg, jnp.asarray(mask), meta,
+                                      jnp.asarray(pl), jnp.asarray(ax))
+            oracle.create_messages(mask, meta, pl, aux=ax)
+            assert_match(jax.block_until_ready(state), oracle,
+                         f"create@{rnd}")
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+    return state, oracle
+
+
+def test_author_gate_unauthorized_create_is_noop():
+    cfg = CFG
+    state = S.init_state(cfg, jax.random.PRNGKey(1))
+    mask = np.arange(cfg.n_peers) == 9   # not authorized, not founder
+    state2 = E.create_messages(state, cfg, jnp.asarray(mask), PROT,
+                               jnp.zeros(cfg.n_peers, jnp.uint32))
+    assert int(jnp.sum(state2.store_gt != jnp.uint32(EMPTY_U32))) == 0
+    # the founder itself may always create a protected record
+    fmask = np.arange(cfg.n_peers) == FOUNDER
+    state3 = E.create_messages(state, cfg, jnp.asarray(fmask), PROT,
+                               jnp.zeros(cfg.n_peers, jnp.uint32))
+    assert int(jnp.sum(state3.store_gt != jnp.uint32(EMPTY_U32))) == 1
+
+
+def test_trace_authorize_then_protected_sync():
+    """A protected record whose grant proof never spread is rejected by
+    every receiver, forever (historical validity); after a real authorize
+    spreads, a newly created record is accepted everywhere — every decision
+    bit-identical between engine and oracle.
+
+    Peer 9's table is seeded with an out-of-band grant so it *authors* a
+    record no other peer can verify: the normal FullSync path delivers
+    authorize records before the records they permit (ascending
+    global_time — exactly why the reference gives authorize high sync
+    priority), so a missing-proof reject can only be provoked this way.
+    """
+    cfg = CFG
+    state = S.init_state(cfg, jax.random.PRNGKey(0))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=4)
+    oracle.seed_overlay(degree=4)
+    # out-of-band grant at gt 1, known only to peer 9 itself
+    state = state.replace(
+        auth_member=state.auth_member.at[9, 0].set(9),
+        auth_mask=state.auth_mask.at[9, 0].set(1 << PROT),
+        auth_gt=state.auth_gt.at[9, 0].set(1))
+    oracle.peers[9].auth.append(O.AuthRow(9, 1 << PROT, 1))
+
+    def create(author, meta, payload, aux):
+        nonlocal state
+        mask = np.arange(cfg.n_peers) == author
+        pl = np.full(cfg.n_peers, payload, np.uint32)
+        ax = np.full(cfg.n_peers, aux, np.uint32)
+        state = E.create_messages(state, cfg, jnp.asarray(mask), meta,
+                                  jnp.asarray(pl), jnp.asarray(ax))
+        oracle.create_messages(mask, meta, pl, aux=ax)
+
+    def run(rounds, tag):
+        nonlocal state
+        for rnd in range(rounds):
+            state = E.step(state, cfg)
+            oracle.step()
+            assert_match(jax.block_until_ready(state), oracle,
+                         f"{tag}{rnd}")
+
+    create(9, PROT, 777, 0)           # provable only to 9 itself
+    run(6, "unprovable")
+    rejected_mid = int(jnp.sum(state.stats.msgs_rejected))
+    assert rejected_mid > 0           # receivers refused it
+    holders_777 = int(jnp.sum(jnp.any(
+        (state.store_payload == 777) & (state.store_member == 9), axis=1)))
+    assert holders_777 == 1           # never accepted anywhere else
+
+    create(FOUNDER, META_AUTHORIZE, 9, 1 << PROT)
+    run(6, "authorized")
+    create(9, PROT, 888, 0)           # now provable via the synced grant
+    run(8, "spread")
+    holders_888 = int(jnp.sum(jnp.any(
+        (state.store_payload == 888) & (state.store_member == 9), axis=1)))
+    assert holders_888 > 1
+    # the old unprovable record STAYS rejected: its gt predates the grant
+    holders_777 = int(jnp.sum(jnp.any(
+        (state.store_payload == 777) & (state.store_member == 9), axis=1)))
+    assert holders_777 == 1
+
+
+def test_trace_revoke_blocks_new_records():
+    """After the founder's revoke, records the member authors at a later
+    global_time are rejected everywhere, while the pre-revoke record keeps
+    spreading (historical validity — Timeline.check at the record's gt)."""
+    script = {
+        0: [(FOUNDER, META_AUTHORIZE, 9, 1 << PROT)],
+        3: [(9, PROT, 111, 0)],
+        6: [(FOUNDER, META_REVOKE, 9, 1 << PROT)],
+        10: [(9, PROT, 222, 0)],
+    }
+    state, oracle = run_both_script(CFG, script, rounds=16)
+    # the post-revoke record may exist only at its author (its own check
+    # passed iff its creation-time table still allowed it; everyone else
+    # rejects) — in practice author 9's own table got the revoke by then,
+    # so creation itself was refused.
+    late = int(jnp.sum(jnp.any(
+        (state.store_payload == 222) & (state.store_member == 9), axis=1)))
+    assert late <= 1
+    early = int(jnp.sum(jnp.any(
+        (state.store_payload == 111) & (state.store_member == 9), axis=1)))
+    assert early > 1
+
+
+def test_trace_undo_own_marks_everywhere():
+    """An undo-own record spreads and flips FLAG_UNDONE on every replica of
+    its target, including replicas that arrive after the undo."""
+    script = {
+        0: [(FOUNDER, META_AUTHORIZE, 9, 1 << PROT)],
+        4: [(9, PROT, 333, 0)],
+    }
+    # find the gt that record will get: author 9 creates at its own clock+1;
+    # run the scripted rounds first, read the gt, then undo it.
+    state, oracle = run_both_script(CFG, script, rounds=8)
+    row = np.asarray(state.store_member[9]) == 9
+    metas = np.asarray(state.store_meta[9])
+    gts = np.asarray(state.store_gt[9])
+    target_gt = int(gts[row & (metas == PROT)][0])
+
+    cfg = CFG
+    mask = np.arange(cfg.n_peers) == 9
+    pl = np.full(cfg.n_peers, 9, np.uint32)
+    ax = np.full(cfg.n_peers, target_gt, np.uint32)
+    state = E.create_messages(state, cfg, jnp.asarray(mask), META_UNDO_OWN,
+                              jnp.asarray(pl), jnp.asarray(ax))
+    oracle.create_messages(mask, META_UNDO_OWN, pl, aux=ax)
+    assert_match(jax.block_until_ready(state), oracle, "undo-create")
+    # author's own replica is marked immediately
+    own = (np.asarray(state.store_member[9]) == 9) & \
+          (np.asarray(state.store_gt[9]) == target_gt) & \
+          (np.asarray(state.store_meta[9]) == PROT)
+    assert np.asarray(state.store_flags[9])[own].item() == S.FLAG_UNDONE
+
+    for rnd in range(10):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, f"undo+{rnd}")
+    sm = np.asarray(state.store_member)
+    sg = np.asarray(state.store_gt)
+    sme = np.asarray(state.store_meta)
+    sf = np.asarray(state.store_flags)
+    target = (sm == 9) & (sg == target_gt) & (sme == PROT)
+    assert target.any(axis=1).sum() > 1          # replicated
+    assert (sf[target] & S.FLAG_UNDONE).all()    # every replica marked

@@ -1,0 +1,114 @@
+"""Checkpoint/restore of the overlay state.
+
+The reference's checkpoint is its SQLite file: every sync-distributed
+message persists in the ``sync`` table, ``Community.load_community``
+replays identity/authorize/revoke to rebuild the Timeline and resumes
+``global_time``, and candidates are *not* persisted — a restarted peer
+re-walks from the trackers (SURVEY.md §5.4).
+
+TPU recast: the whole overlay is one ``PeerState`` pytree, so a checkpoint
+is a flat archive of its leaves plus a config fingerprint and the RNG
+key/round counter (which the reference has no analogue for — its
+randomness is wall-clock; ours must resume bit-exactly).  Two restore
+modes:
+
+- ``fresh_candidates=False`` (default): byte-exact resume — stepping the
+  restored state replays the identical trajectory, which is what the
+  determinism tests pin.
+- ``fresh_candidates=True``: the reference's restart semantics — candidate
+  tables wiped, peers re-walk from their trackers; stores, clocks, auth
+  tables and stats survive (they live in "the database").
+
+Format: one ``.npz`` with dotted-path keys per leaf.  On a multi-host mesh
+each host would save its addressable shards to its own file (orbax-style
+sharded layout); this single-file writer covers the single-host bench and
+test environments and keeps the format inspectable.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+
+import jax
+import numpy as np
+
+from dispersy_tpu.config import CommunityConfig, NO_PEER
+from dispersy_tpu.state import NEVER, PeerState, init_state
+
+FORMAT_VERSION = 1
+
+
+def _fingerprint(cfg: CommunityConfig) -> str:
+    """Config identity a checkpoint is only valid against."""
+    return repr(cfg)
+
+
+def _leaves_with_paths(state: PeerState):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    names = ["/".join(str(getattr(k, "name", k)) for k in path)
+             for path, _ in flat]
+    return names, [leaf for _, leaf in flat], treedef
+
+
+def save(path: str, state: PeerState, cfg: CommunityConfig) -> None:
+    """Write the full overlay state to ``path`` (.npz)."""
+    names, leaves, _ = _leaves_with_paths(state)
+    arrays = {f"leaf:{n}": np.asarray(jax.device_get(leaf))
+              for n, leaf in zip(names, leaves)}
+    arrays["meta:version"] = np.asarray(FORMAT_VERSION)
+    arrays["meta:config"] = np.frombuffer(
+        _fingerprint(cfg).encode(), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:       # atomic-ish: no torn checkpoint files
+        f.write(buf.getvalue())
+    os.replace(tmp, path)
+
+
+def restore(path: str, cfg: CommunityConfig,
+            fresh_candidates: bool = False) -> PeerState:
+    """Load a checkpoint written by :func:`save`.
+
+    Raises ValueError on a config mismatch — a checkpoint is only
+    meaningful against the exact static configuration that produced it.
+    Re-shard the result afterwards with ``parallel.shard_state`` (the
+    archive stores unsharded host arrays).
+    """
+    with np.load(path) as z:
+        version = int(z["meta:version"])
+        if version != FORMAT_VERSION:
+            raise ValueError(f"checkpoint format {version}, "
+                             f"expected {FORMAT_VERSION}")
+        stored_cfg = bytes(z["meta:config"]).decode()
+        if stored_cfg != _fingerprint(cfg):
+            raise ValueError(
+                "checkpoint was written under a different config:\n"
+                f"  stored: {stored_cfg}\n  given:  {_fingerprint(cfg)}")
+        # Template provides the treedef (and validates shapes below).
+        template = init_state(cfg, jax.random.PRNGKey(0))
+        names, t_leaves, treedef = _leaves_with_paths(template)
+        leaves = []
+        for n, t in zip(names, t_leaves):
+            key = f"leaf:{n}"
+            if key not in z:
+                raise ValueError(f"checkpoint missing field {n}")
+            arr = z[key]
+            if arr.shape != t.shape or arr.dtype != t.dtype:
+                raise ValueError(
+                    f"field {n}: checkpoint {arr.shape}/{arr.dtype} vs "
+                    f"config {t.shape}/{t.dtype}")
+            leaves.append(arr)
+    state = jax.tree_util.tree_unflatten(treedef, leaves)
+    if fresh_candidates:
+        # Reference restart semantics: candidates are ephemeral; the
+        # walker re-bootstraps from trackers (SURVEY §5.4).
+        k = cfg.k_candidates
+        never = np.full((cfg.n_peers, k), NEVER, np.float32)
+        state = state.replace(
+            cand_peer=np.full((cfg.n_peers, k), NO_PEER, np.int32),
+            cand_last_walk=never,
+            cand_last_stumble=never.copy(),
+            cand_last_intro=never.copy())
+    return state

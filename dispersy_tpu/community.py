@@ -1,0 +1,225 @@
+"""The rim API: declare a Community; it compiles to kernel configuration.
+
+The reference's application surface is a ``Community`` subclass whose
+``initiate_meta_messages`` binds each message name to one policy from each
+of authentication / resolution / distribution / destination (reference:
+community.py ``Community.initiate_meta_messages``, message.py ``Message``,
+and the four policy modules).  The rebuild keeps that declaration style at
+the rim and *compiles* it down to the static ``CommunityConfig`` the fused
+TPU step consumes — policy objects carry no runtime behavior here; they
+are configuration, which is exactly what XLA wants them to be.
+
+Mapping of the policy matrix onto kernel knobs:
+
+- ``PublicResolution`` / ``LinearResolution`` -> ``protected_meta_mask``
+  bit (+ ``timeline_enabled`` when any meta is linear).
+- ``FullSyncDistribution(enable_sequence_number)`` -> ``seq_meta_mask``
+  bit; ``priority``/``synchronization_direction`` -> ``meta_priority`` /
+  ``desc_meta_mask``.
+- ``LastSyncDistribution(history_size)`` -> ``last_sync_history`` entry.
+- ``DirectDistribution`` -> ``direct_meta_mask`` bit.
+- ``CommunityDestination(node_count)`` -> the push fanout
+  (``forward_fanout`` = max node_count across metas; the reference picks
+  candidates per message batch the same way).
+- ``MemberAuthentication``/``NoAuthentication`` are accepted for API
+  parity: in simulation every record's author IS its member id, so
+  authentication is structural (SURVEY §7 stage 9: crypto off the hot
+  path).
+
+The control metas (``dispersy-authorize``/``revoke``/``undo-*``) are
+built in, as in the reference's ``_initialize_meta_messages``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from dispersy_tpu import engine
+from dispersy_tpu.config import (MAX_USER_META, META_AUTHORIZE, META_REVOKE,
+                                 META_UNDO_OTHER, META_UNDO_OWN,
+                                 CommunityConfig, DEFAULT_PRIORITY)
+from dispersy_tpu.state import PeerState, init_state
+
+
+# ---- policy declarations (reference: authentication.py / resolution.py /
+#      distribution.py / destination.py) --------------------------------
+
+
+class NoAuthentication:
+    pass
+
+
+class MemberAuthentication:
+    def __init__(self, encoding: str = "sha1"):
+        self.encoding = encoding
+
+
+class PublicResolution:
+    pass
+
+
+class LinearResolution:
+    pass
+
+
+class FullSyncDistribution:
+    def __init__(self, enable_sequence_number: bool = False,
+                 synchronization_direction: str = "ASC",
+                 priority: int = DEFAULT_PRIORITY):
+        if synchronization_direction not in ("ASC", "DESC"):
+            raise ValueError("synchronization_direction must be ASC|DESC")
+        self.enable_sequence_number = enable_sequence_number
+        self.synchronization_direction = synchronization_direction
+        self.priority = priority
+
+
+class LastSyncDistribution:
+    def __init__(self, history_size: int,
+                 priority: int = DEFAULT_PRIORITY):
+        if history_size < 1:
+            raise ValueError("history_size must be >= 1")
+        self.history_size = history_size
+        self.priority = priority
+
+
+class DirectDistribution:
+    pass
+
+
+class CommunityDestination:
+    def __init__(self, node_count: int = 10):
+        self.node_count = node_count
+
+
+class CandidateDestination:
+    """Addressed delivery (the reference sends to explicit candidates).
+
+    In the simulation the control plane (walks, introductions, punctures,
+    sync responses) is already candidate-addressed; a user meta declaring
+    this routes like Direct but to the author's sampled candidates."""
+
+
+class Message:
+    """One meta-message declaration (reference: message.py ``Message``)."""
+
+    def __init__(self, name: str, authentication, resolution, distribution,
+                 destination):
+        self.name = name
+        self.authentication = authentication
+        self.resolution = resolution
+        self.distribution = distribution
+        self.destination = destination
+
+
+class Community:
+    """Subclass and override ``initiate_meta_messages`` (reference API).
+
+    Simulation knobs (population size, walker timing, bloom sizing, fault
+    model) pass through ``__init__`` overrides onto ``CommunityConfig``;
+    the policy matrix comes from the declarations.
+    """
+
+    def __init__(self, n_peers: int, **overrides):
+        metas = self.initiate_meta_messages()
+        if len(metas) > MAX_USER_META:
+            raise ValueError(f"at most {MAX_USER_META} user metas")
+        names = [m.name for m in metas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate meta names: {names}")
+        self.meta_ids = {m.name: i for i, m in enumerate(metas)}
+        self.metas = {m.name: m for m in metas}
+
+        n_meta = max(len(metas), 1)
+        protected = seq = direct = desc = 0
+        history = [0] * n_meta
+        priority = [DEFAULT_PRIORITY] * n_meta
+        fanout = 0
+        for i, m in enumerate(metas):
+            if isinstance(m.resolution, LinearResolution):
+                protected |= 1 << i
+            d = m.distribution
+            if isinstance(d, FullSyncDistribution):
+                if d.enable_sequence_number:
+                    seq |= 1 << i
+                if d.synchronization_direction == "DESC":
+                    desc |= 1 << i
+                priority[i] = d.priority
+            elif isinstance(d, LastSyncDistribution):
+                history[i] = d.history_size
+                priority[i] = d.priority
+            elif isinstance(d, (DirectDistribution, CandidateDestination)):
+                direct |= 1 << i
+            else:
+                raise ValueError(f"unknown distribution for {m.name}: {d}")
+            if isinstance(m.destination, CommunityDestination):
+                fanout = max(fanout, m.destination.node_count)
+            if isinstance(m.destination, CandidateDestination):
+                direct |= 1 << i
+
+        fields = {f.name for f in dataclasses.fields(CommunityConfig)}
+        bad = set(overrides) - fields
+        if bad:
+            raise ValueError(f"unknown config overrides: {sorted(bad)}")
+        compiled = dict(
+            n_peers=n_peers,
+            n_meta=n_meta,
+            protected_meta_mask=protected,
+            seq_meta_mask=seq,
+            direct_meta_mask=direct,
+            desc_meta_mask=desc,
+            last_sync_history=tuple(history),
+            meta_priority=tuple(priority),
+            timeline_enabled=protected != 0,
+        )
+        if fanout:
+            k_cand = overrides.get("k_candidates",
+                                   CommunityConfig.k_candidates)
+            compiled["forward_fanout"] = min(fanout, k_cand)
+        conflict = set(compiled) & set(overrides) - {"n_peers"}
+        if conflict:
+            raise ValueError(
+                f"{sorted(conflict)} are compiled from the meta-message "
+                "declarations; override the declarations instead")
+        self.config = CommunityConfig(**{**compiled, **overrides})
+
+    # ---- declaration hook (the reference's override point) ----
+    def initiate_meta_messages(self) -> list:
+        return []
+
+    # ---- runtime conveniences over the engine ----
+    def initialize(self, key=None, seed_degree: int | None = None
+                   ) -> PeerState:
+        state = init_state(self.config, key if key is not None
+                           else jax.random.PRNGKey(0))
+        if seed_degree:
+            state = engine.seed_overlay(state, self.config, seed_degree)
+        return state
+
+    def meta_id(self, name: str) -> int:
+        if name in self.meta_ids:
+            return self.meta_ids[name]
+        control = {"dispersy-authorize": META_AUTHORIZE,
+                   "dispersy-revoke": META_REVOKE,
+                   "dispersy-undo-own": META_UNDO_OWN,
+                   "dispersy-undo-other": META_UNDO_OTHER}
+        if name in control:
+            return control[name]
+        raise KeyError(f"unknown meta {name!r}; "
+                       f"declared: {sorted(self.meta_ids)}")
+
+    def create(self, state: PeerState, name: str, author_mask, payload,
+               aux=None) -> PeerState:
+        """``Community.create_<name>`` — author one record per masked peer."""
+        return engine.create_messages(state, self.config, author_mask,
+                                      self.meta_id(name), payload, aux)
+
+    def step(self, state: PeerState) -> PeerState:
+        """One walker interval for the whole overlay."""
+        return engine.step(state, self.config)
+
+    def coverage(self, state: PeerState, member: int, gt: int, name: str,
+                 payload: int):
+        return engine.coverage(state, member, gt, self.meta_id(name),
+                               payload)

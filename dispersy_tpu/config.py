@@ -52,6 +52,34 @@ META_UNDO_OTHER = 0xF3
 # bit 31 flags a revoke row in the auth table.
 MAX_USER_META = 24
 
+# Sync-response ordering priorities (reference: distribution.py — each
+# Distribution carries a `priority`; community.py gives the permission
+# control messages a high one so proofs outrun the records they permit).
+DEFAULT_PRIORITY = 128
+CONTROL_PRIORITY = 224
+
+# Byte-equivalent packet sizes for the traffic counters (reference:
+# conversion.py wire shapes — 23 B common header = 1 B dispersy version +
+# 1 B community version + 20 B master mid + 1 B message id; addresses are
+# 6 B sockaddrs).  The simulation has no real wire format (declared
+# anti-goal, SURVEY §7); these model the reference's packet sizes so
+# total_up/total_down are comparable, not byte-exact.
+HEADER_BYTES = 23
+ADDR_BYTES = 6
+# introduction-request: header + dest/lan/wan addrs + flags byte +
+# 2 B identifier + sync tuple (time_low/high 8+8, modulo 2, offset 2)
+# + the Bloom bitset (added per-config: bloom_words * 4).
+INTRO_REQUEST_BASE_BYTES = HEADER_BYTES + 3 * ADDR_BYTES + 1 + 2 + 20
+# introduction-response: header + dest/lan/wan + introduced lan/wan +
+# flags + identifier.
+INTRO_RESPONSE_BYTES = HEADER_BYTES + 5 * ADDR_BYTES + 1 + 2
+# puncture-request: header + target lan/wan + identifier.
+PUNCTURE_REQUEST_BYTES = HEADER_BYTES + 2 * ADDR_BYTES + 2
+# puncture: header + own lan/wan + identifier.
+PUNCTURE_BYTES = HEADER_BYTES + 2 * ADDR_BYTES + 2
+# one sync record on the wire: header + 5 uint32 columns.
+RECORD_BYTES = HEADER_BYTES + 20
+
 
 def bloom_size_for(error_rate: float, capacity: int) -> tuple[int, int]:
     """(n_bits, n_hashes) for a Bloom filter with the given design point.
@@ -83,6 +111,19 @@ class CommunityConfig:
     n_peers: int = 1024
     n_trackers: int = 2  # bootstrap peers, indices [0, n_trackers)
     #   (reference: bootstrap.py tracker list -> BootstrapCandidate)
+    # Multi-community layout (reference: dispersy.py multiplexes many
+    # Community instances over one runtime; the sync table is keyed by
+    # community).  Each entry is (n_members, n_trackers) for one community;
+    # the row axis is laid out as [all trackers, community-major][all
+    # members, community-major], so every community is a contiguous block
+    # with its own trackers inside the global tracker prefix and the whole
+    # multiplex runs as ONE fused step — walks, candidates, stores and
+    # clocks never cross blocks because candidates only ever enter through
+    # in-block walks/bootstraps.  A physical peer joining k communities
+    # contributes one row per membership, exactly like the reference's one
+    # Community instance per joined overlay.  Empty = single community
+    # (n_peers, n_trackers).
+    communities: tuple = ()
 
     # ---- walker (reference: community.py walker task + candidate.py) ----
     walk_interval: float = 5.0          # seconds per round / per step
@@ -133,6 +174,31 @@ class CommunityConfig:
     forward_fanout: int = 3             # candidates pushed to per record batch
     forward_buffer: int = 4             # fresh records buffered per peer/round
     push_inbox: int = 16                # pushed records accepted per peer/round
+
+    # ---- distribution policies per user meta (reference: distribution.py
+    #      FullSyncDistribution / LastSyncDistribution / DirectDistribution;
+    #      message.py binds one policy per meta) ----
+    # keep-last-k per (member, meta): 0 = FullSync (keep everything);
+    # k > 0 = LastSyncDistribution(history_size=k).  Empty tuple = all 0.
+    last_sync_history: tuple = ()
+    # Bit i set: user meta i is FullSync with enable_sequence_number — the
+    # author stamps consecutive sequence numbers in `aux` and receivers
+    # accept strictly in order; gaps are repaired by the Bloom pull (the
+    # record stays out of the requester's bloom until accepted, so the
+    # responder keeps re-offering it — the round-synchronous equivalent of
+    # dispersy-missing-sequence).
+    seq_meta_mask: int = 0
+    # Bit i set: user meta i is DirectDistribution — delivered by one push
+    # hop to sampled verified candidates (CommunityDestination shape),
+    # never stored, never synced, never re-forwarded; receipt is counted in
+    # stats.msgs_direct.
+    direct_meta_mask: int = 0
+    # Sync-response ordering (reference: the responder's ORDER BY
+    # (priority DESC, global_time ASC|DESC per meta)).  Empty tuple = all
+    # DEFAULT_PRIORITY.  Control metas are fixed at CONTROL_PRIORITY.
+    meta_priority: tuple = ()
+    # Bit i set: user meta i syncs newest-first (DESC).
+    desc_meta_mask: int = 0
 
     # ---- clock (reference: community.py claim_global_time /
     #      dispersy_acceptable_global_time_range) ----
@@ -191,6 +257,72 @@ class CommunityConfig:
         """Resolved founder index (founder_member with -1 defaulted)."""
         return self.n_trackers if self.founder_member < 0 else self.founder_member
 
+    @property
+    def history(self) -> tuple:
+        """last_sync_history with the empty default expanded."""
+        return self.last_sync_history or (0,) * self.n_meta
+
+    @property
+    def priorities(self) -> tuple:
+        """meta_priority with the empty default expanded."""
+        return self.meta_priority or (DEFAULT_PRIORITY,) * self.n_meta
+
+    @property
+    def any_last_sync(self) -> bool:
+        return any(k > 0 for k in self.history)
+
+    @property
+    def n_communities(self) -> int:
+        return len(self.communities) or 1
+
+    def layout(self):
+        """Per-row community layout arrays (numpy, computed per config).
+
+        Returns ``(community, boot_base, boot_count, mem_base, mem_count)``
+        int32[n_peers] arrays: each row's community id, its community's
+        tracker range [boot_base, boot_base + boot_count) and member range
+        [mem_base, mem_base + mem_count) in global row indices.  Used as
+        trace-time constants by the engine and directly by the oracle, so
+        both derive identical structure from one place.
+        """
+        import numpy as np
+        n = self.n_peers
+        if not self.communities:
+            t = self.n_trackers
+            return (np.zeros(n, np.int32),
+                    np.zeros(n, np.int32),
+                    np.full(n, t, np.int32),
+                    np.full(n, t, np.int32),
+                    np.full(n, n - t, np.int32))
+        community = np.zeros(n, np.int32)
+        boot_base = np.zeros(n, np.int32)
+        boot_count = np.zeros(n, np.int32)
+        mem_base = np.zeros(n, np.int32)
+        mem_count = np.zeros(n, np.int32)
+        t_off = 0
+        m_off = self.n_trackers
+        for c, (m_c, t_c) in enumerate(self.communities):
+            for lo, hi in ((t_off, t_off + t_c), (m_off, m_off + m_c)):
+                community[lo:hi] = c
+                boot_base[lo:hi] = t_off
+                boot_count[lo:hi] = t_c
+                mem_base[lo:hi] = m_off
+                mem_count[lo:hi] = m_c
+            t_off += t_c
+            m_off += m_c
+        return community, boot_base, boot_count, mem_base, mem_count
+
+    @property
+    def needs_response_order(self) -> bool:
+        """Does the sync responder need a non-store-order view?  True when
+        priorities differ across metas (incl. control metas outranking user
+        metas under the timeline) or any meta syncs DESC."""
+        if self.desc_meta_mask:
+            return True
+        if len(set(self.priorities)) > 1:
+            return True
+        return self.timeline_enabled and self.priorities[0] != CONTROL_PRIORITY
+
     def __post_init__(self) -> None:
         if self.n_peers <= 0:
             raise ValueError("n_peers must be positive")
@@ -210,6 +342,42 @@ class CommunityConfig:
             raise ValueError(f"n_meta must be in [1, {MAX_USER_META}]")
         if self.protected_meta_mask >> self.n_meta:
             raise ValueError("protected_meta_mask has bits above n_meta")
+        for name, mask in (("seq_meta_mask", self.seq_meta_mask),
+                           ("direct_meta_mask", self.direct_meta_mask),
+                           ("desc_meta_mask", self.desc_meta_mask)):
+            if mask >> self.n_meta:
+                raise ValueError(f"{name} has bits above n_meta")
+        if self.seq_meta_mask & self.direct_meta_mask:
+            raise ValueError("a meta cannot be both sequenced and direct")
+        if self.seq_meta_mask & self.desc_meta_mask:
+            # DESC would deliver newest-first and leave permanent sequence
+            # gaps; the reference pairs enable_sequence_number with ASC.
+            raise ValueError("sequenced metas must sync ASC")
+        if self.last_sync_history and len(self.last_sync_history) != self.n_meta:
+            raise ValueError("last_sync_history length must equal n_meta")
+        if self.meta_priority and len(self.meta_priority) != self.n_meta:
+            raise ValueError("meta_priority length must equal n_meta")
+        if any(not (0 <= p <= 255) for p in self.priorities):
+            raise ValueError("meta_priority entries must be in [0, 255]")
+        for i, k in enumerate(self.history):
+            if k < 0:
+                raise ValueError("last_sync_history entries must be >= 0")
+            if k > 0 and ((self.seq_meta_mask >> i) & 1
+                          or (self.direct_meta_mask >> i) & 1):
+                raise ValueError("a LastSync meta cannot be sequenced/direct")
+        if self.communities:
+            if any(m < 0 or t < 0 for m, t in self.communities):
+                raise ValueError("community sizes must be non-negative")
+            if sum(m + t for m, t in self.communities) != self.n_peers:
+                raise ValueError("community blocks must sum to n_peers")
+            if sum(t for _, t in self.communities) != self.n_trackers:
+                raise ValueError(
+                    "community tracker counts must sum to n_trackers")
+            if self.timeline_enabled and self.founder_member >= 0:
+                raise ValueError(
+                    "multi-community timelines use per-community founders "
+                    "(each block's first member); founder_member must stay "
+                    "auto (-1)")
         if self.timeline_enabled:
             f = self.founder
             if not (self.n_trackers <= f < self.n_peers):

@@ -50,9 +50,14 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-from dispersy_tpu.config import (EMPTY_U32, META_AUTHORIZE, META_REVOKE,
-                                 META_UNDO_OTHER, META_UNDO_OWN, NO_PEER,
+from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_U32,
+                                 INTRO_REQUEST_BASE_BYTES,
+                                 INTRO_RESPONSE_BYTES, META_AUTHORIZE,
+                                 META_REVOKE, META_UNDO_OTHER, META_UNDO_OWN,
+                                 NO_PEER, PUNCTURE_BYTES,
+                                 PUNCTURE_REQUEST_BYTES, RECORD_BYTES,
                                  CommunityConfig)
 from dispersy_tpu.ops import bloom, candidates as cand, inbox, rng, store as st
 from dispersy_tpu.ops import timeline as tl
@@ -99,6 +104,77 @@ def _auth(state: PeerState) -> tl.AuthTable:
                         gt=state.auth_gt)
 
 
+def _layout_cols(cfg: CommunityConfig, idx: jnp.ndarray):
+    """Per-row (boot_base, boot_count, mem_base, mem_count) device arrays.
+
+    Single community: global ranges broadcast.  Multi-community: each row's
+    own block ranges, derived from the static ``cfg.communities`` tuple via
+    searchsorted over the C block boundaries (C is tiny; the row axis stays
+    sharded).  Must stay consistent with ``CommunityConfig.layout()``,
+    which the oracle uses.
+    """
+    n = cfg.n_peers
+    if not cfg.communities:
+        t = cfg.n_trackers
+        return (jnp.zeros((n,), jnp.int32), jnp.full((n,), t, jnp.int32),
+                jnp.full((n,), t, jnp.int32),
+                jnp.full((n,), n - t, jnp.int32))
+    import numpy as np
+    t_cum = np.cumsum([0] + [t for _, t in cfg.communities])
+    m_cum = np.cumsum([cfg.n_trackers] + [m for m, _ in cfg.communities])
+    comm = jnp.where(
+        idx < cfg.n_trackers,
+        jnp.searchsorted(jnp.asarray(t_cum[1:], jnp.int32), idx,
+                         side="right"),
+        jnp.searchsorted(jnp.asarray(m_cum[1:], jnp.int32), idx,
+                         side="right"))
+    take = lambda a: jnp.take(jnp.asarray(a, jnp.int32), comm, axis=0)
+    return (take(t_cum[:-1]), take([t for _, t in cfg.communities]),
+            take(m_cum[:-1]), take([m for m, _ in cfg.communities]))
+
+
+def _founder_col(cfg: CommunityConfig, mem_base: jnp.ndarray) -> jnp.ndarray:
+    """u32[N]: the founder each row's community answers to.
+
+    Multi-community: the block's first member row (reference: each
+    Community has its own master member).  Single: cfg.founder.
+    """
+    if cfg.communities:
+        return mem_base.astype(jnp.uint32)
+    return jnp.full((cfg.n_peers,), cfg.founder, jnp.uint32)
+
+
+def _response_order(stc: st.StoreCols, cfg: CommunityConfig) -> st.StoreCols:
+    """The sync responder's serving order over a store.
+
+    Reference: the on_introduction_request responder streams missing
+    packets ORDER BY (priority DESC, global_time ASC|DESC per the meta's
+    distribution).  The store itself stays gt-sorted; this builds the
+    responder's *view*: priority first (control metas fixed at
+    CONTROL_PRIORITY so authorize proofs outrun the records they permit),
+    then global_time in the meta's declared direction.  Identity when the
+    community declares no ordering (every priority equal, all ASC).
+    """
+    if not cfg.needs_response_order:
+        return stc
+    nm = cfg.n_meta
+    valid = stc.gt != jnp.uint32(EMPTY_U32)
+    prio_arr = jnp.asarray(cfg.priorities, jnp.uint32)
+    meta_c = jnp.minimum(stc.meta, jnp.uint32(nm - 1)).astype(jnp.int32)
+    prio = jnp.where(stc.meta < nm, jnp.take(prio_arr, meta_c, axis=0),
+                     jnp.uint32(CONTROL_PRIORITY))
+    key1 = jnp.where(valid, jnp.uint32(255) - prio, jnp.uint32(EMPTY_U32))
+    shm = jnp.minimum(stc.meta, jnp.uint32(31))
+    desc = ((jnp.uint32(cfg.desc_meta_mask) >> shm) & 1).astype(bool) \
+        & (stc.meta < nm)
+    key2 = jnp.where(desc, ~stc.gt, stc.gt)
+    k1, k2, gt, member, meta, payload, aux, flags = lax.sort(
+        (key1, key2, stc.gt, stc.member, stc.meta, stc.payload, stc.aux,
+         stc.flags), dimension=-1, num_keys=4)
+    return st.StoreCols(gt=gt, member=member, meta=meta, payload=payload,
+                        aux=aux, flags=flags)
+
+
 def _fold_gt(own_gt: jnp.ndarray, seen_gt: jnp.ndarray, seen_valid: jnp.ndarray,
              rng_range: int) -> jnp.ndarray:
     """Lamport fold: max over acceptable observed global times.
@@ -122,6 +198,14 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     rnd = state.round_index
     now = state.time
     stats = state.stats
+    # Byte-equivalent traffic accounting (endpoint.py total_up/total_down):
+    # accumulated per site below, folded into stats at wrap-up.  Sends
+    # count pre-loss (sendto), receipts per accepted inbox slot (recvfrom).
+    bup = jnp.zeros((n,), jnp.uint32)
+    bdown = jnp.zeros((n,), jnp.uint32)
+    req_bytes = jnp.uint32(
+        INTRO_REQUEST_BASE_BYTES + 4 * cfg.bloom_words
+        if cfg.sync_enabled else INTRO_REQUEST_BASE_BYTES - 20)
 
     # ---- phase 0: churn -------------------------------------------------
     # A churned peer restarts with a wiped disk: empty store, empty
@@ -169,8 +253,10 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     # dispersy_get_walk_candidate + create_introduction_request.  Trackers
     # never walk (reference: TrackerCommunity disables the candidate
     # walker — it stays connected purely through inbound requests).
+    boot_base, boot_count, mem_base, _ = _layout_cols(cfg, idx)
     if cfg.walker_enabled:
-        target = cand.sample_walk_target(tab, now, cfg, seed, rnd, idx)
+        target = cand.sample_walk_target(tab, now, cfg, seed, rnd, idx,
+                                         boot_base, boot_count)
         target = jnp.where(alive & ~state.is_tracker, target, NO_PEER)
     else:
         target = jnp.full((n,), NO_PEER, jnp.int32)
@@ -224,12 +310,18 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             + jnp.sum(push_valid, axis=(1, 2)).astype(jnp.uint32),
             msgs_dropped=stats.msgs_dropped
             + push.n_dropped.astype(jnp.uint32))
+        push_sent = alive[:, None, None] & have_rec & tgt_ok     # pre-loss
+        bup = bup + jnp.sum(push_sent, axis=(1, 2)).astype(jnp.uint32) \
+            * jnp.uint32(RECORD_BYTES)
+        bdown = bdown + jnp.sum(ph_ok, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(RECORD_BYTES)
     else:
         p0 = jnp.zeros((n, 0), jnp.uint32)
         ph_gt = ph_member = ph_meta = ph_payload = ph_aux = p0
         ph_ok = jnp.zeros((n, 0), bool)
 
     req_lost = _lost(seed, rnd, idx, _LOSS_REQUEST, 0, cfg.packet_loss)
+    bup = bup + (alive & (target != NO_PEER)).astype(jnp.uint32) * req_bytes
     send_ok = alive & (target != NO_PEER) & ~req_lost
     to_tracker = (target >= 0) & (target < t)
     # Every request packet carries the sender's clock *as of round start*:
@@ -249,6 +341,10 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     stats = stats.replace(
         requests_dropped=stats.requests_dropped
         + req.n_dropped.astype(jnp.uint32))
+    n_rq = jnp.sum(rq_ok, axis=1).astype(jnp.uint32)
+    # handled requests: request bytes in, one response each out
+    bdown = bdown + n_rq * req_bytes
+    bup = bup + n_rq * jnp.uint32(INTRO_RESPONSE_BYTES)
 
     # ---- phase 2: request processing at the responder ------------------
     # on_introduction_request: stumble the requester, pick a third peer,
@@ -338,11 +434,20 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         stats = stats.replace(
             requests_dropped=stats.requests_dropped.at[:t].add(
                 treq.n_dropped.astype(jnp.uint32)))
+        n_tq = jnp.sum(tq_ok, axis=1).astype(jnp.uint32)
+        bdown = bdown.at[:t].add(n_tq * req_bytes)
+        bup = bup.at[:t].add(n_tq * jnp.uint32(INTRO_RESPONSE_BYTES)
+                             + jnp.sum(tq_ok & (intro_t != NO_PEER),
+                                       axis=1).astype(jnp.uint32)
+                             * jnp.uint32(PUNCTURE_REQUEST_BYTES))
     else:
         rt = 0
 
     intro = cand.sample_introductions(tab, now, cfg, seed, rnd, idx,
                                       exclude=rq_src_i)       # [N, R]
+    bup = bup + jnp.sum(rq_ok & (intro != NO_PEER),
+                        axis=1).astype(jnp.uint32) \
+        * jnp.uint32(PUNCTURE_REQUEST_BYTES)
 
     # Introduction responses are NOT re-routed through a second global sort:
     # the responder's per-slot replies (intro pick, clock) sit where the
@@ -378,6 +483,9 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         # Puncture-path inbox overflow is a real (modeled) loss too.
         requests_dropped=stats.requests_dropped
         + punc_req.n_dropped.astype(jnp.uint32))
+    n_pq = jnp.sum(pq_ok, axis=1).astype(jnp.uint32)
+    bdown = bdown + n_pq * jnp.uint32(PUNCTURE_REQUEST_BYTES)
+    bup = bup + n_pq * jnp.uint32(PUNCTURE_BYTES)   # one puncture each out
 
     # ---- phase 4: puncture hop (C -> requester) ------------------------
     p = cfg.request_inbox
@@ -395,6 +503,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     stats = stats.replace(
         requests_dropped=stats.requests_dropped
         + punc.n_dropped.astype(jnp.uint32))
+    bdown = bdown + jnp.sum(pu_ok, axis=1).astype(jnp.uint32) \
+        * jnp.uint32(PUNCTURE_BYTES)
 
     # ---- phase 3: response processing at the requester -----------------
     # on_introduction_response: mark the responder walked, the introduced
@@ -417,6 +527,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         got_raw, intro_pick = got_n, intro_n
     resp_lost = _lost(seed, rnd, idx, _LOSS_RESPONSE, 0, cfg.packet_loss)
     got_resp = got_raw & ~resp_lost & alive
+    bdown = bdown + got_resp.astype(jnp.uint32) \
+        * jnp.uint32(INTRO_RESPONSE_BYTES)
     walked = jnp.where(got_resp, target, NO_PEER)
     introduced = jnp.where(got_resp, intro_pick, NO_PEER)
     rs_gt = global_time[tgt][:, None]                         # responder clock
@@ -450,30 +562,32 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     # where sync packets are unicast to the introduction-request sender).
     if cfg.sync_enabled:
         b = cfg.response_budget
-        rec_h2 = record_hash(stc.member, stc.gt, stc.meta, stc.payload)
+        # The responder serves from its ordered view (priority DESC, gt
+        # ASC/DESC per meta); identity for default communities.
+        stv = _response_order(stc, cfg)
+        rec_h2 = record_hash(stv.member, stv.gt, stv.meta, stv.payload)
         gts, members, metas, payloads, auxs, valids = [], [], [], [], [], []
         rows = idx[:, None]
         for s in range(r):
             sl_s = st.SyncSlice(time_low=rq_tlow[:, s], time_high=rq_thigh[:, s],
                                 modulo=rq_mod[:, s], offset=rq_off[:, s])
-            in_sl = st.slice_mask(stc.gt, sl_s)                   # [N, M]
+            in_sl = st.slice_mask(stv.gt, sl_s)                   # [N, M]
             present = bloom.bloom_query(rq_bloom[:, s], rec_h2,
                                         cfg.bloom_bits, cfg.bloom_hashes)
             missing = in_sl & ~present & rq_ok[:, s:s + 1]
-            # First `b` missing records in (global_time, …) order — the
-            # store is sorted, mirroring the responder's ORDER BY
-            # global_time under dispersy_sync_response_limit.
+            # First `b` missing records in serving order — the view is the
+            # responder's ORDER BY under dispersy_sync_response_limit.
             rank = jnp.cumsum(missing.astype(jnp.int32), axis=1) - 1
             slot = jnp.where(missing & (rank < b), rank, b)
 
             def compact(col, fill):
                 return (jnp.full((n, b + 1), fill, col.dtype)
                         .at[rows, slot].set(col)[:, :b])
-            gts.append(compact(stc.gt, EMPTY_U32))
-            members.append(compact(stc.member, EMPTY_U32))
-            metas.append(compact(stc.meta, EMPTY_U32))
-            payloads.append(compact(stc.payload, EMPTY_U32))
-            auxs.append(compact(stc.aux, 0))
+            gts.append(compact(stv.gt, EMPTY_U32))
+            members.append(compact(stv.member, EMPTY_U32))
+            metas.append(compact(stv.meta, EMPTY_U32))
+            payloads.append(compact(stv.payload, EMPTY_U32))
+            auxs.append(compact(stv.aux, 0))
             valids.append(compact(missing, False))
         obox = [jnp.stack(c, axis=1)
                 for c in (gts, members, metas, payloads, auxs)]
@@ -486,6 +600,10 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                           jnp.arange(b)[None, :], cfg.packet_loss)
         sy_ok = (obox_ok[tgt, slot_n] & (req.edge_slot >= 0)[:, None]
                  & alive[:, None] & ~sync_lost)
+        bup = bup + jnp.sum(obox_ok, axis=(1, 2)).astype(jnp.uint32) \
+            * jnp.uint32(RECORD_BYTES)
+        bdown = bdown + jnp.sum(sy_ok, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(RECORD_BYTES)
     else:
         s0 = jnp.zeros((n, 0), jnp.uint32)
         sy_gt = sy_member = sy_meta = sy_payload = sy_aux = s0
@@ -524,7 +642,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             # _on_batch_cache -> meta.check_callback -> timeline.py
             # Timeline.check).  Control records carry their own authority
             # rule; user records with a protected meta need a permit grant.
-            founder = jnp.uint32(cfg.founder)
+            founder = _founder_col(cfg, mem_base)[:, None]        # [N, 1]
             is_auth = in_meta == jnp.uint32(META_AUTHORIZE)
             is_rev = in_meta == jnp.uint32(META_REVOKE)
             is_undo_own = in_meta == jnp.uint32(META_UNDO_OWN)
@@ -551,8 +669,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             prot = jnp.uint32(cfg.protected_meta_mask)
             shift = jnp.minimum(in_meta, jnp.uint32(31))
             protected = (((prot >> shift) & 1) == 1) & (in_meta < 32)
-            permitted = tl.check(auth, in_member, in_meta, in_gt,
-                                 cfg.founder)
+            permitted = tl.check(auth, in_member, in_meta, in_gt, founder)
             accept = in_ok & jnp.where(
                 is_ctrl, ctrl_ok, jnp.where(protected, permitted, True))
 
@@ -574,12 +691,84 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         else:
             accept = in_ok
 
-        fresh = accept & ~in_store & ~dup_in_batch                # [N, B]
+        if cfg.seq_meta_mask:
+            # enable_sequence_number intake: a sequenced record is accepted
+            # only when it chains directly onto the highest sequence this
+            # peer holds for its (member, meta) — gaps wait for the Bloom
+            # pull to re-offer the missing link (the round-synchronous
+            # dispersy-missing-sequence; reference: message.py
+            # DelayMessageBySequence + community.py on_missing_sequence).
+            shm = jnp.minimum(in_meta, jnp.uint32(31))
+            is_seq = ((((jnp.uint32(cfg.seq_meta_mask) >> shm) & 1) == 1)
+                      & (in_meta < cfg.n_meta))
+            # Re-deliveries of already-stored records bypass the chain test
+            # (they are plain dups, handled by the UNIQUE insert).
+            seq_check = is_seq & ~in_store
+            same_store = ((stc.member[:, None, :] == in_member[:, :, None])
+                          & (stc.meta[:, None, :] == in_meta[:, :, None])
+                          & (stc.gt[:, None, :] != jnp.uint32(EMPTY_U32)))
+            stored_max = jnp.max(
+                jnp.where(same_store, stc.aux[:, None, :], 0), axis=-1)
+
+            def seq_body(j, carry):
+                acc_max, ok = carry
+                aux_j = lax.dynamic_index_in_dim(in_aux, j, 1, False)  # [N]
+                chain = aux_j == lax.dynamic_index_in_dim(
+                    acc_max, j, 1, False) + 1
+                chk_j = lax.dynamic_index_in_dim(seq_check, j, 1, False)
+                ok_j = jnp.where(chk_j, chain, True)
+                ok = lax.dynamic_update_index_in_dim(ok, ok_j, j, 1)
+                took = (lax.dynamic_index_in_dim(accept, j, 1, False)
+                        & chk_j & chain)
+                grp = ((in_member == lax.dynamic_index_in_dim(
+                            in_member, j, 1)[:, :1])
+                       & (in_meta == lax.dynamic_index_in_dim(
+                           in_meta, j, 1)[:, :1]))
+                acc_max = jnp.where(grp & took[:, None],
+                                    jnp.maximum(acc_max, aux_j[:, None]),
+                                    acc_max)
+                return acc_max, ok
+
+            _, seq_ok = lax.fori_loop(
+                0, bb, seq_body, (stored_max, jnp.ones_like(accept)))
+            stats = stats.replace(
+                msgs_rejected=stats.msgs_rejected
+                + jnp.sum(accept & ~seq_ok, axis=1).astype(jnp.uint32))
+            accept = accept & seq_ok
+
+        if cfg.direct_meta_mask:
+            # DirectDistribution receipt: counted, never stored, never
+            # re-forwarded (reference: distribution.py DirectDistribution —
+            # one-shot delivery outside the sync store).
+            shm = jnp.minimum(in_meta, jnp.uint32(31))
+            is_direct = ((((jnp.uint32(cfg.direct_meta_mask) >> shm) & 1)
+                          == 1) & (in_meta < cfg.n_meta))
+            stats = stats.replace(
+                msgs_direct=stats.msgs_direct
+                + jnp.sum(accept & is_direct, axis=1).astype(jnp.uint32))
+            accept_store = accept & ~is_direct
+        else:
+            accept_store = accept
+
+        fresh = accept_store & ~in_store & ~dup_in_batch          # [N, B]
+        # Per-meta acceptance counters (statistics.py per-message-name
+        # success counts): fresh stored records plus direct receipts;
+        # control metas share the last bucket.
+        counted = fresh
+        if cfg.direct_meta_mask:
+            counted = fresh | (accept & is_direct)
+        bucket = jnp.where(in_meta < cfg.n_meta, in_meta,
+                           cfg.n_meta).astype(jnp.int32)          # [N, B]
+        contrib = jnp.sum(
+            (bucket[:, :, None] == jnp.arange(cfg.n_meta + 1)[None, None, :])
+            & counted[:, :, None], axis=1).astype(jnp.uint32)     # [N, K+1]
+        stats = stats.replace(
+            accepted_by_meta=stats.accepted_by_meta + contrib)
         ins = st.store_insert(
             stc,
             st.StoreCols(gt=in_gt, member=in_member, meta=in_meta,
                          payload=in_payload, aux=in_aux, flags=in_flags),
-            new_mask=accept)
+            new_mask=accept_store, history=cfg.history)
         stc = ins.store
         global_time = _fold_gt(global_time, in_gt, accept,
                                cfg.acceptable_global_time_range)
@@ -629,7 +818,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         fwd_gt=fwd[0], fwd_member=fwd[1], fwd_meta=fwd[2], fwd_payload=fwd[3],
         fwd_aux=fwd[4],
         auth_member=auth.member, auth_mask=auth.mask, auth_gt=auth.gt,
-        stats=stats,
+        stats=stats.replace(bytes_up=stats.bytes_up + bup,
+                            bytes_down=stats.bytes_down + bdown),
         time=now + jnp.float32(cfg.walk_interval),
         round_index=rnd + jnp.uint32(1),
     )
@@ -663,15 +853,28 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
     auth = _auth(state)
     gt_new = state.global_time + jnp.uint32(1)
 
+    is_seq_meta = meta < cfg.n_meta and (cfg.seq_meta_mask >> meta) & 1
+    is_direct_meta = meta < cfg.n_meta and (cfg.direct_meta_mask >> meta) & 1
+    if is_seq_meta:
+        # The author stamps the next sequence number for (self, meta)
+        # (reference: FullSyncDistribution.claim_sequence_number).
+        own = ((state.store_member == idx[:, None])
+               & (state.store_meta == jnp.uint32(meta))
+               & (state.store_gt != jnp.uint32(EMPTY_U32)))
+        aux = jnp.max(jnp.where(own, state.store_aux, 0),
+                      axis=1) + jnp.uint32(1)
+
     if cfg.timeline_enabled:
+        _, _, mem_base, _ = _layout_cols(cfg, jnp.arange(n, dtype=jnp.int32))
+        founder_row = _founder_col(cfg, mem_base)
         if meta in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER):
-            allowed = idx == jnp.uint32(cfg.founder)
+            allowed = idx == founder_row
         elif meta == META_UNDO_OWN:
             allowed = payload == idx
         elif meta < 32 and (cfg.protected_meta_mask >> meta) & 1:
             allowed = tl.check(auth, idx[:, None],
                                jnp.full((n, 1), meta, jnp.uint32),
-                               gt_new[:, None], cfg.founder)[:, 0]
+                               gt_new[:, None], founder_row[:, None])[:, 0]
         else:
             allowed = jnp.ones((n,), bool)
         author_mask = author_mask & allowed
@@ -683,7 +886,11 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
         payload=payload[:, None],
         aux=aux[:, None],
         flags=jnp.zeros((n, 1), jnp.uint32))
-    ins = st.store_insert(_store(state), new, author_mask[:, None])
+    # Direct records are one-shot: pushed, never stored anywhere
+    # (reference: DirectDistribution messages live outside the sync table).
+    store_mask = (jnp.zeros((n,), bool) if is_direct_meta else author_mask)
+    ins = st.store_insert(_store(state), new, store_mask[:, None],
+                          history=cfg.history)
     stc = ins.store
 
     if cfg.timeline_enabled and meta in (META_AUTHORIZE, META_REVOKE):
@@ -724,7 +931,10 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
         global_time=jnp.where(author_mask, gt_new, state.global_time),
         stats=state.stats.replace(
             msgs_stored=state.stats.msgs_stored
-            + ins.n_inserted.astype(jnp.uint32)))
+            + ins.n_inserted.astype(jnp.uint32),
+            accepted_by_meta=state.stats.accepted_by_meta
+            .at[:, min(meta, cfg.n_meta)]
+            .add(author_mask.astype(jnp.uint32))))
 
 
 def seed_overlay(state: PeerState, cfg: CommunityConfig,
@@ -739,16 +949,23 @@ def seed_overlay(state: PeerState, cfg: CommunityConfig,
     assert degree <= cfg.k_candidates
     n, t = cfg.n_peers, cfg.n_trackers
     assert n - t > 1, "need at least two non-tracker peers to seed an overlay"
+    if cfg.communities:
+        assert all(m > 1 for m, _ in cfg.communities), \
+            "every community needs at least two members to seed"
     seed = rng.fold_seed(state.key)
     idx = jnp.arange(n, dtype=jnp.int32)
     j = jnp.arange(degree)[None, :]
-    # Neighbors are drawn from [n_trackers, n): trackers must never enter the
-    # walk categories (see ops/candidates.upsert_many).
-    span = jnp.uint32(n - t)
-    nbr = t + (rng.rand_u32(seed, jnp.uint32(0xE1), idx[:, None], rng.P_GOSSIP, j)
-               % span).astype(jnp.int32)
+    # Neighbors are drawn from the row's own community member block:
+    # trackers must never enter the walk categories (see
+    # ops/candidates.upsert_many), and overlays never cross communities.
+    _, _, mem_base, mem_count = _layout_cols(cfg, idx)
+    base = mem_base[:, None]
+    span = jnp.maximum(mem_count, 1)[:, None]
+    nbr = base + (rng.rand_u32(seed, jnp.uint32(0xE1), idx[:, None],
+                               rng.P_GOSSIP, j)
+                  % span.astype(jnp.uint32)).astype(jnp.int32)
     nbr = jnp.where(nbr == idx[:, None],
-                    t + (nbr - t + 1) % span.astype(jnp.int32), nbr)
+                    base + (nbr - base + 1) % span, nbr)
     # One slot per neighbor: the candidate table is keyed by peer (the
     # reference's dict is keyed by address), so a duplicate draw becomes an
     # empty slot instead of two entries for one peer.
@@ -783,3 +1000,27 @@ def coverage(state: PeerState, member: int, gt: int, meta: int,
     syncing = state.alive & ~state.is_tracker
     has = jnp.any(hit, axis=1) & syncing
     return jnp.sum(has) / jnp.maximum(jnp.sum(syncing), 1)
+
+
+def coverage_by_community(state: PeerState, cfg: CommunityConfig,
+                          member: int, gt: int, meta: int,
+                          payload: int) -> jnp.ndarray:
+    """f32[C]: per-community fraction of alive members holding one record.
+
+    Multi-community form of :func:`coverage` (driver config #5 reports
+    per-community convergence).  A record authored in community c can only
+    ever live in block c, so other blocks report 0 for it.
+    """
+    comm = jnp.asarray(cfg.layout()[0])
+    hit = ((state.store_gt == jnp.uint32(gt))
+           & (state.store_member == jnp.uint32(member))
+           & (state.store_meta == jnp.uint32(meta))
+           & (state.store_payload == jnp.uint32(payload)))
+    syncing = state.alive & ~state.is_tracker
+    has = jnp.any(hit, axis=1) & syncing
+    out = []
+    for c in range(cfg.n_communities):
+        in_c = comm == c
+        out.append(jnp.sum(has & in_c)
+                   / jnp.maximum(jnp.sum(syncing & in_c), 1))
+    return jnp.stack(out)

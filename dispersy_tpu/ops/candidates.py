@@ -161,7 +161,9 @@ def _pick_by_priority(mask: jnp.ndarray, prio: jnp.ndarray) -> jnp.ndarray:
 
 def sample_walk_target(tab: CandTable, now: jnp.ndarray, cfg: CommunityConfig,
                        seed: jnp.ndarray, round_index: jnp.ndarray,
-                       self_idx: jnp.ndarray) -> jnp.ndarray:
+                       self_idx: jnp.ndarray,
+                       boot_base: jnp.ndarray | None = None,
+                       boot_count: jnp.ndarray | None = None) -> jnp.ndarray:
     """One walk destination per peer: ``dispersy_get_walk_candidate``.
 
     Category chosen by threshold on one uniform draw (≈49.75 / 24.875 /
@@ -172,6 +174,11 @@ def sample_walk_target(tab: CandTable, now: jnp.ndarray, cfg: CommunityConfig,
     within a category is by hashed per-slot priority (uniform over eligible
     slots, oracle-replayable).  Returns i32[N], NO_PEER where no target
     exists (no eligible candidates and no trackers).
+
+    ``boot_base``/``boot_count`` (i32[N]): each row's community tracker
+    range for the bootstrap branch — multi-community layouts bootstrap
+    within their own block (reference: each Community resolves its own
+    tracker list).  Defaults to the global [0, n_trackers) range.
     """
     n, k = tab.peer.shape
     cats = categories(tab, now, cfg)
@@ -187,13 +194,19 @@ def sample_walk_target(tab: CandTable, now: jnp.ndarray, cfg: CommunityConfig,
                                    tab.peer, jnp.maximum(slot, 0)[:, None],
                                    axis=1)[:, 0],
                                NO_PEER))
-    # Bootstrap: a random tracker (indices [0, n_trackers)), never self.
+    # Bootstrap: a random tracker of the row's own community, never self.
     if cfg.n_trackers > 0:
-        t = rng.rand_u32(seed, round_index, self_idx, rng.P_BOOTSTRAP) \
-            % jnp.uint32(cfg.n_trackers)
-        t = t.astype(jnp.int32)
-        t = jnp.where(t == self_idx, (t + 1) % cfg.n_trackers, t)
-        boot = jnp.where(t == self_idx, NO_PEER, t)
+        if boot_base is None:
+            boot_base = jnp.zeros((n,), jnp.int32)
+            boot_count = jnp.full((n,), cfg.n_trackers, jnp.int32)
+        cnt = jnp.maximum(boot_count, 1).astype(jnp.uint32)
+        t = boot_base + (rng.rand_u32(seed, round_index, self_idx,
+                                      rng.P_BOOTSTRAP)
+                         % cnt).astype(jnp.int32)
+        t = jnp.where(t == self_idx,
+                      boot_base + (t - boot_base + 1) % jnp.maximum(boot_count, 1),
+                      t)
+        boot = jnp.where((t == self_idx) | (boot_count == 0), NO_PEER, t)
     else:
         boot = jnp.full((n,), NO_PEER, jnp.int32)
     picks.append(boot)

@@ -74,7 +74,8 @@ class InsertResult(NamedTuple):
 
 
 def store_insert(store: StoreCols, new: StoreCols,
-                 new_mask: jnp.ndarray) -> InsertResult:
+                 new_mask: jnp.ndarray,
+                 history: tuple = ()) -> InsertResult:
     """Merge a batch of records into each peer's sorted store.
 
     Semantics mirror the reference's store pipeline
@@ -84,6 +85,13 @@ def store_insert(store: StoreCols, new: StoreCols,
       *existing* store entry wins (a second message by the same member at the
       same global_time is dropped — the reference treats that as a conflict
       and keeps the first-seen packet).
+    - ``history``: per-user-meta keep-last-k (reference: distribution.py
+      ``LastSyncDistribution(history_size=k)`` + the check/clean-up in
+      community.py that deletes older rows per (member, meta)): when meta i
+      has history[i] = k > 0, only the k highest-global-time records per
+      (member, meta) survive the merge — an arriving older record is
+      dropped, an arriving newer one evicts the oldest kept.  Empty tuple
+      (or all zeros) = FullSync for every meta.
     - capacity overflow keeps the M records that sort first (lowest
       global_time) — modeling a full store the way UDP overflow drops
       packets: counted, never raised.  New records that don't fit are
@@ -123,13 +131,31 @@ def store_insert(store: StoreCols, new: StoreCols,
     dup = jnp.zeros_like(gt, dtype=bool).at[..., 1:].set(
         (gt[..., 1:] == gt[..., :-1]) & (member[..., 1:] == member[..., :-1])
         & (gt[..., 1:] != _EMPTY))
-    gt = jnp.where(dup, _EMPTY, gt)
-    member = jnp.where(dup, _EMPTY, member)
-    meta = jnp.where(dup, _EMPTY, meta)
-    payload = jnp.where(dup, _EMPTY, payload)
-    aux = jnp.where(dup, 0, aux)
-    flags = jnp.where(dup, 0, flags)
-    origin = jnp.where(dup, 0, origin)
+    kill = dup
+    if any(k > 0 for k in history):
+        # LastSync keep-last-k: evict every record with >= k higher-gt
+        # survivors in its (member, meta) group.  gts within a group are
+        # unique (UNIQUE(member, gt) holds after the dup kill), so the
+        # count is unambiguous.  [.., W, W] pairwise compare, W = M + B —
+        # only compiled in for communities that declare a LastSync meta.
+        nm = len(history)
+        k_arr = jnp.asarray(history, jnp.int32)
+        meta_c = jnp.minimum(meta, jnp.uint32(nm - 1)).astype(jnp.int32)
+        k_meta = jnp.where(meta < nm, jnp.take(k_arr, meta_c, axis=0), 0)
+        live = (gt != _EMPTY) & ~dup
+        same = (live[..., :, None] & live[..., None, :]
+                & (member[..., :, None] == member[..., None, :])
+                & (meta[..., :, None] == meta[..., None, :]))
+        newer = jnp.sum(same & (gt[..., None, :] > gt[..., :, None]),
+                        axis=-1)
+        kill = dup | ((k_meta > 0) & live & (newer >= k_meta))
+    gt = jnp.where(kill, _EMPTY, gt)
+    member = jnp.where(kill, _EMPTY, member)
+    meta = jnp.where(kill, _EMPTY, meta)
+    payload = jnp.where(kill, _EMPTY, payload)
+    aux = jnp.where(kill, 0, aux)
+    flags = jnp.where(kill, 0, flags)
+    origin = jnp.where(kill, 0, origin)
 
     # Compact: killed/hole entries (gt == EMPTY) sort to the end; truncate.
     gt, member, meta, payload, origin, aux, flags = lax.sort(

@@ -49,7 +49,7 @@ class AuthTable(NamedTuple):
 
 
 def check(tab: AuthTable, member: jnp.ndarray, meta: jnp.ndarray,
-          gt: jnp.ndarray, founder: int) -> jnp.ndarray:
+          gt: jnp.ndarray, founder) -> jnp.ndarray:
     """Is ``member`` permitted to emit ``meta`` at ``gt``?  [N, B] verdicts.
 
     Mirrors ``Timeline.check`` for the permit permission: the latest
@@ -58,7 +58,9 @@ def check(tab: AuthTable, member: jnp.ndarray, meta: jnp.ndarray,
     permitted.  The founder is always permitted.
 
     ``member``/``meta``/``gt`` are [N, B] record fields checked against each
-    receiving peer's own table.
+    receiving peer's own table.  ``founder`` is an int (one community) or a
+    per-row array broadcastable against [N, B] (multi-community layouts,
+    where each block answers to its own founder).
     """
     # Clamped shift: control metas (>= 32) never match a mask bit, and a
     # shift >= the bit width would be undefined in XLA.
@@ -76,7 +78,7 @@ def check(tab: AuthTable, member: jnp.ndarray, meta: jnp.ndarray,
     granted = (jnp.any(at_best & ~is_revoke, axis=-1)
                & ~jnp.any(at_best & is_revoke, axis=-1)
                & jnp.any(match, axis=-1))
-    return granted | (member == jnp.uint32(founder))
+    return granted | (member == jnp.asarray(founder, jnp.uint32))
 
 
 class FoldResult(NamedTuple):

@@ -25,8 +25,12 @@ from __future__ import annotations
 
 import numpy as np
 
-from dispersy_tpu.config import (EMPTY_U32, META_AUTHORIZE, META_REVOKE,
-                                 META_UNDO_OTHER, META_UNDO_OWN, NO_PEER,
+from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_U32,
+                                 INTRO_REQUEST_BASE_BYTES,
+                                 INTRO_RESPONSE_BYTES, META_AUTHORIZE,
+                                 META_REVOKE, META_UNDO_OTHER, META_UNDO_OWN,
+                                 NO_PEER, PUNCTURE_BYTES,
+                                 PUNCTURE_REQUEST_BYTES, RECORD_BYTES,
                                  CommunityConfig)
 from dispersy_tpu.oracle.bloom import OracleBloom, record_hash
 from dispersy_tpu.ops import rng as _jrng
@@ -146,6 +150,9 @@ class OraclePeer:
         self.msgs_stored = self.msgs_dropped = 0
         self.requests_dropped = self.punctures = 0
         self.msgs_forwarded = self.msgs_rejected = 0
+        self.msgs_direct = 0
+        self.bytes_up = self.bytes_down = 0          # wrap mod 2^32
+        self.accepted_by_meta = [0] * (cfg.n_meta + 1)
 
 
 class OracleSim:
@@ -157,6 +164,16 @@ class OracleSim:
         self.rnd = 0
         self.now = np.float32(0.0)
         self.peers = [OraclePeer(cfg) for _ in range(cfg.n_peers)]
+        # Multi-community layout (engine._layout_cols mirror, same source).
+        (self.community, self.boot_base, self.boot_count,
+         self.mem_base, self.mem_count) = cfg.layout()
+
+    def _founder(self, owner: int) -> int:
+        """The founder row the owner's community answers to
+        (engine._founder_col mirror)."""
+        if self.cfg.communities:
+            return int(self.mem_base[owner])
+        return self.cfg.founder
 
     # ---- helpers mirroring ops/candidates.py --------------------------------
 
@@ -232,10 +249,13 @@ class OracleSim:
             j = self._pick_by_priority(mask, prio)
             picks.append(slots[j].peer if j >= 0 else NO_PEER)
         if cfg.n_trackers > 0:
-            tdraw = rand_u32(self.seed, self.rnd, i, P_BOOTSTRAP) % cfg.n_trackers
+            base = int(self.boot_base[i])
+            cnt = int(self.boot_count[i])
+            c = max(cnt, 1)
+            tdraw = base + rand_u32(self.seed, self.rnd, i, P_BOOTSTRAP) % c
             if tdraw == i:
-                tdraw = (tdraw + 1) % cfg.n_trackers
-            picks.append(NO_PEER if tdraw == i else tdraw)
+                tdraw = base + (tdraw - base + 1) % c
+            picks.append(NO_PEER if (tdraw == i or cnt == 0) else tdraw)
         else:
             picks.append(NO_PEER)
         r = rand_uniform(self.seed, self.rnd, i, P_CATEGORY)
@@ -297,6 +317,22 @@ class OracleSim:
             if kept and kept[-1][0].gt == r.gt and kept[-1][0].member == r.member:
                 continue  # duplicate (gt, member): first (existing) wins
             kept.append((r, o))
+        history = self.cfg.history
+        if any(k > 0 for k in history):
+            # LastSync keep-last-k per (member, meta), counted against the
+            # post-dedup merged set (the engine's `newer` count).
+            def k_of(meta: int) -> int:
+                return history[meta] if meta < len(history) else 0
+
+            def survives(r: Record) -> bool:
+                k = k_of(r.meta)
+                if k == 0:
+                    return True
+                newer = sum(1 for q, _ in kept
+                            if q.member == r.member and q.meta == r.meta
+                            and q.gt > r.gt)
+                return newer < k
+            kept = [(r, o) for r, o in kept if survives(r)]
         kept = kept[:m]
         p.store = [r for r, _ in kept]
         n_inserted = sum(1 for _, o in kept if o == 1)
@@ -305,6 +341,21 @@ class OracleSim:
         if count_drops:
             p.msgs_dropped += ((n_new_valid - n_inserted)
                                + (n_before - n_surviving_old))
+
+    def _serve_order(self, store: list[Record]) -> list[Record]:
+        """engine._response_order mirror: the responder's serving view."""
+        cfg = self.cfg
+        if not cfg.needs_response_order:
+            return store
+        nm = cfg.n_meta
+        pr = cfg.priorities
+
+        def key(r: Record):
+            prio = pr[r.meta] if r.meta < nm else CONTROL_PRIORITY
+            desc = r.meta < nm and ((cfg.desc_meta_mask >> r.meta) & 1)
+            k2 = (M32 - r.gt) if desc else r.gt
+            return (255 - prio, k2, r.gt, r.member)
+        return sorted(store, key=key)
 
     def _claim_slice(self, owner: int):
         """(time_low, time_high, modulo, offset) — claim_slice_largest/_modulo."""
@@ -340,7 +391,7 @@ class OracleSim:
 
     def _auth_check(self, owner: int, member: int, meta: int, gt: int) -> bool:
         """tl.check for one record vs one peer's table."""
-        if member == self.cfg.founder:
+        if member == self._founder(owner):
             return True
         if meta >= 32:
             return False
@@ -377,7 +428,7 @@ class OracleSim:
             return True
         m = rec.meta
         if m in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER):
-            return rec.member == cfg.founder
+            return rec.member == self._founder(owner)
         if m == META_UNDO_OWN:
             return rec.member == rec.payload
         if m < 32 and (cfg.protected_meta_mask >> m) & 1:
@@ -398,7 +449,7 @@ class OracleSim:
             pv = int(payload[i])
             if cfg.timeline_enabled:
                 if meta in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER):
-                    if i != cfg.founder:
+                    if i != self._founder(i):
                         continue
                 elif meta == META_UNDO_OWN:
                     if pv != i:
@@ -406,8 +457,12 @@ class OracleSim:
                 elif meta < 32 and (cfg.protected_meta_mask >> meta) & 1:
                     if not self._auth_check(i, i, meta, gt):
                         continue
+            if meta < cfg.n_meta and (cfg.seq_meta_mask >> meta) & 1:
+                av = max((r.aux for r in p.store
+                          if r.member == i and r.meta == meta), default=0) + 1
             rec = Record(gt, i, meta, pv, av)
-            self._store_insert(i, [rec], count_drops=False)
+            if not (meta < cfg.n_meta and (cfg.direct_meta_mask >> meta) & 1):
+                self._store_insert(i, [rec], count_drops=False)
             if cfg.timeline_enabled and meta in (META_AUTHORIZE, META_REVOKE):
                 self._auth_fold(i, pv, av & ((1 << cfg.n_meta) - 1), gt,
                                 meta == META_REVOKE)
@@ -421,17 +476,17 @@ class OracleSim:
             p.global_time = gt
 
     def seed_overlay(self, degree: int) -> None:
-        """engine.seed_overlay mirror."""
+        """engine.seed_overlay mirror (per-community member blocks)."""
         cfg = self.cfg
-        t = cfg.n_trackers
-        span = cfg.n_peers - t
         eligible_at = _f32(np.float32(0.0) - np.float32(cfg.eligibility_delay))
         for i, p in enumerate(self.peers):
+            base = int(self.mem_base[i])
+            span = max(int(self.mem_count[i]), 1)
             seen: set[int] = set()
             for j in range(degree):
-                nbr = t + rand_u32(self.seed, 0xE1, i, P_GOSSIP, j) % span
+                nbr = base + rand_u32(self.seed, 0xE1, i, P_GOSSIP, j) % span
                 if nbr == i:
-                    nbr = t + (nbr - t + 1) % span
+                    nbr = base + (nbr - base + 1) % span
                 if nbr in seen:   # one slot per neighbor (engine dedup)
                     continue
                 seen.add(nbr)
@@ -479,8 +534,14 @@ class OracleSim:
                         bloom.add(rec.hash())
                 slices[i], blooms[i] = sl, bloom
 
+        # byte-equivalent sizes (engine mirror)
+        req_bytes = (INTRO_REQUEST_BASE_BYTES + 4 * (cfg.bloom_bits // 32)
+                     if cfg.sync_enabled else INTRO_REQUEST_BASE_BYTES - 20)
+
         send_ok = [False] * n
         for i in range(n):
+            if self.peers[i].alive and targets[i] != NO_PEER:
+                self.peers[i].bytes_up += req_bytes          # sendto, pre-loss
             send_ok[i] = (self.peers[i].alive and targets[i] != NO_PEER
                           and not self._lost(i, _LOSS_REQUEST, 0))
 
@@ -503,14 +564,18 @@ class OracleSim:
                 sent = 0
                 for fi, rec in enumerate(p.fwd):
                     for ci, tc in enumerate(tgts):
-                        if (p.alive and tc != NO_PEER
-                                and not self._lost(i, _LOSS_FORWARD,
-                                                   fi * cc + ci)):
-                            sent += 1
-                            if len(push_inbox[tc]) < cfg.push_inbox:
-                                push_inbox[tc].append(rec)
-                            else:
-                                self.peers[tc].msgs_dropped += 1
+                        if p.alive and tc != NO_PEER:
+                            p.bytes_up += RECORD_BYTES       # pre-loss
+                            if not self._lost(i, _LOSS_FORWARD,
+                                              fi * cc + ci):
+                                sent += 1
+                                if len(push_inbox[tc]) < cfg.push_inbox:
+                                    push_inbox[tc].append(rec)
+                                    if self.peers[tc].alive:
+                                        self.peers[tc].bytes_down += \
+                                            RECORD_BYTES
+                                else:
+                                    self.peers[tc].msgs_dropped += 1
                 p.msgs_forwarded += sent
 
         # request delivery (normal peers): edge order = sender order
@@ -527,6 +592,11 @@ class OracleSim:
         # rq_ok also requires the *receiver* alive
         rq_ok = [[self.peers[d].alive for _ in box]
                  for d, box in enumerate(req_inbox)]
+        for d in range(n):
+            n_rq = sum(rq_ok[d])
+            # handled requests: request bytes in, one response each out
+            self.peers[d].bytes_down += n_rq * req_bytes
+            self.peers[d].bytes_up += n_rq * INTRO_RESPONSE_BYTES
 
         # snapshot sender clocks as they rode the request packet
         req_gt = {i: self.peers[i].global_time for i in range(n)}
@@ -592,6 +662,13 @@ class OracleSim:
                                       else ring_pick)
                 self._fold_gt(d, [req_gt[src] for s_ix, src in
                                   enumerate(tq_inbox[d]) if tq_ok[d][s_ix]])
+                n_tq = sum(tq_ok[d])
+                self.peers[d].bytes_down += n_tq * req_bytes
+                self.peers[d].bytes_up += (
+                    n_tq * INTRO_RESPONSE_BYTES
+                    + sum(1 for s_ix in range(len(tq_inbox[d]))
+                          if tq_ok[d][s_ix] and intro_t[d][s_ix] != NO_PEER)
+                    * PUNCTURE_REQUEST_BYTES)
 
         # introduction picks at normal responders
         intro: list[list[int]] = [[] for _ in range(n)]
@@ -600,6 +677,8 @@ class OracleSim:
                 ex = src if rq_ok[d][s_ix] else NO_PEER
                 intro[d].append(self._sample_intro(
                     d, self.peers[d].slots, s_ix, ex, 0))
+                if rq_ok[d][s_ix] and intro[d][s_ix] != NO_PEER:
+                    self.peers[d].bytes_up += PUNCTURE_REQUEST_BYTES
 
         # puncture-request edges: normal responders (row-major), then trackers
         pr_edges = []  # (dst=C, named requester A)
@@ -628,7 +707,10 @@ class OracleSim:
         pq_ok = [[self.peers[c].alive for _ in box]
                  for c, box in enumerate(punc_req_inbox)]
         for c in range(n):
-            self.peers[c].punctures += sum(pq_ok[c])
+            n_pq = sum(pq_ok[c])
+            self.peers[c].punctures += n_pq
+            self.peers[c].bytes_down += n_pq * PUNCTURE_REQUEST_BYTES
+            self.peers[c].bytes_up += n_pq * PUNCTURE_BYTES
 
         # phase 4: puncture hop C -> A
         pu_edges = []
@@ -645,6 +727,8 @@ class OracleSim:
                     self.peers[a].requests_dropped += 1
         pu_ok = [[self.peers[a].alive for _ in box]
                  for a, box in enumerate(punc_inbox)]
+        for a in range(n):
+            self.peers[a].bytes_down += sum(pu_ok[a]) * PUNCTURE_BYTES
 
         # phase 3: response pickup by receipt
         got_resp = [False] * n
@@ -683,16 +767,17 @@ class OracleSim:
                 self.peers[i].walk_fail += 1
                 self._remove(i, targets[i])
 
-        # phase 2b: sync responder outboxes
+        # phase 2b: sync responder outboxes (served in the ordered view)
         outbox: dict[tuple[int, int], list[Record]] = {}
         if cfg.sync_enabled:
             b = cfg.response_budget
             for d in range(n):
+                view = self._serve_order(self.peers[d].store)
                 for s_ix, src in enumerate(req_inbox[d]):
                     sel: list[Record] = []
                     if rq_ok[d][s_ix]:
                         sl, bl = slices[src], blooms[src]
-                        for rec in self.peers[d].store:
+                        for rec in view:
                             if len(sel) >= b:
                                 break
                             if self._in_slice(rec, sl) and rec.hash() not in bl:
@@ -730,12 +815,48 @@ class OracleSim:
                 # before any check runs (engine: tl.fold precedes tl.check).
                 for rec, f0 in zip(ok_batch, fresh0):
                     if (rec.meta in (META_AUTHORIZE, META_REVOKE) and f0
-                            and rec.member == cfg.founder):
+                            and rec.member == self._founder(i)):
                         self._auth_fold(i, rec.payload,
                                         rec.aux & ((1 << cfg.n_meta) - 1),
                                         rec.gt, rec.meta == META_REVOKE)
             accept = [self._intake_accept(i, rec) for rec in ok_batch]
             p.msgs_rejected += sum(1 for a in accept if not a)
+
+            if cfg.seq_meta_mask:
+                # Sequence-chain intake (engine's fori scan, in batch order).
+                acc_state: dict[tuple[int, int], int] = {}
+                accept2 = []
+                for rec, a in zip(ok_batch, accept):
+                    is_seq = (rec.meta < cfg.n_meta
+                              and (cfg.seq_meta_mask >> rec.meta) & 1)
+                    chk = is_seq and (rec.gt, rec.member) not in store_keys
+                    if chk:
+                        gkey = (rec.member, rec.meta)
+                        cur = acc_state.get(gkey)
+                        if cur is None:
+                            cur = max((r.aux for r in p.store
+                                       if r.member == rec.member
+                                       and r.meta == rec.meta), default=0)
+                        ok_i = rec.aux == cur + 1
+                        if a and ok_i:
+                            acc_state[gkey] = max(cur, rec.aux)
+                    else:
+                        ok_i = True
+                    if a and not ok_i:
+                        p.msgs_rejected += 1
+                    accept2.append(a and ok_i)
+                accept = accept2
+
+            if cfg.direct_meta_mask:
+                accept_store = []
+                for rec, a in zip(ok_batch, accept):
+                    is_dir = (rec.meta < cfg.n_meta
+                              and (cfg.direct_meta_mask >> rec.meta) & 1)
+                    if a and is_dir:
+                        p.msgs_direct += 1
+                    accept_store.append(a and not is_dir)
+            else:
+                accept_store = accept
 
             def pre_undone(rec: Record) -> bool:
                 # Control records (meta >= 32) are never markable, matching
@@ -748,8 +869,8 @@ class OracleSim:
                 Record(rec.gt, rec.member, rec.meta, rec.payload, rec.aux,
                        FLAG_UNDONE if (cfg.timeline_enabled
                                        and pre_undone(rec)) else 0)
-                for rec, a in zip(ok_batch, accept) if a]
-            fresh = [rec for rec, a, f0 in zip(ok_batch, accept, fresh0)
+                for rec, a in zip(ok_batch, accept_store) if a]
+            fresh = [rec for rec, a, f0 in zip(ok_batch, accept_store, fresh0)
                      if a and f0]
             if ok_batch:
                 self._store_insert(i, ins_batch)
@@ -805,6 +926,8 @@ class OracleSim:
                                        np.uint32),
             "msgs_rejected": np.array([p.msgs_rejected for p in self.peers],
                                       np.uint32),
+            "msgs_direct": np.array([p.msgs_direct for p in self.peers],
+                                    np.uint32),
             "walk_success": np.array([p.walk_success for p in self.peers],
                                      np.uint32),
             "walk_fail": np.array([p.walk_fail for p in self.peers], np.uint32),

@@ -46,9 +46,21 @@ class Stats:
     requests_dropped: jnp.ndarray  # u32[N] intro-requests dropped (inbox full)
     punctures: jnp.ndarray        # u32[N] punctures sent (as introduced peer)
     msgs_forwarded: jnp.ndarray   # u32[N] push-forward packets sent
-    msgs_rejected: jnp.ndarray    # u32[N] records refused by Timeline checks
-    #   (reference: statistics.py drop counts from the check pipeline —
-    #    DropMessage outcomes of Timeline.check)
+    msgs_rejected: jnp.ndarray    # u32[N] records refused by the check
+    #   pipeline (Timeline permission or sequence-order violations —
+    #   reference: statistics.py drop counts from check_callback outcomes)
+    msgs_direct: jnp.ndarray      # u32[N] DirectDistribution records received
+    # Byte-equivalent traffic totals (reference: endpoint.py total_up /
+    # total_down).  Sent bytes count at the sender pre-loss (the reference
+    # counts at sendto()); received bytes count per accepted inbox slot
+    # (recvfrom() — packets lost or overflowing the socket buffer never
+    # reach the counter).  uint32, wraps mod 2^32 on very long runs.
+    bytes_up: jnp.ndarray         # u32[N]
+    bytes_down: jnp.ndarray      # u32[N]
+    # Records newly accepted into the store pipeline per meta (pre-capacity;
+    # reference: statistics.py per-message-name success counts).  Buckets:
+    # [0, n_meta) = user metas, bucket n_meta = the dispersy-* control band.
+    accepted_by_meta: jnp.ndarray  # u32[N, n_meta + 1]
 
 
 @struct.dataclass
@@ -100,14 +112,16 @@ class PeerState:
 FLAG_UNDONE = 1
 
 
-def init_stats(n: int) -> Stats:
-    # Six distinct buffers on purpose: aliased arrays break donation
+def init_stats(n: int, n_meta: int = 8) -> Stats:
+    # Distinct buffers on purpose: aliased arrays break donation
     # (Execute() rejects the same buffer donated twice).
     def z():
         return jnp.zeros((n,), jnp.uint32)
     return Stats(walk_success=z(), walk_fail=z(), msgs_stored=z(),
                  msgs_dropped=z(), requests_dropped=z(), punctures=z(),
-                 msgs_forwarded=z(), msgs_rejected=z())
+                 msgs_forwarded=z(), msgs_rejected=z(), msgs_direct=z(),
+                 bytes_up=z(), bytes_down=z(),
+                 accepted_by_meta=jnp.zeros((n, n_meta + 1), jnp.uint32))
 
 
 def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
@@ -146,7 +160,7 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         auth_member=jnp.full((n, a), EMPTY_U32, jnp.uint32),
         auth_mask=jnp.zeros((n, a), jnp.uint32),
         auth_gt=jnp.zeros((n, a), jnp.uint32),
-        stats=init_stats(n),
+        stats=init_stats(n, config.n_meta),
         key=jax.random.key_data(key) if key.dtype != jnp.uint32 else key,
         time=jnp.float32(0.0),
         round_index=jnp.uint32(0),

@@ -1,0 +1,167 @@
+"""The rim API: DebugCommunity declared the reference way, compiled down.
+
+Mirrors the reference's instrumented test community (reference:
+tests/debugcommunity/community.py ``DebugCommunity`` — one meta per policy
+cell) and checks that declarations compile to the expected static config
+and actually run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispersy_tpu.community import (CandidateDestination, Community,
+                                    CommunityDestination, DirectDistribution,
+                                    FullSyncDistribution, LastSyncDistribution,
+                                    LinearResolution, MemberAuthentication,
+                                    Message, PublicResolution)
+from dispersy_tpu.config import DEFAULT_PRIORITY, EMPTY_U32
+
+
+class DebugCommunity(Community):
+    """One meta per (resolution x distribution) policy cell, as the
+    reference's DebugCommunity does."""
+
+    def initiate_meta_messages(self):
+        return [
+            Message("full-sync-text", MemberAuthentication(),
+                    PublicResolution(), FullSyncDistribution(),
+                    CommunityDestination(node_count=3)),
+            Message("protected-full-sync-text", MemberAuthentication(),
+                    LinearResolution(), FullSyncDistribution(priority=160),
+                    CommunityDestination(node_count=3)),
+            Message("last-1-test", MemberAuthentication(),
+                    PublicResolution(), LastSyncDistribution(history_size=1),
+                    CommunityDestination(node_count=3)),
+            Message("sequence-text", MemberAuthentication(),
+                    PublicResolution(),
+                    FullSyncDistribution(enable_sequence_number=True),
+                    CommunityDestination(node_count=3)),
+            Message("direct-text", MemberAuthentication(),
+                    PublicResolution(), DirectDistribution(),
+                    CommunityDestination(node_count=3)),
+        ]
+
+
+def mk(n=24, **kw):
+    kw.setdefault("n_trackers", 2)
+    kw.setdefault("msg_capacity", 32)
+    kw.setdefault("bloom_capacity", 16)
+    kw.setdefault("k_candidates", 8)
+    kw.setdefault("request_inbox", 4)
+    kw.setdefault("tracker_inbox", 8)
+    kw.setdefault("response_budget", 4)
+    return DebugCommunity(n, **kw)
+
+
+def test_declarations_compile_to_config():
+    c = mk()
+    cfg = c.config
+    assert cfg.n_meta == 5
+    assert cfg.protected_meta_mask == 0b00010
+    assert cfg.seq_meta_mask == 0b01000
+    assert cfg.direct_meta_mask == 0b10000
+    assert cfg.desc_meta_mask == 0
+    assert cfg.last_sync_history == (0, 0, 1, 0, 0)
+    assert cfg.meta_priority == (DEFAULT_PRIORITY, 160, DEFAULT_PRIORITY,
+                                 DEFAULT_PRIORITY, DEFAULT_PRIORITY)
+    assert cfg.timeline_enabled
+    assert cfg.forward_fanout == 3
+    assert c.meta_id("full-sync-text") == 0
+    assert c.meta_id("dispersy-authorize") == 0xF0
+
+
+def test_rim_end_to_end_policy_behaviors():
+    """Drive the rim like an application: authorize, broadcast, replace,
+    sequence — each policy behaves on the state the rim returns."""
+    c = mk(48)
+    cfg = c.config
+    n = cfg.n_peers
+    st = c.initialize(jax.random.PRNGKey(0), seed_degree=4)
+
+    def m(author):
+        return jnp.asarray(np.arange(n) == author)
+    full = jnp.full(n, 7, jnp.uint32)
+
+    # founder grants peer 9 the protected meta, then 9 publishes
+    st = c.create(st, "dispersy-authorize", m(cfg.founder),
+                  jnp.full(n, 9, jnp.uint32),
+                  jnp.full(n, 1 << c.meta_id("protected-full-sync-text"),
+                           jnp.uint32))
+    for _ in range(6):
+        st = c.step(st)
+    st = c.create(st, "protected-full-sync-text", m(9), full)
+    gt9 = int(st.global_time[9])
+    # last-1: two generations; the second must displace the first
+    st = c.create(st, "last-1-test", m(11), jnp.full(n, 1, jnp.uint32))
+    for _ in range(6):
+        st = c.step(st)
+    st = c.create(st, "last-1-test", m(11), jnp.full(n, 2, jnp.uint32))
+    # sequence: three records, numbered automatically
+    for _ in range(3):
+        st = c.create(st, "sequence-text", m(12), full)
+    for _ in range(24):
+        st = c.step(st)
+    st = jax.block_until_ready(st)
+
+    cov = float(c.coverage(st, member=9, gt=gt9,
+                           name="protected-full-sync-text", payload=7))
+    assert cov == 1.0, cov
+    # last-1 replacement: payload-2 generation spread, no payload-1 remains
+    sm = np.asarray(st.store_member)
+    sme = np.asarray(st.store_meta)
+    spl = np.asarray(st.store_payload)
+    l1 = c.meta_id("last-1-test")
+    assert ((sm == 11) & (sme == l1) & (spl == 2)).any(axis=1).sum() > 1
+    assert not ((sm == 11) & (sme == l1) & (spl == 1)).any()
+    # sequence numbering came out 1..3 at the author
+    sq = c.meta_id("sequence-text")
+    own = (sm[12] == 12) & (sme[12] == sq)
+    assert sorted(np.asarray(st.store_aux)[12][own].tolist()) == [1, 2, 3]
+
+
+def test_direct_meta_counts_but_never_stores():
+    c = mk(24)
+    n = c.config.n_peers
+    st = c.initialize(jax.random.PRNGKey(1), seed_degree=4)
+    for _ in range(2):
+        st = c.step(st)
+    st = c.create(st, "direct-text", jnp.asarray(np.arange(n) == 9),
+                  jnp.full(n, 5, jnp.uint32))
+    for _ in range(4):
+        st = c.step(st)
+    st = jax.block_until_ready(st)
+    d = c.meta_id("direct-text")
+    assert not ((np.asarray(st.store_meta) == d)
+                & (np.asarray(st.store_gt) != EMPTY_U32)).any()
+    assert int(np.asarray(st.stats.msgs_direct).sum()) >= 1
+
+
+def test_rim_validation():
+    class Dup(Community):
+        def initiate_meta_messages(self):
+            return [Message("x", MemberAuthentication(), PublicResolution(),
+                            FullSyncDistribution(), CommunityDestination()),
+                    Message("x", MemberAuthentication(), PublicResolution(),
+                            FullSyncDistribution(), CommunityDestination())]
+    with pytest.raises(ValueError, match="duplicate"):
+        Dup(16)
+    with pytest.raises(ValueError, match="compiled from"):
+        mk(seq_meta_mask=1)
+    with pytest.raises(ValueError, match="unknown config overrides"):
+        mk(not_a_knob=1)
+    with pytest.raises(KeyError):
+        mk().meta_id("nope")
+
+
+def test_candidate_destination_routes_like_direct():
+    class C(Community):
+        def initiate_meta_messages(self):
+            return [Message("addressed", MemberAuthentication(),
+                            PublicResolution(), FullSyncDistribution(),
+                            CandidateDestination())]
+    c = C(16, n_trackers=2, msg_capacity=16, bloom_capacity=16,
+          k_candidates=8, request_inbox=4, tracker_inbox=8,
+          response_budget=4)
+    assert c.config.direct_meta_mask == 0b1

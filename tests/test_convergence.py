@@ -1,0 +1,36 @@
+"""Convergence-curve tooling: curve shape at a CI-sized population.
+
+The committed artifacts (artifacts/convergence_cfg*.json) are produced by
+tools/convergence.py at full size; this pins the curve's qualitative shape
+— monotone, reaches the target, S-curve-ish epidemic growth — at a size
+CI can afford.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from convergence import backlog_curve, broadcast_curve
+
+
+def test_broadcast_curve_shape():
+    out = broadcast_curve(n_peers=2000, degree=8, max_rounds=60)
+    curve = out["curve"]
+    assert out["rounds_to_target"] is not None, curve[-5:]
+    assert curve[-1] >= 0.99
+    # monotone non-decreasing (static corpus, no churn)
+    assert all(b >= a for a, b in zip(curve, curve[1:]))
+    # epidemic S-curve: coverage is tiny early, then explodes — the
+    # doubling phase must exist (some round more than doubles coverage)
+    assert curve[0] < 0.05
+    assert any(b > 2 * a for a, b in zip(curve, curve[1:]) if a > 0)
+
+
+def test_backlog_curve_reaches_target_small():
+    out = backlog_curve(n_peers=512, backlog=32, degree=8, max_rounds=200,
+                        msg_capacity=64)
+    assert out["rounds_to_target"] is not None, out["curve"][-5:]
+    curve = out["curve"]
+    assert all(b >= a - 1e-6 for a, b in zip(curve, curve[1:]))

@@ -1,0 +1,152 @@
+"""Multi-community multiplexing: block layout, isolation, convergence.
+
+The reference runs many Community instances over one runtime
+(reference: dispersy.py community registry, `sync` table keyed by
+community; tests/test_classification.py load/reclassify themes).  The TPU
+recast lays communities out as contiguous blocks of the row axis sharing
+one fused step; these tests pin the isolation invariant (nothing —
+candidates, records, clocks — crosses blocks) and per-community
+convergence, with engine/oracle trace equality over the whole multiplex.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispersy_tpu import engine as E
+from dispersy_tpu import state as S
+from dispersy_tpu.config import EMPTY_U32, META_AUTHORIZE, CommunityConfig
+from dispersy_tpu.oracle import sim as O
+
+from test_oracle import assert_match
+
+# Three communities of different sizes: members 8+6+8, trackers 1+1+2.
+CFG = CommunityConfig(
+    n_peers=26, n_trackers=4, communities=((8, 1), (6, 1), (8, 2)),
+    msg_capacity=32, bloom_capacity=16, k_candidates=8, request_inbox=4,
+    tracker_inbox=8, response_budget=4)
+
+
+def blocks(cfg):
+    comm, *_ = cfg.layout()
+    return comm
+
+
+def run_both(cfg, script, rounds, seed=0, warm=0):
+    state = S.init_state(cfg, jax.random.PRNGKey(seed))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    if warm:
+        state = E.seed_overlay(state, cfg, degree=warm)
+        oracle.seed_overlay(degree=warm)
+    for rnd in range(rounds):
+        for author, meta, payload in script.get(rnd, []):
+            mask = np.arange(cfg.n_peers) == author
+            pl = np.full(cfg.n_peers, payload, np.uint32)
+            state = E.create_messages(state, cfg, jnp.asarray(mask), meta,
+                                      jnp.asarray(pl))
+            oracle.create_messages(mask, meta, pl)
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+    return state, oracle
+
+
+def test_layout_shapes():
+    comm, boot_base, boot_count, mem_base, mem_count = CFG.layout()
+    # trackers: rows 0..3 belong to communities 0,1,2,2
+    assert list(comm[:4]) == [0, 1, 2, 2]
+    # members: 8 of c0, then 6 of c1, then 8 of c2
+    assert list(comm[4:12]) == [0] * 8
+    assert list(comm[12:18]) == [1] * 6
+    assert list(comm[18:26]) == [2] * 8
+    assert boot_base[5] == 0 and boot_count[5] == 1
+    assert boot_base[20] == 2 and boot_count[20] == 2
+    assert mem_base[0] == 4 and mem_count[0] == 8
+    assert mem_base[25] == 18 and mem_count[25] == 8
+
+
+def test_trace_cold_start_multicommunity():
+    """Cold bootstrap through per-community trackers, bit-exact vs oracle,
+    and candidate tables never cross community blocks."""
+    script = {0: [(5, 1, 100), (13, 1, 200), (20, 1, 300)]}
+    state, _ = run_both(CFG, script, rounds=12)
+    comm = blocks(CFG)
+    cand = np.asarray(state.cand_peer)
+    for i in range(CFG.n_peers):
+        for p in cand[i]:
+            if p >= 0:
+                assert comm[p] == comm[i], (i, p)
+
+
+def test_records_never_cross_communities():
+    script = {0: [(5, 1, 100), (13, 1, 200)]}
+    state, _ = run_both(CFG, script, rounds=14, warm=4)
+    comm = blocks(CFG)
+    sm = np.asarray(state.store_member)
+    sgt = np.asarray(state.store_gt)
+    for i in range(CFG.n_peers):
+        for j in range(sm.shape[1]):
+            if sgt[i, j] != EMPTY_U32:
+                assert comm[int(sm[i, j])] == comm[i], (i, j)
+
+
+def test_per_community_convergence():
+    """Each community's broadcast reaches its whole block (config #5's
+    per-community convergence metric) and only that block."""
+    cfg = CFG
+    state = S.init_state(cfg, jax.random.PRNGKey(2))
+    state = E.seed_overlay(state, cfg, degree=4)
+    authors = {5: 111, 13: 222, 20: 333}
+    for a, pl in authors.items():
+        state = E.create_messages(state, cfg, jnp.arange(cfg.n_peers) == a,
+                                  1, jnp.full(cfg.n_peers, pl, jnp.uint32))
+    gts = {a: int(state.global_time[a]) for a in authors}
+    for _ in range(40):
+        state = E.step(state, cfg)
+    state = jax.block_until_ready(state)
+    comm = blocks(cfg)
+    for a, pl in authors.items():
+        cov = np.asarray(E.coverage_by_community(
+            state, cfg, member=a, gt=gts[a], meta=1, payload=pl))
+        c = comm[a]
+        assert cov[c] == 1.0, (a, cov)
+        for other in range(cfg.n_communities):
+            if other != c:
+                assert cov[other] == 0.0, (a, cov)
+
+
+def test_timeline_per_community_founders():
+    """Each block answers to its own founder: block 0's founder authorizes
+    a member of block 0; the grant works there and a same-shaped record in
+    another block is independent — all trace-equal with the oracle."""
+    cfg = CFG.replace(timeline_enabled=True, protected_meta_mask=0b10,
+                      k_authorized=8)
+    comm, _, _, mem_base, _ = cfg.layout()
+    f0 = int(mem_base[4])    # block 0 founder (first member row = 4)
+    f1 = int(mem_base[12])   # block 1 founder (row 12)
+    assert f0 == 4 and f1 == 12
+    script = {
+        0: [(f0, META_AUTHORIZE, 6)],    # grant to member 6 (block 0)
+        4: [(6, 1, 777)],                # provable in block 0
+        5: [(f1, 1, 888)],               # block 1 founder, implicit permit
+    }
+    # aux for authorize = mask bit for meta 1
+    state = S.init_state(cfg, jax.random.PRNGKey(3))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=4)
+    oracle.seed_overlay(degree=4)
+    for rnd in range(16):
+        for author, meta, payload in script.get(rnd, []):
+            mask = np.arange(cfg.n_peers) == author
+            pl = np.full(cfg.n_peers, payload, np.uint32)
+            ax = np.full(cfg.n_peers, 0b10, np.uint32)
+            state = E.create_messages(state, cfg, jnp.asarray(mask), meta,
+                                      jnp.asarray(pl), jnp.asarray(ax))
+            oracle.create_messages(mask, meta, pl, aux=ax)
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+    sm = np.asarray(state.store_member)
+    spl = np.asarray(state.store_payload)
+    assert ((sm == 6) & (spl == 777)).any(axis=1).sum() > 1
+    assert ((sm == f1) & (spl == 888)).any(axis=1).sum() > 1

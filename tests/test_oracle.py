@@ -30,7 +30,7 @@ FIELDS = ["alive", "session", "global_time",
           "auth_member", "auth_mask", "auth_gt"]
 STAT_FIELDS = ["walk_success", "walk_fail", "msgs_stored", "msgs_dropped",
                "requests_dropped", "punctures", "msgs_forwarded",
-               "msgs_rejected"]
+               "msgs_rejected", "msgs_direct"]
 
 
 def assert_match(state, oracle, rnd):

@@ -1,0 +1,166 @@
+"""Convergence curves: rounds-to-99%-coverage (the driver's second metric).
+
+Runs BASELINE.md's evaluation configs and writes a JSON artifact with the
+per-round coverage curve:
+
+- config #2: 10k-peer single-message epidemic broadcast over a seeded
+  Erdős–Rényi-style overlay (``engine.seed_overlay``).
+- config #3: 100k-peer Bloom-sync with a 1k-message backlog spread over
+  the population, static overlay.  TPU-recommended; runs (slowly) on CPU
+  at reduced size with ``--scale``.
+
+Usage:
+    python tools/convergence.py --config 2 --out artifacts/convergence_cfg2.json
+    python tools/convergence.py --config 3 --scale 0.1   # 10k peers, CPU-sized
+
+The reference has no such tool in-repo (its convergence numbers live in
+external experiments driven by tool/scenarioscript.py); this is the
+rebuild's equivalent of those scenario runs, kept in-repo so the curves
+are reproducible artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispersy_tpu import engine
+from dispersy_tpu.config import CommunityConfig
+from dispersy_tpu.state import init_state
+
+
+def broadcast_curve(n_peers: int = 10_000, degree: int = 8,
+                    max_rounds: int = 120, target: float = 0.99,
+                    seed: int = 0, **overrides) -> dict:
+    """Config #2: one author's record floods the overlay; returns the
+    per-round coverage curve and rounds-to-target."""
+    cfg = CommunityConfig(
+        n_peers=n_peers, n_trackers=2, k_candidates=16, msg_capacity=16,
+        bloom_capacity=16, request_inbox=8,
+        tracker_inbox=max(64, n_peers // 64), response_budget=8,
+        **overrides)
+    state = init_state(cfg, jax.random.PRNGKey(seed))
+    state = engine.seed_overlay(state, cfg, degree=degree)
+    author = cfg.n_trackers + 1
+    state = engine.create_messages(
+        state, cfg, jnp.arange(n_peers) == author, meta=1,
+        payload=jnp.full(n_peers, 42, jnp.uint32))
+    gt = int(state.global_time[author])
+
+    curve = []
+    t0 = time.perf_counter()
+    rounds_to_target = None
+    for rnd in range(1, max_rounds + 1):
+        state = engine.step(state, cfg)
+        cov = float(engine.coverage(state, member=author, gt=gt, meta=1,
+                                    payload=42))
+        curve.append(round(cov, 6))
+        if rounds_to_target is None and cov >= target:
+            rounds_to_target = rnd
+            break
+    wall = time.perf_counter() - t0
+    return {
+        "config": "broadcast_cfg2",
+        "n_peers": n_peers, "degree": degree, "seed": seed,
+        "target": target,
+        "rounds_to_target": rounds_to_target,
+        "rounds_run": len(curve),
+        "curve": curve,
+        "wall_seconds": round(wall, 2),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def backlog_curve(n_peers: int = 100_000, backlog: int = 1000,
+                  degree: int = 8, max_rounds: int = 400,
+                  target: float = 0.99, seed: int = 0,
+                  msg_capacity: int = 1152) -> dict:
+    """Config #3: a `backlog`-message corpus authored across the overlay
+    must reach every peer; coverage = mean fraction of the corpus held.
+
+    The store is sized to hold the whole corpus (the reference's SQLite
+    has no practical cap); the Bloom modulo claim strategy stripes the
+    backlog across rounds exactly as
+    ``_dispersy_claim_sync_bloom_filter_modulo`` does.
+    """
+    cfg = CommunityConfig(
+        n_peers=n_peers, n_trackers=2, k_candidates=16,
+        msg_capacity=msg_capacity, bloom_capacity=256, request_inbox=8,
+        tracker_inbox=max(64, n_peers // 64), response_budget=64,
+        sync_strategy="modulo", forward_fanout=3)
+    state = init_state(cfg, jax.random.PRNGKey(seed))
+    state = engine.seed_overlay(state, cfg, degree=degree)
+    # The corpus: `backlog` records authored by evenly spaced peers.
+    stride = max((n_peers - cfg.n_trackers) // backlog, 1)
+    authors = ((jnp.arange(n_peers) - cfg.n_trackers) % stride == 0) \
+        & (jnp.arange(n_peers) >= cfg.n_trackers)
+    authors = authors & (jnp.cumsum(authors) <= backlog)
+    n_msgs = int(jnp.sum(authors))
+    state = engine.create_messages(
+        state, cfg, authors, meta=1,
+        payload=jnp.arange(n_peers, dtype=jnp.uint32))
+
+    syncing = ~state.is_tracker
+    n_sync = int(jnp.sum(syncing))
+
+    def corpus_coverage(st):
+        held = jnp.sum(jnp.where(syncing[:, None],
+                                 (st.store_meta == 1), False))
+        return float(held) / (n_msgs * n_sync)
+
+    curve = []
+    t0 = time.perf_counter()
+    rounds_to_target = None
+    for rnd in range(1, max_rounds + 1):
+        state = engine.step(state, cfg)
+        cov = corpus_coverage(state)
+        curve.append(round(cov, 6))
+        if rounds_to_target is None and cov >= target:
+            rounds_to_target = rnd
+            break
+    wall = time.perf_counter() - t0
+    return {
+        "config": "backlog_cfg3",
+        "n_peers": n_peers, "backlog": n_msgs, "degree": degree,
+        "seed": seed, "target": target,
+        "rounds_to_target": rounds_to_target,
+        "rounds_run": len(curve),
+        "curve": curve,
+        "wall_seconds": round(wall, 2),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, choices=(2, 3), required=True)
+    ap.add_argument("--out", type=str, default=None)
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="population scale factor (CPU-sized runs)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.config == 2:
+        out = broadcast_curve(n_peers=int(10_000 * args.scale),
+                              seed=args.seed)
+    else:
+        out = backlog_curve(n_peers=int(100_000 * args.scale),
+                            backlog=int(1000 * min(args.scale * 10, 1.0)),
+                            seed=args.seed)
+    path = args.out or f"artifacts/convergence_cfg{args.config}.json"
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "curve"}))
+
+
+if __name__ == "__main__":
+    main()

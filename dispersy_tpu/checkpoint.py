@@ -36,7 +36,9 @@ import numpy as np
 from dispersy_tpu.config import CommunityConfig, NO_PEER
 from dispersy_tpu.state import NEVER, PeerState, init_state
 
-FORMAT_VERSION = 1
+# v2: PeerState gained the signature request cache (sig_*) and Stats the
+# sig_signed/sig_done/sig_expired counters — v1 archives lack those leaves.
+FORMAT_VERSION = 2
 
 
 def _fingerprint(cfg: CommunityConfig) -> str:

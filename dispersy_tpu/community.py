@@ -37,9 +37,10 @@ import dataclasses
 import jax
 
 from dispersy_tpu import engine
-from dispersy_tpu.config import (MAX_USER_META, META_AUTHORIZE, META_REVOKE,
-                                 META_UNDO_OTHER, META_UNDO_OWN,
-                                 CommunityConfig, DEFAULT_PRIORITY)
+from dispersy_tpu.config import (MAX_USER_META, META_AUTHORIZE, META_DESTROY,
+                                 META_DYNAMIC, META_REVOKE, META_UNDO_OTHER,
+                                 META_UNDO_OWN, CommunityConfig,
+                                 DEFAULT_PRIORITY)
 from dispersy_tpu.state import PeerState, init_state
 
 
@@ -56,12 +57,39 @@ class MemberAuthentication:
         self.encoding = encoding
 
 
+class DoubleMemberAuthentication:
+    """Two signers per record (reference: authentication.py
+    DoubleMemberAuthentication + the dispersy-signature-request/-response
+    flow).  ``allow_signature_rate`` stands in for the app-supplied
+    ``allow_signature_func``: the probability a counterparty countersigns
+    (compiled to ``CommunityConfig.countersign_rate``)."""
+
+    def __init__(self, allow_signature_rate: float = 1.0):
+        self.allow_signature_rate = allow_signature_rate
+
+
 class PublicResolution:
     pass
 
 
 class LinearResolution:
     pass
+
+
+class DynamicResolution:
+    """Runtime-switchable resolution (reference: resolution.py
+    DynamicResolution): the founder flips the meta between the candidate
+    policies with ``dispersy-dynamic-settings`` records.  ``policies[0]``
+    is the initial policy."""
+
+    def __init__(self, *policies):
+        if not policies:
+            policies = (PublicResolution(), LinearResolution())
+        if not all(isinstance(p, (PublicResolution, LinearResolution))
+                   for p in policies):
+            raise ValueError("DynamicResolution candidates must be "
+                             "Public/LinearResolution instances")
+        self.policies = policies
 
 
 class FullSyncDistribution:
@@ -132,13 +160,22 @@ class Community:
         self.metas = {m.name: m for m in metas}
 
         n_meta = max(len(metas), 1)
-        protected = seq = direct = desc = 0
+        protected = seq = direct = desc = double = 0
         history = [0] * n_meta
         priority = [DEFAULT_PRIORITY] * n_meta
         fanout = 0
+        sign_rates = set()
+        dynamic = 0
         for i, m in enumerate(metas):
             if isinstance(m.resolution, LinearResolution):
                 protected |= 1 << i
+            elif isinstance(m.resolution, DynamicResolution):
+                dynamic |= 1 << i
+                if isinstance(m.resolution.policies[0], LinearResolution):
+                    protected |= 1 << i
+            if isinstance(m.authentication, DoubleMemberAuthentication):
+                double |= 1 << i
+                sign_rates.add(m.authentication.allow_signature_rate)
             d = m.distribution
             if isinstance(d, FullSyncDistribution):
                 if d.enable_sequence_number:
@@ -162,6 +199,10 @@ class Community:
         bad = set(overrides) - fields
         if bad:
             raise ValueError(f"unknown config overrides: {sorted(bad)}")
+        if len(sign_rates) > 1:
+            raise ValueError("all DoubleMemberAuthentication metas must "
+                             "share one allow_signature_rate (the kernel "
+                             "compiles a single countersign_rate)")
         compiled = dict(
             n_peers=n_peers,
             n_meta=n_meta,
@@ -171,8 +212,12 @@ class Community:
             desc_meta_mask=desc,
             last_sync_history=tuple(history),
             meta_priority=tuple(priority),
-            timeline_enabled=protected != 0,
+            dynamic_meta_mask=dynamic,
+            timeline_enabled=protected != 0 or dynamic != 0,
         )
+        if double:
+            compiled["double_meta_mask"] = double
+            compiled["countersign_rate"] = sign_rates.pop()
         if fanout:
             k_cand = overrides.get("k_candidates",
                                    CommunityConfig.k_candidates)
@@ -203,7 +248,9 @@ class Community:
         control = {"dispersy-authorize": META_AUTHORIZE,
                    "dispersy-revoke": META_REVOKE,
                    "dispersy-undo-own": META_UNDO_OWN,
-                   "dispersy-undo-other": META_UNDO_OTHER}
+                   "dispersy-undo-other": META_UNDO_OTHER,
+                   "dispersy-dynamic-settings": META_DYNAMIC,
+                   "dispersy-destroy-community": META_DESTROY}
         if name in control:
             return control[name]
         raise KeyError(f"unknown meta {name!r}; "
@@ -214,6 +261,15 @@ class Community:
         """``Community.create_<name>`` — author one record per masked peer."""
         return engine.create_messages(state, self.config, author_mask,
                                       self.meta_id(name), payload, aux)
+
+    def create_signature_request(self, state: PeerState, name: str,
+                                 author_mask, counterparty,
+                                 payload) -> PeerState:
+        """``Community.create_signature_request`` — open a double-signed
+        draft toward each masked peer's chosen counterparty."""
+        return engine.create_signature_request(
+            state, self.config, author_mask, self.meta_id(name),
+            counterparty, payload)
 
     def step(self, state: PeerState) -> PeerState:
         """One walker interval for the whole overlay."""

@@ -44,19 +44,36 @@ CAT_INTRODUCED = 3
 #   dispersy-undo-own / dispersy-undo-other: payload = target member,
 #       aux = target global_time (reference: payload.py UndoPayload
 #       (member, global_time, packet))
+#   dispersy-dynamic-settings: payload = target user meta id, aux bit 0 =
+#       new resolution policy (0 = PublicResolution, 1 = LinearResolution)
+#       taking effect for records with global_time > this record's
+#       (reference: payload.py DynamicSettingsPayload [(meta, policy)];
+#       timeline.py Timeline.get_resolution_policy)
+#   dispersy-destroy-community: payload/aux unused — once stored, the
+#       peer's community is hard-killed (reference: community.py
+#       HardKilledCommunity + DestroyCommunityPayload)
 META_AUTHORIZE = 0xF0
 META_REVOKE = 0xF1
 META_UNDO_OWN = 0xF2
 META_UNDO_OTHER = 0xF3
+META_DYNAMIC = 0xF4
+META_DESTROY = 0xF5
+#   dispersy-identity: payload = mid32 (first 4 bytes of SHA1(pubkey));
+#       see dispersy_tpu/crypto.py create_identities.
+META_IDENTITY = 0xF6
 # Max user metas: permission bitmasks live in the low bits of a uint32 and
 # bit 31 flags a revoke row in the auth table.
 MAX_USER_META = 24
 
 # Sync-response ordering priorities (reference: distribution.py — each
 # Distribution carries a `priority`; community.py gives the permission
-# control messages a high one so proofs outrun the records they permit).
+# control messages a high one so proofs outrun the records they permit,
+# and dispersy-identity a LOW one: identities are bulk data, not urgent —
+# without this, an identity flood starves permission records of the
+# bounded forward slots and the sync budget).
 DEFAULT_PRIORITY = 128
 CONTROL_PRIORITY = 224
+IDENTITY_PRIORITY = 16
 
 # Byte-equivalent packet sizes for the traffic counters (reference:
 # conversion.py wire shapes — 23 B common header = 1 B dispersy version +
@@ -79,6 +96,21 @@ PUNCTURE_REQUEST_BYTES = HEADER_BYTES + 2 * ADDR_BYTES + 2
 PUNCTURE_BYTES = HEADER_BYTES + 2 * ADDR_BYTES + 2
 # one sync record on the wire: header + 5 uint32 columns.
 RECORD_BYTES = HEADER_BYTES + 20
+# signature-request: header + 2 B identifier + the draft record's columns
+# (reference: conversion.py packs the half-signed message inside
+# dispersy-signature-request; the response carries it back countersigned).
+SIGNATURE_REQUEST_BYTES = HEADER_BYTES + 2 + 20
+SIGNATURE_RESPONSE_BYTES = HEADER_BYTES + 2 + 20
+
+
+def priority_of(meta: int, n_meta: int, priorities) -> int:
+    """Serving/forwarding priority of one meta id (scalar form; the engine
+    computes the same thing vectorized).  User metas carry their declared
+    priority; the control band is CONTROL_PRIORITY except low-priority
+    dispersy-identity."""
+    if meta < n_meta:
+        return priorities[meta]
+    return IDENTITY_PRIORITY if meta == META_IDENTITY else CONTROL_PRIORITY
 
 
 def bloom_size_for(error_rate: float, capacity: int) -> tuple[int, int]:
@@ -200,6 +232,26 @@ class CommunityConfig:
     # Bit i set: user meta i syncs newest-first (DESC).
     desc_meta_mask: int = 0
 
+    # ---- double-signed messages (reference: authentication.py
+    #      DoubleMemberAuthentication + the dispersy-signature-request/
+    #      -response flow, SURVEY §3.5; stored rows land in
+    #      double_signed_sync) ----
+    # Bit i set: user meta i needs two signatures — the author drafts the
+    # record and a chosen counterparty countersigns before it enters the
+    # store (record's `aux` column carries the countersigner id).
+    double_meta_mask: int = 0
+    # Outstanding signature request lifetime (reference: the signature
+    # RequestCache timeout; the request is sent ONCE — no retransmit — and
+    # the cache slot frees on timeout, exactly like the reference).
+    sig_timeout: float = 10.5
+    # signature-requests a peer processes per round (bounded inbox).
+    sig_inbox: int = 4
+    # Probability the counterparty agrees to countersign — the simulation
+    # knob standing in for the app-supplied allow_signature_func
+    # (reference: community.py on_signature_request delegates the decision
+    # to the application).  Deterministic per (peer, round, slot) draw.
+    countersign_rate: float = 1.0
+
     # ---- clock (reference: community.py claim_global_time /
     #      dispersy_acceptable_global_time_range) ----
     acceptable_global_time_range: int = 10000
@@ -208,6 +260,14 @@ class CommunityConfig:
     #      protocol — candidate timeouts, walk timeouts; SURVEY.md §5.3) ----
     churn_rate: float = 0.0             # fraction of peers replaced per round
     packet_loss: float = 0.0            # Bernoulli drop per logical packet
+
+    # ---- identity (reference: member.py / dispersy-identity; see
+    #      dispersy_tpu/crypto.py) ----
+    # Declares that dispersy-identity records are in play, which folds
+    # IDENTITY_PRIORITY into the serving/forwarding order so an identity
+    # flood cannot starve other records of the bounded budgets.
+    # create_identities refuses to run without it.
+    identity_enabled: bool = False
 
     # ---- permissions (reference: timeline.py; bounded table of authorized
     #      members — real overlays authorize a handful of members) ----
@@ -219,6 +279,15 @@ class CommunityConfig:
     # record's global_time (reference: resolution.py LinearResolution +
     # timeline.py Timeline.check).  Unset bits are PublicResolution.
     protected_meta_mask: int = 0
+    # Bit i set: user meta i is DynamicResolution — its policy can be
+    # flipped at runtime by founder-sent dispersy-dynamic-settings records
+    # (reference: resolution.py DynamicResolution, community.py
+    # create_dynamic_settings).  The meta's protected_meta_mask bit is its
+    # *initial* policy; a record is checked against the policy in force at
+    # the record's own global_time, i.e. the highest-global_time flip at or
+    # below it, replayed from the store exactly like the reference rebuilds
+    # Timeline policy state from the database.
+    dynamic_meta_mask: int = 0
     # The community founder: implicit holder of every permission, and the
     # only member whose authorize/revoke/undo-other records are accepted
     # (reference: community.py master member — the permission root; the
@@ -251,6 +320,11 @@ class CommunityConfig:
     @property
     def eligibility_delay_rounds(self) -> float:
         return self.eligibility_delay / self.walk_interval
+
+    @property
+    def sig_timeout_rounds(self) -> int:
+        """Signature-request lifetime in whole rounds (>= 1 when enabled)."""
+        return int(self.sig_timeout / self.walk_interval)
 
     @property
     def founder(self) -> int:
@@ -313,13 +387,27 @@ class CommunityConfig:
         return community, boot_base, boot_count, mem_base, mem_count
 
     @property
+    def needs_priority_forward(self) -> bool:
+        """Does the forward-buffer selection need priority ordering?  The
+        bounded push buffer admits the F highest-priority fresh records
+        (control metas outrank user metas), so a dispersy-authorize or
+        dynamic-settings record cannot lose its only push to bulk traffic.
+        Plain communities (no timeline, no identities, uniform priorities)
+        keep cheap batch-order selection."""
+        return (self.timeline_enabled or self.identity_enabled
+                or len(set(self.priorities)) > 1)
+
+    @property
     def needs_response_order(self) -> bool:
         """Does the sync responder need a non-store-order view?  True when
         priorities differ across metas (incl. control metas outranking user
-        metas under the timeline) or any meta syncs DESC."""
+        metas under the timeline, or low-priority identity records being
+        in play) or any meta syncs DESC."""
         if self.desc_meta_mask:
             return True
         if len(set(self.priorities)) > 1:
+            return True
+        if self.identity_enabled and self.priorities[0] != IDENTITY_PRIORITY:
             return True
         return self.timeline_enabled and self.priorities[0] != CONTROL_PRIORITY
 
@@ -342,13 +430,35 @@ class CommunityConfig:
             raise ValueError(f"n_meta must be in [1, {MAX_USER_META}]")
         if self.protected_meta_mask >> self.n_meta:
             raise ValueError("protected_meta_mask has bits above n_meta")
+        if self.dynamic_meta_mask:
+            if self.dynamic_meta_mask >> self.n_meta:
+                raise ValueError("dynamic_meta_mask has bits above n_meta")
+            if not self.timeline_enabled:
+                raise ValueError("dynamic_meta_mask requires "
+                                 "timeline_enabled (policy flips are "
+                                 "timeline state)")
         for name, mask in (("seq_meta_mask", self.seq_meta_mask),
                            ("direct_meta_mask", self.direct_meta_mask),
-                           ("desc_meta_mask", self.desc_meta_mask)):
+                           ("desc_meta_mask", self.desc_meta_mask),
+                           ("double_meta_mask", self.double_meta_mask)):
             if mask >> self.n_meta:
                 raise ValueError(f"{name} has bits above n_meta")
         if self.seq_meta_mask & self.direct_meta_mask:
             raise ValueError("a meta cannot be both sequenced and direct")
+        if self.double_meta_mask & (self.seq_meta_mask
+                                    | self.direct_meta_mask):
+            # aux carries the countersigner for double metas, so it cannot
+            # also carry a sequence number; Direct never stores, so a
+            # double signature would protect nothing.
+            raise ValueError("a double-signed meta cannot be sequenced or "
+                             "direct")
+        if self.double_meta_mask:
+            if self.sig_inbox < 1:
+                raise ValueError("double_meta_mask requires sig_inbox >= 1")
+            if self.sig_timeout_rounds < 1:
+                raise ValueError("sig_timeout must cover >= 1 round")
+            if not (0.0 <= self.countersign_rate <= 1.0):
+                raise ValueError("countersign_rate must be in [0, 1]")
         if self.seq_meta_mask & self.desc_meta_mask:
             # DESC would deliver newest-first and leave permanent sequence
             # gaps; the reference pairs enable_sequence_number with ASC.

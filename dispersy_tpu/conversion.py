@@ -1,0 +1,138 @@
+"""Binary wire format: the conversion.py analogue, for conformance only.
+
+The core simulation deliberately has NO wire format (SURVEY §7 anti-goals:
+no byte format "except where conformance tests need golden packets") — on
+device a message is five uint32 columns.  This module packs those columns
+into reference-shaped packets so that (a) golden-packet tests pin the
+layout, and (b) tiny-N conformance runs can sign/verify real bytes with
+real keys (:mod:`dispersy_tpu.crypto`), putting the reference's
+decode+verify semantics under test without ever entering the hot path.
+
+Layout (reference: conversion.py BinaryConversion — 23 B common header =
+1 B dispersy version + 1 B community version + 20 B master-member mid +
+1 B message id; then authentication / distribution / payload; trailing
+signature):
+
+    [0]     dispersy version        (1 B)  -- 0x00 for this framework
+    [1]     community version       (1 B)
+    [2:22]  master-member mid       (20 B)
+    [22]    message id              (1 B)  -- the meta id byte
+    [23:43] author mid              (20 B) -- MemberAuthentication("sha1")
+    [43:51] global_time             (8 B, big-endian u64)
+    [51:55] payload word            (4 B, big-endian u32)
+    [55:59] aux word                (4 B, big-endian u32)
+    [59:]   signature over [0:59]
+
+Sequence-enabled metas insert 4 B of sequence number (the aux word re-used)
+after global_time in the reference; here aux always rides explicitly, so
+one layout serves every policy — a documented simplification, pinned by the
+golden packets below.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from dispersy_tpu.config import EMPTY_U32
+from dispersy_tpu.crypto import ECCrypto, Member, MemberRegistry
+
+DISPERSY_VERSION = 0x00
+HEADER_LEN = 23
+BODY_LEN = HEADER_LEN + 20 + 8 + 4 + 4    # 59 bytes before the signature
+
+
+class Packet(NamedTuple):
+    """A decoded packet (reference: message.Packet / Placeholder stages)."""
+    community_mid: bytes
+    community_version: int
+    meta: int
+    author_mid: bytes
+    global_time: int
+    payload: int
+    aux: int
+    signature: bytes
+    valid_signature: bool
+
+
+def encode_record(community_mid: bytes, community_version: int, meta: int,
+                  member: Member, global_time: int, payload: int, aux: int,
+                  crypto: ECCrypto) -> bytes:
+    """Pack one sim record into a reference-shaped signed packet.
+
+    Mirrors BinaryConversion.encode_message: header, authentication (the
+    author's 20-byte mid), distribution (global_time), payload words, then
+    the author's signature over everything before it.
+    """
+    if len(community_mid) != 20:
+        raise ValueError("community mid must be 20 bytes (SHA1)")
+    if not (0 <= meta <= 0xFF):
+        raise ValueError("meta id must fit one byte")
+    body = bytes([DISPERSY_VERSION, community_version & 0xFF])
+    body += community_mid
+    body += bytes([meta])
+    body += member.mid
+    body += int(global_time).to_bytes(8, "big")
+    body += int(payload).to_bytes(4, "big")
+    body += int(aux).to_bytes(4, "big")
+    assert len(body) == BODY_LEN
+    return body + crypto.create_signature(member.key, body)
+
+
+def decode_record(data: bytes, registry: MemberRegistry,
+                  crypto: ECCrypto) -> Packet:
+    """Unpack + verify one packet (BinaryConversion.decode_message).
+
+    Stages mirror the reference's Placeholder decode: fixed header, then
+    authentication (mid -> member via the registry, the member-table
+    lookup), then distribution/payload, then signature verification with
+    the resolved member's real public key.  An unresolvable mid or bad
+    signature yields ``valid_signature=False`` (the reference raises
+    DelayPacketByMissingMember / DropPacket — the caller decides).
+    """
+    if len(data) < BODY_LEN:
+        raise ValueError(f"packet too short: {len(data)} < {BODY_LEN}")
+    if data[0] != DISPERSY_VERSION:
+        raise ValueError(f"unknown dispersy version {data[0]:#x}")
+    community_mid = data[2:22]
+    meta = data[22]
+    author_mid = data[23:43]
+    global_time = int.from_bytes(data[43:51], "big")
+    payload = int.from_bytes(data[51:55], "big")
+    aux = int.from_bytes(data[55:59], "big")
+    signature = data[BODY_LEN:]
+    member = registry.by_mid(author_mid)
+    ok = (member is not None
+          and crypto.is_valid_signature(member.key, data[:BODY_LEN],
+                                        signature))
+    return Packet(community_mid=community_mid,
+                  community_version=data[1], meta=meta,
+                  author_mid=author_mid, global_time=global_time,
+                  payload=payload, aux=aux, signature=signature,
+                  valid_signature=ok)
+
+
+def encode_store(state, cfg, registry: MemberRegistry, crypto: ECCrypto,
+                 peer: int, community_mid: bytes | None = None,
+                 community_version: int = 1) -> list[bytes]:
+    """Serialize one peer's whole store to signed packets — the conformance
+    bridge: a tiny-N device run's records become reference-shaped,
+    individually verifiable bytes (the reference's sync table holds exactly
+    these packets in its ``packet`` BLOB column)."""
+    import numpy as np
+    if community_mid is None:
+        import hashlib
+        community_mid = hashlib.sha1(b"dispersy-tpu-community").digest()
+    gt = np.asarray(state.store_gt[peer])
+    member = np.asarray(state.store_member[peer])
+    meta = np.asarray(state.store_meta[peer])
+    payload = np.asarray(state.store_payload[peer])
+    aux = np.asarray(state.store_aux[peer])
+    out = []
+    for j in range(gt.shape[0]):
+        if gt[j] == EMPTY_U32:
+            continue
+        out.append(encode_record(
+            community_mid, community_version, int(meta[j]) & 0xFF,
+            registry.member(int(member[j])), int(gt[j]), int(payload[j]),
+            int(aux[j]), crypto))
+    return out

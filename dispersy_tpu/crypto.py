@@ -1,0 +1,304 @@
+"""Identity and crypto: the member.py / crypto.py analogue.
+
+The reference gives every peer an EC keypair (reference: crypto.py
+``ECCrypto`` — curves keyed u"very-low"..u"high" via M2Crypto/OpenSSL;
+member.py ``Member`` with ``mid`` = SHA1(public key), ``DummyMember`` for
+mid-only peers) and signs every packet.  Signature work dominated the
+reference's receive pipeline (SURVEY §3.3 marks decode+verify as the CPU
+hot spot).
+
+The TPU rebuild keeps crypto OFF the hot path by design (SURVEY §7 stage
+9): on device a member IS its row index, and records carry no signatures —
+authentication is structural (only row i can author member-i records,
+because ``create_messages`` stamps ``member = idx``).  This module supplies
+the identity layer *around* that core:
+
+- ``ECCrypto``: real asymmetric Schnorr signatures over the RFC 3526
+  group-14 prime (pure Python ints + hashlib — no OpenSSL binding exists
+  in this image).  Security levels mirror the reference's curve ladder by
+  scaling the exponent/hash width.  SIMULATION-GRADE: textbook Schnorr,
+  deterministic nonces, no side-channel hardening — it exists so tiny-N
+  conformance runs can sign and verify *real* packets (see
+  :mod:`dispersy_tpu.conversion`), not to protect production traffic.
+- ``NoCrypto``: the reference's no-op variant (empty signatures, always
+  verifies) for pure-simulation runs.
+- ``Member`` / ``MemberRegistry``: deterministic per-row keypairs so any
+  row index resolves to a stable (private key, public key, mid) triple
+  without storing per-peer key material on device.
+- ``create_identities``: the ``dispersy-identity`` message (reference:
+  community.py create_identity / on_identity, payload.py IdentityPayload)
+  — each member publishes one identity record carrying ``mid32`` (the
+  first 4 bytes of its mid) so other peers can bind row index -> key
+  digest after sync; the epidemic pull doubles as the
+  ``dispersy-missing-identity`` repair path (a peer lacking the record
+  keeps re-pulling it through the Bloom sync).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from dispersy_tpu import engine
+from dispersy_tpu.config import META_IDENTITY, CommunityConfig
+from dispersy_tpu.state import PeerState
+
+# RFC 3526 MODP group 14: 2048-bit safe prime, generator 2.  q = (p-1)/2
+# is prime, and g = 4 generates the order-q subgroup.
+_P_HEX = (
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3DC2007CB8A163BF05"
+    "98DA48361C55D39A69163FA8FD24CF5F83655D23DCA3AD961C62F356208552BB"
+    "9ED529077096966D670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9DE2BCBF695581718"
+    "3995497CEA956AE515D2261898FA051015728E5A8AACAA68FFFFFFFFFFFFFFFF")
+P = int(_P_HEX, 16)
+Q = (P - 1) // 2
+G = 4  # = 2^2: a quadratic residue, so it generates the order-q subgroup
+
+# The reference's security ladder (crypto.py: sect163k1..sect571r1) recast
+# as exponent bit-widths; signature size scales the same way the curve
+# choice scales it in the reference.
+SECURITY_LEVELS = {
+    u"very-low": 160,
+    u"low": 192,
+    u"medium": 256,
+    u"high": 384,
+}
+
+
+def _h(*parts: bytes) -> int:
+    dig = hashlib.sha256()
+    for p in parts:
+        dig.update(len(p).to_bytes(4, "big"))
+        dig.update(p)
+    return int.from_bytes(dig.digest(), "big")
+
+
+def _int_to_bytes(x: int, width: int) -> bytes:
+    return x.to_bytes(width, "big")
+
+
+class ECCrypto:
+    """Schnorr sign/verify with the reference ECCrypto's surface.
+
+    ``generate_key(security)`` -> key object; ``key_to_bin`` /
+    ``key_from_private_bin`` / ``key_from_public_bin`` serialize;
+    ``create_signature`` / ``is_valid_signature`` sign and verify.
+    """
+
+    def __init__(self):
+        self._pub_width = (P.bit_length() + 7) // 8  # 256 bytes
+
+    # ---- key management ------------------------------------------------
+
+    def generate_key(self, security: str = u"medium",
+                     seed: bytes | None = None) -> "Key":
+        if security not in SECURITY_LEVELS:
+            raise ValueError(f"unknown security level {security!r}; "
+                             f"choose from {sorted(SECURITY_LEVELS)}")
+        bits = SECURITY_LEVELS[security]
+        if seed is None:
+            import os
+            seed = os.urandom(32)
+        x = (_h(b"dispersy-tpu-key", security.encode(), seed)
+             % (1 << bits)) | 1
+        x %= Q
+        return Key(security=security, private=x, public=pow(G, x, P))
+
+    def key_to_bin(self, key: "Key") -> bytes:
+        """Public key serialization (what travels / what mids digest)."""
+        return (b"TPSC" + key.security.encode().ljust(8, b"\0")
+                + _int_to_bytes(key.public, self._pub_width))
+
+    def key_from_public_bin(self, data: bytes) -> "Key":
+        if data[:4] != b"TPSC":
+            raise ValueError("not a serialized public key")
+        security = data[4:12].rstrip(b"\0").decode()
+        public = int.from_bytes(data[12:12 + self._pub_width], "big")
+        return Key(security=security, private=None, public=public)
+
+    def signature_length(self, key: "Key") -> int:
+        """Bytes of one signature under this key's security level."""
+        bits = SECURITY_LEVELS[key.security]
+        e_w = (bits + 7) // 8
+        s_w = (Q.bit_length() + 7) // 8
+        return e_w + s_w
+
+    # ---- sign / verify -------------------------------------------------
+
+    def create_signature(self, key: "Key", data: bytes) -> bytes:
+        if key.private is None:
+            raise ValueError("cannot sign with a public-only key")
+        bits = SECURITY_LEVELS[key.security]
+        e_w = (bits + 7) // 8
+        s_w = (Q.bit_length() + 7) // 8
+        # Deterministic nonce (RFC 6979 style): no RNG state to mirror.
+        k = _h(b"nonce", _int_to_bytes(key.private, s_w), data) % Q
+        if k == 0:
+            k = 1
+        r = pow(G, k, P)
+        e = _h(b"chal", _int_to_bytes(r, self._pub_width), data) % (1 << bits)
+        s = (k + key.private * e) % Q
+        return _int_to_bytes(e, e_w) + _int_to_bytes(s, s_w)
+
+    def is_valid_signature(self, key: "Key", data: bytes,
+                           signature: bytes) -> bool:
+        bits = SECURITY_LEVELS[key.security]
+        e_w = (bits + 7) // 8
+        s_w = (Q.bit_length() + 7) // 8
+        if len(signature) != e_w + s_w:
+            return False
+        e = int.from_bytes(signature[:e_w], "big")
+        s = int.from_bytes(signature[e_w:], "big")
+        # g^s == r * pk^e  =>  r = g^s * pk^-e
+        r = (pow(G, s, P) * pow(key.public, (Q - e) % Q, P)) % P
+        e2 = _h(b"chal", _int_to_bytes(r, self._pub_width), data) % (1 << bits)
+        return e == e2
+
+
+class NoCrypto(ECCrypto):
+    """The reference's NoCrypto: empty signatures, everything verifies."""
+
+    def create_signature(self, key, data):
+        return b""
+
+    def is_valid_signature(self, key, data, signature):
+        return True
+
+    def signature_length(self, key):
+        return 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Key:
+    security: str
+    private: int | None
+    public: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Member:
+    """One member identity (reference: member.py Member / DummyMember).
+
+    ``mid`` = SHA1(serialized public key), exactly the reference's rule;
+    ``index`` is the device row the member occupies (the reference's
+    database_id).  A Member without a private key mirrors DummyMember.
+    """
+    index: int
+    public_key: bytes
+    mid: bytes
+    key: Key
+
+    @property
+    def mid32(self) -> int:
+        """First 4 bytes of the mid as the uint32 that rides in
+        dispersy-identity records on device."""
+        return int.from_bytes(self.mid[:4], "big")
+
+    @property
+    def has_private_key(self) -> bool:
+        return self.key.private is not None
+
+
+class MemberRegistry:
+    """Deterministic row-index -> Member resolution.
+
+    The reference resolves mids through the member table + identity
+    messages (member.py, dispersy.py get_member).  Here every keypair is
+    derived from (community seed, row index), so the registry IS the
+    member table — nothing per-peer needs storing, and any host can
+    resolve any row without communication.
+    """
+
+    def __init__(self, seed: bytes = b"dispersy-tpu", n_peers: int = 0,
+                 security: str = u"very-low", crypto: ECCrypto | None = None):
+        self.seed = seed
+        self.n_peers = n_peers
+        self.security = security
+        self.crypto = crypto or ECCrypto()
+        self._cache: dict[int, Member] = {}
+        self._by_mid: dict[bytes, Member] = {}
+
+    def member(self, index: int) -> Member:
+        if index not in self._cache:
+            key = self.crypto.generate_key(
+                self.security,
+                seed=self.seed + int(index).to_bytes(8, "big"))
+            pub = self.crypto.key_to_bin(key)
+            m = Member(index=index, public_key=pub,
+                       mid=hashlib.sha1(pub).digest(), key=key)
+            self._cache[index] = m
+            self._by_mid[m.mid] = m
+        return self._cache[index]
+
+    def mid32_array(self, n: int) -> np.ndarray:
+        """uint32[n] of every row's mid32 (payloads for create_identities)."""
+        return np.array([self.member(i).mid32 for i in range(n)], np.uint32)
+
+    def by_mid(self, mid: bytes, n: int | None = None) -> Member | None:
+        """mid -> member resolution (the reference's member-table lookup).
+
+        O(1) against already-derived members; on a miss, derives rows up
+        to ``n`` (or the registry's ``n_peers``) — after which the dict
+        covers them all."""
+        if mid in self._by_mid:
+            return self._by_mid[mid]
+        for i in range(n if n is not None else self.n_peers):
+            if self.member(i).mid == mid:
+                return self._by_mid[mid]
+        return None
+
+
+def create_identities(state: PeerState, cfg: CommunityConfig,
+                      registry: MemberRegistry,
+                      mask: jnp.ndarray | None = None) -> PeerState:
+    """Publish dispersy-identity records (reference: create_identity on
+    community join).  Each masked non-tracker member authors one control
+    record with payload = its mid32; the record syncs epidemically at
+    control priority, and peers that missed it keep pulling it through
+    the Bloom sync — the dispersy-missing-identity repair, round-form.
+
+    Caveat (shared with the reference): creating EVERY member's identity in
+    one call stamps them all with the same small global_time, and a mass of
+    same-gt records defeats the "largest" claim strategy's gt-range
+    subdivision — the advertised slice covers them all and saturates the
+    Bloom filter (the reference's gt-range slicing has the identical
+    degenerate case; real overlays join over time, spreading the gts).
+    For large-N runs either size ``bloom_capacity`` near the community
+    size, use masks to stagger joins across rounds, or accept push-only
+    spread for the flood.
+    """
+    if not cfg.identity_enabled:
+        raise ValueError(
+            "create_identities needs CommunityConfig.identity_enabled=True "
+            "— it folds IDENTITY_PRIORITY into the serving/forward order "
+            "so the identity flood cannot starve other records")
+    n = cfg.n_peers
+    if mask is None:
+        mask = jnp.arange(n) >= cfg.n_trackers
+    payload = jnp.asarray(registry.mid32_array(n))
+    return engine.create_messages(state, cfg, jnp.asarray(mask, bool),
+                                  meta=META_IDENTITY, payload=payload)
+
+
+def verify_identities(state: PeerState, cfg: CommunityConfig,
+                      registry: MemberRegistry) -> float:
+    """Fraction of stored identity records whose mid32 matches the real
+    key digest of the claimed author — the conformance bridge between
+    device records and actual crypto identities.  1.0 = every synced
+    identity record is authentic."""
+    meta = np.asarray(state.store_meta)
+    member = np.asarray(state.store_member)
+    payload = np.asarray(state.store_payload)
+    rows = meta == META_IDENTITY
+    if not rows.any():
+        return 1.0
+    want = registry.mid32_array(cfg.n_peers)
+    ok = payload[rows] == want[member[rows].astype(np.int64)]
+    return float(np.mean(ok))

@@ -53,12 +53,15 @@ import jax.numpy as jnp
 from jax import lax
 
 from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_U32,
+                                 IDENTITY_PRIORITY,
                                  INTRO_REQUEST_BASE_BYTES,
                                  INTRO_RESPONSE_BYTES, META_AUTHORIZE,
+                                 META_DESTROY, META_DYNAMIC, META_IDENTITY,
                                  META_REVOKE, META_UNDO_OTHER, META_UNDO_OWN,
                                  NO_PEER, PUNCTURE_BYTES,
                                  PUNCTURE_REQUEST_BYTES, RECORD_BYTES,
-                                 CommunityConfig)
+                                 SIGNATURE_REQUEST_BYTES,
+                                 SIGNATURE_RESPONSE_BYTES, CommunityConfig)
 from dispersy_tpu.ops import bloom, candidates as cand, inbox, rng, store as st
 from dispersy_tpu.ops import timeline as tl
 from dispersy_tpu.ops.hashing import record_hash
@@ -73,6 +76,8 @@ _LOSS_PUNCTURE_REQ = 2 << 16
 _LOSS_PUNCTURE = 3 << 16
 _LOSS_SYNC = 4 << 16
 _LOSS_FORWARD = 5 << 16
+_LOSS_SIGREQ = 6 << 16
+_LOSS_SIGRESP = 7 << 16
 _TRACKER_SALT = 1 << 15
 _TRACKER_INTRO_SALT = 1 << 20
 
@@ -159,10 +164,7 @@ def _response_order(stc: st.StoreCols, cfg: CommunityConfig) -> st.StoreCols:
         return stc
     nm = cfg.n_meta
     valid = stc.gt != jnp.uint32(EMPTY_U32)
-    prio_arr = jnp.asarray(cfg.priorities, jnp.uint32)
-    meta_c = jnp.minimum(stc.meta, jnp.uint32(nm - 1)).astype(jnp.int32)
-    prio = jnp.where(stc.meta < nm, jnp.take(prio_arr, meta_c, axis=0),
-                     jnp.uint32(CONTROL_PRIORITY))
+    prio = _priority_vec(cfg, stc.meta)
     key1 = jnp.where(valid, jnp.uint32(255) - prio, jnp.uint32(EMPTY_U32))
     shm = jnp.minimum(stc.meta, jnp.uint32(31))
     desc = ((jnp.uint32(cfg.desc_meta_mask) >> shm) & 1).astype(bool) \
@@ -173,6 +175,46 @@ def _response_order(stc: st.StoreCols, cfg: CommunityConfig) -> st.StoreCols:
          stc.flags), dimension=-1, num_keys=4)
     return st.StoreCols(gt=gt, member=member, meta=meta, payload=payload,
                         aux=aux, flags=flags)
+
+
+def _priority_vec(cfg: CommunityConfig, meta: jnp.ndarray) -> jnp.ndarray:
+    """u32 serving/forwarding priority per record (config.priority_of,
+    vectorized): declared per-meta priorities for the user band,
+    IDENTITY_PRIORITY for dispersy-identity, CONTROL_PRIORITY otherwise."""
+    prio_arr = jnp.asarray(cfg.priorities, jnp.uint32)
+    meta_c = jnp.minimum(meta, jnp.uint32(cfg.n_meta - 1)).astype(jnp.int32)
+    return jnp.where(meta < cfg.n_meta, jnp.take(prio_arr, meta_c, axis=0),
+                     jnp.where(meta == jnp.uint32(META_IDENTITY),
+                               jnp.uint32(IDENTITY_PRIORITY),
+                               jnp.uint32(CONTROL_PRIORITY)))
+
+
+def _flip_best(stc: "st.StoreCols", q_meta: jnp.ndarray,
+               q_gt: jnp.ndarray) -> jnp.ndarray:
+    """u32[N, Q]: per (meta, gt) query, the max ``gt*2 | policy`` key over
+    the stored dispersy-dynamic-settings flips at or below the query gt —
+    the DynamicResolution replay (0 = no flip applies).  One definition
+    serves the author gate, the countersigner check, and the intake check;
+    the oracle mirrors it in ``_linear_at``."""
+    m = ((stc.meta[:, None, :] == jnp.uint32(META_DYNAMIC))
+         & (stc.payload[:, None, :] == q_meta[:, :, None])
+         & (stc.gt[:, None, :] <= q_gt[:, :, None]))
+    return jnp.max(jnp.where(
+        m, stc.gt[:, None, :] * 2 + (stc.aux[:, None, :] & 1), 0), axis=-1)
+
+
+def _author_linear(state: PeerState, cfg: CommunityConfig, meta: int,
+                   gt_at: jnp.ndarray) -> jnp.ndarray:
+    """bool[N]: is user meta ``meta`` LinearResolution at ``gt_at`` per each
+    row's own stored dynamic-settings flips (DynamicResolution replay; the
+    static protected bit when no flip applies or the meta isn't dynamic)."""
+    static = bool((cfg.protected_meta_mask >> meta) & 1)
+    if not (meta < cfg.n_meta and (cfg.dynamic_meta_mask >> meta) & 1):
+        return jnp.full((cfg.n_peers,), static, bool)
+    best = _flip_best(_store(state),
+                      jnp.full((cfg.n_peers, 1), meta, jnp.uint32),
+                      gt_at[:, None])[:, 0]
+    return jnp.where(best > 0, (best & 1) == 1, static)
 
 
 def _fold_gt(own_gt: jnp.ndarray, seen_gt: jnp.ndarray, seen_valid: jnp.ndarray,
@@ -238,6 +280,13 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             member=jnp.where(r1, jnp.uint32(EMPTY_U32), state.auth_member),
             mask=jnp.where(r1, jnp.uint32(0), state.auth_mask),
             gt=jnp.where(r1, jnp.uint32(0), state.auth_gt))
+        # The signature request cache dies with the process (reference:
+        # RequestCache is in-memory only).
+        sig = (jnp.where(reborn, NO_PEER, state.sig_target),
+               jnp.where(reborn, jnp.uint32(0), state.sig_meta),
+               jnp.where(reborn, jnp.uint32(0), state.sig_payload),
+               jnp.where(reborn, jnp.uint32(0), state.sig_gt),
+               jnp.where(reborn, jnp.uint32(0), state.sig_since))
         global_time = jnp.where(reborn, jnp.uint32(1), state.global_time)
         session = state.session + reborn.astype(jnp.uint32)
     else:
@@ -245,19 +294,34 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         fwd = (state.fwd_gt, state.fwd_member, state.fwd_meta,
                state.fwd_payload, state.fwd_aux)
         auth = _auth(state)
+        sig = (state.sig_target, state.sig_meta, state.sig_payload,
+               state.sig_gt, state.sig_since)
         global_time, session = state.global_time, state.session
 
     alive = state.alive
+
+    # Hard-kill state (reference: community.py HardKilledCommunity — once a
+    # peer stores the founder's dispersy-destroy-community, its community
+    # instance is dead: no walking, no authoring, no intake; its sync
+    # responder serves ONLY the destroy record so destruction keeps
+    # spreading).  Derived from the (post-churn) store each round, the way
+    # the reference derives the classification from the database on load;
+    # a churned-out peer forgets the kill and re-learns it by syncing.
+    if cfg.timeline_enabled:
+        killed = jnp.any(stc.meta == jnp.uint32(META_DESTROY), axis=1)
+    else:
+        killed = jnp.zeros((n,), bool)
 
     # ---- phase 1: walker send ------------------------------------------
     # dispersy_get_walk_candidate + create_introduction_request.  Trackers
     # never walk (reference: TrackerCommunity disables the candidate
     # walker — it stays connected purely through inbound requests).
-    boot_base, boot_count, mem_base, _ = _layout_cols(cfg, idx)
+    boot_base, boot_count, mem_base, mem_count = _layout_cols(cfg, idx)
     if cfg.walker_enabled:
         target = cand.sample_walk_target(tab, now, cfg, seed, rnd, idx,
                                          boot_base, boot_count)
-        target = jnp.where(alive & ~state.is_tracker, target, NO_PEER)
+        target = jnp.where(alive & ~state.is_tracker & ~killed, target,
+                           NO_PEER)
     else:
         target = jnp.full((n,), NO_PEER, jnp.int32)
 
@@ -292,7 +356,18 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                    + jnp.arange(c)[None, :])[None, :, :]          # [1, F, C]
         push_lost = _lost(seed, rnd, idx[:, None, None], _LOSS_FORWARD,
                           fc_salt, cfg.packet_loss)
-        push_valid = (alive[:, None, None] & have_rec & tgt_ok & ~push_lost)
+        if cfg.timeline_enabled:
+            # A hard-killed peer pushes NOTHING except destroy records —
+            # HardKilledCommunity actively spreads the kill (the creator
+            # itself is killed the instant its own destroy stores, so
+            # without this the record would never leave the founder).
+            send_rec_ok = (alive[:, None]
+                           & (~killed[:, None]
+                              | (fwd_meta == jnp.uint32(META_DESTROY))
+                              ))[:, :, None]                  # [N, F, 1]
+        else:
+            send_rec_ok = alive[:, None, None]
+        push_valid = send_rec_ok & have_rec & tgt_ok & ~push_lost
         push_dst = jnp.broadcast_to(fwd_targets[:, None, :], (n, f, c))
 
         def bcast(col):
@@ -310,7 +385,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             + jnp.sum(push_valid, axis=(1, 2)).astype(jnp.uint32),
             msgs_dropped=stats.msgs_dropped
             + push.n_dropped.astype(jnp.uint32))
-        push_sent = alive[:, None, None] & have_rec & tgt_ok     # pre-loss
+        push_sent = send_rec_ok & have_rec & tgt_ok              # pre-loss
         bup = bup + jnp.sum(push_sent, axis=(1, 2)).astype(jnp.uint32) \
             * jnp.uint32(RECORD_BYTES)
         bdown = bdown + jnp.sum(ph_ok, axis=1).astype(jnp.uint32) \
@@ -321,6 +396,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         ph_ok = jnp.zeros((n, 0), bool)
 
     req_lost = _lost(seed, rnd, idx, _LOSS_REQUEST, 0, cfg.packet_loss)
+    # target is already NO_PEER for dead/tracker/killed peers (phase 1).
     bup = bup + (alive & (target != NO_PEER)).astype(jnp.uint32) * req_bytes
     send_ok = alive & (target != NO_PEER) & ~req_lost
     to_tracker = (target >= 0) & (target < t)
@@ -554,6 +630,114 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         + (walked_ok & got_resp).astype(jnp.uint32),
         walk_fail=stats.walk_fail + failed.astype(jnp.uint32))
 
+    # ---- phase 3s: signature-request/-response exchange ----------------
+    # DoubleMemberAuthentication (reference: authentication.py; community.py
+    # create_signature_request / on_signature_request / on_signature_response
+    # + the signature RequestCache, SURVEY §3.5).  The draft rides to the
+    # counterparty ONCE, in the round it was created; the counterparty
+    # decides (the app's allow_signature_func, modeled by the
+    # countersign_rate draw, plus its own Timeline view for protected
+    # metas) and the countersigned record rides back along the same edge
+    # by receipt.  A completed record joins this round's intake batch as
+    # one more incoming packet; an unanswered request idles until the
+    # cache timeout frees the slot — no retransmit, exactly like the
+    # reference's one-shot request + cache expiry.
+    sg_target, sg_meta, sg_payload, sg_gt, sg_since = sig
+    if cfg.double_meta_mask:
+        s_sz = cfg.sig_inbox
+        sending = alive & ~killed & (sg_target != NO_PEER) & (sg_since == rnd)
+        srq_lost = _lost(seed, rnd, idx, _LOSS_SIGREQ, 0, cfg.packet_loss)
+        bup = bup + sending.astype(jnp.uint32) \
+            * jnp.uint32(SIGNATURE_REQUEST_BYTES)
+        sreq = inbox.deliver(
+            dst=jnp.where(sending, sg_target, NO_PEER),
+            cols=[idx.astype(jnp.uint32), sg_meta, sg_payload, sg_gt],
+            valid=sending & ~srq_lost, n_peers=n, inbox_size=s_sz)
+        sq_src, sq_meta, sq_payload, sq_gt = sreq.inbox          # [N, S]
+        # Trackers never countersign (infrastructure, not members); neither
+        # do hard-killed peers (their community instance is unloaded).
+        sq_ok = (sreq.inbox_valid & alive[:, None]
+                 & ~state.is_tracker[:, None] & ~killed[:, None])
+        if cfg.countersign_rate >= 1.0:
+            agree = jnp.ones((n, s_sz), bool)
+        elif cfg.countersign_rate <= 0.0:
+            agree = jnp.zeros((n, s_sz), bool)
+        else:
+            agree = rng.rand_uniform(
+                seed, rnd, idx[:, None], rng.P_SIGN,
+                jnp.arange(s_sz)[None, :]) < jnp.float32(
+                    cfg.countersign_rate)
+        if cfg.timeline_enabled and ((cfg.protected_meta_mask
+                                      | cfg.dynamic_meta_mask)
+                                     & cfg.double_meta_mask):
+            # on_signature_request runs the draft through B's check
+            # pipeline: for a meta that is linear AT THE DRAFT'S
+            # global_time (static bit, or B's replayed dynamic flips)
+            # both signers need the permit in B's timeline (reference:
+            # Timeline.check walks every authentication member).
+            founder_b = _founder_col(cfg, mem_base)[:, None]
+            shq = jnp.minimum(sq_meta, jnp.uint32(31))
+            prot_q = ((((jnp.uint32(cfg.protected_meta_mask) >> shq) & 1)
+                       == 1) & (sq_meta < cfg.n_meta))
+            if cfg.dynamic_meta_mask & cfg.double_meta_mask:
+                dyn_q = ((((jnp.uint32(cfg.dynamic_meta_mask) >> shq) & 1)
+                          == 1) & (sq_meta < cfg.n_meta))
+                best_q = _flip_best(stc, sq_meta, sq_gt)         # [N, S]
+                prot_q = jnp.where(dyn_q,
+                                   jnp.where(best_q > 0,
+                                             (best_q & 1) == 1, prot_q),
+                                   prot_q)
+            perm_q = (tl.check(auth, sq_src, sq_meta, sq_gt, founder_b)
+                      & tl.check(auth,
+                                 jnp.broadcast_to(idx[:, None].astype(
+                                     jnp.uint32), (n, s_sz)),
+                                 sq_meta, sq_gt, founder_b))
+            agree = agree & jnp.where(prot_q, perm_q, True)
+        countersign = sq_ok & agree
+        n_sq = jnp.sum(sq_ok, axis=1).astype(jnp.uint32)
+        n_cs = jnp.sum(countersign, axis=1).astype(jnp.uint32)
+        bdown = bdown + n_sq * jnp.uint32(SIGNATURE_REQUEST_BYTES)
+        bup = bup + n_cs * jnp.uint32(SIGNATURE_RESPONSE_BYTES)
+
+        # Response pickup by receipt at the author.
+        tgt_a = jnp.maximum(jnp.where(sending, sg_target, 0), 0)
+        slot_a = jnp.maximum(sreq.edge_slot, 0)
+        got_sig = (sreq.edge_slot >= 0) & countersign[tgt_a, slot_a]
+        srs_lost = _lost(seed, rnd, idx, _LOSS_SIGRESP, 0, cfg.packet_loss)
+        completed = sending & got_sig & ~srs_lost
+        bdown = bdown + completed.astype(jnp.uint32) \
+            * jnp.uint32(SIGNATURE_RESPONSE_BYTES)
+
+        # Cache lifecycle: free on completion, expire on timeout.
+        expired = (alive & (sg_target != NO_PEER) & ~completed
+                   & (rnd - sg_since >= jnp.uint32(cfg.sig_timeout_rounds)))
+        clear = completed | expired
+        sig = (jnp.where(clear, NO_PEER, sg_target),
+               jnp.where(clear, jnp.uint32(0), sg_meta),
+               jnp.where(clear, jnp.uint32(0), sg_payload),
+               jnp.where(clear, jnp.uint32(0), sg_gt),
+               jnp.where(clear, jnp.uint32(0), sg_since))
+        stats = stats.replace(
+            sig_signed=stats.sig_signed + n_cs,
+            sig_done=stats.sig_done + completed.astype(jnp.uint32),
+            sig_expired=stats.sig_expired + expired.astype(jnp.uint32),
+            # A signature request lost to inbox overflow is a dropped
+            # request like any other.
+            requests_dropped=stats.requests_dropped
+            + sreq.n_dropped.astype(jnp.uint32))
+        # The completed double-signed record, as one intake column.
+        db_gt = jnp.where(completed, sg_gt, jnp.uint32(EMPTY_U32))[:, None]
+        db_member = idx.astype(jnp.uint32)[:, None]
+        db_meta = sg_meta[:, None]
+        db_payload = sg_payload[:, None]
+        db_aux = jnp.where(sg_target == NO_PEER, 0,
+                           sg_target).astype(jnp.uint32)[:, None]
+        db_ok = completed[:, None]
+    else:
+        d0 = jnp.zeros((n, 0), jnp.uint32)
+        db_gt = db_member = db_meta = db_payload = db_aux = d0
+        db_ok = jnp.zeros((n, 0), bool)
+
     # ---- phase 2b/5: sync responder + store insert ---------------------
     # The responder fills a per-request-slot *outbox* of up to
     # ``response_budget`` records the requester provably lacks; the
@@ -566,14 +750,32 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         # ASC/DESC per meta); identity for default communities.
         stv = _response_order(stc, cfg)
         rec_h2 = record_hash(stv.member, stv.gt, stv.meta, stv.payload)
+        # A hard-killed responder serves nothing but the destroy record —
+        # the reference's HardKilledCommunity answers every packet with the
+        # packed dispersy-destroy-community message.
+        if cfg.timeline_enabled:
+            servable = ~killed[:, None] | (stv.meta == jnp.uint32(
+                META_DESTROY))                                    # [N, M]
+        else:
+            servable = None
         gts, members, metas, payloads, auxs, valids = [], [], [], [], [], []
         rows = idx[:, None]
         for s in range(r):
             sl_s = st.SyncSlice(time_low=rq_tlow[:, s], time_high=rq_thigh[:, s],
                                 modulo=rq_mod[:, s], offset=rq_off[:, s])
             in_sl = st.slice_mask(stv.gt, sl_s)                   # [N, M]
+            if servable is not None:
+                in_sl = in_sl & servable
             present = bloom.bloom_query(rq_bloom[:, s], rec_h2,
                                         cfg.bloom_bits, cfg.bloom_hashes)
+            if cfg.timeline_enabled:
+                # A hard-killed responder answers every request with the
+                # destroy record UNCONDITIONALLY (reference:
+                # HardKilledCommunity replies with the packed destroy
+                # message to any packet) — never skipped on a Bloom
+                # false-positive, or a saturated filter would stall the
+                # kill's spread.
+                present = present & ~killed[:, None]
             missing = in_sl & ~present & rq_ok[:, s:s + 1]
             # First `b` missing records in serving order — the view is the
             # responder's ORDER BY under dispersy_sync_response_limit.
@@ -609,21 +811,38 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         sy_gt = sy_member = sy_meta = sy_payload = sy_aux = s0
         sy_ok = jnp.zeros((n, 0), bool)
 
-    # ---- phase 5: combined intake (sync pull + push) -> store ----------
-    # One batch per round: sync records first, then pushed records, in
+    # ---- phase 5: combined intake (sync pull + push + completed
+    # double-signed) -> store.  One batch per round: sync records first,
+    # then pushed records, then this round's countersigned completion, in
     # delivery order — mirroring the reference's _on_batch_cache handling
     # one grouped batch per meta per window.
-    in_gt = jnp.concatenate([sy_gt, ph_gt], axis=1)               # [N, B]
-    in_member = jnp.concatenate([sy_member, ph_member], axis=1)
-    in_meta = jnp.concatenate([sy_meta, ph_meta], axis=1)
-    in_payload = jnp.concatenate([sy_payload, ph_payload], axis=1)
-    in_aux = jnp.concatenate([sy_aux, ph_aux], axis=1)
-    in_ok = jnp.concatenate([sy_ok, ph_ok], axis=1)
+    in_gt = jnp.concatenate([sy_gt, ph_gt, db_gt], axis=1)        # [N, B]
+    in_member = jnp.concatenate([sy_member, ph_member, db_member], axis=1)
+    in_meta = jnp.concatenate([sy_meta, ph_meta, db_meta], axis=1)
+    in_payload = jnp.concatenate([sy_payload, ph_payload, db_payload],
+                                 axis=1)
+    in_aux = jnp.concatenate([sy_aux, ph_aux, db_aux], axis=1)
+    in_ok = jnp.concatenate([sy_ok, ph_ok, db_ok], axis=1)
     bb = in_gt.shape[1]
     if bb > 0:
         # Clock-jump defense before the store accepts anything.
         in_ok = in_ok & (in_gt <= global_time[:, None] + jnp.uint32(
             cfg.acceptable_global_time_range))
+        if cfg.double_meta_mask:
+            # The structural "signature verify" for double-signed records
+            # (whether freshly countersigned or arriving via sync): the
+            # countersigner in `aux` must be a real, distinct, non-tracker
+            # member of the receiver's community (reference:
+            # conversion.py decode rejects a double-signed packet whose
+            # second signature does not verify).
+            shd = jnp.minimum(in_meta, jnp.uint32(31))
+            is_dbl = ((((jnp.uint32(cfg.double_meta_mask) >> shd) & 1) == 1)
+                      & (in_meta < cfg.n_meta))
+            dbl_ok = ((in_aux != in_member)
+                      & (in_aux >= mem_base.astype(jnp.uint32)[:, None])
+                      & (in_aux < (mem_base + mem_count).astype(
+                          jnp.uint32)[:, None]))
+            in_ok = in_ok & jnp.where(is_dbl, dbl_ok, True)
         # Freshness (drives next round's forward batch): not already in the
         # store on the UNIQUE(member, global_time) identity, and not a
         # duplicate of an earlier record in this same batch.
@@ -638,6 +857,10 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
 
         in_flags = jnp.zeros_like(in_gt)
         if cfg.timeline_enabled:
+            # A hard-killed peer's community instance is unloaded: it
+            # processes no incoming messages at all (reference:
+            # HardKilledCommunity drops everything).
+            in_ok = in_ok & ~killed[:, None]
             # The receive pipeline's check step (reference: dispersy.py
             # _on_batch_cache -> meta.check_callback -> timeline.py
             # Timeline.check).  Control records carry their own authority
@@ -648,9 +871,12 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             is_undo_own = in_meta == jnp.uint32(META_UNDO_OWN)
             is_undo_other = in_meta == jnp.uint32(META_UNDO_OTHER)
             is_undo = is_undo_own | is_undo_other
-            is_ctrl = is_auth | is_rev | is_undo
-            # authorize/revoke/undo-other: founder-only (one delegation
-            # level — see ops/timeline.py).  undo-own: author undoes itself.
+            is_flip = in_meta == jnp.uint32(META_DYNAMIC)
+            is_destroy = in_meta == jnp.uint32(META_DESTROY)
+            is_ctrl = is_auth | is_rev | is_undo | is_flip | is_destroy
+            # authorize/revoke/undo-other/dynamic-settings/destroy:
+            # founder-only (one delegation level — see ops/timeline.py).
+            # undo-own: the author undoes itself.
             ctrl_ok = jnp.where(is_undo_own, in_member == in_payload,
                                 in_member == founder)
 
@@ -669,7 +895,36 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             prot = jnp.uint32(cfg.protected_meta_mask)
             shift = jnp.minimum(in_meta, jnp.uint32(31))
             protected = (((prot >> shift) & 1) == 1) & (in_meta < 32)
+            if cfg.dynamic_meta_mask:
+                # DynamicResolution: the policy in force at the record's
+                # own global_time is the highest-gt flip at or below it —
+                # replayed from the store plus this batch's fresh flips
+                # (reference: Timeline.get_resolution_policy walks the
+                # stored dispersy-dynamic-settings chain).  A flip's
+                # (gt, policy) packs into one sortable key gt*2 | policy.
+                dynm = jnp.uint32(cfg.dynamic_meta_mask)
+                is_dyn = ((((dynm >> shift) & 1) == 1)
+                          & (in_meta < cfg.n_meta))
+                best = _flip_best(stc, in_meta, in_gt)            # [N, B]
+                flip_ok = fresh0 & is_flip & ctrl_ok              # [N, B]
+                flip_b = (flip_ok[:, None, :]
+                          & (in_payload[:, None, :] == in_meta[:, :, None])
+                          & (in_gt[:, None, :] <= in_gt[:, :, None]))
+                key_b = jnp.where(
+                    flip_b, in_gt[:, None, :] * 2 + (in_aux[:, None, :] & 1),
+                    0)
+                best = jnp.maximum(best, jnp.max(key_b, axis=-1))
+                linear_now = jnp.where(best > 0, (best & 1) == 1, protected)
+                protected = jnp.where(is_dyn, linear_now, protected)
             permitted = tl.check(auth, in_member, in_meta, in_gt, founder)
+            if cfg.double_meta_mask & (cfg.protected_meta_mask
+                                       | cfg.dynamic_meta_mask):
+                # Both signers of a protected double-signed record need the
+                # permit (reference: Timeline.check iterates every
+                # authentication member of the message).
+                permitted = permitted & jnp.where(
+                    is_dbl, tl.check(auth, in_aux, in_meta, in_gt, founder),
+                    True)
             accept = in_ok & jnp.where(
                 is_ctrl, ctrl_ok, jnp.where(protected, permitted, True))
 
@@ -793,9 +1048,25 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             stc = stc._replace(flags=jnp.where(
                 hit, stc.flags | jnp.uint32(FLAG_UNDONE), stc.flags))
 
-        # Next round's forward batch = first F fresh records of this batch.
+        # Next round's forward batch = F fresh records of this batch.
+        # With a timeline or mixed priorities, the F slots go to the
+        # HIGHEST-priority fresh records (ties by delivery order) so a
+        # control record (authorize / dynamic-settings / destroy, at
+        # CONTROL_PRIORITY) cannot lose its only push to bulk records —
+        # the bounded-buffer form of the reference's priority field.
         fb = cfg.forward_buffer
-        rank = jnp.cumsum(fresh.astype(jnp.int32), axis=1) - 1
+        if cfg.needs_priority_forward:
+            assert bb < 4096
+            fprio = _priority_vec(cfg, in_meta)
+            okey = jnp.where(
+                fresh,
+                (jnp.uint32(255) - fprio) * jnp.uint32(4096)
+                + jnp.arange(bb, dtype=jnp.uint32),
+                jnp.uint32(EMPTY_U32))
+            rank = jnp.sum((okey[:, None, :] < okey[:, :, None])
+                           & fresh[:, None, :], axis=-1)
+        else:
+            rank = jnp.cumsum(fresh.astype(jnp.int32), axis=1) - 1
         fslot = jnp.where(fresh & (rank < fb), rank, fb)
         rows_all = idx[:, None]
 
@@ -818,11 +1089,30 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         fwd_gt=fwd[0], fwd_member=fwd[1], fwd_meta=fwd[2], fwd_payload=fwd[3],
         fwd_aux=fwd[4],
         auth_member=auth.member, auth_mask=auth.mask, auth_gt=auth.gt,
+        sig_target=sig[0], sig_meta=sig[1], sig_payload=sig[2],
+        sig_gt=sig[3], sig_since=sig[4],
         stats=stats.replace(bytes_up=stats.bytes_up + bup,
                             bytes_down=stats.bytes_down + bdown),
         time=now + jnp.float32(cfg.walk_interval),
         round_index=rnd + jnp.uint32(1),
     )
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2), donate_argnums=0)
+def multi_step(state: PeerState, cfg: CommunityConfig, k: int) -> PeerState:
+    """Advance ``k`` rounds in ONE dispatch (a ``lax.fori_loop`` over
+    :func:`step`'s body).
+
+    The per-call form pays host-dispatch latency every round — measured at
+    ~300 us through this environment's TPU tunnel, ~60x the ~5 us the
+    device spends computing a 1M-peer round (BENCH.md).  Steady-state
+    simulation (the driver's rounds/sec metric, long convergence runs)
+    should therefore batch rounds through this entry point and only
+    surface to the host when it actually wants to look at the state —
+    exactly how the reference amortizes work across its 5-second walker
+    ticks without returning to the caller in between.
+    """
+    return lax.fori_loop(0, k, lambda i, s: step.__wrapped__(s, cfg), state)
 
 
 def create_messages(state: PeerState, cfg: CommunityConfig,
@@ -837,6 +1127,10 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
     is the peer index in simulation), and stores locally; epidemic spread
     then happens through the Bloom-sync rounds.
 
+    Control metas (authorize/revoke/undo/dynamic-settings/destroy) only
+    exist under a timeline — authoring one with ``timeline_enabled=False``
+    is a configuration error, raised loudly rather than synced inertly.
+
     With ``cfg.timeline_enabled`` the author side of ``Timeline.check`` runs
     too (the reference refuses to create a message the local timeline would
     reject): control metas enforce their authority rule, protected metas
@@ -844,6 +1138,15 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
     authorize/revoke/undo records act on the author's own state immediately
     (reference: store_update_forward processes a created message locally).
     """
+    if meta in (META_AUTHORIZE, META_REVOKE, META_UNDO_OWN, META_UNDO_OTHER,
+                META_DYNAMIC, META_DESTROY) and not cfg.timeline_enabled:
+        # (dispersy-identity is deliberately NOT here: identity records are
+        # public announcements and enforce nothing.)
+        raise ValueError(
+            f"meta {meta:#x} is a permission control message; it needs "
+            "timeline_enabled=True (declare a Linear/DynamicResolution "
+            "meta or set the flag) — without a timeline the record would "
+            "sync but enforce nothing")
     n = cfg.n_peers
     idx = jnp.arange(n, dtype=jnp.uint32)
     if aux is None:
@@ -867,17 +1170,29 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
     if cfg.timeline_enabled:
         _, _, mem_base, _ = _layout_cols(cfg, jnp.arange(n, dtype=jnp.int32))
         founder_row = _founder_col(cfg, mem_base)
-        if meta in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER):
+        if meta in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER,
+                    META_DYNAMIC, META_DESTROY):
             allowed = idx == founder_row
         elif meta == META_UNDO_OWN:
             allowed = payload == idx
+        elif meta < cfg.n_meta and (cfg.dynamic_meta_mask >> meta) & 1:
+            # DynamicResolution author gate: policy at the claimed
+            # global_time, replayed from the author's own store.
+            linear_now = _author_linear(state, cfg, meta, gt_new)
+            permit = tl.check(auth, idx[:, None],
+                              jnp.full((n, 1), meta, jnp.uint32),
+                              gt_new[:, None], founder_row[:, None])[:, 0]
+            allowed = ~linear_now | permit
         elif meta < 32 and (cfg.protected_meta_mask >> meta) & 1:
             allowed = tl.check(auth, idx[:, None],
                                jnp.full((n, 1), meta, jnp.uint32),
                                gt_new[:, None], founder_row[:, None])[:, 0]
         else:
             allowed = jnp.ones((n,), bool)
-        author_mask = author_mask & allowed
+        # A hard-killed peer's community is unloaded: nothing to create on.
+        killed = jnp.any(state.store_meta == jnp.uint32(META_DESTROY),
+                         axis=1)
+        author_mask = author_mask & allowed & ~killed
 
     new = st.StoreCols(
         gt=gt_new[:, None],
@@ -908,12 +1223,16 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
         stc = stc._replace(flags=jnp.where(
             hit, stc.flags | jnp.uint32(FLAG_UNDONE), stc.flags))
 
-    # A created record also enters the forward batch (the reference calls
-    # store_update_forward on create — forward=True pushes it immediately).
+    # A created record ALWAYS enters the forward batch (the reference calls
+    # store_update_forward on create — forward=True pushes it
+    # unconditionally).  When relayed records already fill the buffer, the
+    # newest of them is displaced: an author's own creation must not lose
+    # its only push to unrelated relay traffic (with a saturated Bloom
+    # slice, a never-pushed record would never spread at all).
     fslot = st.count_valid(state.fwd_gt)                       # first free slot
-    can_buf = author_mask & (fslot < cfg.forward_buffer)
+    can_buf = author_mask if cfg.forward_buffer > 0 else jnp.zeros((n,), bool)
     rows = jnp.arange(n)
-    put = (jnp.minimum(fslot, cfg.forward_buffer - 1),)
+    put = (jnp.minimum(fslot, max(cfg.forward_buffer - 1, 0)),)
 
     def buf(cur, val):
         return cur.at[rows, put[0]].set(
@@ -935,6 +1254,66 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
             accepted_by_meta=state.stats.accepted_by_meta
             .at[:, min(meta, cfg.n_meta)]
             .add(author_mask.astype(jnp.uint32))))
+
+
+def create_signature_request(state: PeerState, cfg: CommunityConfig,
+                             author_mask: jnp.ndarray, meta: int,
+                             counterparty: jnp.ndarray,
+                             payload: jnp.ndarray) -> PeerState:
+    """Draft a double-signed record and open the signature request.
+
+    Mirrors ``Community.create_signature_request`` (reference: community.py
+    — draft a DoubleMemberAuthentication message, park it in the
+    RequestCache, send ``dispersy-signature-request`` to the counterparty):
+    each masked peer claims global_time+1 for the draft and fills its
+    one-slot signature cache; the request itself rides in the *next*
+    :func:`step` and resolves (or expires) there.  The draft is NOT stored
+    locally — only the countersigned completion enters the store, exactly
+    as in the reference where the half-signed packet lives in the cache
+    only.
+
+    ``counterparty`` is i32[N]: each author's chosen second signer.  A
+    request is refused (mask cleared, no side effect) when the author
+    already has one in flight, the counterparty is itself / a tracker /
+    outside the author's community, or — for protected metas — the author
+    lacks the permit in its own timeline.
+    """
+    if not (meta < cfg.n_meta and (cfg.double_meta_mask >> meta) & 1):
+        raise ValueError(f"meta {meta} is not double-signed "
+                         f"(double_meta_mask={cfg.double_meta_mask:#x})")
+    n = cfg.n_peers
+    idx = jnp.arange(n, dtype=jnp.int32)
+    counterparty = jnp.asarray(counterparty, jnp.int32).reshape(n)
+    payload = jnp.asarray(payload, jnp.uint32).reshape(n)
+    _, _, mem_base, mem_count = _layout_cols(cfg, idx)
+    gt_new = state.global_time + jnp.uint32(1)
+    ok = (jnp.asarray(author_mask, bool) & state.alive & ~state.is_tracker
+          & (state.sig_target == NO_PEER)
+          & (counterparty != idx)
+          & (counterparty >= mem_base)
+          & (counterparty < mem_base + mem_count))
+    if cfg.timeline_enabled:
+        ok = ok & ~jnp.any(state.store_meta == jnp.uint32(META_DESTROY),
+                           axis=1)
+    if (cfg.timeline_enabled
+            and ((cfg.protected_meta_mask | cfg.dynamic_meta_mask)
+                 >> meta) & 1):
+        # The author's own timeline view, dynamic flips included — the
+        # same gate create_messages applies (an unpermitted author must
+        # not burn a counterparty's signature on a record every intake
+        # would reject).
+        founder_row = _founder_col(cfg, mem_base)
+        permit = tl.check(_auth(state), idx[:, None].astype(jnp.uint32),
+                          jnp.full((n, 1), meta, jnp.uint32),
+                          gt_new[:, None], founder_row[:, None])[:, 0]
+        ok = ok & (~_author_linear(state, cfg, meta, gt_new) | permit)
+    return state.replace(
+        sig_target=jnp.where(ok, counterparty, state.sig_target),
+        sig_meta=jnp.where(ok, jnp.uint32(meta), state.sig_meta),
+        sig_payload=jnp.where(ok, payload, state.sig_payload),
+        sig_gt=jnp.where(ok, gt_new, state.sig_gt),
+        sig_since=jnp.where(ok, state.round_index, state.sig_since),
+        global_time=jnp.where(ok, gt_new, state.global_time))
 
 
 def seed_overlay(state: PeerState, cfg: CommunityConfig,

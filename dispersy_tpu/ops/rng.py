@@ -34,6 +34,7 @@ P_BOOTSTRAP = 4  # which tracker to bootstrap from
 P_CHURN = 5      # does this peer churn out this round
 P_LOSS = 6       # per-packet Bernoulli loss
 P_GOSSIP = 7     # forwarding fan-out choice (CommunityDestination)
+P_SIGN = 8       # counterparty's countersign decision (allow_signature_func)
 
 
 def fold_seed(key: jnp.ndarray) -> jnp.ndarray:

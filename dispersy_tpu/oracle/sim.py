@@ -28,10 +28,12 @@ import numpy as np
 from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_U32,
                                  INTRO_REQUEST_BASE_BYTES,
                                  INTRO_RESPONSE_BYTES, META_AUTHORIZE,
-                                 META_REVOKE, META_UNDO_OTHER, META_UNDO_OWN,
-                                 NO_PEER, PUNCTURE_BYTES,
-                                 PUNCTURE_REQUEST_BYTES, RECORD_BYTES,
-                                 CommunityConfig)
+                                 META_DESTROY, META_DYNAMIC, META_REVOKE,
+                                 META_UNDO_OTHER, META_UNDO_OWN, NO_PEER,
+                                 PUNCTURE_BYTES, PUNCTURE_REQUEST_BYTES,
+                                 RECORD_BYTES, SIGNATURE_REQUEST_BYTES,
+                                 SIGNATURE_RESPONSE_BYTES, CommunityConfig,
+                                 priority_of)
 from dispersy_tpu.oracle.bloom import OracleBloom, record_hash
 from dispersy_tpu.ops import rng as _jrng
 
@@ -49,12 +51,14 @@ _LOSS_PUNCTURE_REQ = 2 << 16
 _LOSS_PUNCTURE = 3 << 16
 _LOSS_SYNC = 4 << 16
 _LOSS_FORWARD = 5 << 16
+_LOSS_SIGREQ = 6 << 16
+_LOSS_SIGRESP = 7 << 16
 _TRACKER_SALT = 1 << 15
 _TRACKER_INTRO_SALT = 1 << 20
 
 # Purpose tags (ops/rng.py).
 P_CATEGORY, P_SLOT, P_INTRO, P_BOOTSTRAP = 1, 2, 3, 4
-P_CHURN, P_LOSS, P_GOSSIP = 5, 6, 7
+P_CHURN, P_LOSS, P_GOSSIP, P_SIGN = 5, 6, 7, 8
 
 KIND_WALK, KIND_STUMBLE, KIND_INTRO = 0, 1, 2
 CAT_NONE, CAT_WALKED, CAT_STUMBLED, CAT_INTRODUCED = 0, 1, 2, 3
@@ -145,12 +149,17 @@ class OraclePeer:
         self.store: list[Record] = []   # kept sorted by Record.key()
         self.fwd: list[Record] = []     # forward batch for next round
         self.auth: list[AuthRow] = []   # bounded at cfg.k_authorized
+        # signature request cache (one in flight; engine sig_* fields)
+        self.sig_target = NO_PEER
+        self.sig_meta = self.sig_payload = 0
+        self.sig_gt = self.sig_since = 0
         # stats
         self.walk_success = self.walk_fail = 0
         self.msgs_stored = self.msgs_dropped = 0
         self.requests_dropped = self.punctures = 0
         self.msgs_forwarded = self.msgs_rejected = 0
         self.msgs_direct = 0
+        self.sig_signed = self.sig_done = self.sig_expired = 0
         self.bytes_up = self.bytes_down = 0          # wrap mod 2^32
         self.accepted_by_meta = [0] * (cfg.n_meta + 1)
 
@@ -351,7 +360,7 @@ class OracleSim:
         pr = cfg.priorities
 
         def key(r: Record):
-            prio = pr[r.meta] if r.meta < nm else CONTROL_PRIORITY
+            prio = priority_of(r.meta, nm, pr)
             desc = r.meta < nm and ((cfg.desc_meta_mask >> r.meta) & 1)
             k2 = (M32 - r.gt) if desc else r.gt
             return (255 - prio, k2, r.gt, r.member)
@@ -419,7 +428,40 @@ class OracleSim:
         else:
             p.msgs_dropped += 1
 
-    def _intake_accept(self, owner: int, rec: Record) -> bool:
+    def _dbl_struct_ok(self, owner: int, rec: Record) -> bool:
+        """Engine's structural countersigner check (phase 5): for a
+        double-signed meta, ``aux`` must name a real, distinct member of
+        the receiver's community.  True for every other meta."""
+        cfg = self.cfg
+        if not (rec.meta < cfg.n_meta
+                and (cfg.double_meta_mask >> rec.meta) & 1):
+            return True
+        base = int(self.mem_base[owner])
+        cnt = int(self.mem_count[owner])
+        return rec.aux != rec.member and base <= rec.aux < base + cnt
+
+    def _linear_at(self, owner: int, meta: int, gt: int,
+                   batch_flips=()) -> bool:
+        """Resolution policy for ``meta`` at ``gt``: the highest-gt
+        dynamic-settings flip at or below it (store + this batch's fresh
+        accepted flips), defaulting to the static protected bit (engine's
+        gt*2|policy key-max)."""
+        cfg = self.cfg
+        linear = bool((cfg.protected_meta_mask >> meta) & 1)
+        if not (meta < cfg.n_meta and (cfg.dynamic_meta_mask >> meta) & 1):
+            return linear
+        best = 0
+        for r in self.peers[owner].store:
+            if (r.meta == META_DYNAMIC and r.payload == meta
+                    and r.gt <= gt):
+                best = max(best, r.gt * 2 + (r.aux & 1))
+        for fgt, ftarget, faux in batch_flips:
+            if ftarget == meta and fgt <= gt:
+                best = max(best, fgt * 2 + (faux & 1))
+        return bool(best & 1) if best > 0 else linear
+
+    def _intake_accept(self, owner: int, rec: Record,
+                       batch_flips=()) -> bool:
         """The engine's timeline accept mask for one in_ok record.  Pure:
         the batch's fresh authorize/revoke records must already be folded
         (the engine folds the whole batch before any check runs)."""
@@ -427,12 +469,18 @@ class OracleSim:
         if not cfg.timeline_enabled:
             return True
         m = rec.meta
-        if m in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER):
+        if m in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER,
+                 META_DYNAMIC, META_DESTROY):
             return rec.member == self._founder(owner)
         if m == META_UNDO_OWN:
             return rec.member == rec.payload
-        if m < 32 and (cfg.protected_meta_mask >> m) & 1:
-            return self._auth_check(owner, rec.member, m, rec.gt)
+        if m < 32 and self._linear_at(owner, m, rec.gt, batch_flips):
+            ok = self._auth_check(owner, rec.member, m, rec.gt)
+            if (m < cfg.n_meta and (cfg.double_meta_mask >> m) & 1):
+                # Both signers need the permit (engine mirrors
+                # Timeline.check over every authentication member).
+                ok = ok and self._auth_check(owner, rec.aux, m, rec.gt)
+            return ok
         return True
 
     # ---- setup mirrors ------------------------------------------------------
@@ -448,11 +496,19 @@ class OracleSim:
             av = int(aux[i]) if aux is not None else 0
             pv = int(payload[i])
             if cfg.timeline_enabled:
-                if meta in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER):
+                if any(r.meta == META_DESTROY for r in p.store):
+                    continue          # hard-killed: community unloaded
+                if meta in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER,
+                            META_DYNAMIC, META_DESTROY):
                     if i != self._founder(i):
                         continue
                 elif meta == META_UNDO_OWN:
                     if pv != i:
+                        continue
+                elif (meta < cfg.n_meta
+                      and (cfg.dynamic_meta_mask >> meta) & 1):
+                    if (self._linear_at(i, meta, gt)
+                            and not self._auth_check(i, i, meta, gt)):
                         continue
                 elif meta < 32 and (cfg.protected_meta_mask >> meta) & 1:
                     if not self._auth_check(i, i, meta, gt):
@@ -473,7 +529,42 @@ class OracleSim:
                         r.flags |= FLAG_UNDONE
             if len(p.fwd) < cfg.forward_buffer:
                 p.fwd.append(rec.copy())
+            elif cfg.forward_buffer > 0:
+                # own creation displaces the newest relayed entry (engine:
+                # create_messages always buffers at min(fslot, F-1))
+                p.fwd[cfg.forward_buffer - 1] = rec.copy()
             p.global_time = gt
+            p.accepted_by_meta[min(meta, cfg.n_meta)] += 1
+
+    def create_signature_request(self, author_mask, meta: int, counterparty,
+                                 payload) -> None:
+        """engine.create_signature_request mirror."""
+        cfg = self.cfg
+        assert meta < cfg.n_meta and (cfg.double_meta_mask >> meta) & 1
+        for i, p in enumerate(self.peers):
+            if not author_mask[i]:
+                continue
+            cp = int(counterparty[i])
+            base = int(self.mem_base[i])
+            cnt = int(self.mem_count[i])
+            gt_new = p.global_time + 1
+            if not (p.alive and i >= cfg.n_trackers
+                    and p.sig_target == NO_PEER and cp != i
+                    and base <= cp < base + cnt):
+                continue
+            if cfg.timeline_enabled and any(
+                    r.meta == META_DESTROY for r in p.store):
+                continue
+            if (cfg.timeline_enabled
+                    and self._linear_at(i, meta, gt_new)
+                    and not self._auth_check(i, i, meta, gt_new)):
+                continue
+            p.sig_target = cp
+            p.sig_meta = meta
+            p.sig_payload = int(payload[i])
+            p.sig_gt = gt_new
+            p.sig_since = self.rnd
+            p.global_time = gt_new
 
     def seed_overlay(self, degree: int) -> None:
         """engine.seed_overlay mirror (per-community member blocks)."""
@@ -514,14 +605,23 @@ class OracleSim:
                     p.store = []
                     p.fwd = []
                     p.auth = []
+                    p.sig_target = NO_PEER
+                    p.sig_meta = p.sig_payload = p.sig_gt = p.sig_since = 0
                     p.global_time = 1
                     p.session += 1
+
+        # hard-kill state (engine mirror: derived from the post-churn store)
+        if cfg.timeline_enabled:
+            killed = [any(r.meta == META_DESTROY for r in p.store)
+                      for p in self.peers]
+        else:
+            killed = [False] * n
 
         # phase 1: walker send + sync claim
         targets = [NO_PEER] * n
         if cfg.walker_enabled:
             for i, p in enumerate(self.peers):
-                if p.alive and i >= t:
+                if p.alive and i >= t and not killed[i]:
                     targets[i] = self._sample_walk_target(i)
 
         slices, blooms = [None] * n, [None] * n
@@ -563,8 +663,11 @@ class OracleSim:
                         for j in order]
                 sent = 0
                 for fi, rec in enumerate(p.fwd):
+                    # killed peers push only destroy records (engine
+                    # send_rec_ok)
+                    rec_ok = not killed[i] or rec.meta == META_DESTROY
                     for ci, tc in enumerate(tgts):
-                        if p.alive and tc != NO_PEER:
+                        if p.alive and rec_ok and tc != NO_PEER:
                             p.bytes_up += RECORD_BYTES       # pre-loss
                             if not self._lost(i, _LOSS_FORWARD,
                                               fi * cc + ci):
@@ -747,6 +850,8 @@ class OracleSim:
             got = (got and not self._lost(i, _LOSS_RESPONSE, 0)
                    and self.peers[i].alive)
             got_resp[i] = got
+            if got:
+                self.peers[i].bytes_down += INTRO_RESPONSE_BYTES
             introduced[i] = pick if got else NO_PEER
             resp_gt[i] = self.peers[d].global_time if d >= 0 else 0
 
@@ -767,12 +872,93 @@ class OracleSim:
                 self.peers[i].walk_fail += 1
                 self._remove(i, targets[i])
 
+        # phase 3s: signature-request/-response exchange (engine phase 3s)
+        sig_completed: list = [None] * n
+        if cfg.double_meta_mask:
+            s_sz = cfg.sig_inbox
+            sig_inbox_: list[list[int]] = [[] for _ in range(n)]
+            sig_slot = [-1] * n
+            sending = [False] * n
+            for i, p in enumerate(self.peers):
+                sending[i] = (p.alive and not killed[i]
+                              and p.sig_target != NO_PEER
+                              and p.sig_since == rnd)
+                if sending[i]:
+                    p.bytes_up += SIGNATURE_REQUEST_BYTES
+                    if not self._lost(i, _LOSS_SIGREQ, 0):
+                        d = p.sig_target
+                        if len(sig_inbox_[d]) < s_sz:
+                            sig_slot[i] = len(sig_inbox_[d])
+                            sig_inbox_[d].append(i)
+                        else:
+                            self.peers[d].requests_dropped += 1
+            countersign: list[list[bool]] = [[] for _ in range(n)]
+            for d in range(n):
+                pd = self.peers[d]
+                # trackers and hard-killed peers never countersign
+                ok_d = pd.alive and d >= t and not killed[d]
+                n_sq = n_cs = 0
+                for s_ix, src in enumerate(sig_inbox_[d]):
+                    if ok_d:
+                        n_sq += 1
+                    if cfg.countersign_rate >= 1.0:
+                        agree = True
+                    elif cfg.countersign_rate <= 0.0:
+                        agree = False
+                    else:
+                        agree = rand_uniform(
+                            seed, rnd, d, P_SIGN, s_ix) < np.float32(
+                                cfg.countersign_rate)
+                    sp = self.peers[src]
+                    if (cfg.timeline_enabled
+                            and ((cfg.protected_meta_mask
+                                  | cfg.dynamic_meta_mask)
+                                 & cfg.double_meta_mask)):
+                        m = sp.sig_meta
+                        if (m < cfg.n_meta
+                                and self._linear_at(d, m, sp.sig_gt)):
+                            agree = (agree
+                                     and self._auth_check(d, src, m,
+                                                          sp.sig_gt)
+                                     and self._auth_check(d, d, m,
+                                                          sp.sig_gt))
+                    cs = ok_d and agree
+                    if cs:
+                        n_cs += 1
+                    countersign[d].append(cs)
+                pd.bytes_down += n_sq * SIGNATURE_REQUEST_BYTES
+                pd.bytes_up += n_cs * SIGNATURE_RESPONSE_BYTES
+                pd.sig_signed += n_cs
+            for i, p in enumerate(self.peers):
+                completed = False
+                if sending[i] and sig_slot[i] >= 0:
+                    if (countersign[p.sig_target][sig_slot[i]]
+                            and not self._lost(i, _LOSS_SIGRESP, 0)):
+                        completed = True
+                if completed:
+                    p.bytes_down += SIGNATURE_RESPONSE_BYTES
+                    p.sig_done += 1
+                    sig_completed[i] = Record(p.sig_gt, i, p.sig_meta,
+                                              p.sig_payload, p.sig_target)
+                expired = (p.alive and p.sig_target != NO_PEER
+                           and not completed
+                           and rnd - p.sig_since >= cfg.sig_timeout_rounds)
+                if expired:
+                    p.sig_expired += 1
+                if completed or expired:
+                    p.sig_target = NO_PEER
+                    p.sig_meta = p.sig_payload = 0
+                    p.sig_gt = p.sig_since = 0
+
         # phase 2b: sync responder outboxes (served in the ordered view)
         outbox: dict[tuple[int, int], list[Record]] = {}
         if cfg.sync_enabled:
             b = cfg.response_budget
             for d in range(n):
                 view = self._serve_order(self.peers[d].store)
+                if killed[d]:
+                    # HardKilledCommunity serves only the destroy record
+                    view = [r for r in view if r.meta == META_DESTROY]
                 for s_ix, src in enumerate(req_inbox[d]):
                     sel: list[Record] = []
                     if rq_ok[d][s_ix]:
@@ -780,9 +966,15 @@ class OracleSim:
                         for rec in view:
                             if len(sel) >= b:
                                 break
-                            if self._in_slice(rec, sl) and rec.hash() not in bl:
+                            # killed responder: destroy served without the
+                            # Bloom test (engine: present &= ~killed)
+                            if self._in_slice(rec, sl) and (
+                                    killed[d] or rec.hash() not in bl):
                                 sel.append(rec)
                     outbox[(d, s_ix)] = sel
+                    # served records leave the responder pre-loss (engine
+                    # counts obox_ok at the sender)
+                    self.peers[d].bytes_up += len(sel) * RECORD_BYTES
 
         # phase 5: combined intake (sync pull + push) -> store + fwd batch
         for i in range(n):
@@ -792,16 +984,27 @@ class OracleSim:
             batch: list[Record] = []
             if cfg.sync_enabled and p.alive and req_slot[i] >= 0:
                 recs = outbox.get((targets[i], req_slot[i]), [])
-                batch.extend(Record(r.gt, r.member, r.meta, r.payload, r.aux)
-                             for j, r in enumerate(recs)
-                             if not self._lost(i, _LOSS_SYNC, j))
+                for j, r in enumerate(recs):
+                    if not self._lost(i, _LOSS_SYNC, j):
+                        batch.append(Record(r.gt, r.member, r.meta,
+                                            r.payload, r.aux))
+                        p.bytes_down += RECORD_BYTES
             if p.alive:
                 batch.extend(Record(r.gt, r.member, r.meta, r.payload, r.aux)
                              for r in push_inbox[i])
-            # clock-jump defense (engine: post-walk-fold clock)
+            if sig_completed[i] is not None:
+                batch.append(sig_completed[i])
+            # clock-jump defense (engine: post-walk-fold clock), plus the
+            # structural countersigner check for double-signed metas
             ok_batch = [rec for rec in batch
                         if rec.gt <= (p.global_time
-                                      + cfg.acceptable_global_time_range)]
+                                      + cfg.acceptable_global_time_range)
+                        and self._dbl_struct_ok(i, rec)]
+            if cfg.timeline_enabled and killed[i]:
+                # engine: in_ok &= ~killed — a hard-killed peer processes
+                # no incoming messages (delivery bytes were already
+                # counted at recvfrom above, as in the engine)
+                ok_batch = []
             # freshness: not stored yet, not a dup of an earlier batch entry
             store_keys = {(r.gt, r.member) for r in p.store}
             fresh0: list[bool] = []
@@ -810,6 +1013,7 @@ class OracleSim:
                 k2 = (rec.gt, rec.member)
                 fresh0.append(k2 not in store_keys and k2 not in seen)
                 seen.add(k2)
+            batch_flips = []
             if cfg.timeline_enabled:
                 # Fold the whole batch's fresh authorize/revoke records
                 # before any check runs (engine: tl.fold precedes tl.check).
@@ -819,7 +1023,16 @@ class OracleSim:
                         self._auth_fold(i, rec.payload,
                                         rec.aux & ((1 << cfg.n_meta) - 1),
                                         rec.gt, rec.meta == META_REVOKE)
-            accept = [self._intake_accept(i, rec) for rec in ok_batch]
+                if cfg.dynamic_meta_mask:
+                    # this batch's fresh accepted dynamic-settings flips
+                    # (engine: flip_ok = fresh0 & is_flip & ctrl_ok)
+                    for rec, f0 in zip(ok_batch, fresh0):
+                        if (rec.meta == META_DYNAMIC and f0
+                                and rec.member == self._founder(i)):
+                            batch_flips.append((rec.gt, rec.payload,
+                                                rec.aux))
+            accept = [self._intake_accept(i, rec, batch_flips)
+                      for rec in ok_batch]
             p.msgs_rejected += sum(1 for a in accept if not a)
 
             if cfg.seq_meta_mask:
@@ -872,6 +1085,15 @@ class OracleSim:
                 for rec, a in zip(ok_batch, accept_store) if a]
             fresh = [rec for rec, a, f0 in zip(ok_batch, accept_store, fresh0)
                      if a and f0]
+            # Per-meta acceptance counters (engine: accepted_by_meta —
+            # fresh stored records plus direct receipts, disjoint sets).
+            for rec in fresh:
+                p.accepted_by_meta[min(rec.meta, cfg.n_meta)] += 1
+            if cfg.direct_meta_mask:
+                for rec, a in zip(ok_batch, accept):
+                    if (a and rec.meta < cfg.n_meta
+                            and (cfg.direct_meta_mask >> rec.meta) & 1):
+                        p.accepted_by_meta[min(rec.meta, cfg.n_meta)] += 1
             if ok_batch:
                 self._store_insert(i, ins_batch)
                 self._fold_gt(i, [rec.gt for rec, a in zip(ok_batch, accept)
@@ -885,7 +1107,19 @@ class OracleSim:
                             if (r.member == rec.payload and r.gt == rec.aux
                                     and r.meta < 32):
                                 r.flags |= FLAG_UNDONE
-            p.fwd = [rec.copy() for rec in fresh[:cfg.forward_buffer]]
+            fresh_ix = [(j, rec) for j, (rec, a, f0) in
+                        enumerate(zip(ok_batch, accept_store, fresh0))
+                        if a and f0]
+            if cfg.needs_priority_forward:
+                # engine: F slots to the highest-priority fresh records,
+                # ties by delivery order ((255-prio)*4096 + idx key)
+                def fkey(jr):
+                    j, rec = jr
+                    prio = priority_of(rec.meta, cfg.n_meta, cfg.priorities)
+                    return (255 - prio) * 4096 + j
+                fresh_ix.sort(key=fkey)
+            p.fwd = [rec.copy()
+                     for _, rec in fresh_ix[:cfg.forward_buffer]]
 
         self.now = _f32(self.now + np.float32(cfg.walk_interval))
         self.rnd += 1
@@ -922,6 +1156,25 @@ class OracleSim:
             "auth_member": np.full((n, a), EMPTY_U32, np.uint32),
             "auth_mask": np.zeros((n, a), np.uint32),
             "auth_gt": np.zeros((n, a), np.uint32),
+            "sig_target": np.array([p.sig_target for p in self.peers],
+                                   np.int32),
+            "sig_meta": np.array([p.sig_meta for p in self.peers], np.uint32),
+            "sig_payload": np.array([p.sig_payload for p in self.peers],
+                                    np.uint32),
+            "sig_gt": np.array([p.sig_gt for p in self.peers], np.uint32),
+            "sig_since": np.array([p.sig_since for p in self.peers],
+                                  np.uint32),
+            "sig_signed": np.array([p.sig_signed for p in self.peers],
+                                   np.uint32),
+            "sig_done": np.array([p.sig_done for p in self.peers], np.uint32),
+            "sig_expired": np.array([p.sig_expired for p in self.peers],
+                                    np.uint32),
+            "bytes_up": np.array([p.bytes_up & M32 for p in self.peers],
+                                 np.uint32),
+            "bytes_down": np.array([p.bytes_down & M32 for p in self.peers],
+                                   np.uint32),
+            "accepted_by_meta": np.array(
+                [p.accepted_by_meta for p in self.peers], np.uint32),
             "msgs_forwarded": np.array([p.msgs_forwarded for p in self.peers],
                                        np.uint32),
             "msgs_rejected": np.array([p.msgs_rejected for p in self.peers],

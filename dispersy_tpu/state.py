@@ -50,6 +50,11 @@ class Stats:
     #   pipeline (Timeline permission or sequence-order violations —
     #   reference: statistics.py drop counts from check_callback outcomes)
     msgs_direct: jnp.ndarray      # u32[N] DirectDistribution records received
+    # Double-signed flow counters (reference: statistics.py counts
+    # signature-request/-response traffic; SURVEY §3.5):
+    sig_signed: jnp.ndarray       # u32[N] countersignatures granted (B side)
+    sig_done: jnp.ndarray         # u32[N] double-signed records completed (A)
+    sig_expired: jnp.ndarray      # u32[N] signature requests timed out (A)
     # Byte-equivalent traffic totals (reference: endpoint.py total_up /
     # total_down).  Sent bytes count at the sender pre-loss (the reference
     # counts at sendto()); received bytes count per accepted inbox slot
@@ -101,6 +106,15 @@ class PeerState:
     auth_mask: jnp.ndarray       # u32[N, A] meta bitmask; bit 31 = revoke row
     auth_gt: jnp.ndarray         # u32[N, A] global_time the row takes effect
 
+    # ---- outstanding signature request (reference: requestcache.py — the
+    #      dispersy-signature-request cache entry; one in flight per peer,
+    #      sent once, freed on response or timeout) ----
+    sig_target: jnp.ndarray      # i32[N] counterparty, NO_PEER = no request
+    sig_meta: jnp.ndarray        # u32[N] draft meta id
+    sig_payload: jnp.ndarray     # u32[N] draft payload word
+    sig_gt: jnp.ndarray          # u32[N] global_time claimed at draft
+    sig_since: jnp.ndarray       # u32[N] round the request was created
+
     stats: Stats
     key: jnp.ndarray          # uint32[2] threefry key for this community
     time: jnp.ndarray         # f32 scalar, sim-seconds (round * walk_interval)
@@ -120,6 +134,7 @@ def init_stats(n: int, n_meta: int = 8) -> Stats:
     return Stats(walk_success=z(), walk_fail=z(), msgs_stored=z(),
                  msgs_dropped=z(), requests_dropped=z(), punctures=z(),
                  msgs_forwarded=z(), msgs_rejected=z(), msgs_direct=z(),
+                 sig_signed=z(), sig_done=z(), sig_expired=z(),
                  bytes_up=z(), bytes_down=z(),
                  accepted_by_meta=jnp.zeros((n, n_meta + 1), jnp.uint32))
 
@@ -160,6 +175,11 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         auth_member=jnp.full((n, a), EMPTY_U32, jnp.uint32),
         auth_mask=jnp.zeros((n, a), jnp.uint32),
         auth_gt=jnp.zeros((n, a), jnp.uint32),
+        sig_target=jnp.full((n,), NO_PEER, jnp.int32),
+        sig_meta=jnp.zeros((n,), jnp.uint32),
+        sig_payload=jnp.zeros((n,), jnp.uint32),
+        sig_gt=jnp.zeros((n,), jnp.uint32),
+        sig_since=jnp.zeros((n,), jnp.uint32),
         stats=init_stats(n, config.n_meta),
         key=jax.random.key_data(key) if key.dtype != jnp.uint32 else key,
         time=jnp.float32(0.0),

@@ -1,0 +1,144 @@
+"""Identity/crypto layer + wire-format golden packets.
+
+Reference test themes mirrored (reference: tests/test_crypto.py,
+test_member.py, and the DebugNode practice of asserting raw packet bytes):
+real sign/verify round-trips, mid = SHA1(pubkey), deterministic member
+resolution, packet encode/decode with signature verification, and golden
+bytes pinning the layout so it can never drift silently.
+"""
+
+import hashlib
+
+import jax
+import numpy as np
+import pytest
+
+from dispersy_tpu import engine as E
+from dispersy_tpu import state as S
+from dispersy_tpu.config import CommunityConfig
+from dispersy_tpu.conversion import (BODY_LEN, decode_record, encode_record,
+                                     encode_store)
+from dispersy_tpu.crypto import (ECCrypto, Member, MemberRegistry,
+                                 META_IDENTITY, NoCrypto, SECURITY_LEVELS,
+                                 create_identities, verify_identities)
+
+
+def test_sign_verify_roundtrip_all_levels():
+    crypto = ECCrypto()
+    for level in SECURITY_LEVELS:
+        key = crypto.generate_key(level, seed=b"k" + level.encode())
+        data = b"hello dispersy " + level.encode()
+        sig = crypto.create_signature(key, data)
+        assert len(sig) == crypto.signature_length(key)
+        assert crypto.is_valid_signature(key, data, sig)
+        assert not crypto.is_valid_signature(key, data + b"!", sig)
+        bad = bytes([sig[0] ^ 1]) + sig[1:]
+        assert not crypto.is_valid_signature(key, data, bad)
+
+
+def test_public_key_serialization_and_mid():
+    crypto = ECCrypto()
+    key = crypto.generate_key(u"low", seed=b"serialize-me")
+    pub = crypto.key_to_bin(key)
+    restored = crypto.key_from_public_bin(pub)
+    assert restored.public == key.public
+    assert restored.private is None
+    # mid = SHA1(serialized pubkey), the reference's rule
+    reg = MemberRegistry(seed=b"x", security=u"low", crypto=crypto)
+    m = reg.member(3)
+    assert m.mid == hashlib.sha1(m.public_key).digest()
+    assert len(m.mid) == 20
+    # a signature by the private key verifies under the deserialized public
+    sig = crypto.create_signature(key, b"data")
+    assert crypto.is_valid_signature(restored, b"data", sig)
+
+
+def test_registry_determinism_and_resolution():
+    a = MemberRegistry(seed=b"same", security=u"very-low")
+    b = MemberRegistry(seed=b"same", security=u"very-low")
+    assert a.member(7).mid == b.member(7).mid
+    assert a.member(7).mid != a.member(8).mid
+    found = a.by_mid(a.member(4).mid, n=10)
+    assert found is not None and found.index == 4
+    assert a.by_mid(b"\0" * 20, n=10) is None
+
+
+def test_golden_packet():
+    """Layout pin: these bytes must never change (wire compatibility)."""
+    crypto = ECCrypto()
+    reg = MemberRegistry(seed=b"golden", security=u"very-low", crypto=crypto)
+    m5 = reg.member(5)
+    assert m5.mid.hex() == "db20f1b98187e401c721c10a81e39c22d7c5ce97"
+    assert m5.mid32 == 0xDB20F1B9
+    cmid = hashlib.sha1(b"golden-community").digest()
+    pkt = encode_record(cmid, 1, 2, m5, global_time=77, payload=1234, aux=9,
+                        crypto=crypto)
+    assert len(pkt) == 335
+    assert pkt[:BODY_LEN].hex() == (
+        "0001c5cb7b930f6fd1225f0d7ae6442731a753b6f30802db20f1b98187e401c7"
+        "21c10a81e39c22d7c5ce97000000000000004d000004d200000009")
+    assert hashlib.sha256(pkt).hexdigest() == (
+        "e711a385c9d4b236029c316d32deb0246d9252dff540b37fddc3c9700f3e5f8c")
+
+
+def test_encode_decode_roundtrip():
+    crypto = ECCrypto()
+    reg = MemberRegistry(seed=b"rt", security=u"very-low", crypto=crypto)
+    cmid = hashlib.sha1(b"rt-community").digest()
+    pkt = encode_record(cmid, 3, 1, reg.member(2), 55, 0xDEAD, 7, crypto)
+    dec = decode_record(pkt, reg, crypto)
+    assert dec.valid_signature
+    assert dec.community_mid == cmid
+    assert dec.community_version == 3
+    assert dec.meta == 1
+    assert dec.author_mid == reg.member(2).mid
+    assert (dec.global_time, dec.payload, dec.aux) == (55, 0xDEAD, 7)
+    # Any body tamper invalidates the signature.
+    for i in (0, 25, 45, 52):
+        if i == 0:
+            continue  # version byte raises instead
+        bad = pkt[:i] + bytes([pkt[i] ^ 0xFF]) + pkt[i + 1:]
+        assert not decode_record(bad, reg, crypto).valid_signature
+    # Unknown author mid -> unverifiable.
+    stranger = pkt[:23] + b"\x11" * 20 + pkt[43:]
+    assert not decode_record(stranger, reg, crypto).valid_signature
+
+
+def test_nocrypto_mode():
+    crypto = NoCrypto()
+    reg = MemberRegistry(seed=b"nc", crypto=crypto)
+    cmid = hashlib.sha1(b"nc-community").digest()
+    pkt = encode_record(cmid, 1, 0, reg.member(1), 9, 1, 0, crypto)
+    assert len(pkt) == BODY_LEN          # empty signature
+    assert decode_record(pkt, reg, crypto).valid_signature
+
+
+@pytest.mark.slow
+def test_identity_sync_and_conformance():
+    """The dispersy-identity flow end-to-end: members publish identities,
+    the overlay syncs them, and every synced record's mid32 matches the
+    author's real key digest; then the whole store of one peer round-trips
+    through reference-shaped signed packets (tiny-N conformance,
+    SURVEY §7 stage 9)."""
+    cfg = CommunityConfig(
+        n_peers=24, n_trackers=2, msg_capacity=64, bloom_capacity=32,
+        k_candidates=8, request_inbox=4, tracker_inbox=8, response_budget=8,
+        identity_enabled=True)
+    reg = MemberRegistry(seed=b"conf", security=u"very-low")
+    state = S.init_state(cfg, jax.random.PRNGKey(0))
+    state = E.seed_overlay(state, cfg, degree=4)
+    state = create_identities(state, cfg, reg)
+    for _ in range(12):
+        state = E.step(state, cfg)
+    # identities spread: most peers hold most identity records
+    n_id = np.sum(np.asarray(state.store_meta) == META_IDENTITY, axis=1)
+    members = cfg.n_peers - cfg.n_trackers
+    assert np.median(n_id[cfg.n_trackers:]) >= members * 0.8
+    assert verify_identities(state, cfg, reg) == 1.0
+
+    crypto = reg.crypto
+    packets = encode_store(state, cfg, reg, crypto, peer=5)
+    assert len(packets) > 0
+    for pkt in packets:
+        dec = decode_record(pkt, reg, crypto)
+        assert dec.valid_signature

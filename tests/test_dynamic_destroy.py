@@ -1,0 +1,195 @@
+"""DynamicResolution flips and community destruction.
+
+Reference behaviors pinned here (reference: resolution.py
+DynamicResolution, community.py create_dynamic_settings /
+on_dynamic_settings, tests/test_dynamicsettings.py; community.py
+HardKilledCommunity + dispersy-destroy-community,
+tests/test_destroy_community.py):
+
+- a dynamic meta starts under its declared initial policy; a founder flip
+  to LinearResolution rejects unpermitted records with global_time after
+  the flip, while records older than the flip keep the old policy;
+- flipping back to PublicResolution re-opens the meta;
+- non-founder flips are dropped;
+- destroy: once a peer syncs the founder's destroy record it stops
+  walking, authoring, and accepting, serves only the destroy record, and
+  the kill spreads to the whole overlay;
+- all of it bit-for-bit against the CPU oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispersy_tpu import engine as E
+from dispersy_tpu import state as S
+from dispersy_tpu.config import (META_DESTROY, META_DYNAMIC,
+                                 CommunityConfig)
+from dispersy_tpu.oracle import sim as O
+
+from test_oracle import assert_match
+
+DYN = 1  # the dynamic user meta in these configs
+
+CFG = CommunityConfig(
+    n_peers=24, n_trackers=2, msg_capacity=32, bloom_capacity=16,
+    k_candidates=8, request_inbox=4, tracker_inbox=8, response_budget=4,
+    n_meta=8, timeline_enabled=True, dynamic_meta_mask=1 << DYN,
+    k_authorized=8)
+FOUNDER = CFG.founder
+
+
+def both(cfg, seed=0, warm=4):
+    key = jax.random.PRNGKey(seed)
+    state = S.init_state(cfg, key)
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    if warm:
+        state = E.seed_overlay(state, cfg, degree=warm)
+        oracle.seed_overlay(degree=warm)
+    return state, oracle
+
+
+def create(state, oracle, cfg, author, meta, payload, aux=0):
+    mask = np.arange(cfg.n_peers) == author
+    pl = np.full(cfg.n_peers, payload, np.uint32)
+    ax = np.full(cfg.n_peers, aux, np.uint32)
+    state = E.create_messages(state, cfg, jnp.asarray(mask), meta=meta,
+                              payload=jnp.asarray(pl), aux=jnp.asarray(ax))
+    oracle.create_messages(mask, meta=meta, payload=pl, aux=ax)
+    return state
+
+
+def run(state, oracle, cfg, rounds, tag=""):
+    for rnd in range(rounds):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, f"{tag}{rnd}")
+    return state
+
+
+def stored_count(state, meta):
+    return int(np.sum(np.asarray(state.store_meta) == meta))
+
+
+def test_flip_to_linear_closes_meta():
+    cfg = CFG
+    state, oracle = both(cfg)
+    # Open (initial policy public): anyone can publish.
+    state = create(state, oracle, cfg, author=7, meta=DYN, payload=1)
+    state = run(state, oracle, cfg, 6, "open-")
+    open_spread = stored_count(state, DYN)
+    assert open_spread > 5
+
+    # Founder flips DYN to linear; flip syncs to everyone.
+    state = create(state, oracle, cfg, author=FOUNDER, meta=META_DYNAMIC,
+                   payload=DYN, aux=1)
+    state = run(state, oracle, cfg, 6, "flip-")
+    assert stored_count(state, META_DYNAMIC) > 20
+
+    # A new record by an unpermitted author is now rejected everywhere —
+    # including at create (the author's own timeline refuses).
+    state = create(state, oracle, cfg, author=8, meta=DYN, payload=2)
+    state = run(state, oracle, cfg, 4, "closed-")
+    assert not np.any((np.asarray(state.store_meta) == DYN)
+                      & (np.asarray(state.store_payload) == 2))
+    # The OLD record (gt before the flip) still spreads: policy is
+    # evaluated at the record's own global_time.
+    assert stored_count(state, DYN) >= open_spread
+
+    # Flip back to public: the meta re-opens.
+    state = create(state, oracle, cfg, author=FOUNDER, meta=META_DYNAMIC,
+                   payload=DYN, aux=0)
+    state = run(state, oracle, cfg, 6, "reopen-")
+    state = create(state, oracle, cfg, author=8, meta=DYN, payload=3)
+    state = run(state, oracle, cfg, 6, "reopened-")
+    assert np.any((np.asarray(state.store_meta) == DYN)
+                  & (np.asarray(state.store_payload) == 3))
+
+
+def test_non_founder_flip_rejected():
+    cfg = CFG
+    state, oracle = both(cfg)
+    state = create(state, oracle, cfg, author=9, meta=META_DYNAMIC,
+                   payload=DYN, aux=1)
+    # Refused at create: nothing stored anywhere.
+    state = run(state, oracle, cfg, 3, "nf-")
+    assert stored_count(state, META_DYNAMIC) == 0
+
+
+def test_initial_linear_dynamic():
+    """DynamicResolution starting linear (protected bit set) behaves like
+    LinearResolution until flipped open."""
+    cfg = CFG.replace(protected_meta_mask=1 << DYN)
+    state, oracle = both(cfg)
+    state = create(state, oracle, cfg, author=7, meta=DYN, payload=1)
+    state = run(state, oracle, cfg, 3, "closed-")
+    assert stored_count(state, DYN) == 0
+    state = create(state, oracle, cfg, author=FOUNDER, meta=META_DYNAMIC,
+                   payload=DYN, aux=0)
+    state = run(state, oracle, cfg, 6, "spread-")
+    state = create(state, oracle, cfg, author=7, meta=DYN, payload=1)
+    state = run(state, oracle, cfg, 6, "open-")
+    assert stored_count(state, DYN) > 5
+
+
+def test_destroy_spreads_and_freezes():
+    cfg = CFG
+    state, oracle = both(cfg)
+    # Some traffic first.
+    state = create(state, oracle, cfg, author=7, meta=DYN, payload=1)
+    state = run(state, oracle, cfg, 4, "pre-")
+    state = create(state, oracle, cfg, author=FOUNDER, meta=META_DESTROY,
+                   payload=0)
+    state = run(state, oracle, cfg, 14, "kill-")
+    killed = np.any(np.asarray(state.store_meta) == META_DESTROY, axis=1)
+    n_members = cfg.n_peers - cfg.n_trackers
+    # The kill reached (nearly) the whole community.
+    assert killed[cfg.n_trackers:].sum() >= n_members - 1
+    # Killed peers have stopped walking: walk counters frozen.
+    ws = np.asarray(state.stats.walk_success) + np.asarray(
+        state.stats.walk_fail)
+    state2 = run(state, oracle, cfg, 2, "frozen-")
+    ws2 = np.asarray(state2.stats.walk_success) + np.asarray(
+        state2.stats.walk_fail)
+    frozen = killed[cfg.n_trackers:]
+    assert np.all((ws2 - ws)[cfg.n_trackers:][frozen] == 0)
+    # ...and refuse new records.
+    state2 = create(state2, oracle, cfg, author=7, meta=DYN, payload=9)
+    assert not np.any((np.asarray(state2.store_meta[7]) == DYN)
+                      & (np.asarray(state2.store_payload[7]) == 9))
+
+
+def test_non_founder_destroy_rejected():
+    cfg = CFG
+    state, oracle = both(cfg)
+    state = create(state, oracle, cfg, author=9, meta=META_DESTROY,
+                   payload=0)
+    state = run(state, oracle, cfg, 3, "nd-")
+    assert stored_count(state, META_DESTROY) == 0
+
+
+def test_rim_dynamic_community():
+    from dispersy_tpu.community import (Community, CommunityDestination,
+                                        DynamicResolution,
+                                        FullSyncDistribution,
+                                        LinearResolution,
+                                        MemberAuthentication, Message,
+                                        PublicResolution)
+
+    class FlippableCommunity(Community):
+        def initiate_meta_messages(self):
+            return [Message("post", MemberAuthentication(),
+                            DynamicResolution(PublicResolution(),
+                                              LinearResolution()),
+                            FullSyncDistribution(),
+                            CommunityDestination(node_count=3))]
+
+    comm = FlippableCommunity(n_peers=24, n_trackers=2, msg_capacity=32,
+                              bloom_capacity=16, k_candidates=8,
+                              request_inbox=4, tracker_inbox=8,
+                              response_budget=4)
+    assert comm.config.dynamic_meta_mask == 1
+    assert comm.config.timeline_enabled
+    assert not comm.config.protected_meta_mask & 1
+    assert comm.meta_id("dispersy-dynamic-settings") == META_DYNAMIC
+    assert comm.meta_id("dispersy-destroy-community") == META_DESTROY

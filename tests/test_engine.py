@@ -169,3 +169,20 @@ def test_forward_targets_prefer_verified_unsigned_topk():
             tab, now, cfg, jnp.uint32(7), jnp.uint32(rnd),
             jnp.arange(n, dtype=jnp.int32)))
         assert set(out.ravel().tolist()) <= {51, 52}, out
+
+
+def test_multi_step_equals_stepped():
+    """multi_step(k) is bit-identical to k successive step() calls."""
+    cfg = BASE.replace(packet_loss=0.1, churn_rate=0.05)
+    st_a = S.init_state(cfg, jax.random.PRNGKey(3))
+    st_a = E.seed_overlay(st_a, cfg, degree=4)
+    st_a = E.create_messages(st_a, cfg, jnp.arange(cfg.n_peers) == 5,
+                             meta=1, payload=jnp.full(cfg.n_peers, 42))
+    st_b = jax.tree.map(jnp.copy, st_a)
+    for _ in range(6):
+        st_a = E.step(st_a, cfg)
+    st_b = E.multi_step(st_b, cfg, 6)
+    la, _ = jax.tree_util.tree_flatten(jax.block_until_ready(st_a))
+    lb, _ = jax.tree_util.tree_flatten(jax.block_until_ready(st_b))
+    for a, b in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -27,10 +27,13 @@ FIELDS = ["alive", "session", "global_time",
           "store_gt", "store_member", "store_meta", "store_payload",
           "store_aux", "store_flags",
           "fwd_gt", "fwd_member", "fwd_meta", "fwd_payload", "fwd_aux",
-          "auth_member", "auth_mask", "auth_gt"]
+          "auth_member", "auth_mask", "auth_gt",
+          "sig_target", "sig_meta", "sig_payload", "sig_gt", "sig_since"]
 STAT_FIELDS = ["walk_success", "walk_fail", "msgs_stored", "msgs_dropped",
                "requests_dropped", "punctures", "msgs_forwarded",
-               "msgs_rejected", "msgs_direct"]
+               "msgs_rejected", "msgs_direct",
+               "sig_signed", "sig_done", "sig_expired",
+               "bytes_up", "bytes_down", "accepted_by_meta"]
 
 
 def assert_match(state, oracle, rnd):
@@ -98,3 +101,30 @@ def test_trace_churn_warm_overlay_modulo():
 @pytest.mark.slow
 def test_trace_long_convergence():
     run_both(BASE, rounds=40, author=3)
+
+
+def test_create_overflow_displaces_newest():
+    """An author's own creation always enters the forward buffer: when the
+    buffer is full the newest entry is displaced (a record that never
+    pushes could never spread once the Bloom slice saturates)."""
+    cfg = BASE
+    key = jax.random.PRNGKey(1)
+    state = S.init_state(cfg, key)
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=4)
+    oracle.seed_overlay(degree=4)
+    mask = np.arange(cfg.n_peers) == 5
+    for k in range(6):      # forward_buffer defaults to 4
+        payload = np.full(cfg.n_peers, 100 + k, np.uint32)
+        state = E.create_messages(state, cfg, jnp.asarray(mask), meta=1,
+                                  payload=jnp.asarray(payload))
+        oracle.create_messages(mask, meta=1, payload=payload)
+    assert_match(state, oracle, "create-overflow")
+    fwd = np.asarray(state.fwd_payload[5])
+    assert list(fwd) == [100, 101, 102, 105]
+    for rnd in range(2):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+    # the displaced-in record (payload 105) actually spread
+    assert np.sum(np.asarray(state.store_payload) == 105) > 1

@@ -1,0 +1,298 @@
+"""Double-signed messages: the dispersy-signature-request/-response flow.
+
+Reference behaviors pinned here (reference: community.py
+create_signature_request / on_signature_request / on_signature_response,
+authentication.py DoubleMemberAuthentication, tests/test_signature.py's
+DebugCommunity "double-signed-text" scenarios):
+
+- happy path: the author drafts, the counterparty countersigns in-round,
+  the completed record enters the author's store with the countersigner in
+  ``aux`` and then spreads epidemically like any sync record;
+- decline: an unanswered request (declining counterparty, lost packet,
+  dead counterparty) expires after the cache timeout, never stores;
+- structural: self-signing, tracker counterparties, and one-in-flight are
+  refused at create; synced copies with a bogus countersigner are dropped;
+- permissions: a protected double-signed meta needs the permit for BOTH
+  signers;
+- trace equality: the whole flow replays bit-for-bit in the CPU oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispersy_tpu import engine as E
+from dispersy_tpu import state as S
+from dispersy_tpu.config import CommunityConfig
+from dispersy_tpu.oracle import sim as O
+
+from test_oracle import assert_match
+
+DBL = 2  # the double-signed user meta in these configs (bit 2)
+
+CFG = CommunityConfig(
+    n_peers=24, n_trackers=2, msg_capacity=32, bloom_capacity=16,
+    k_candidates=8, request_inbox=4, tracker_inbox=8, response_budget=4,
+    n_meta=8, double_meta_mask=1 << DBL)
+
+
+def both(cfg, seed=0, warm=4):
+    key = jax.random.PRNGKey(seed)
+    state = S.init_state(cfg, key)
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    if warm:
+        state = E.seed_overlay(state, cfg, degree=warm)
+        oracle.seed_overlay(degree=warm)
+    return state, oracle
+
+
+def open_sig(state, oracle, cfg, author, counterparty, payload=77):
+    mask = np.arange(cfg.n_peers) == author
+    cp = np.full(cfg.n_peers, counterparty, np.int32)
+    pl = np.full(cfg.n_peers, payload, np.uint32)
+    state = E.create_signature_request(state, cfg, jnp.asarray(mask), DBL,
+                                       jnp.asarray(cp), jnp.asarray(pl))
+    oracle.create_signature_request(mask, DBL, cp, pl)
+    return state
+
+
+def test_happy_path_and_spread():
+    cfg = CFG
+    state, oracle = both(cfg)
+    state = open_sig(state, oracle, cfg, author=5, counterparty=9)
+    assert_match(state, oracle, "draft")
+    # The draft is cached, not stored.
+    assert int(state.sig_target[5]) == 9
+    assert not np.any(np.asarray(state.store_meta[5]) == DBL)
+    for rnd in range(10):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+    # Completed in round 0: stored at the author with the countersigner in
+    # aux, cache cleared, counters ticked.
+    row = np.asarray(state.store_meta[5]) == DBL
+    assert row.any()
+    assert np.asarray(state.store_aux[5])[row][0] == 9
+    assert int(state.sig_target[5]) == O.NO_PEER
+    assert int(state.stats.sig_done[5]) == 1
+    assert int(state.stats.sig_signed[9]) == 1
+    assert int(state.stats.sig_expired[5]) == 0
+    # ...and it spread to other peers via sync.
+    cov = float(E.coverage(state, member=5, gt=int(state.store_gt[5][row][0]),
+                           meta=DBL, payload=77))
+    assert cov > 0.3
+
+
+def test_decline_expires():
+    cfg = CFG.replace(countersign_rate=0.0)
+    state, oracle = both(cfg)
+    state = open_sig(state, oracle, cfg, author=5, counterparty=9)
+    for rnd in range(cfg.sig_timeout_rounds + 1):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+    assert int(state.stats.sig_done[5]) == 0
+    assert int(state.stats.sig_expired[5]) == 1
+    assert int(state.sig_target[5]) == O.NO_PEER
+    assert not np.any(np.asarray(state.store_meta[5]) == DBL)
+
+
+def test_create_guards():
+    cfg = CFG
+    state, oracle = both(cfg)
+    # Self, tracker, and out-of-range counterparties are refused.
+    for bad in (5, 0, cfg.n_peers + 3):
+        state = open_sig(state, oracle, cfg, author=5, counterparty=bad)
+        assert int(state.sig_target[5]) == O.NO_PEER
+    # One in flight: the second draft is refused, not queued.
+    state = open_sig(state, oracle, cfg, author=5, counterparty=9)
+    gt0 = int(state.sig_gt[5])
+    state = open_sig(state, oracle, cfg, author=5, counterparty=10)
+    assert int(state.sig_target[5]) == 9
+    assert int(state.sig_gt[5]) == gt0
+    assert_match(state, oracle, "guards")
+
+
+def test_lossy_flow_trace_equality():
+    cfg = CFG.replace(packet_loss=0.3, countersign_rate=0.7)
+    state, oracle = both(cfg)
+    rng = np.random.default_rng(3)
+    for rnd in range(12):
+        if rnd % 2 == 0:
+            a = int(rng.integers(cfg.n_trackers, cfg.n_peers))
+            b = int(rng.integers(cfg.n_trackers, cfg.n_peers))
+            state = open_sig(state, oracle, cfg, author=a, counterparty=b,
+                             payload=rnd)
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+
+
+def test_protected_double_needs_both_permits():
+    cfg = CFG.replace(timeline_enabled=True,
+                      protected_meta_mask=1 << DBL, k_authorized=8)
+    founder = cfg.founder
+    state, oracle = both(cfg)
+
+    def authorize(state, member):
+        mask = np.arange(cfg.n_peers) == founder
+        pl = np.full(cfg.n_peers, member, np.uint32)
+        ax = np.full(cfg.n_peers, 1 << DBL, np.uint32)
+        state = E.create_messages(state, cfg, jnp.asarray(mask),
+                                  meta=O.META_AUTHORIZE,
+                                  payload=jnp.asarray(pl),
+                                  aux=jnp.asarray(ax))
+        oracle.create_messages(mask, meta=O.META_AUTHORIZE, payload=pl,
+                               aux=ax)
+        return state
+
+    # Author 5 has no permit: the draft is refused at create.
+    state = open_sig(state, oracle, cfg, author=5, counterparty=9)
+    assert int(state.sig_target[5]) == O.NO_PEER
+
+    # Grant the author only; counterparty 9 has no permit, and 9's OWN
+    # timeline must know the grants to countersign — so spread the grant
+    # first, then check the countersigner-side refusal.
+    state = authorize(state, 5)
+    for rnd in range(6):
+        state = E.step(state, cfg)
+        oracle.step()
+    assert_match(jax.block_until_ready(state), oracle, "grant-spread")
+    state = open_sig(state, oracle, cfg, author=5, counterparty=9)
+    assert int(state.sig_target[5]) == 9
+    for rnd in range(cfg.sig_timeout_rounds + 1):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+    # 9 declined (its timeline rejects a protected record it cannot sign).
+    assert int(state.stats.sig_done[5]) == 0
+    assert int(state.stats.sig_expired[5]) == 1
+
+    # Grant the counterparty too and retry: completes.
+    state = authorize(state, 9)
+    for rnd in range(6):
+        state = E.step(state, cfg)
+        oracle.step()
+    state = open_sig(state, oracle, cfg, author=5, counterparty=9)
+    for rnd in range(3):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, f"retry-{rnd}")
+    assert int(state.stats.sig_done[5]) == 1
+
+
+def test_bogus_countersigner_rejected_at_intake():
+    """A double-signed record whose aux names a tracker/self is dropped in
+    the receive pipeline (the structural signature-verify analogue)."""
+    cfg = CFG
+    state, oracle = both(cfg)
+    # Hand-craft bad records into one peer's forward buffer, as a DebugNode
+    # would inject raw packets (reference: debugcommunity/node.py).
+    bad_aux = 5          # == member: "self-countersigned"
+    fwd_gt = np.asarray(state.fwd_gt).copy()
+    fwd_member = np.asarray(state.fwd_member).copy()
+    fwd_meta = np.asarray(state.fwd_meta).copy()
+    fwd_payload = np.asarray(state.fwd_payload).copy()
+    fwd_aux = np.asarray(state.fwd_aux).copy()
+    fwd_gt[5, 0] = 7
+    fwd_member[5, 0] = 5
+    fwd_meta[5, 0] = DBL
+    fwd_payload[5, 0] = 1
+    fwd_aux[5, 0] = bad_aux
+    state = state.replace(fwd_gt=jnp.asarray(fwd_gt),
+                          fwd_member=jnp.asarray(fwd_member),
+                          fwd_meta=jnp.asarray(fwd_meta),
+                          fwd_payload=jnp.asarray(fwd_payload),
+                          fwd_aux=jnp.asarray(fwd_aux))
+    p5 = oracle.peers[5]
+    p5.fwd = [O.Record(7, 5, DBL, 1, bad_aux)]
+    for rnd in range(2):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+    # Nobody stored the forged record.
+    assert not np.any((np.asarray(state.store_meta) == DBL)
+                      & (np.asarray(state.store_member) == 5))
+
+
+@pytest.mark.slow
+def test_rim_double_signed_community():
+    from dispersy_tpu.community import (Community, CommunityDestination,
+                                        DoubleMemberAuthentication,
+                                        FullSyncDistribution, Message,
+                                        PublicResolution)
+
+    class AgreementCommunity(Community):
+        def initiate_meta_messages(self):
+            return [Message("agreement", DoubleMemberAuthentication(),
+                            PublicResolution(), FullSyncDistribution(),
+                            CommunityDestination(node_count=3))]
+
+    comm = AgreementCommunity(n_peers=32, n_trackers=2, msg_capacity=32,
+                              bloom_capacity=16, k_candidates=8,
+                              request_inbox=4, tracker_inbox=8,
+                              response_budget=4)
+    assert comm.config.double_meta_mask == 1
+    state = comm.initialize(seed_degree=4)
+    mask = np.arange(32) == 7
+    state = comm.create_signature_request(
+        state, "agreement", jnp.asarray(mask),
+        np.full(32, 12, np.int32), np.full(32, 1, np.uint32))
+    for _ in range(8):
+        state = comm.step(state)
+    assert int(state.stats.sig_done[7]) == 1
+    row = np.asarray(state.store_meta[7]) == 0
+    assert row.any()
+
+
+def test_dynamic_double_signed_respects_flips():
+    """A DynamicResolution + DoubleMemberAuthentication meta: after the
+    founder flips it to linear, an unpermitted author's signature request
+    is refused at create (review finding: the gate must replay flips, not
+    just the static bit)."""
+    cfg = CFG.replace(timeline_enabled=True, dynamic_meta_mask=1 << DBL,
+                      k_authorized=8)
+    founder = cfg.founder
+    state, oracle = both(cfg)
+    # Open initially: the draft is accepted and completes.
+    state = open_sig(state, oracle, cfg, author=5, counterparty=9)
+    assert int(state.sig_target[5]) == 9
+    for rnd in range(3):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, f"open-{rnd}")
+    assert int(state.stats.sig_done[5]) == 1
+
+    # Founder flips DBL to linear and the flip spreads.
+    mask = np.arange(cfg.n_peers) == founder
+    pl = np.full(cfg.n_peers, DBL, np.uint32)
+    ax = np.ones(cfg.n_peers, np.uint32)
+    state = E.create_messages(state, cfg, jnp.asarray(mask),
+                              meta=O.META_DYNAMIC, payload=jnp.asarray(pl),
+                              aux=jnp.asarray(ax))
+    oracle.create_messages(mask, meta=O.META_DYNAMIC, payload=pl, aux=ax)
+    for rnd in range(6):
+        state = E.step(state, cfg)
+        oracle.step()
+    assert_match(jax.block_until_ready(state), oracle, "flip-spread")
+
+    # Now the same author is refused at create — no signature burnt.
+    state = open_sig(state, oracle, cfg, author=5, counterparty=9,
+                     payload=88)
+    assert int(state.sig_target[5]) == O.NO_PEER
+    for rnd in range(3):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, f"closed-{rnd}")
+    assert int(state.stats.sig_done[5]) == 1  # unchanged
+
+
+def test_control_meta_requires_timeline():
+    cfg = CFG  # timeline_enabled=False
+    state, _ = both(cfg, warm=0)
+    with pytest.raises(ValueError, match="timeline_enabled"):
+        E.create_messages(state, cfg,
+                          jnp.asarray(np.arange(cfg.n_peers) == 2),
+                          meta=O.META_DESTROY,
+                          payload=jnp.zeros(cfg.n_peers, jnp.uint32))

@@ -25,7 +25,18 @@ See ``SURVEY.md`` for the reference's layer map and the provenance caveat
 symbol-level).
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 from dispersy_tpu.config import CommunityConfig  # noqa: F401
 from dispersy_tpu.community import Community  # noqa: F401
+
+__all__ = ["CommunityConfig", "Community"]
+# Deeper layers by module (imported on demand, not re-exported):
+#   dispersy_tpu.engine      step / multi_step / create_* / coverage
+#   dispersy_tpu.state       PeerState / init_state
+#   dispersy_tpu.crypto      ECCrypto / Member / MemberRegistry / identities
+#   dispersy_tpu.conversion  packet encode/decode (conformance)
+#   dispersy_tpu.checkpoint  save / restore
+#   dispersy_tpu.metrics     snapshot / MetricsLog
+#   dispersy_tpu.scenario    Scenario / run + event types
+#   dispersy_tpu.parallel    make_mesh / shard_state

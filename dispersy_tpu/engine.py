@@ -195,7 +195,13 @@ def _flip_best(stc: "st.StoreCols", q_meta: jnp.ndarray,
     the stored dispersy-dynamic-settings flips at or below the query gt —
     the DynamicResolution replay (0 = no flip applies).  One definition
     serves the author gate, the countersigner check, and the intake check;
-    the oracle mirrors it in ``_linear_at``."""
+    the oracle mirrors it in ``_linear_at``.
+
+    The [N, Q, M] broadcast never materializes: XLA fuses the
+    mask-compare into the reduce, the same pattern (and premise) as the
+    Bloom kernels and the intake's in_store check — all of which run at
+    1M peers in the measured bench without allocating the product shape
+    (ops/bloom.py module docstring; BENCH.md)."""
     m = ((stc.meta[:, None, :] == jnp.uint32(META_DYNAMIC))
          & (stc.payload[:, None, :] == q_meta[:, :, None])
          & (stc.gt[:, None, :] <= q_gt[:, :, None]))

@@ -1,0 +1,131 @@
+"""Observability: aggregate metrics snapshots and a round log.
+
+The reference exposes a pull-model statistics snapshot consumed by
+Tribler's debug panel (reference: statistics.py ``DispersyStatistics`` /
+``CommunityStatistics`` — walk success/failure, per-message-type counts,
+drop/delay/success counts, endpoint byte totals) and decodes experiment
+logs offline (reference: tool/ldecoder.py).  The rebuild's equivalents:
+
+- :func:`snapshot` — one aggregate dict over the whole overlay (per-peer
+  counters reduced on device, a handful of scalars cross to host);
+- :class:`MetricsLog` — append per-round snapshots, dump JSON/JSONL — the
+  in-repo replacement for the binary experiment logs;
+- standard :mod:`logging` integration via the module logger
+  ``dispersy_tpu.metrics`` (the reference configures per-module loggers
+  the same way — logger.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from dispersy_tpu.config import (EMPTY_U32, META_DESTROY, NO_PEER,
+                                 CommunityConfig)
+from dispersy_tpu.state import PeerState
+
+logger = logging.getLogger(__name__)
+
+
+def snapshot(state: PeerState, cfg: CommunityConfig) -> dict:
+    """Aggregate overlay metrics (DispersyStatistics snapshot analogue).
+
+    Everything reduces on device first; only scalars cross to host.
+    Counters are cumulative (as the reference's are); rates are this
+    snapshot's view of them.
+    """
+    s = state.stats
+    members = state.alive & ~state.is_tracker
+    n_members = jnp.maximum(jnp.sum(members), 1)
+
+    def total(counter) -> int:
+        # Host-side uint64 reduction: on-device sums stay uint32 without
+        # jax_enable_x64 and would wrap (1M peers exceed 2^32 aggregate
+        # bytes within one round).  Counters are [N]-shaped, so one host
+        # transfer per field is cheap next to the step itself.
+        return int(np.asarray(counter, dtype=np.uint64).sum())
+
+    walk_success = total(s.walk_success)
+    walk_fail = total(s.walk_fail)
+    out = {
+        "round": int(state.round_index),
+        "sim_time": float(state.time),
+        "alive_members": int(jnp.sum(members)),
+        "killed": int(jnp.sum(jnp.any(
+            state.store_meta == jnp.uint32(META_DESTROY), axis=1))),
+        # walker (statistics.py walk_success / walk_failure)
+        "walk_success": walk_success,
+        "walk_fail": walk_fail,
+        "walk_success_rate": walk_success / max(walk_success + walk_fail, 1),
+        # store pipeline (drop/delay/success counts)
+        "msgs_stored": total(s.msgs_stored),
+        "msgs_dropped": total(s.msgs_dropped),
+        "msgs_rejected": total(s.msgs_rejected),
+        "msgs_forwarded": total(s.msgs_forwarded),
+        "msgs_direct": total(s.msgs_direct),
+        "requests_dropped": total(s.requests_dropped),
+        "punctures": total(s.punctures),
+        # double-signed flow
+        "sig_signed": total(s.sig_signed),
+        "sig_done": total(s.sig_done),
+        "sig_expired": total(s.sig_expired),
+        # endpoint byte totals (endpoint.py total_up / total_down).
+        # NOTE: the per-peer device counters themselves wrap mod 2^32 by
+        # design (state.py); the host reduction is exact over them.
+        "bytes_up": total(s.bytes_up),
+        "bytes_down": total(s.bytes_down),
+        # occupancy (how full the bounded structures run)
+        "store_fill": float(jnp.mean(
+            jnp.sum(state.store_gt != jnp.uint32(EMPTY_U32), axis=1)
+            / cfg.msg_capacity)),
+        "candidate_fill": float(jnp.mean(jnp.where(
+            members,
+            jnp.sum(state.cand_peer != NO_PEER, axis=1) / cfg.k_candidates,
+            0)) * (cfg.n_peers / float(n_members))),
+        # per-meta acceptance (statistics.py per-message-name counts);
+        # bucket n_meta = the dispersy-* control band
+        "accepted_by_meta": [
+            int(x) for x in
+            np.asarray(s.accepted_by_meta, dtype=np.uint64).sum(axis=0)],
+    }
+    return out
+
+
+class MetricsLog:
+    """Per-round metrics accumulator (tool/ldecoder.py's role, JSON-native).
+
+    ``append`` records a snapshot (plus arbitrary extra fields, e.g. a
+    coverage value); ``dump`` writes the whole run as one JSON artifact;
+    ``dump_jsonl`` streams one line per round.
+    """
+
+    def __init__(self, meta: dict | None = None):
+        self.meta = meta or {}
+        self.rows: list[dict] = []
+
+    def append(self, state: PeerState, cfg: CommunityConfig,
+               **extra) -> dict:
+        row = snapshot(state, cfg)
+        row.update(extra)
+        self.rows.append(row)
+        logger.debug("round %d: %s", row["round"], row)
+        return row
+
+    def dump(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"meta": self.meta, "rounds": self.rows}, f, indent=1)
+
+    def dump_jsonl(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            for row in self.rows:
+                f.write(json.dumps(row) + "\n")
+
+    def series(self, key: str) -> list:
+        """One metric across rounds (curve extraction)."""
+        return [row.get(key) for row in self.rows]

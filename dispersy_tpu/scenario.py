@@ -1,0 +1,228 @@
+"""Scenario driver: scripted experiment timelines over the overlay.
+
+The reference runs cluster experiments from per-peer scenario scripts —
+timelines of "at T, do X" lines parsed by ``ScenarioScript`` subclasses
+(reference: tool/scenarioscript.py: scenario_start / scenario_churn /
+scenario-defined app events, with results decoded offline by
+tool/ldecoder.py).  The TPU recast schedules *vectorized* events at round
+boundaries — each event acts on a peer mask instead of one process — and
+logs per-round aggregate metrics (:mod:`dispersy_tpu.metrics`) plus the
+coverage of tracked records, which is exactly what the reference's
+experiment pipeline extracted from its logs.
+
+Events that change the fault model (churn/loss) swap the static config,
+which recompiles the step — a few compiles per scenario, amortized over
+the rounds between events (the reference pays process restarts at the
+same points).
+
+Use the library directly::
+
+    sc = Scenario(rounds=40, events=[
+        (0,  Create(meta=1, authors=[5], payload=42, track="post")),
+        (10, SetFault(churn_rate=0.05)),
+        (20, Authorize(members=[5], metas=0b10)),
+        (30, Destroy()),
+    ])
+    state, log = run(cfg, sc)
+
+or from JSON via ``tools/scenario.py`` (the CLI form of scenarioscript).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispersy_tpu import checkpoint as ckpt
+from dispersy_tpu import engine
+from dispersy_tpu.config import (META_AUTHORIZE, META_DESTROY, META_DYNAMIC,
+                                 META_REVOKE, META_UNDO_OTHER, META_UNDO_OWN,
+                                 CommunityConfig)
+from dispersy_tpu.metrics import MetricsLog
+from dispersy_tpu.state import PeerState, init_state
+
+
+def _mask(cfg: CommunityConfig, peers) -> jnp.ndarray:
+    """int | sequence of ints | bool array -> bool[N]."""
+    if isinstance(peers, (int, np.integer)):
+        return jnp.arange(cfg.n_peers) == int(peers)
+    arr = np.asarray(peers)
+    if arr.dtype == bool:
+        return jnp.asarray(arr)
+    m = np.zeros(cfg.n_peers, bool)
+    m[arr.astype(np.int64)] = True
+    return jnp.asarray(m)
+
+
+def _full(cfg: CommunityConfig, value) -> jnp.ndarray:
+    return jnp.full(cfg.n_peers, value, jnp.uint32)
+
+
+@dataclasses.dataclass
+class Create:
+    """App-level publish (scenarioscript's per-peer publish events)."""
+    meta: int
+    authors: object
+    payload: int = 0
+    aux: int = 0
+    track: str | None = None  # label: per-round coverage of this record
+
+
+@dataclasses.dataclass
+class SignatureRequest:
+    """Open double-signed drafts author -> counterparty."""
+    meta: int
+    authors: object
+    counterparty: int
+    payload: int = 0
+
+
+@dataclasses.dataclass
+class Authorize:
+    """Founder grants `metas` (bitmask) to `members`."""
+    members: Sequence[int]
+    metas: int
+
+
+@dataclasses.dataclass
+class Revoke:
+    members: Sequence[int]
+    metas: int
+
+
+@dataclasses.dataclass
+class Undo:
+    """Mark (member, gt) undone; own=True means the author undoes itself,
+    else the founder undoes it."""
+    member: int
+    gt: int
+    own: bool = True
+
+
+@dataclasses.dataclass
+class DynamicSettings:
+    """Founder flips user meta `meta` to Linear (linear=True) or Public."""
+    meta: int
+    linear: bool
+
+
+@dataclasses.dataclass
+class Destroy:
+    """Founder hard-kills the community."""
+
+
+@dataclasses.dataclass
+class SetFault:
+    """Swap the fault model mid-run (config change -> recompile)."""
+    churn_rate: float | None = None
+    packet_loss: float | None = None
+
+
+@dataclasses.dataclass
+class Checkpoint:
+    path: str
+
+
+@dataclasses.dataclass
+class Scenario:
+    rounds: int
+    events: Sequence[tuple]          # (round, event) pairs
+    seed_degree: int | None = 8
+    snapshot_every: int = 1
+
+
+def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict):
+    founder = cfg.founder
+    if isinstance(ev, Create):
+        m = _mask(cfg, ev.authors)
+        authors = np.flatnonzero(np.asarray(m))
+        if ev.track is not None and len(authors) == 0:
+            raise ValueError(
+                f"Create(track={ev.track!r}) has an empty author set — "
+                "nothing to track")
+        gt_before = (int(state.global_time[authors[0]])
+                     if len(authors) else 0)
+        state = engine.create_messages(state, cfg, m, ev.meta,
+                                       _full(cfg, ev.payload),
+                                       _full(cfg, ev.aux))
+        if ev.track is not None:
+            author = int(authors[0])
+            gt_after = int(state.global_time[author])
+            if gt_after == gt_before:
+                # The timeline gate refused the creation (e.g. protected
+                # meta scheduled before its authorize): a silent garbage
+                # coverage curve would be worse than failing the scenario.
+                raise ValueError(
+                    f"Create(track={ev.track!r}): author {author}'s "
+                    f"creation of meta {ev.meta} was refused by the "
+                    "timeline gate — reorder the scenario's events")
+            tracked[ev.track] = (author, gt_after, ev.meta, ev.payload)
+    elif isinstance(ev, SignatureRequest):
+        state = engine.create_signature_request(
+            state, cfg, _mask(cfg, ev.authors), ev.meta,
+            jnp.full(cfg.n_peers, ev.counterparty, jnp.int32),
+            _full(cfg, ev.payload))
+    elif isinstance(ev, (Authorize, Revoke)):
+        meta = META_AUTHORIZE if isinstance(ev, Authorize) else META_REVOKE
+        for member in ev.members:   # one record per target member
+            state = engine.create_messages(
+                state, cfg, _mask(cfg, founder), meta,
+                _full(cfg, member), _full(cfg, ev.metas))
+    elif isinstance(ev, Undo):
+        meta = META_UNDO_OWN if ev.own else META_UNDO_OTHER
+        author = ev.member if ev.own else founder
+        state = engine.create_messages(
+            state, cfg, _mask(cfg, author), meta,
+            _full(cfg, ev.member), _full(cfg, ev.gt))
+    elif isinstance(ev, DynamicSettings):
+        state = engine.create_messages(
+            state, cfg, _mask(cfg, founder), META_DYNAMIC,
+            _full(cfg, ev.meta), _full(cfg, int(ev.linear)))
+    elif isinstance(ev, Destroy):
+        state = engine.create_messages(
+            state, cfg, _mask(cfg, founder), META_DESTROY,
+            _full(cfg, 0))
+    elif isinstance(ev, SetFault):
+        kw = {}
+        if ev.churn_rate is not None:
+            kw["churn_rate"] = ev.churn_rate
+        if ev.packet_loss is not None:
+            kw["packet_loss"] = ev.packet_loss
+        cfg = cfg.replace(**kw)
+    elif isinstance(ev, Checkpoint):
+        ckpt.save(ev.path, state, cfg)
+    else:
+        raise TypeError(f"unknown scenario event {ev!r}")
+    return state, cfg
+
+
+def run(cfg: CommunityConfig, scenario: Scenario, key=None,
+        log: MetricsLog | None = None) -> tuple[PeerState, MetricsLog]:
+    """Execute the scenario; returns the final state and the metrics log.
+
+    Every logged row carries ``cov_<label>`` for each tracked record —
+    the convergence curves the reference's experiment pipeline mined from
+    its logs.
+    """
+    state = init_state(cfg, key if key is not None else jax.random.PRNGKey(0))
+    if scenario.seed_degree:
+        state = engine.seed_overlay(state, cfg, scenario.seed_degree)
+    log = log or MetricsLog(meta={"scenario_rounds": scenario.rounds})
+    by_round: dict[int, list] = {}
+    for rnd, ev in scenario.events:
+        by_round.setdefault(int(rnd), []).append(ev)
+    tracked: dict[str, tuple] = {}
+
+    for rnd in range(scenario.rounds):
+        for ev in by_round.get(rnd, ()):
+            state, cfg = _apply(state, cfg, ev, tracked)
+        state = engine.step(state, cfg)
+        if rnd % scenario.snapshot_every == 0:
+            covs = {f"cov_{label}": float(engine.coverage(state, *spec))
+                    for label, spec in tracked.items()}
+            log.append(state, cfg, **covs)
+    return jax.block_until_ready(state), log

@@ -186,3 +186,33 @@ def test_multi_step_equals_stepped():
     lb, _ = jax.tree_util.tree_flatten(jax.block_until_ready(st_b))
     for a, b in zip(la, lb):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_forward_targets_verified_beat_unverified():
+    """ADVICE r1: sample_forward_targets packs the verified flag into bit
+    31 of a uint32 score and relies on lax.top_k honoring unsigned order —
+    pin that verified candidates ALWAYS win over unverified ones."""
+    from dispersy_tpu.ops import candidates as cand
+    cfg = BASE.replace(k_candidates=8, forward_fanout=3)
+    n, k = 4, 8
+    # Slots 0-2 verified (recent stumble), slots 3-7 introduced-only
+    # (unverified); try several rounds so slot priorities shuffle.
+    peer = jnp.tile(jnp.arange(10, 10 + k)[None, :], (n, 1)).astype(jnp.int32)
+    now = jnp.float32(100.0)
+    tab = cand.CandTable(
+        peer=peer,
+        last_walk=jnp.full((n, k), S.NEVER, jnp.float32),
+        last_stumble=jnp.where(jnp.arange(k)[None, :] < 3,
+                               now, jnp.float32(S.NEVER)),
+        last_intro=jnp.where(jnp.arange(k)[None, :] >= 3,
+                             now, jnp.float32(S.NEVER)))
+    for rnd in range(16):
+        tgts = cand.sample_forward_targets(
+            tab, now, cfg, jnp.uint32(123), jnp.uint32(rnd),
+            jnp.arange(n, dtype=jnp.int32))
+        got = np.asarray(tgts)
+        assert got.shape == (n, 3)
+        # all three picks are verified slots (peers 10, 11, 12), never an
+        # unverified one, never NO_PEER
+        assert np.all((got >= 10) & (got <= 12)), (rnd, got)
+        assert all(len(set(row)) == 3 for row in got)  # distinct

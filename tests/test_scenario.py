@@ -1,0 +1,138 @@
+"""Scenario driver + metrics log (the scenarioscript/ldecoder analogues).
+
+Reference themes (reference: tool/scenarioscript.py timelines,
+tool/ldecoder.py offline curve extraction, statistics.py snapshots): a
+scripted run mixes publishing, fault-model changes, permissions, and
+destruction, and the metrics log yields the convergence curves.
+"""
+
+import json
+
+import numpy as np
+
+from dispersy_tpu import scenario as S
+from dispersy_tpu.config import CommunityConfig
+from dispersy_tpu.metrics import MetricsLog, snapshot
+
+CFG = CommunityConfig(
+    n_peers=48, n_trackers=2, msg_capacity=32, bloom_capacity=16,
+    k_candidates=8, request_inbox=4, tracker_inbox=16, response_budget=4,
+    n_meta=8, timeline_enabled=True, protected_meta_mask=0b10,
+    k_authorized=8)
+
+
+def test_snapshot_shape():
+    import jax
+    from dispersy_tpu.state import init_state
+    st = init_state(CFG, jax.random.PRNGKey(0))
+    snap = snapshot(st, CFG)
+    assert snap["round"] == 0
+    assert snap["alive_members"] == 46
+    assert snap["killed"] == 0
+    assert len(snap["accepted_by_meta"]) == CFG.n_meta + 1
+    assert snap["walk_success"] == 0 and snap["bytes_up"] == 0
+
+
+def test_scenario_end_to_end(tmp_path):
+    sc = S.Scenario(rounds=26, events=[
+        (0, S.Create(meta=0, authors=[5], payload=42, track="post")),
+        # protected meta 1: silently refused pre-grant (untracked),
+        # accepted post-grant
+        (0, S.Create(meta=1, authors=[7], payload=9)),
+        (8, S.Authorize(members=[7], metas=0b10)),
+        (14, S.Create(meta=1, authors=[7], payload=10, track="late")),
+        (10, S.SetFault(churn_rate=0.02, packet_loss=0.05)),
+        (18, S.Checkpoint(str(tmp_path / "mid.npz"))),
+        (22, S.Destroy()),
+    ])
+    state, log = S.run(CFG, sc)
+    assert len(log.rows) == 26
+    # the public post converged before the destroy
+    cov = log.series("cov_post")
+    assert cov[20] > 0.9
+    # the pre-grant protected record never entered any store
+    assert not (np.asarray(state.store_payload) == 9).any()
+    # the post-grant one spread
+    assert log.series("cov_late")[21] > 0.5
+    # destroy at round 22 starts killing peers
+    assert log.rows[-1]["killed"] > 0
+    # fault-model switch is visible in the config-driven behavior
+    assert log.rows[-1]["alive_members"] == 46  # churn = rebirth, not death
+    # checkpoint artifact exists and restores under the *current* config
+    import jax
+    from dispersy_tpu import checkpoint as C
+    mid = C.restore(str(tmp_path / "mid.npz"),
+                    CFG.replace(churn_rate=0.02, packet_loss=0.05))
+    assert int(mid.round_index) == 18
+
+
+def test_scenario_cli(tmp_path):
+    doc = {
+        "config": {"n_peers": 32, "n_trackers": 2, "msg_capacity": 16,
+                   "bloom_capacity": 8, "k_candidates": 8,
+                   "request_inbox": 4, "tracker_inbox": 8,
+                   "response_budget": 4},
+        "rounds": 8,
+        "events": [
+            {"round": 0, "type": "create", "meta": 1, "authors": [5],
+             "payload": 42, "track": "m"},
+        ],
+    }
+    p = tmp_path / "sc.json"
+    p.write_text(json.dumps(doc))
+    import os
+    import subprocess
+    import sys
+    out_path = tmp_path / "out.json"
+    # Scrubbed env: drop the TPU-tunnel sitecustomize (PYTHONPATH) and
+    # force CPU — mirrors dispersy_tpu.cpuenv for subprocesses in tests.
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "tools/scenario.py", str(p), "--out", str(out_path)],
+        capture_output=True, text=True, cwd=".", env=env, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    last = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert last["round"] == 8
+    art = json.loads(out_path.read_text())
+    assert len(art["rounds"]) == 8
+    assert art["rounds"][-1]["cov_m"] > 0.3
+
+
+def test_metrics_log_roundtrip(tmp_path):
+    import jax
+    from dispersy_tpu import engine
+    from dispersy_tpu.state import init_state
+    cfg = CommunityConfig(n_peers=32, n_trackers=2, msg_capacity=16,
+                          bloom_capacity=8, k_candidates=8, request_inbox=4,
+                          tracker_inbox=8, response_budget=4)
+    st = init_state(cfg, jax.random.PRNGKey(0))
+    st = engine.seed_overlay(st, cfg, 4)
+    log = MetricsLog(meta={"test": True})
+    for _ in range(3):
+        st = engine.step(st, cfg)
+        log.append(st, cfg)
+    jpath = tmp_path / "log.json"
+    lpath = tmp_path / "log.jsonl"
+    log.dump(str(jpath))
+    log.dump_jsonl(str(lpath))
+    doc = json.loads(jpath.read_text())
+    assert doc["meta"] == {"test": True}
+    assert [r["round"] for r in doc["rounds"]] == [1, 2, 3]
+    lines = [json.loads(x) for x in lpath.read_text().splitlines()]
+    assert lines == doc["rounds"]
+    assert np.all(np.diff(log.series("bytes_up")) >= 0)
+
+
+def test_tracked_refused_create_fails_loud():
+    """Tracking a creation the timeline refuses raises instead of logging
+    a garbage coverage curve (review finding)."""
+    import pytest
+    sc = S.Scenario(rounds=2, events=[
+        (0, S.Create(meta=1, authors=[7], payload=9, track="early")),
+    ])
+    with pytest.raises(ValueError, match="refused by the timeline"):
+        S.run(CFG, sc)
+    with pytest.raises(ValueError, match="empty author set"):
+        S.run(CFG, S.Scenario(rounds=2, events=[
+            (0, S.Create(meta=0, authors=[], payload=1, track="x"))]))

@@ -1,0 +1,93 @@
+"""CLI scenario runner: JSON timelines over the simulated overlay.
+
+The command-line face of :mod:`dispersy_tpu.scenario` (reference:
+tool/scenarioscript.py parses "@T do X" script lines per peer; here one
+JSON file describes the whole vectorized experiment):
+
+    python tools/scenario.py examples/flood.json --out artifacts/flood.json
+
+Scenario file shape::
+
+    {
+      "config": {"n_peers": 4096, "k_candidates": 16, ...},
+      "rounds": 60,
+      "seed_degree": 8,
+      "events": [
+        {"round": 0,  "type": "create", "meta": 1, "authors": [5],
+         "payload": 42, "track": "post"},
+        {"round": 10, "type": "set_fault", "churn_rate": 0.05},
+        {"round": 20, "type": "authorize", "members": [5], "metas": 2},
+        {"round": 40, "type": "destroy"}
+      ]
+    }
+
+The output artifact is the full per-round metrics log, including
+``cov_<label>`` convergence curves for tracked records.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dispersy_tpu import scenario as S
+from dispersy_tpu.config import CommunityConfig
+
+EVENT_TYPES = {
+    "create": S.Create,
+    "signature_request": S.SignatureRequest,
+    "authorize": S.Authorize,
+    "revoke": S.Revoke,
+    "undo": S.Undo,
+    "dynamic_settings": S.DynamicSettings,
+    "destroy": S.Destroy,
+    "set_fault": S.SetFault,
+    "checkpoint": S.Checkpoint,
+}
+
+
+def _tuplize(v):
+    """JSON lists -> tuples, recursively: tuple-typed config knobs
+    (meta_priority, last_sync_history, communities) must stay hashable
+    for the jitted step's static config argument."""
+    if isinstance(v, list):
+        return tuple(_tuplize(x) for x in v)
+    return v
+
+
+def load(path: str) -> tuple[CommunityConfig, S.Scenario]:
+    with open(path) as f:
+        doc = json.load(f)
+    cfg = CommunityConfig(**{k: _tuplize(v)
+                             for k, v in doc.get("config", {}).items()})
+    events = []
+    for e in doc.get("events", ()):
+        e = dict(e)
+        rnd = e.pop("round")
+        cls = EVENT_TYPES[e.pop("type")]
+        events.append((rnd, cls(**e)))
+    return cfg, S.Scenario(rounds=doc["rounds"], events=events,
+                           seed_degree=doc.get("seed_degree", 8),
+                           snapshot_every=doc.get("snapshot_every", 1))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("scenario", help="scenario JSON file")
+    ap.add_argument("--out", default=None, help="metrics artifact path")
+    args = ap.parse_args()
+    cfg, sc = load(args.scenario)
+    state, log = S.run(cfg, sc)
+    if args.out:
+        log.dump(args.out)
+    last = log.rows[-1] if log.rows else {}
+    print(json.dumps({k: v for k, v in last.items()
+                      if not isinstance(v, list)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -38,7 +38,8 @@ from dispersy_tpu.state import NEVER, PeerState, init_state
 
 # v2: PeerState gained the signature request cache (sig_*) and Stats the
 # sig_signed/sig_done/sig_expired counters — v1 archives lack those leaves.
-FORMAT_VERSION = 2
+# v3: + the malicious-member blacklist (mal_member) and conflicts counter.
+FORMAT_VERSION = 3
 
 
 def _fingerprint(cfg: CommunityConfig) -> str:

@@ -269,6 +269,20 @@ class CommunityConfig:
     # create_identities refuses to run without it.
     identity_enabled: bool = False
 
+    # ---- malicious-member bookkeeping (reference: dispersy.py's
+    #      malicious-member machinery + dispersy-malicious-proof: a member
+    #      provably signing two DIFFERENT messages at one global_time is
+    #      blacklisted).  Here detection is local-per-peer: a conflicting
+    #      arrival against the store convicts the author on the receiving
+    #      peer, which then rejects all its records at intake and ejects
+    #      it from the candidate table.  The reference additionally
+    #      *spreads* the proof (both packets) and drops the member's
+    #      control traffic too; the simulation models conviction and the
+    #      store/candidate consequences, not proof gossip — blacklists
+    #      converge as each peer observes a conflict itself. ----
+    malicious_enabled: bool = False
+    k_malicious: int = 8                # blacklist slots per peer
+
     # ---- permissions (reference: timeline.py; bounded table of authorized
     #      members — real overlays authorize a handful of members) ----
     timeline_enabled: bool = False
@@ -494,6 +508,8 @@ class CommunityConfig:
                 raise ValueError("founder_member must be a non-tracker peer")
             if self.k_authorized < 1:
                 raise ValueError("timeline_enabled requires k_authorized >= 1")
+        if self.malicious_enabled and self.k_malicious < 1:
+            raise ValueError("malicious_enabled requires k_malicious >= 1")
 
     def replace(self, **kw) -> "CommunityConfig":
         return dataclasses.replace(self, **kw)

@@ -293,6 +293,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                jnp.where(reborn, jnp.uint32(0), state.sig_payload),
                jnp.where(reborn, jnp.uint32(0), state.sig_gt),
                jnp.where(reborn, jnp.uint32(0), state.sig_since))
+        # A reborn peer forgets its convictions (in-memory bookkeeping).
+        mal = jnp.where(r1, jnp.uint32(EMPTY_U32), state.mal_member)
         global_time = jnp.where(reborn, jnp.uint32(1), state.global_time)
         session = state.session + reborn.astype(jnp.uint32)
     else:
@@ -302,6 +304,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         auth = _auth(state)
         sig = (state.sig_target, state.sig_meta, state.sig_payload,
                state.sig_gt, state.sig_since)
+        mal = state.mal_member
         global_time, session = state.global_time, state.session
 
     alive = state.alive
@@ -834,6 +837,12 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         # Clock-jump defense before the store accepts anything.
         in_ok = in_ok & (in_gt <= global_time[:, None] + jnp.uint32(
             cfg.acceptable_global_time_range))
+        if cfg.timeline_enabled:
+            # A hard-killed peer's community instance is unloaded: it
+            # processes no incoming messages at all (reference:
+            # HardKilledCommunity drops everything) — applied before ANY
+            # intake bookkeeping, including malicious conviction.
+            in_ok = in_ok & ~killed[:, None]
         if cfg.double_meta_mask:
             # The structural "signature verify" for double-signed records
             # (whether freshly countersigned or arriving via sync): the
@@ -849,6 +858,32 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                       & (in_aux < (mem_base + mem_count).astype(
                           jnp.uint32)[:, None]))
             in_ok = in_ok & jnp.where(is_dbl, dbl_ok, True)
+        if cfg.malicious_enabled:
+            # Double-sign conviction (reference: dispersy.py malicious-
+            # member bookkeeping / dispersy-malicious-proof): an arriving
+            # record matching a STORED record's (member, global_time) but
+            # differing in content proves its author signed two messages
+            # at one time.  Convict locally, then reject this batch's (and
+            # every future) record by any convicted member.
+            same_mg = ((stc.member[:, None, :] == in_member[:, :, None])
+                       & (stc.gt[:, None, :] == in_gt[:, :, None])
+                       & (stc.gt[:, None, :] != jnp.uint32(EMPTY_U32)))
+            differs = ((stc.meta[:, None, :] != in_meta[:, :, None])
+                       | (stc.payload[:, None, :] != in_payload[:, :, None])
+                       | (stc.aux[:, None, :] != in_aux[:, :, None]))
+            conflict = in_ok & jnp.any(same_mg & differs, axis=-1)  # [N, B]
+            mf = tl.fold_set(mal, in_member, valid=conflict)
+            mal = mf.table
+            stats = stats.replace(
+                conflicts=stats.conflicts + mf.n_inserted.astype(jnp.uint32),
+                msgs_dropped=stats.msgs_dropped
+                + mf.n_dropped.astype(jnp.uint32))
+            is_black = jnp.any(mal[:, None, :] == in_member[:, :, None],
+                               axis=-1)
+            stats = stats.replace(
+                msgs_rejected=stats.msgs_rejected
+                + jnp.sum(in_ok & is_black, axis=1).astype(jnp.uint32))
+            in_ok = in_ok & ~is_black
         # Freshness (drives next round's forward batch): not already in the
         # store on the UNIQUE(member, global_time) identity, and not a
         # duplicate of an earlier record in this same batch.
@@ -863,10 +898,6 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
 
         in_flags = jnp.zeros_like(in_gt)
         if cfg.timeline_enabled:
-            # A hard-killed peer's community instance is unloaded: it
-            # processes no incoming messages at all (reference:
-            # HardKilledCommunity drops everything).
-            in_ok = in_ok & ~killed[:, None]
             # The receive pipeline's check step (reference: dispersy.py
             # _on_batch_cache -> meta.check_callback -> timeline.py
             # Timeline.check).  Control records carry their own authority
@@ -1086,8 +1117,22 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         fwd = (e0, e0, e0, e0, e0)
 
     # ---- wrap up --------------------------------------------------------
+    if cfg.malicious_enabled:
+        # Eject convicted members from the candidate table: the walker
+        # must not keep visiting a provably malicious peer (reference:
+        # candidates of malicious members are dropped).  Guarded on real
+        # slots — the EMPTY_U32 sentinel casts to NO_PEER in int32.
+        bad = (tab.peer != NO_PEER) & jnp.any(
+            tab.peer[:, :, None] == mal.astype(jnp.int32)[:, None, :],
+            axis=-1)
+        tab = cand.CandTable(
+            peer=jnp.where(bad, NO_PEER, tab.peer),
+            last_walk=jnp.where(bad, NEVER, tab.last_walk),
+            last_stumble=jnp.where(bad, NEVER, tab.last_stumble),
+            last_intro=jnp.where(bad, NEVER, tab.last_intro))
     return state.replace(
         alive=alive, session=session, global_time=global_time,
+        mal_member=mal,
         cand_peer=tab.peer, cand_last_walk=tab.last_walk,
         cand_last_stumble=tab.last_stumble, cand_last_intro=tab.last_intro,
         store_gt=stc.gt, store_member=stc.member, store_meta=stc.meta,

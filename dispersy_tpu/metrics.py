@@ -73,6 +73,8 @@ def snapshot(state: PeerState, cfg: CommunityConfig) -> dict:
         "sig_signed": total(s.sig_signed),
         "sig_done": total(s.sig_done),
         "sig_expired": total(s.sig_expired),
+        # malicious-member convictions observed (malicious_enabled)
+        "conflicts": total(s.conflicts),
         # endpoint byte totals (endpoint.py total_up / total_down).
         # NOTE: the per-peer device counters themselves wrap mod 2^32 by
         # design (state.py); the host reduction is exact over them.

@@ -122,3 +122,39 @@ def fold(tab: AuthTable, target: jnp.ndarray, mask: jnp.ndarray,
     init = (tab, jnp.zeros((n,), jnp.int32))
     t, dropped = lax.fori_loop(0, b, body, init) if b > 0 else init
     return FoldResult(table=t, n_dropped=dropped)
+
+
+class SetFoldResult(NamedTuple):
+    table: jnp.ndarray       # u32[N, S] updated member set
+    n_inserted: jnp.ndarray  # i32[N] members newly added
+    n_dropped: jnp.ndarray   # i32[N] members lost to a full table
+
+
+def fold_set(tab: jnp.ndarray, member: jnp.ndarray,
+             valid: jnp.ndarray) -> SetFoldResult:
+    """Insert [N, B] member ids into each row's bounded member set.
+
+    The blacklist form of :func:`fold` (reference: dispersy.py keeps a
+    malicious-member set keyed by member): idempotent per member, first
+    free slot, overflow counted.  ``tab`` is u32[N, S] with ``EMPTY_U32``
+    free slots.
+    """
+    n, b = member.shape
+
+    def body(i, carry):
+        t, inserted, dropped = carry
+        mb = lax.dynamic_index_in_dim(member, i, axis=1)      # [N, 1]
+        ok = lax.dynamic_index_in_dim(valid, i, axis=1)
+        dup = jnp.any(t == mb, axis=1, keepdims=True)
+        want = ok & ~dup
+        free = t == jnp.uint32(EMPTY_U32)
+        slot = jnp.argmax(free, axis=1)
+        can = jnp.any(free, axis=1, keepdims=True) & want
+        hit = (jnp.arange(t.shape[1]) == slot[:, None]) & can
+        return (jnp.where(hit, mb, t),
+                inserted + can[:, 0].astype(jnp.int32),
+                dropped + (want & ~can)[:, 0].astype(jnp.int32))
+
+    init = (tab, jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
+    t, inserted, dropped = lax.fori_loop(0, b, body, init) if b > 0 else init
+    return SetFoldResult(table=t, n_inserted=inserted, n_dropped=dropped)

@@ -153,6 +153,8 @@ class OraclePeer:
         self.sig_target = NO_PEER
         self.sig_meta = self.sig_payload = 0
         self.sig_gt = self.sig_since = 0
+        # malicious-member blacklist (engine mal_member)
+        self.mal: list[int] = []
         # stats
         self.walk_success = self.walk_fail = 0
         self.msgs_stored = self.msgs_dropped = 0
@@ -160,6 +162,7 @@ class OraclePeer:
         self.msgs_forwarded = self.msgs_rejected = 0
         self.msgs_direct = 0
         self.sig_signed = self.sig_done = self.sig_expired = 0
+        self.conflicts = 0
         self.bytes_up = self.bytes_down = 0          # wrap mod 2^32
         self.accepted_by_meta = [0] * (cfg.n_meta + 1)
 
@@ -607,6 +610,7 @@ class OracleSim:
                     p.auth = []
                     p.sig_target = NO_PEER
                     p.sig_meta = p.sig_payload = p.sig_gt = p.sig_since = 0
+                    p.mal = []
                     p.global_time = 1
                     p.session += 1
 
@@ -1001,10 +1005,29 @@ class OracleSim:
                                       + cfg.acceptable_global_time_range)
                         and self._dbl_struct_ok(i, rec)]
             if cfg.timeline_enabled and killed[i]:
-                # engine: in_ok &= ~killed — a hard-killed peer processes
-                # no incoming messages (delivery bytes were already
-                # counted at recvfrom above, as in the engine)
+                # engine: in_ok &= ~killed before ANY intake bookkeeping —
+                # a hard-killed peer convicts nobody and counts nothing
+                # (delivery bytes were already counted at recvfrom above)
                 ok_batch = []
+            if cfg.malicious_enabled:
+                # engine: conviction + blacklist run before the killed
+                # gate, in batch order (fold_set semantics)
+                for rec in ok_batch:
+                    conflict = any(
+                        r.member == rec.member and r.gt == rec.gt
+                        and (r.meta != rec.meta or r.payload != rec.payload
+                             or r.aux != rec.aux)
+                        for r in p.store)
+                    if conflict and rec.member not in p.mal:
+                        if len(p.mal) < cfg.k_malicious:
+                            p.mal.append(rec.member)
+                            p.conflicts += 1
+                        else:
+                            p.msgs_dropped += 1
+                n_black = sum(1 for rec in ok_batch if rec.member in p.mal)
+                p.msgs_rejected += n_black
+                ok_batch = [rec for rec in ok_batch
+                            if rec.member not in p.mal]
             # freshness: not stored yet, not a dup of an earlier batch entry
             store_keys = {(r.gt, r.member) for r in p.store}
             fresh0: list[bool] = []
@@ -1121,6 +1144,16 @@ class OracleSim:
             p.fwd = [rec.copy()
                      for _, rec in fresh_ix[:cfg.forward_buffer]]
 
+        # wrap up: eject convicted members from candidate tables (engine)
+        if cfg.malicious_enabled:
+            for i, p in enumerate(self.peers):
+                if not p.mal:
+                    continue
+                for s in p.slots:
+                    if s.peer != NO_PEER and s.peer in p.mal:
+                        s.peer = NO_PEER
+                        s.walk = s.stumble = s.intro = NEVER
+
         self.now = _f32(self.now + np.float32(cfg.walk_interval))
         self.rnd += 1
 
@@ -1156,6 +1189,9 @@ class OracleSim:
             "auth_member": np.full((n, a), EMPTY_U32, np.uint32),
             "auth_mask": np.zeros((n, a), np.uint32),
             "auth_gt": np.zeros((n, a), np.uint32),
+            "mal_member": np.full((n, cfg.k_malicious), EMPTY_U32, np.uint32),
+            "conflicts": np.array([p.conflicts for p in self.peers],
+                                  np.uint32),
             "sig_target": np.array([p.sig_target for p in self.peers],
                                    np.int32),
             "sig_meta": np.array([p.sig_meta for p in self.peers], np.uint32),
@@ -1215,6 +1251,8 @@ class OracleSim:
                 out["auth_member"][i, j] = row.member
                 out["auth_mask"][i, j] = row.mask
                 out["auth_gt"][i, j] = row.gt
+            for j, mb in enumerate(p.mal):
+                out["mal_member"][i, j] = mb
         return out
 
 

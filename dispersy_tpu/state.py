@@ -55,6 +55,8 @@ class Stats:
     sig_signed: jnp.ndarray       # u32[N] countersignatures granted (B side)
     sig_done: jnp.ndarray         # u32[N] double-signed records completed (A)
     sig_expired: jnp.ndarray      # u32[N] signature requests timed out (A)
+    conflicts: jnp.ndarray        # u32[N] double-sign conflicts observed
+    #   (malicious-member convictions at this peer; malicious_enabled)
     # Byte-equivalent traffic totals (reference: endpoint.py total_up /
     # total_down).  Sent bytes count at the sender pre-loss (the reference
     # counts at sendto()); received bytes count per accepted inbox slot
@@ -106,6 +108,10 @@ class PeerState:
     auth_mask: jnp.ndarray       # u32[N, A] meta bitmask; bit 31 = revoke row
     auth_gt: jnp.ndarray         # u32[N, A] global_time the row takes effect
 
+    # ---- malicious-member blacklist (reference: dispersy.py malicious-
+    #      member bookkeeping; config.malicious_enabled) ----
+    mal_member: jnp.ndarray      # u32[N, Bm], EMPTY_U32 = free slot
+
     # ---- outstanding signature request (reference: requestcache.py — the
     #      dispersy-signature-request cache entry; one in flight per peer,
     #      sent once, freed on response or timeout) ----
@@ -135,6 +141,7 @@ def init_stats(n: int, n_meta: int = 8) -> Stats:
                  msgs_dropped=z(), requests_dropped=z(), punctures=z(),
                  msgs_forwarded=z(), msgs_rejected=z(), msgs_direct=z(),
                  sig_signed=z(), sig_done=z(), sig_expired=z(),
+                 conflicts=z(),
                  bytes_up=z(), bytes_down=z(),
                  accepted_by_meta=jnp.zeros((n, n_meta + 1), jnp.uint32))
 
@@ -175,6 +182,7 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         auth_member=jnp.full((n, a), EMPTY_U32, jnp.uint32),
         auth_mask=jnp.zeros((n, a), jnp.uint32),
         auth_gt=jnp.zeros((n, a), jnp.uint32),
+        mal_member=jnp.full((n, config.k_malicious), EMPTY_U32, jnp.uint32),
         sig_target=jnp.full((n,), NO_PEER, jnp.int32),
         sig_meta=jnp.zeros((n,), jnp.uint32),
         sig_payload=jnp.zeros((n,), jnp.uint32),

@@ -82,14 +82,43 @@ def _worker() -> None:
 
     rounds_per_sec = n_rounds / dt
     scale = min(1.0, cfg.n_peers / NORTH_STAR_PEERS)
-    print(json.dumps({
+    out = {
         "metric": f"sync_rounds_per_sec_{cfg.n_peers}_peers",
         "value": round(rounds_per_sec, 3),
         "unit": "rounds/s",
         "vs_baseline": round(
             rounds_per_sec * scale / NORTH_STAR_ROUNDS_PER_SEC, 4),
         "platform": platform,
-    }))
+    }
+
+    if platform == "tpu":
+        # Config #5's shape as a secondary datapoint: the same population
+        # split into 8 communities with Timeline permission checks on.
+        # Best-effort — the headline metric above is already secured.
+        try:
+            n_c = cfg.n_peers // 8
+            cfg5 = cfg.replace(
+                n_trackers=8, communities=((n_c - 1, 1),) * 8,
+                timeline_enabled=True, protected_meta_mask=0b10,
+                k_authorized=8, founder_member=-1)
+            st5 = init_state(cfg5, jax.random.PRNGKey(1))
+            st5 = engine.seed_overlay(st5, cfg5, degree=8)
+            authors5 = jnp.arange(cfg5.n_peers) % 64 == 63
+            st5 = engine.create_messages(
+                st5, cfg5, author_mask=authors5, meta=0,
+                payload=jnp.arange(cfg5.n_peers, dtype=jnp.uint32))
+            for _ in range(3):
+                st5 = engine.step(st5, cfg5)
+            jax.block_until_ready(st5)
+            t0 = time.perf_counter()
+            for _ in range(15):
+                st5 = engine.step(st5, cfg5)
+            jax.block_until_ready(st5)
+            out["communities8_timeline_rounds_per_sec"] = round(
+                15 / (time.perf_counter() - t0), 3)
+        except Exception as e:  # noqa: BLE001 — secondary metric only
+            out["communities8_error"] = str(e)[:200]
+    print(json.dumps(out))
 
 
 def _try_worker(env: dict, timeout_s: int) -> dict | None:

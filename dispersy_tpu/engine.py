@@ -177,6 +177,15 @@ def _response_order(stc: st.StoreCols, cfg: CommunityConfig) -> st.StoreCols:
                         aux=aux, flags=flags)
 
 
+def killed_mask(store_meta: jnp.ndarray) -> jnp.ndarray:
+    """bool[N]: which peers are hard-killed (their store holds the
+    founder's dispersy-destroy-community record).  The ONE definition of
+    killed-ness — step(), the create paths, and metrics all derive it
+    from here (reference: HardKilledCommunity classification is derived
+    from the database on load)."""
+    return jnp.any(store_meta == jnp.uint32(META_DESTROY), axis=1)
+
+
 def _priority_vec(cfg: CommunityConfig, meta: jnp.ndarray) -> jnp.ndarray:
     """u32 serving/forwarding priority per record (config.priority_of,
     vectorized): declared per-meta priorities for the user band,
@@ -317,7 +326,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     # the reference derives the classification from the database on load;
     # a churned-out peer forgets the kill and re-learns it by syncing.
     if cfg.timeline_enabled:
-        killed = jnp.any(stc.meta == jnp.uint32(META_DESTROY), axis=1)
+        killed = killed_mask(stc.meta)
     else:
         killed = jnp.zeros((n,), bool)
 
@@ -1198,6 +1207,13 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
             "timeline_enabled=True (declare a Linear/DynamicResolution "
             "meta or set the flag) — without a timeline the record would "
             "sync but enforce nothing")
+    if meta < cfg.n_meta and (cfg.double_meta_mask >> meta) & 1:
+        # A double-signed record only exists through the countersign
+        # exchange; minting one here would forge the second signature.
+        raise ValueError(
+            f"meta {meta} is DoubleMemberAuthentication — use "
+            "create_signature_request, which obtains the counterparty's "
+            "signature instead of forging it")
     n = cfg.n_peers
     idx = jnp.arange(n, dtype=jnp.uint32)
     if aux is None:
@@ -1241,9 +1257,7 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
         else:
             allowed = jnp.ones((n,), bool)
         # A hard-killed peer's community is unloaded: nothing to create on.
-        killed = jnp.any(state.store_meta == jnp.uint32(META_DESTROY),
-                         axis=1)
-        author_mask = author_mask & allowed & ~killed
+        author_mask = author_mask & allowed & ~killed_mask(state.store_meta)
 
     new = st.StoreCols(
         gt=gt_new[:, None],
@@ -1344,8 +1358,7 @@ def create_signature_request(state: PeerState, cfg: CommunityConfig,
           & (counterparty >= mem_base)
           & (counterparty < mem_base + mem_count))
     if cfg.timeline_enabled:
-        ok = ok & ~jnp.any(state.store_meta == jnp.uint32(META_DESTROY),
-                           axis=1)
+        ok = ok & ~killed_mask(state.store_meta)
     if (cfg.timeline_enabled
             and ((cfg.protected_meta_mask | cfg.dynamic_meta_mask)
                  >> meta) & 1):

@@ -24,8 +24,8 @@ import os
 import jax.numpy as jnp
 import numpy as np
 
-from dispersy_tpu.config import (EMPTY_U32, META_DESTROY, NO_PEER,
-                                 CommunityConfig)
+from dispersy_tpu.config import EMPTY_U32, NO_PEER, CommunityConfig
+from dispersy_tpu.engine import killed_mask
 from dispersy_tpu.state import PeerState
 
 logger = logging.getLogger(__name__)
@@ -55,8 +55,7 @@ def snapshot(state: PeerState, cfg: CommunityConfig) -> dict:
         "round": int(state.round_index),
         "sim_time": float(state.time),
         "alive_members": int(jnp.sum(members)),
-        "killed": int(jnp.sum(jnp.any(
-            state.store_meta == jnp.uint32(META_DESTROY), axis=1))),
+        "killed": int(jnp.sum(killed_mask(state.store_meta))),
         # walker (statistics.py walk_success / walk_failure)
         "walk_success": walk_success,
         "walk_fail": walk_fail,

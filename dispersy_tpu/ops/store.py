@@ -149,25 +149,27 @@ def store_insert(store: StoreCols, new: StoreCols,
         newer = jnp.sum(same & (gt[..., None, :] > gt[..., :, None]),
                         axis=-1)
         kill = dup | ((k_meta > 0) & live & (newer >= k_meta))
-    gt = jnp.where(kill, _EMPTY, gt)
-    member = jnp.where(kill, _EMPTY, member)
-    meta = jnp.where(kill, _EMPTY, meta)
-    payload = jnp.where(kill, _EMPTY, payload)
-    aux = jnp.where(kill, 0, aux)
-    flags = jnp.where(kill, 0, flags)
-    origin = jnp.where(kill, 0, origin)
+    # Compact by scatter instead of a second sort: survivors are already
+    # in sorted order (UNIQUE(member, gt) holds after the dup kill, so
+    # (gt, member) alone determines the order), and a rank-scatter is
+    # linear where the sort is O(W log W) — store_insert runs once per
+    # round over [N, M+B] columns, so this is a hot-path win.
+    keep = (gt != _EMPTY) & ~kill
+    rank = jnp.cumsum(keep.astype(jnp.int32), axis=-1) - 1
+    # survivors beyond capacity (rank >= m) drop into the spill slot m
+    slot = jnp.where(keep & (rank < m), rank, m)
+    rows = jnp.arange(gt.shape[0])[:, None]
 
-    # Compact: killed/hole entries (gt == EMPTY) sort to the end; truncate.
-    gt, member, meta, payload, origin, aux, flags = lax.sort(
-        (gt, member, meta, payload, origin, aux, flags), dimension=-1,
-        num_keys=4)
-    out = StoreCols(gt=gt[..., :m], member=member[..., :m],
-                    meta=meta[..., :m], payload=payload[..., :m],
-                    aux=aux[..., :m], flags=flags[..., :m])
-    kept = gt[..., :m] != _EMPTY
-    n_inserted = jnp.sum((origin[..., :m] == 1) & kept,
-                         axis=-1).astype(jnp.int32)
-    n_surviving_old = jnp.sum((origin[..., :m] == 0) & kept,
+    def compact(col, fill):
+        return (jnp.full((gt.shape[0], m + 1), fill, col.dtype)
+                .at[rows, slot].set(col)[..., :m])
+    out = StoreCols(gt=compact(gt, _EMPTY), member=compact(member, _EMPTY),
+                    meta=compact(meta, _EMPTY),
+                    payload=compact(payload, _EMPTY),
+                    aux=compact(aux, 0), flags=compact(flags, 0))
+    kept = keep & (rank < m)
+    n_inserted = jnp.sum(kept & (origin == 1), axis=-1).astype(jnp.int32)
+    n_surviving_old = jnp.sum(kept & (origin == 0),
                               axis=-1).astype(jnp.int32)
     return InsertResult(store=out, n_inserted=n_inserted,
                         n_dropped=n_new_valid - n_inserted,

@@ -492,6 +492,8 @@ class OracleSim:
                         aux=None) -> None:
         """engine.create_messages mirror (incl. the timeline author gate)."""
         cfg = self.cfg
+        assert not (meta < cfg.n_meta and (cfg.double_meta_mask >> meta) & 1), \
+            "double-signed metas go through create_signature_request"
         for i, p in enumerate(self.peers):
             if not author_mask[i]:
                 continue
@@ -1010,8 +1012,9 @@ class OracleSim:
                 # (delivery bytes were already counted at recvfrom above)
                 ok_batch = []
             if cfg.malicious_enabled:
-                # engine: conviction + blacklist run before the killed
-                # gate, in batch order (fold_set semantics)
+                # engine: conviction + blacklist run AFTER the killed gate
+                # (a killed peer's emptied batch convicts nobody), in
+                # batch order (fold_set semantics)
                 for rec in ok_batch:
                     conflict = any(
                         r.member == rec.member and r.gt == rec.gt

@@ -214,6 +214,12 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
     log = log or MetricsLog(meta={"scenario_rounds": scenario.rounds})
     by_round: dict[int, list] = {}
     for rnd, ev in scenario.events:
+        if not (0 <= int(rnd) < scenario.rounds):
+            # Silently skipping a scripted event would make the artifact
+            # describe a different experiment than the scenario file.
+            raise ValueError(
+                f"event {ev!r} scheduled at round {rnd}, outside the "
+                f"scenario's [0, {scenario.rounds}) range")
         by_round.setdefault(int(rnd), []).append(ev)
     tracked: dict[str, tuple] = {}
 

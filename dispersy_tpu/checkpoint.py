@@ -15,9 +15,11 @@ modes:
 - ``fresh_candidates=False`` (default): byte-exact resume — stepping the
   restored state replays the identical trajectory, which is what the
   determinism tests pin.
-- ``fresh_candidates=True``: the reference's restart semantics — candidate
-  tables wiped, peers re-walk from their trackers; stores, clocks, auth
-  tables and stats survive (they live in "the database").
+- ``fresh_candidates=True``: the reference's restart semantics — the
+  in-memory half dies with the process (candidate tables, the signature
+  request cache, the delayed-message pen, malicious convictions) and
+  peers re-walk from their trackers; stores, clocks, auth tables and
+  stats survive (they live in "the database").
 
 Format: one ``.npz`` with dotted-path keys per leaf.  On a multi-host mesh
 each host would save its addressable shards to its own file (orbax-style
@@ -33,13 +35,14 @@ import os
 import jax
 import numpy as np
 
-from dispersy_tpu.config import CommunityConfig, NO_PEER
+from dispersy_tpu.config import EMPTY_U32, CommunityConfig, NO_PEER
 from dispersy_tpu.state import NEVER, PeerState, init_state
 
 # v2: PeerState gained the signature request cache (sig_*) and Stats the
 # sig_signed/sig_done/sig_expired counters — v1 archives lack those leaves.
 # v3: + the malicious-member blacklist (mal_member) and conflicts counter.
-FORMAT_VERSION = 3
+# v4: + the delayed-message pen (dly_*) and msgs_delayed counter.
+FORMAT_VERSION = 4
 
 
 def _fingerprint(cfg: CommunityConfig) -> str:
@@ -105,13 +108,29 @@ def restore(path: str, cfg: CommunityConfig,
             leaves.append(arr)
     state = jax.tree_util.tree_unflatten(treedef, leaves)
     if fresh_candidates:
-        # Reference restart semantics: candidates are ephemeral; the
-        # walker re-bootstraps from trackers (SURVEY §5.4).
-        k = cfg.k_candidates
-        never = np.full((cfg.n_peers, k), NEVER, np.float32)
+        # Reference restart semantics: everything that lives in process
+        # memory (not the database) is ephemeral — candidates (the walker
+        # re-bootstraps from trackers, SURVEY §5.4), the signature
+        # RequestCache, the delayed-message pen, and malicious-member
+        # convictions all die with the process, exactly as the engine's
+        # churn rebirth models.
+        n, k, d = cfg.n_peers, cfg.k_candidates, cfg.delay_inbox
+        never = np.full((n, k), NEVER, np.float32)
         state = state.replace(
-            cand_peer=np.full((cfg.n_peers, k), NO_PEER, np.int32),
+            cand_peer=np.full((n, k), NO_PEER, np.int32),
             cand_last_walk=never,
             cand_last_stumble=never.copy(),
-            cand_last_intro=never.copy())
+            cand_last_intro=never.copy(),
+            sig_target=np.full((n,), NO_PEER, np.int32),
+            sig_meta=np.zeros((n,), np.uint32),
+            sig_payload=np.zeros((n,), np.uint32),
+            sig_gt=np.zeros((n,), np.uint32),
+            sig_since=np.zeros((n,), np.uint32),
+            mal_member=np.full((n, cfg.k_malicious), EMPTY_U32, np.uint32),
+            dly_gt=np.full((n, d), EMPTY_U32, np.uint32),
+            dly_member=np.full((n, d), EMPTY_U32, np.uint32),
+            dly_meta=np.full((n, d), EMPTY_U32, np.uint32),
+            dly_payload=np.full((n, d), EMPTY_U32, np.uint32),
+            dly_aux=np.zeros((n, d), np.uint32),
+            dly_since=np.zeros((n, d), np.uint32))
     return state

@@ -252,6 +252,23 @@ class CommunityConfig:
     # to the application).  Deterministic per (peer, round, slot) draw.
     countersign_rate: float = 1.0
 
+    # ---- delayed messages (reference: message.py ``DelayMessageByProof``
+    #      + community.py on_missing_proof / dispersy-missing-proof): a
+    #      record rejected ONLY because its permission proof has not
+    #      arrived yet is parked in a bounded per-peer pen and re-enters
+    #      the intake batch every round until the authorize record lands,
+    #      the pen overflows, or it times out.  The round-synchronous
+    #      recast of "delay the batch, request the proof, release on
+    #      arrival": the proof request itself is subsumed by the timeline
+    #      records' CONTROL_PRIORITY spread; the *delay semantics* — the
+    #      record is not lost while the proof is in flight — live here.
+    #      0 disables the pen (rejected records are dropped and re-learned
+    #      only when a Bloom re-offer happens to repeat them). ----
+    delay_inbox: int = 0                # pen slots per peer
+    delay_timeout: float = 52.5         # seconds a record may wait
+    #   (reference: DelayMessage lifetimes are request-cache timeouts;
+    #    10.5 s x ~5 retries is the missing-proof retry window)
+
     # ---- clock (reference: community.py claim_global_time /
     #      dispersy_acceptable_global_time_range) ----
     acceptable_global_time_range: int = 10000
@@ -339,6 +356,16 @@ class CommunityConfig:
     def sig_timeout_rounds(self) -> int:
         """Signature-request lifetime in whole rounds (>= 1 when enabled)."""
         return int(self.sig_timeout / self.walk_interval)
+
+    @property
+    def delay_enabled(self) -> bool:
+        """Is the DelayMessageByProof pen compiled in?"""
+        return self.delay_inbox > 0
+
+    @property
+    def delay_timeout_rounds(self) -> int:
+        """Pen-record lifetime in whole rounds (>= 1 when enabled)."""
+        return int(self.delay_timeout / self.walk_interval)
 
     @property
     def founder(self) -> int:
@@ -510,6 +537,15 @@ class CommunityConfig:
                 raise ValueError("timeline_enabled requires k_authorized >= 1")
         if self.malicious_enabled and self.k_malicious < 1:
             raise ValueError("malicious_enabled requires k_malicious >= 1")
+        if self.delay_inbox < 0:
+            raise ValueError("delay_inbox must be >= 0")
+        if self.delay_inbox > 0:
+            if not self.timeline_enabled:
+                raise ValueError("delay_inbox requires timeline_enabled "
+                                 "(only permission-rejected records are "
+                                 "delayable — DelayMessageByProof)")
+            if self.delay_timeout_rounds < 1:
+                raise ValueError("delay_timeout must cover >= 1 round")
 
     def replace(self, **kw) -> "CommunityConfig":
         return dataclasses.replace(self, **kw)

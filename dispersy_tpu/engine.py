@@ -288,6 +288,14 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         fwd = tuple(jnp.where(r1, jnp.uint32(EMPTY_U32), c) for c in
                     (state.fwd_gt, state.fwd_member, state.fwd_meta,
                      state.fwd_payload, state.fwd_aux))
+        # The delayed-message pen dies with the process (reference: delayed
+        # batches live in the in-memory RequestCache, not the database).
+        dly = (jnp.where(r1, jnp.uint32(EMPTY_U32), state.dly_gt),
+               jnp.where(r1, jnp.uint32(EMPTY_U32), state.dly_member),
+               jnp.where(r1, jnp.uint32(EMPTY_U32), state.dly_meta),
+               jnp.where(r1, jnp.uint32(EMPTY_U32), state.dly_payload),
+               jnp.where(r1, jnp.uint32(0), state.dly_aux),
+               jnp.where(r1, jnp.uint32(0), state.dly_since))
         # The auth table is folded from the (wiped) store, so it wipes too:
         # a reborn peer re-learns permissions as authorize records re-sync
         # (reference: Timeline is rebuilt from the database on load).
@@ -310,6 +318,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         tab, stc = _tab(state), _store(state)
         fwd = (state.fwd_gt, state.fwd_member, state.fwd_meta,
                state.fwd_payload, state.fwd_aux)
+        dly = (state.dly_gt, state.dly_member, state.dly_meta,
+               state.dly_payload, state.dly_aux, state.dly_since)
         auth = _auth(state)
         sig = (state.sig_target, state.sig_meta, state.sig_payload,
                state.sig_gt, state.sig_since)
@@ -799,16 +809,12 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             # responder's ORDER BY under dispersy_sync_response_limit.
             rank = jnp.cumsum(missing.astype(jnp.int32), axis=1) - 1
             slot = jnp.where(missing & (rank < b), rank, b)
-
-            def compact(col, fill):
-                return (jnp.full((n, b + 1), fill, col.dtype)
-                        .at[rows, slot].set(col)[:, :b])
-            gts.append(compact(stv.gt, EMPTY_U32))
-            members.append(compact(stv.member, EMPTY_U32))
-            metas.append(compact(stv.meta, EMPTY_U32))
-            payloads.append(compact(stv.payload, EMPTY_U32))
-            auxs.append(compact(stv.aux, 0))
-            valids.append(compact(missing, False))
+            gts.append(st.rank_compact(stv.gt, slot, b, EMPTY_U32))
+            members.append(st.rank_compact(stv.member, slot, b, EMPTY_U32))
+            metas.append(st.rank_compact(stv.meta, slot, b, EMPTY_U32))
+            payloads.append(st.rank_compact(stv.payload, slot, b, EMPTY_U32))
+            auxs.append(st.rank_compact(stv.aux, slot, b, 0))
+            valids.append(st.rank_compact(missing, slot, b, False))
         obox = [jnp.stack(c, axis=1)
                 for c in (gts, members, metas, payloads, auxs)]
         obox_ok = jnp.stack(valids, axis=1)                       # [N, R, b]
@@ -829,19 +835,36 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         sy_gt = sy_member = sy_meta = sy_payload = sy_aux = s0
         sy_ok = jnp.zeros((n, 0), bool)
 
-    # ---- phase 5: combined intake (sync pull + push + completed
-    # double-signed) -> store.  One batch per round: sync records first,
-    # then pushed records, then this round's countersigned completion, in
-    # delivery order — mirroring the reference's _on_batch_cache handling
-    # one grouped batch per meta per window.
-    in_gt = jnp.concatenate([sy_gt, ph_gt, db_gt], axis=1)        # [N, B]
-    in_member = jnp.concatenate([sy_member, ph_member, db_member], axis=1)
-    in_meta = jnp.concatenate([sy_meta, ph_meta, db_meta], axis=1)
-    in_payload = jnp.concatenate([sy_payload, ph_payload, db_payload],
-                                 axis=1)
-    in_aux = jnp.concatenate([sy_aux, ph_aux, db_aux], axis=1)
-    in_ok = jnp.concatenate([sy_ok, ph_ok, db_ok], axis=1)
+    # ---- phase 5: combined intake (delayed pen + sync pull + push +
+    # completed double-signed) -> store.  One batch per round: the pen's
+    # waiting records first (they were delivered in an earlier round —
+    # the reference re-processes a delayed batch ahead of fresh arrivals
+    # when its proof lands), then sync records, then pushed records, then
+    # this round's countersigned completion, in delivery order — mirroring
+    # the reference's _on_batch_cache handling one grouped batch per meta
+    # per window.
+    if cfg.delay_enabled:
+        dl_gt, dl_member, dl_meta, dl_payload, dl_aux, dl_since = dly
+        dl_ok = (dl_gt != jnp.uint32(EMPTY_U32)) & alive[:, None]
+    else:
+        z0 = jnp.zeros((n, 0), jnp.uint32)
+        dl_gt = dl_member = dl_meta = dl_payload = dl_aux = dl_since = z0
+        dl_ok = jnp.zeros((n, 0), bool)
+    in_gt = jnp.concatenate([dl_gt, sy_gt, ph_gt, db_gt], axis=1)  # [N, B]
+    in_member = jnp.concatenate([dl_member, sy_member, ph_member, db_member],
+                                axis=1)
+    in_meta = jnp.concatenate([dl_meta, sy_meta, ph_meta, db_meta], axis=1)
+    in_payload = jnp.concatenate([dl_payload, sy_payload, ph_payload,
+                                  db_payload], axis=1)
+    in_aux = jnp.concatenate([dl_aux, sy_aux, ph_aux, db_aux], axis=1)
+    in_ok = jnp.concatenate([dl_ok, sy_ok, ph_ok, db_ok], axis=1)
     bb = in_gt.shape[1]
+    if cfg.delay_enabled:
+        # Round each batch entry was (first) delivered: pen entries keep
+        # their parking round, everything else arrived now.
+        in_since = jnp.concatenate(
+            [dl_since, jnp.broadcast_to(rnd, (n, bb - dl_since.shape[1]))],
+            axis=1).astype(jnp.uint32)
     if bb > 0:
         # Clock-jump defense before the store accepts anything.
         in_ok = in_ok & (in_gt <= global_time[:, None] + jnp.uint32(
@@ -984,9 +1007,27 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                 & (stc.aux[:, None, :] == in_gt[:, :, None]), axis=-1)
             in_flags = jnp.where(pre_undone, jnp.uint32(FLAG_UNDONE),
                                  jnp.uint32(0))
+            if cfg.delay_enabled:
+                # DelayMessageByProof: a non-control record that failed
+                # ONLY the permission check (for a control record ~accept
+                # means a forged authority — never delayable), is not
+                # already covered (stored, or a dup of an earlier batch
+                # entry), and has not exceeded its waiting time, parks in
+                # the pen instead of being rejected.  First-fit into the
+                # bounded pen; overflow rejects like the reference's
+                # delay-queue cap.
+                waiting = (in_ok & ~is_ctrl & ~accept & ~in_store
+                           & ~dup_in_batch
+                           & (rnd - in_since
+                              < jnp.uint32(cfg.delay_timeout_rounds)))
+                drank = jnp.cumsum(waiting.astype(jnp.int32), axis=1) - 1
+                parked = waiting & (drank < cfg.delay_inbox)
+            else:
+                parked = jnp.zeros_like(accept)
             stats = stats.replace(
                 msgs_rejected=stats.msgs_rejected
-                + jnp.sum(in_ok & ~accept, axis=1).astype(jnp.uint32),
+                + jnp.sum(in_ok & ~accept & ~parked,
+                          axis=1).astype(jnp.uint32),
                 msgs_dropped=stats.msgs_dropped
                 + fr.n_dropped.astype(jnp.uint32))
         else:
@@ -1114,13 +1155,26 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         else:
             rank = jnp.cumsum(fresh.astype(jnp.int32), axis=1) - 1
         fslot = jnp.where(fresh & (rank < fb), rank, fb)
-        rows_all = idx[:, None]
+        fwd = tuple(st.rank_compact(col, fslot, fb, EMPTY_U32)
+                    for col in (in_gt, in_member, in_meta, in_payload,
+                                in_aux))
 
-        def fcompact(col):
-            return (jnp.full((n, fb + 1), EMPTY_U32, jnp.uint32)
-                    .at[rows_all, fslot].set(col)[:, :fb])
-        fwd = (fcompact(in_gt), fcompact(in_member), fcompact(in_meta),
-               fcompact(in_payload), fcompact(in_aux))
+        if cfg.delay_enabled:
+            # Rebuild the pen from this batch's parked records (waiting
+            # pen entries re-park with their original since; newly
+            # delayed records stamp this round).
+            dd = cfg.delay_inbox
+            dslot = jnp.where(parked, drank, dd)
+            dly = (st.rank_compact(in_gt, dslot, dd, EMPTY_U32),
+                   st.rank_compact(in_member, dslot, dd, EMPTY_U32),
+                   st.rank_compact(in_meta, dslot, dd, EMPTY_U32),
+                   st.rank_compact(in_payload, dslot, dd, EMPTY_U32),
+                   st.rank_compact(in_aux, dslot, dd, 0),
+                   st.rank_compact(in_since, dslot, dd, 0))
+            stats = stats.replace(
+                msgs_delayed=stats.msgs_delayed
+                + jnp.sum(parked & (in_since == rnd),
+                          axis=1).astype(jnp.uint32))
     else:
         e0 = jnp.full((n, cfg.forward_buffer), EMPTY_U32, jnp.uint32)
         fwd = (e0, e0, e0, e0, e0)
@@ -1148,6 +1202,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         store_payload=stc.payload, store_aux=stc.aux, store_flags=stc.flags,
         fwd_gt=fwd[0], fwd_member=fwd[1], fwd_meta=fwd[2], fwd_payload=fwd[3],
         fwd_aux=fwd[4],
+        dly_gt=dly[0], dly_member=dly[1], dly_meta=dly[2], dly_payload=dly[3],
+        dly_aux=dly[4], dly_since=dly[5],
         auth_member=auth.member, auth_mask=auth.mask, auth_gt=auth.gt,
         sig_target=sig[0], sig_meta=sig[1], sig_payload=sig[2],
         sig_gt=sig[3], sig_since=sig[4],

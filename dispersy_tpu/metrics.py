@@ -66,6 +66,7 @@ def snapshot(state: PeerState, cfg: CommunityConfig) -> dict:
         "msgs_rejected": total(s.msgs_rejected),
         "msgs_forwarded": total(s.msgs_forwarded),
         "msgs_direct": total(s.msgs_direct),
+        "msgs_delayed": total(s.msgs_delayed),
         "requests_dropped": total(s.requests_dropped),
         "punctures": total(s.punctures),
         # double-signed flow

@@ -66,6 +66,24 @@ def count_valid(gt: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum((gt != _EMPTY).astype(jnp.int32), axis=-1)
 
 
+def rank_compact(col: jnp.ndarray, slot: jnp.ndarray, width: int,
+                 fill) -> jnp.ndarray:
+    """Rank-scatter compaction: keep entries whose ``slot`` < ``width``.
+
+    ``col``/``slot`` are [N, W]-shaped; entries scatter to ``slot`` in a
+    fresh ``fill``-initialized row, with ``slot == width`` as the shared
+    spill column that is trimmed off.  Slots below ``width`` must be unique
+    per row (ranks from a cumsum are).  This is the one definition of the
+    idiom used by the store merge, the sync-responder outbox, the forward
+    buffer, and the delayed-message pen — linear, where a second sort
+    would be O(W log W).
+    """
+    n = col.shape[0]
+    rows = jnp.arange(n)[:, None]
+    return (jnp.full((n, width + 1), fill, col.dtype)
+            .at[rows, slot].set(col)[..., :width])
+
+
 class InsertResult(NamedTuple):
     store: StoreCols
     n_inserted: jnp.ndarray  # i32[N] new records now in the store
@@ -158,15 +176,12 @@ def store_insert(store: StoreCols, new: StoreCols,
     rank = jnp.cumsum(keep.astype(jnp.int32), axis=-1) - 1
     # survivors beyond capacity (rank >= m) drop into the spill slot m
     slot = jnp.where(keep & (rank < m), rank, m)
-    rows = jnp.arange(gt.shape[0])[:, None]
-
-    def compact(col, fill):
-        return (jnp.full((gt.shape[0], m + 1), fill, col.dtype)
-                .at[rows, slot].set(col)[..., :m])
-    out = StoreCols(gt=compact(gt, _EMPTY), member=compact(member, _EMPTY),
-                    meta=compact(meta, _EMPTY),
-                    payload=compact(payload, _EMPTY),
-                    aux=compact(aux, 0), flags=compact(flags, 0))
+    out = StoreCols(gt=rank_compact(gt, slot, m, _EMPTY),
+                    member=rank_compact(member, slot, m, _EMPTY),
+                    meta=rank_compact(meta, slot, m, _EMPTY),
+                    payload=rank_compact(payload, slot, m, _EMPTY),
+                    aux=rank_compact(aux, slot, m, 0),
+                    flags=rank_compact(flags, slot, m, 0))
     kept = keep & (rank < m)
     n_inserted = jnp.sum(kept & (origin == 1), axis=-1).astype(jnp.int32)
     n_surviving_old = jnp.sum(kept & (origin == 0),

@@ -149,6 +149,9 @@ class OraclePeer:
         self.store: list[Record] = []   # kept sorted by Record.key()
         self.fwd: list[Record] = []     # forward batch for next round
         self.auth: list[AuthRow] = []   # bounded at cfg.k_authorized
+        # delayed-message pen: (record, round first parked) pairs, bounded
+        # at cfg.delay_inbox (engine dly_* fields)
+        self.delay: list[tuple[Record, int]] = []
         # signature request cache (one in flight; engine sig_* fields)
         self.sig_target = NO_PEER
         self.sig_meta = self.sig_payload = 0
@@ -161,6 +164,7 @@ class OraclePeer:
         self.requests_dropped = self.punctures = 0
         self.msgs_forwarded = self.msgs_rejected = 0
         self.msgs_direct = 0
+        self.msgs_delayed = 0
         self.sig_signed = self.sig_done = self.sig_expired = 0
         self.conflicts = 0
         self.bytes_up = self.bytes_down = 0          # wrap mod 2^32
@@ -610,6 +614,7 @@ class OracleSim:
                     p.store = []
                     p.fwd = []
                     p.auth = []
+                    p.delay = []
                     p.sig_target = NO_PEER
                     p.sig_meta = p.sig_payload = p.sig_gt = p.sig_since = 0
                     p.mal = []
@@ -982,27 +987,36 @@ class OracleSim:
                     # counts obox_ok at the sender)
                     self.peers[d].bytes_up += len(sel) * RECORD_BYTES
 
-        # phase 5: combined intake (sync pull + push) -> store + fwd batch
+        # phase 5: combined intake (delayed pen + sync pull + push) ->
+        # store + fwd batch + rebuilt pen
+        delay_on = cfg.delay_inbox > 0
         for i in range(n):
             p = self.peers[i]
             # On-the-wire records: (gt, member, meta, payload, aux) — flags
             # are receiver-local and never travel (engine sends 5 columns).
-            batch: list[Record] = []
+            # Each batch entry pairs the record with the round it (first)
+            # arrived: pen entries keep their parking round (engine
+            # in_since), fresh deliveries stamp this round.
+            batch: list[tuple[Record, int]] = []
+            if delay_on and p.alive:
+                # pen first (engine: dl segment leads the concat)
+                batch.extend((rec, since) for rec, since in p.delay)
             if cfg.sync_enabled and p.alive and req_slot[i] >= 0:
                 recs = outbox.get((targets[i], req_slot[i]), [])
                 for j, r in enumerate(recs):
                     if not self._lost(i, _LOSS_SYNC, j):
-                        batch.append(Record(r.gt, r.member, r.meta,
-                                            r.payload, r.aux))
+                        batch.append((Record(r.gt, r.member, r.meta,
+                                             r.payload, r.aux), rnd))
                         p.bytes_down += RECORD_BYTES
             if p.alive:
-                batch.extend(Record(r.gt, r.member, r.meta, r.payload, r.aux)
+                batch.extend((Record(r.gt, r.member, r.meta, r.payload,
+                                     r.aux), rnd)
                              for r in push_inbox[i])
             if sig_completed[i] is not None:
-                batch.append(sig_completed[i])
+                batch.append((sig_completed[i], rnd))
             # clock-jump defense (engine: post-walk-fold clock), plus the
             # structural countersigner check for double-signed metas
-            ok_batch = [rec for rec in batch
+            ok_pairs = [(rec, s) for rec, s in batch
                         if rec.gt <= (p.global_time
                                       + cfg.acceptable_global_time_range)
                         and self._dbl_struct_ok(i, rec)]
@@ -1010,12 +1024,12 @@ class OracleSim:
                 # engine: in_ok &= ~killed before ANY intake bookkeeping —
                 # a hard-killed peer convicts nobody and counts nothing
                 # (delivery bytes were already counted at recvfrom above)
-                ok_batch = []
+                ok_pairs = []
             if cfg.malicious_enabled:
                 # engine: conviction + blacklist run AFTER the killed gate
                 # (a killed peer's emptied batch convicts nobody), in
                 # batch order (fold_set semantics)
-                for rec in ok_batch:
+                for rec, _ in ok_pairs:
                     conflict = any(
                         r.member == rec.member and r.gt == rec.gt
                         and (r.meta != rec.meta or r.payload != rec.payload
@@ -1027,10 +1041,13 @@ class OracleSim:
                             p.conflicts += 1
                         else:
                             p.msgs_dropped += 1
-                n_black = sum(1 for rec in ok_batch if rec.member in p.mal)
+                n_black = sum(1 for rec, _ in ok_pairs
+                              if rec.member in p.mal)
                 p.msgs_rejected += n_black
-                ok_batch = [rec for rec in ok_batch
+                ok_pairs = [(rec, s) for rec, s in ok_pairs
                             if rec.member not in p.mal]
+            ok_batch = [rec for rec, _ in ok_pairs]
+            ok_since = [s for _, s in ok_pairs]
             # freshness: not stored yet, not a dup of an earlier batch entry
             store_keys = {(r.gt, r.member) for r in p.store}
             fresh0: list[bool] = []
@@ -1059,7 +1076,32 @@ class OracleSim:
                                                 rec.aux))
             accept = [self._intake_accept(i, rec, batch_flips)
                       for rec in ok_batch]
-            p.msgs_rejected += sum(1 for a in accept if not a)
+            if delay_on:
+                # DelayMessageByProof pen (engine: waiting/parked masks).
+                # A non-control record failing only the permission check,
+                # not already covered (fresh0), and still inside its
+                # waiting window parks; first-fit into the bounded pen.
+                ctrl = (META_AUTHORIZE, META_REVOKE, META_UNDO_OWN,
+                        META_UNDO_OTHER, META_DYNAMIC, META_DESTROY)
+                new_delay: list[tuple[Record, int]] = []
+                parked_flags: list[bool] = []
+                for rec, s, a, f0 in zip(ok_batch, ok_since, accept,
+                                         fresh0):
+                    waiting = (not a and rec.meta not in ctrl and f0
+                               and rnd - s < cfg.delay_timeout_rounds)
+                    parked = waiting and len(new_delay) < cfg.delay_inbox
+                    if parked:
+                        new_delay.append(
+                            (Record(rec.gt, rec.member, rec.meta,
+                                    rec.payload, rec.aux), s))
+                        if s == rnd:
+                            p.msgs_delayed += 1
+                    parked_flags.append(parked)
+                p.delay = new_delay
+            else:
+                parked_flags = [False] * len(ok_batch)
+            p.msgs_rejected += sum(1 for a, pk in zip(accept, parked_flags)
+                                   if not a and not pk)
 
             if cfg.seq_meta_mask:
                 # Sequence-chain intake (engine's fori scan, in batch order).
@@ -1192,6 +1234,16 @@ class OracleSim:
             "auth_member": np.full((n, a), EMPTY_U32, np.uint32),
             "auth_mask": np.zeros((n, a), np.uint32),
             "auth_gt": np.zeros((n, a), np.uint32),
+            "dly_gt": np.full((n, cfg.delay_inbox), EMPTY_U32, np.uint32),
+            "dly_member": np.full((n, cfg.delay_inbox), EMPTY_U32,
+                                  np.uint32),
+            "dly_meta": np.full((n, cfg.delay_inbox), EMPTY_U32, np.uint32),
+            "dly_payload": np.full((n, cfg.delay_inbox), EMPTY_U32,
+                                   np.uint32),
+            "dly_aux": np.zeros((n, cfg.delay_inbox), np.uint32),
+            "dly_since": np.zeros((n, cfg.delay_inbox), np.uint32),
+            "msgs_delayed": np.array([p.msgs_delayed for p in self.peers],
+                                     np.uint32),
             "mal_member": np.full((n, cfg.k_malicious), EMPTY_U32, np.uint32),
             "conflicts": np.array([p.conflicts for p in self.peers],
                                   np.uint32),
@@ -1254,6 +1306,13 @@ class OracleSim:
                 out["auth_member"][i, j] = row.member
                 out["auth_mask"][i, j] = row.mask
                 out["auth_gt"][i, j] = row.gt
+            for j, (rec, since) in enumerate(p.delay):
+                out["dly_gt"][i, j] = rec.gt
+                out["dly_member"][i, j] = rec.member
+                out["dly_meta"][i, j] = rec.meta
+                out["dly_payload"][i, j] = rec.payload
+                out["dly_aux"][i, j] = rec.aux
+                out["dly_since"][i, j] = since
             for j, mb in enumerate(p.mal):
                 out["mal_member"][i, j] = mb
         return out

@@ -50,6 +50,9 @@ class Stats:
     #   pipeline (Timeline permission or sequence-order violations —
     #   reference: statistics.py drop counts from check_callback outcomes)
     msgs_direct: jnp.ndarray      # u32[N] DirectDistribution records received
+    msgs_delayed: jnp.ndarray     # u32[N] records parked awaiting a
+    #   permission proof (reference: statistics.py delay counts from
+    #   check_callback DelayMessageByProof outcomes; config.delay_inbox)
     # Double-signed flow counters (reference: statistics.py counts
     # signature-request/-response traffic; SURVEY §3.5):
     sig_signed: jnp.ndarray       # u32[N] countersignatures granted (B side)
@@ -112,6 +115,17 @@ class PeerState:
     #      member bookkeeping; config.malicious_enabled) ----
     mal_member: jnp.ndarray      # u32[N, Bm], EMPTY_U32 = free slot
 
+    # ---- delayed-message pen [N, D] (reference: message.py
+    #      DelayMessageByProof — records waiting for their permission
+    #      proof re-enter the intake batch each round; in-memory only,
+    #      dies with the process on churn; config.delay_inbox) ----
+    dly_gt: jnp.ndarray       # u32, EMPTY_U32 = free slot
+    dly_member: jnp.ndarray   # u32
+    dly_meta: jnp.ndarray     # u32
+    dly_payload: jnp.ndarray  # u32
+    dly_aux: jnp.ndarray      # u32
+    dly_since: jnp.ndarray    # u32 round the record was first parked
+
     # ---- outstanding signature request (reference: requestcache.py — the
     #      dispersy-signature-request cache entry; one in flight per peer,
     #      sent once, freed on response or timeout) ----
@@ -140,6 +154,7 @@ def init_stats(n: int, n_meta: int = 8) -> Stats:
     return Stats(walk_success=z(), walk_fail=z(), msgs_stored=z(),
                  msgs_dropped=z(), requests_dropped=z(), punctures=z(),
                  msgs_forwarded=z(), msgs_rejected=z(), msgs_direct=z(),
+                 msgs_delayed=z(),
                  sig_signed=z(), sig_done=z(), sig_expired=z(),
                  conflicts=z(),
                  bytes_up=z(), bytes_down=z(),
@@ -179,6 +194,12 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         fwd_meta=jnp.full((n, f), EMPTY_U32, jnp.uint32),
         fwd_payload=jnp.full((n, f), EMPTY_U32, jnp.uint32),
         fwd_aux=jnp.full((n, f), EMPTY_U32, jnp.uint32),
+        dly_gt=jnp.full((n, config.delay_inbox), EMPTY_U32, jnp.uint32),
+        dly_member=jnp.full((n, config.delay_inbox), EMPTY_U32, jnp.uint32),
+        dly_meta=jnp.full((n, config.delay_inbox), EMPTY_U32, jnp.uint32),
+        dly_payload=jnp.full((n, config.delay_inbox), EMPTY_U32, jnp.uint32),
+        dly_aux=jnp.zeros((n, config.delay_inbox), jnp.uint32),
+        dly_since=jnp.zeros((n, config.delay_inbox), jnp.uint32),
         auth_member=jnp.full((n, a), EMPTY_U32, jnp.uint32),
         auth_mask=jnp.zeros((n, a), jnp.uint32),
         auth_gt=jnp.zeros((n, a), jnp.uint32),

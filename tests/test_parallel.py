@@ -56,6 +56,29 @@ def test_sharded_step_matches_single_device(cfg):
         assert jnp.array_equal(a, b), "sharding changed a result"
 
 
+def test_sharded_step_matches_single_device_full_features():
+    """Same bit-equality contract with every subsystem compiled in:
+    timeline + delay pen + double-signed + malicious bookkeeping (the
+    pen's [N, D] arrays and the auth/sig/mal tables must all shard on the
+    peer axis without changing any outcome)."""
+    fcfg = CommunityConfig(
+        n_peers=64, n_trackers=2, k_candidates=8, msg_capacity=32,
+        bloom_capacity=32, request_inbox=4, tracker_inbox=32,
+        response_budget=8, churn_rate=0.05, packet_loss=0.2,
+        timeline_enabled=True, protected_meta_mask=0b10, n_meta=8,
+        k_authorized=8, delay_inbox=2, double_meta_mask=0b100,
+        malicious_enabled=True)
+    single = _prepared(fcfg)
+    mesh = make_mesh(8)
+    sharded = shard_state(_prepared(fcfg), mesh, fcfg.n_peers)
+    for _ in range(2):
+        single = engine.step(single, fcfg)
+        sharded = engine.step(sharded, fcfg)
+        jax.block_until_ready(sharded)
+    for a, b in zip(jax.tree.leaves(single), jax.tree.leaves(sharded)):
+        assert jnp.array_equal(a, b), "sharding changed a result"
+
+
 def test_sharding_layout(cfg):
     mesh = make_mesh(4)
     state = shard_state(_prepared(cfg), mesh, cfg.n_peers)

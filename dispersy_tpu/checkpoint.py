@@ -115,12 +115,18 @@ def restore(path: str, cfg: CommunityConfig,
         # convictions all die with the process, exactly as the engine's
         # churn rebirth models.
         n, k, d = cfg.n_peers, cfg.k_candidates, cfg.delay_inbox
+        f = cfg.forward_buffer
         never = np.full((n, k), NEVER, np.float32)
         state = state.replace(
             cand_peer=np.full((n, k), NO_PEER, np.int32),
             cand_last_walk=never,
             cand_last_stumble=never.copy(),
             cand_last_intro=never.copy(),
+            fwd_gt=np.full((n, f), EMPTY_U32, np.uint32),
+            fwd_member=np.full((n, f), EMPTY_U32, np.uint32),
+            fwd_meta=np.full((n, f), EMPTY_U32, np.uint32),
+            fwd_payload=np.full((n, f), EMPTY_U32, np.uint32),
+            fwd_aux=np.full((n, f), EMPTY_U32, np.uint32),
             sig_target=np.full((n,), NO_PEER, np.int32),
             sig_meta=np.zeros((n,), np.uint32),
             sig_payload=np.zeros((n,), np.uint32),

@@ -361,8 +361,13 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             sl = st.claim_slice_largest(stc.gt, cfg.bloom_capacity)
         in_slice = st.slice_mask(stc.gt, sl)                         # [N, M]
         rec_h = record_hash(stc.member, stc.gt, stc.meta, stc.payload)
+        # Per-round salt = the reference's per-claim filter prefix: a
+        # false positive this round is re-randomized next round, so pull
+        # repair converges to 100% even against static stores (see
+        # ops/bloom._h1_h2).  Round-synchronous, so the responder derives
+        # the identical salt from its own round counter.
         my_bloom = bloom.bloom_build(rec_h, in_slice, cfg.bloom_bits,
-                                     cfg.bloom_hashes)
+                                     cfg.bloom_hashes, salt=rnd)
     else:
         zu = jnp.zeros((n,), jnp.uint32)
         sl = st.SyncSlice(time_low=zu, time_high=zu, modulo=zu, offset=zu)
@@ -795,7 +800,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             if servable is not None:
                 in_sl = in_sl & servable
             present = bloom.bloom_query(rq_bloom[:, s], rec_h2,
-                                        cfg.bloom_bits, cfg.bloom_hashes)
+                                        cfg.bloom_bits, cfg.bloom_hashes,
+                                        salt=rnd)
             if cfg.timeline_enabled:
                 # A hard-killed responder answers every request with the
                 # destroy record UNCONDITIONALLY (reference:

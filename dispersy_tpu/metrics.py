@@ -128,6 +128,20 @@ class MetricsLog:
             for row in self.rows:
                 f.write(json.dumps(row) + "\n")
 
+    def dump_binary(self, path: str) -> None:
+        """Packed fixed-schema form (see :mod:`dispersy_tpu.binlog`) —
+        the experiment-rate format tool/ldecoder.py decodes in the
+        reference.  Scalar fields of the first row fix the schema;
+        non-scalar extras (e.g. accepted_by_meta) stay JSON-only."""
+        from dispersy_tpu import binlog
+        if not self.rows:
+            raise ValueError("nothing logged")
+        fields = [k for k, v in self.rows[0].items()
+                  if isinstance(v, (int, float)) and not isinstance(v, bool)]
+        with binlog.BinaryLog(path, fields, meta=self.meta) as log:
+            for row in self.rows:
+                log.append(row)
+
     def series(self, key: str) -> list:
         """One metric across rounds (curve extraction)."""
         return [row.get(key) for row in self.rows]

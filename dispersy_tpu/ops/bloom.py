@@ -22,46 +22,99 @@ The CPU oracle (:mod:`dispersy_tpu.oracle.bloom`) mirrors this bit-for-bit.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
-from dispersy_tpu.ops.hashing import BLOOM_SEED_1, BLOOM_SEED_2, hash_u32
+from dispersy_tpu.ops.hashing import (BLOOM_SALT_SEED, BLOOM_SEED_1,
+                                      BLOOM_SEED_2, hash_u32)
 
 
-def _h1_h2(item_hash: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+def _auto_impl(impl: str | None) -> str:
+    """Pick the kernel form: ``"compare"`` (broadcast-compare-reduce) on
+    TPU, ``"gather"`` (word gather / bitmap scatter) elsewhere.
+
+    The two forms are bit-identical; they differ only in what the backend
+    materializes.  On TPU the compare form fuses into the surrounding step
+    and runs at memory bandwidth, while gathers serialize (~40x slower,
+    module docstring).  On CPU the fusion does NOT happen: XLA:CPU
+    materializes the [..., M, W] compare tensor per hash function — at
+    config #3 scale (10k peers x M=1152 x W=77 x 7 hashes x 8 request
+    slots) that is a ~200 GB allocation, observed OOM — whereas the
+    gather/scatter forms stay at [..., M] / [..., bits].
+    """
+    if impl is not None:
+        return impl
+    return "compare" if jax.default_backend() == "tpu" else "gather"
+
+
+def _h1_h2(item_hash: jnp.ndarray,
+           salt=None) -> tuple[jnp.ndarray, jnp.ndarray]:
     """The double-hashing pair: h2 forced odd so successive probes never
     collapse when h2 would be 0 (and cycle through all residues when n_bits
-    is a power of two)."""
+    is a power of two).
+
+    ``salt`` re-randomizes the probe sequence per filter — the reference's
+    BloomFilter *prefix* (bloomfilter.py: every claimed sync filter
+    carries a fresh prefix so a false positive against one claim is not a
+    false positive against the next; without it a static store's missing
+    records can collide permanently and pull repair stalls short of
+    100%).  Build and query must use the same salt; the round index works
+    because the whole exchange is round-synchronous.  ``None`` = unsalted
+    (NOT equivalent to salt 0, which mixes hash(0) in — the distinction is
+    static, never data-dependent, so it traces).
+    """
     h = item_hash.astype(jnp.uint32)
+    if salt is not None:
+        h = h ^ hash_u32(jnp.asarray(salt, jnp.uint32), BLOOM_SALT_SEED)
     h1 = hash_u32(h, BLOOM_SEED_1)
     h2 = hash_u32(h, BLOOM_SEED_2) | jnp.uint32(1)
     return h1, h2
 
 
-def probe_bits(item_hash: jnp.ndarray, n_bits: int, n_hashes: int) -> jnp.ndarray:
+def probe_bits(item_hash: jnp.ndarray, n_bits: int, n_hashes: int,
+               salt=None) -> jnp.ndarray:
     """Bit indices probed for an item: shape ``item_hash.shape + (n_hashes,)``.
 
     Reference/oracle view of the probe sequence; the hot kernels below never
     materialize this axis (see module docstring).
     """
-    h1, h2 = _h1_h2(item_hash)
+    h1, h2 = _h1_h2(item_hash, salt)
     j = jnp.arange(n_hashes, dtype=jnp.uint32)
     idx = (h1[..., None] + j * h2[..., None]) % jnp.uint32(n_bits)
     return idx.astype(jnp.int32)
 
 
 def bloom_build(item_hashes: jnp.ndarray, mask: jnp.ndarray,
-                n_bits: int, n_hashes: int) -> jnp.ndarray:
+                n_bits: int, n_hashes: int,
+                impl: str | None = None, salt=None) -> jnp.ndarray:
     """Build packed filters from ``[..., M]`` item hashes under a mask.
 
     Returns ``uint32[..., n_bits // 32]``; leading dims are batch dims (one
     filter per row).  Masked-out items contribute no bits (the reference
     loops ``BloomFilter.add`` over the sync-slice SELECT; here the slice
-    mask plays that role).
+    mask plays that role).  ``impl``: None = per-backend auto
+    (:func:`_auto_impl`); ``"compare"`` / ``"gather"`` force a form — both
+    produce identical bits.
     """
     assert n_bits % 32 == 0, "n_bits must pack into uint32 words"
     w = n_bits // 32
+    h1, h2 = _h1_h2(item_hashes, salt)
+    if _auto_impl(impl) == "gather":
+        # Bitmap scatter: set bool bits at [..., n_bits], then pack.
+        # Duplicate probes just re-set the same bit; masked items aim at
+        # the trimmed spill column n_bits.
+        lead = item_hashes.shape[:-1]
+        flat = 1
+        for d in lead:
+            flat *= d
+        bits = jnp.zeros((flat, n_bits + 1), jnp.bool_)
+        rows = jnp.arange(flat)[:, None]
+        for j in range(n_hashes):
+            idx = (h1 + jnp.uint32(j) * h2) % jnp.uint32(n_bits)
+            tgt = jnp.where(mask, idx, jnp.uint32(n_bits))
+            bits = bits.at[rows, tgt.reshape(flat, -1)].set(True)
+        return pack_bits(bits[:, :n_bits]).reshape(*lead, w)
     w_ix = jnp.arange(w, dtype=jnp.uint32)                    # [W]
-    h1, h2 = _h1_h2(item_hashes)
     words = jnp.zeros(item_hashes.shape[:-1] + (w,), jnp.uint32)
     for j in range(n_hashes):
         idx = (h1 + jnp.uint32(j) * h2) % jnp.uint32(n_bits)  # [..., M]
@@ -87,22 +140,32 @@ def unpack_bits(words: jnp.ndarray) -> jnp.ndarray:
 
 
 def bloom_query(words: jnp.ndarray, item_hashes: jnp.ndarray,
-                n_bits: int, n_hashes: int) -> jnp.ndarray:
+                n_bits: int, n_hashes: int,
+                impl: str | None = None, salt=None) -> jnp.ndarray:
     """Membership test: ``words`` uint32[..., W], ``item_hashes`` [..., M]
     -> bool[..., M], batched over matching leading dims.
 
     Reference: ``BloomFilter.__contains__``.  True means *possibly present*
     (standard Bloom semantics: false positives at the configured error rate,
-    never false negatives).
+    never false negatives).  ``impl``/``salt`` as in :func:`bloom_build`.
     """
-    w_ix = jnp.arange(words.shape[-1], dtype=jnp.uint32)      # [W]
-    h1, h2 = _h1_h2(item_hashes)
+    h1, h2 = _h1_h2(item_hashes, salt)
     ok = jnp.ones(item_hashes.shape, jnp.bool_)
+    gather = _auto_impl(impl) == "gather"
+    w_ix = jnp.arange(words.shape[-1], dtype=jnp.uint32)      # [W]
     for j in range(n_hashes):
         idx = (h1 + jnp.uint32(j) * h2) % jnp.uint32(n_bits)  # [..., M]
-        # Select each item's word by broadcast-compare (no gather).
-        sel = jnp.sum(jnp.where((idx >> jnp.uint32(5))[..., None] == w_ix,
-                                words[..., None, :], jnp.uint32(0)),
-                      axis=-1, dtype=jnp.uint32)              # [..., M]
+        if gather:
+            # Per-item word fetch; row-local along the last axis, cheap
+            # where gathers are cheap.
+            sel = jnp.take_along_axis(
+                jnp.broadcast_to(words, idx.shape[:-1] + words.shape[-1:]),
+                (idx >> jnp.uint32(5)).astype(jnp.int32), axis=-1)
+        else:
+            # Select each item's word by broadcast-compare (no gather).
+            sel = jnp.sum(jnp.where(
+                (idx >> jnp.uint32(5))[..., None] == w_ix,
+                words[..., None, :], jnp.uint32(0)),
+                axis=-1, dtype=jnp.uint32)                    # [..., M]
         ok = ok & (((sel >> (idx & jnp.uint32(31))) & jnp.uint32(1)) == 1)
     return ok

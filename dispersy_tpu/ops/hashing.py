@@ -26,6 +26,11 @@ _C2 = 0xC2B2AE35
 # Domain-separation seeds for the two Bloom double-hashing streams.
 BLOOM_SEED_1 = 0x8F1BBCDC
 BLOOM_SEED_2 = 0xCA62C1D6
+# Seed for mixing the per-filter salt (the reference's BloomFilter
+# *prefix*: each claimed filter carries a fresh prefix byte so a false
+# positive is re-randomized per claim instead of being permanent —
+# reference: bloomfilter.py constructor prefix + community.py claim).
+BLOOM_SALT_SEED = 0x6ED9EBA1
 
 
 def fmix32(x: jnp.ndarray) -> jnp.ndarray:

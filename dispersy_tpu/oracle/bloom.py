@@ -15,6 +15,7 @@ _C1 = 0x85EBCA6B
 _C2 = 0xC2B2AE35
 BLOOM_SEED_1 = 0x8F1BBCDC
 BLOOM_SEED_2 = 0xCA62C1D6
+BLOOM_SALT_SEED = 0x6ED9EBA1
 
 
 def fmix32(x: int) -> int:
@@ -44,28 +45,38 @@ def record_hash(member: int, global_time: int, meta: int, payload: int) -> int:
     return h
 
 
-def probe_bits(item_hash: int, n_bits: int, n_hashes: int) -> list[int]:
-    h1 = hash_u32(item_hash, BLOOM_SEED_1)
-    h2 = hash_u32(item_hash, BLOOM_SEED_2) | 1
+def probe_bits(item_hash: int, n_bits: int, n_hashes: int,
+               salt: int | None = None) -> list[int]:
+    h = item_hash & M32
+    if salt is not None:
+        h ^= hash_u32(salt, BLOOM_SALT_SEED)
+    h1 = hash_u32(h, BLOOM_SEED_1)
+    h2 = hash_u32(h, BLOOM_SEED_2) | 1
     return [((h1 + j * h2) & M32) % n_bits for j in range(n_hashes)]
 
 
 class OracleBloom:
-    """Mirror of the packed-uint32 filter; reference: bloomfilter.py BloomFilter."""
+    """Mirror of the packed-uint32 filter; reference: bloomfilter.py
+    BloomFilter.  ``salt`` = the per-claim filter prefix (ops/bloom
+    ``_h1_h2`` salt), re-randomizing the probe sequence per filter."""
 
-    def __init__(self, n_bits: int, n_hashes: int) -> None:
+    def __init__(self, n_bits: int, n_hashes: int,
+                 salt: int | None = None) -> None:
         assert n_bits % 32 == 0
         self.n_bits = n_bits
         self.n_hashes = n_hashes
+        self.salt = salt
         self.bits = [False] * n_bits
 
     def add(self, item_hash: int) -> None:
-        for b in probe_bits(item_hash, self.n_bits, self.n_hashes):
+        for b in probe_bits(item_hash, self.n_bits, self.n_hashes,
+                            self.salt):
             self.bits[b] = True
 
     def __contains__(self, item_hash: int) -> bool:
         return all(self.bits[b]
-                   for b in probe_bits(item_hash, self.n_bits, self.n_hashes))
+                   for b in probe_bits(item_hash, self.n_bits,
+                                       self.n_hashes, self.salt))
 
     def words(self) -> list[int]:
         """Packed uint32 words, same layout as ops.bloom.pack_bits."""

@@ -639,7 +639,10 @@ class OracleSim:
         if cfg.sync_enabled:
             for i, p in enumerate(self.peers):
                 sl = self._claim_slice(i)
-                bloom = OracleBloom(cfg.bloom_bits, cfg.bloom_hashes)
+                # Per-round salt = the per-claim filter prefix (engine
+                # passes salt=rnd to bloom_build/bloom_query).
+                bloom = OracleBloom(cfg.bloom_bits, cfg.bloom_hashes,
+                                    salt=rnd)
                 for rec in p.store:
                     if self._in_slice(rec, sl):
                         bloom.add(rec.hash())

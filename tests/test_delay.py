@@ -161,5 +161,6 @@ def test_checkpoint_roundtrip_with_pen():
     assert (np.asarray(restart.dly_gt) == EMPTY_U32).all()
     assert (np.asarray(restart.sig_target) == -1).all()
     assert (np.asarray(restart.mal_member) == EMPTY_U32).all()
+    assert (np.asarray(restart.fwd_gt) == EMPTY_U32).all()
     np.testing.assert_array_equal(np.asarray(restart.store_gt),
                                   np.asarray(state.store_gt))

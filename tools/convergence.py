@@ -64,6 +64,7 @@ def broadcast_curve(n_peers: int = 10_000, degree: int = 8,
         cov = float(engine.coverage(state, member=author, gt=gt, meta=1,
                                     payload=42))
         curve.append(round(cov, 6))
+        print(f"round {rnd}: coverage {cov:.4f}", file=sys.stderr, flush=True)
         if rounds_to_target is None and cov >= target:
             rounds_to_target = rnd
             break
@@ -124,6 +125,8 @@ def backlog_curve(n_peers: int = 100_000, backlog: int = 1000,
         state = engine.step(state, cfg)
         cov = corpus_coverage(state)
         curve.append(round(cov, 6))
+        print(f"round {rnd}: corpus coverage {cov:.4f}", file=sys.stderr,
+              flush=True)
         if rounds_to_target is None and cov >= target:
             rounds_to_target = rnd
             break
@@ -140,17 +143,77 @@ def backlog_curve(n_peers: int = 100_000, backlog: int = 1000,
     }
 
 
+def walker_churn_health(n_peers: int = 1_000_000, churn: float = 0.05,
+                        rounds: int = 60, seed: int = 0,
+                        dispatch: str = "per-call") -> dict:
+    """Config #4: 1M-peer walker-only discovery under 5%/round churn.
+
+    No sync — the metric is walker health: does the overlay keep itself
+    connected (verified-candidate occupancy, walk success rate) while 5%
+    of peers are reborn with wiped state every round, and at what
+    rounds/sec.  The reference's equivalent is its deployed-overlay
+    behavior under real churn (SURVEY §5.3); this makes it a reproducible
+    artifact.
+    """
+    cfg = CommunityConfig(
+        n_peers=n_peers, n_trackers=max(4, n_peers // 65536),
+        k_candidates=16, sync_enabled=False, forward_fanout=0,
+        request_inbox=8, tracker_inbox=max(256, n_peers // 256),
+        churn_rate=churn, msg_capacity=1, bloom_capacity=32)
+    state = init_state(cfg, jax.random.PRNGKey(seed))
+    state = engine.seed_overlay(state, cfg, degree=8)
+    t0 = time.perf_counter()
+    if dispatch == "multi":
+        # One lax.fori_loop dispatch — the true device-throughput number
+        # on a directly-attached TPU.  NOT the default because this
+        # environment's axon TPU tunnel executes fori_loop pathologically
+        # (per-iteration host round-trips; faults at 1M peers — BENCH.md
+        # dispatch-overhead study), so per-call async stepping is the
+        # honest sustained-throughput measurement here.
+        state = engine.multi_step(state, cfg, rounds)
+    else:
+        for _ in range(rounds):
+            state = engine.step(state, cfg)
+    jax.block_until_ready(state)
+    wall = time.perf_counter() - t0
+    members = ~np.asarray(state.is_tracker)
+    cand_fill = float(np.mean(
+        (np.asarray(state.cand_peer)[members] >= 0).sum(axis=1))
+        / cfg.k_candidates)
+    ws = np.asarray(state.stats.walk_success, np.uint64).sum()
+    wf = np.asarray(state.stats.walk_fail, np.uint64).sum()
+    return {
+        "config": "walker_churn_cfg4",
+        "n_peers": n_peers, "churn_rate": churn, "rounds_run": rounds,
+        "seed": seed, "dispatch": dispatch,
+        "rounds_per_sec": round(rounds / wall, 2),
+        "candidate_fill": round(cand_fill, 4),
+        "walk_success_rate": round(float(ws) / max(float(ws + wf), 1), 4),
+        "wall_seconds": round(wall, 2),
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", type=int, choices=(2, 3), required=True)
+    ap.add_argument("--config", type=int, choices=(2, 3, 4), required=True)
     ap.add_argument("--out", type=str, default=None)
     ap.add_argument("--scale", type=float, default=1.0,
                     help="population scale factor (CPU-sized runs)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--dispatch", choices=("per-call", "multi"),
+                    default="per-call",
+                    help="config #4 stepping: 'multi' = one fused "
+                         "lax.fori_loop dispatch (directly-attached TPU); "
+                         "'per-call' = async per-round dispatch (default; "
+                         "required on the axon tunnel, see BENCH.md)")
     args = ap.parse_args()
     if args.config == 2:
         out = broadcast_curve(n_peers=int(10_000 * args.scale),
                               seed=args.seed)
+    elif args.config == 4:
+        out = walker_churn_health(n_peers=int(1_000_000 * args.scale),
+                                  seed=args.seed, dispatch=args.dispatch)
     else:
         out = backlog_curve(n_peers=int(100_000 * args.scale),
                             backlog=int(1000 * min(args.scale * 10, 1.0)),

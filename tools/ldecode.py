@@ -1,0 +1,35 @@
+"""Decode a binary round log to JSONL (reference: tool/ldecoder.py).
+
+Usage:
+    python tools/ldecode.py artifacts/run.binlog            # rows as JSONL
+    python tools/ldecode.py artifacts/run.binlog --meta     # header only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dispersy_tpu import binlog
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path")
+    ap.add_argument("--meta", action="store_true",
+                    help="print only the metadata header")
+    args = ap.parse_args()
+    meta, rows = binlog.decode(args.path)
+    if args.meta:
+        print(json.dumps(meta))
+        return
+    for row in rows:
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

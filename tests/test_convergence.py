@@ -12,7 +12,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "tools"))
 
-from convergence import backlog_curve, broadcast_curve
+from convergence import backlog_curve, broadcast_curve, walker_churn_health
 
 
 def test_broadcast_curve_shape():
@@ -34,3 +34,17 @@ def test_backlog_curve_reaches_target_small():
     assert out["rounds_to_target"] is not None, out["curve"][-5:]
     curve = out["curve"]
     assert all(b >= a - 1e-6 for a, b in zip(curve, curve[1:]))
+
+
+def test_walker_churn_health_small():
+    """Config #4's shape: under 5%/round churn the walker keeps the
+    overlay healthy — candidate tables mostly full, walks succeeding —
+    and both dispatch modes agree on the health numbers (multi_step is
+    bit-identical to per-call stepping)."""
+    a = walker_churn_health(n_peers=512, churn=0.05, rounds=40)
+    assert a["candidate_fill"] > 0.5, a
+    assert a["walk_success_rate"] > 0.9, a
+    b = walker_churn_health(n_peers=512, churn=0.05, rounds=40,
+                            dispatch="multi")
+    assert b["candidate_fill"] == a["candidate_fill"]
+    assert b["walk_success_rate"] == a["walk_success_rate"]

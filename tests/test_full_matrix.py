@@ -1,0 +1,140 @@
+"""The full policy matrix in ONE overlay: every feature on, trace-equal.
+
+The reference's DebugCommunity declares one message per policy combination
+so every (authentication x resolution x distribution x destination) cell
+is exercised together (reference: tests/debugcommunity/community.py).
+The pairwise feature tests elsewhere each isolate one axis; this test is
+the everything-on run — two communities multiplexed, all four policy
+axes, the timeline with a dynamic flip, the delay pen, double-signing,
+LastSync eviction, sequence chains, DESC priorities, direct delivery,
+malicious bookkeeping, churn, loss, and a destroy-community ending —
+checked bit-for-bit against the oracle every round.  Interaction bugs
+between subsystems have nowhere to hide but here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispersy_tpu import engine as E
+from dispersy_tpu import state as S
+from dispersy_tpu.config import (META_AUTHORIZE, META_DESTROY, META_DYNAMIC,
+                                 CommunityConfig)
+from dispersy_tpu.oracle import sim as O
+
+from test_oracle import assert_match
+
+#  meta 0: public FullSync          meta 4: DirectDistribution
+#  meta 1: Linear-protected FullSync meta 5: DESC FullSync, priority 200
+#  meta 2: DoubleMember + Dynamic    meta 6: FullSync + sequence numbers
+#  meta 3: LastSync(history=2)       meta 7: public FullSync (spare)
+CFG = CommunityConfig(
+    n_peers=26, n_trackers=2, communities=((13, 1), (11, 1)),
+    msg_capacity=48, bloom_capacity=16, k_candidates=8, request_inbox=4,
+    tracker_inbox=8, response_budget=6,
+    n_meta=8, timeline_enabled=True, k_authorized=8,
+    protected_meta_mask=0b0000010, dynamic_meta_mask=0b0000100,
+    double_meta_mask=0b0000100, sig_inbox=2, countersign_rate=1.0,
+    last_sync_history=(0, 0, 0, 2, 0, 0, 0, 0),
+    direct_meta_mask=0b0010000,
+    desc_meta_mask=0b0100000,
+    meta_priority=(128, 128, 128, 128, 128, 200, 128, 128),
+    seq_meta_mask=0b1000000,
+    delay_inbox=2, delay_timeout=26.0,
+    malicious_enabled=True, k_malicious=4,
+    churn_rate=0.04, packet_loss=0.12)
+
+F0, F1 = 2, 15        # per-community founders (first member rows)
+
+
+def _create(state, oracle, author, meta, payload, aux=0):
+    mask = np.arange(CFG.n_peers) == author
+    pl = np.full(CFG.n_peers, payload, np.uint32)
+    ax = np.full(CFG.n_peers, aux, np.uint32)
+    state = E.create_messages(state, CFG, jnp.asarray(mask), meta,
+                              jnp.asarray(pl), jnp.asarray(ax))
+    oracle.create_messages(mask, meta, pl, aux=ax)
+    return state
+
+
+def _sig_request(state, oracle, author, meta, counterparty, payload):
+    mask = np.arange(CFG.n_peers) == author
+    cp = np.full(CFG.n_peers, counterparty, np.int32)
+    pl = np.full(CFG.n_peers, payload, np.uint32)
+    state = E.create_signature_request(state, CFG, jnp.asarray(mask), meta,
+                                       jnp.asarray(cp), jnp.asarray(pl))
+    oracle.create_signature_request(mask, meta, cp, pl)
+    return state
+
+
+def test_everything_on_trace_equality():
+    comm_layout, _, _, mem_base, _ = CFG.layout()
+    assert int(mem_base[F0]) == F0 and int(mem_base[F1]) == F1
+
+    state = S.init_state(CFG, jax.random.PRNGKey(11))
+    oracle = O.OracleSim(CFG, np.asarray(state.key))
+    state = E.seed_overlay(state, CFG, degree=4)
+    oracle.seed_overlay(degree=4)
+
+    events = {
+        # founders authorize one member each for the protected meta 1
+        0: [("create", F0, META_AUTHORIZE, 5, 0b10),
+            ("create", F1, META_AUTHORIZE, 18, 0b10)],
+        # bulk public traffic in both blocks
+        1: [("create", 6, 0, 1001, 0), ("create", 19, 0, 2001, 0)],
+        # sequence chain (meta 6): three in-order records by peer 7
+        2: [("create", 7, 6, 600, 0)],
+        3: [("create", 7, 6, 601, 0), ("create", 5, 1, 1111, 0)],
+        4: [("create", 7, 6, 602, 0),
+            # LastSync (meta 3): three records, keep-last-2
+            ("create", 8, 3, 300, 0)],
+        5: [("create", 8, 3, 301, 0), ("create", 18, 1, 2222, 0)],
+        6: [("create", 8, 3, 302, 0),
+            # direct one-shot (meta 4) + DESC high-priority (meta 5)
+            ("create", 9, 4, 400, 0), ("create", 20, 5, 500, 0)],
+        # double-signed draft (meta 2, dynamic, initially public)
+        7: [("sig", 10, 2, 11, 7000)],
+        # founder flips meta 2 to Linear from its flip's global time on
+        9: [("create", F0, META_DYNAMIC, 2, 1)],
+        # a second draft after the flip: both signers now need permits
+        # (they don't have them -> countersigner refuses; cache expires)
+        11: [("sig", 10, 2, 12, 7001)],
+        # community 1 dies; community 0 must keep running
+        13: [("create", F1, META_DESTROY, 0, 0)],
+    }
+
+    for rnd in range(20):
+        for ev in events.get(rnd, []):
+            if ev[0] == "create":
+                state = _create(state, oracle, *ev[1:])
+            else:
+                state = _sig_request(state, oracle, *ev[1:])
+        state = E.step(state, CFG)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+
+    # The run exercised what it claims: every subsystem visibly fired
+    # (trace equality alone would also pass if both sides no-opped a
+    # feature; these counters rule that out).  Malicious bookkeeping is
+    # compiled in but no double-sign attack is staged, so conflicts
+    # stays 0 by design (conviction itself is pinned in test_malicious).
+    stats = state.stats
+    meta_cols = np.asarray(state.store_meta)
+    assert (meta_cols == 0).any() and (meta_cols == 6).any()
+    assert (meta_cols == 1).any()                   # protected meta spread
+    assert (meta_cols == META_DYNAMIC).any()        # the flip record spread
+    assert int(jnp.sum(stats.msgs_direct)) > 0      # direct received
+    assert int(jnp.sum(stats.sig_done)) > 0         # double-signed done
+    assert int(jnp.sum(stats.msgs_delayed)) > 0     # pen parked something
+    assert int(jnp.sum(stats.msgs_rejected)) > 0    # check pipeline refused
+    # LastSync keep-last-2: peer 8 authored three meta-3 records; the
+    # maximum anyone holds is exactly 2 (0 would mean the feature never
+    # ran; 3 would mean eviction failed)
+    m3 = (meta_cols == 3) & (np.asarray(state.store_member) == 8)
+    assert m3.sum(axis=1).max() == 2
+    # destroy spread: most of community 1 is hard-killed, community 0 not
+    killed = np.asarray(E.killed_mask(state.store_meta))
+    c1_members = (comm_layout == 1) & ~np.asarray(state.is_tracker)
+    c0_members = (comm_layout == 0) & ~np.asarray(state.is_tracker)
+    assert killed[c1_members].mean() > 0.5
+    assert killed[c0_members].sum() == 0

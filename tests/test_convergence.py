@@ -12,7 +12,8 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "tools"))
 
-from convergence import backlog_curve, broadcast_curve, walker_churn_health
+from convergence import (backlog_curve, broadcast_curve,
+                         communities_timeline_curve, walker_churn_health)
 
 
 def test_broadcast_curve_shape():
@@ -34,6 +35,16 @@ def test_backlog_curve_reaches_target_small():
     assert out["rounds_to_target"] is not None, out["curve"][-5:]
     curve = out["curve"]
     assert all(b >= a - 1e-6 for a, b in zip(curve, curve[1:]))
+
+
+def test_communities_timeline_curve_small():
+    """Config #5's shape: 8 communities x timeline-protected broadcast;
+    the WORST community reaches target (the authorize record must
+    out-run or release the protected record in every block)."""
+    out = communities_timeline_curve(n_peers=2048, n_communities=8,
+                                     max_rounds=80)
+    assert out["rounds_to_target"] is not None, out["curve"][-5:]
+    assert out["curve"][-1] >= 0.99
 
 
 def test_walker_churn_health_small():

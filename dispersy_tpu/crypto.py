@@ -282,9 +282,18 @@ def create_identities(state: PeerState, cfg: CommunityConfig,
     n = cfg.n_peers
     if mask is None:
         mask = jnp.arange(n) >= cfg.n_trackers
-    payload = jnp.asarray(registry.mid32_array(n))
-    return engine.create_messages(state, cfg, jnp.asarray(mask, bool),
-                                  meta=META_IDENTITY, payload=payload)
+    # Key derivation is a pure-Python modexp per member — derive mids for
+    # the MASKED rows only (unmasked rows' payload entries are never
+    # authored, so zeros are fine).  A full-population mask still pays
+    # n_peers derivations; that is the real cost of a full-population
+    # join, not overhead.
+    mask_np = np.asarray(mask, bool)
+    rows = np.flatnonzero(mask_np)
+    payload = np.zeros(n, np.uint32)
+    payload[rows] = [registry.member(int(i)).mid32 for i in rows]
+    return engine.create_messages(state, cfg, jnp.asarray(mask_np),
+                                  meta=META_IDENTITY,
+                                  payload=jnp.asarray(payload))
 
 
 def verify_identities(state: PeerState, cfg: CommunityConfig,

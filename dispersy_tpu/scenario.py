@@ -111,6 +111,16 @@ class DynamicSettings:
 
 
 @dataclasses.dataclass
+class Identity:
+    """Masked members publish dispersy-identity records (crypto.py
+    create_identities: payload = mid32 from the member registry; the
+    scenario's registry is derived from the config's peer count).
+    ``peers=None`` = every non-tracker member — see create_identities'
+    caveat about mass same-gt joins saturating the Bloom slice."""
+    peers: object = None
+
+
+@dataclasses.dataclass
 class Destroy:
     """Founder hard-kills the community."""
 
@@ -135,7 +145,8 @@ class Scenario:
     snapshot_every: int = 1
 
 
-def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict):
+def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
+           ctx: dict):
     founder = cfg.founder
     if isinstance(ev, Create):
         m = _mask(cfg, ev.authors)
@@ -182,6 +193,15 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict):
         state = engine.create_messages(
             state, cfg, _mask(cfg, founder), META_DYNAMIC,
             _full(cfg, ev.meta), _full(cfg, int(ev.linear)))
+    elif isinstance(ev, Identity):
+        from dispersy_tpu import crypto
+        # One registry per run: derived members are cached across events
+        # (staggered-join scenarios re-use earlier derivations).
+        registry = ctx.setdefault(
+            "registry", crypto.MemberRegistry(n_peers=cfg.n_peers))
+        state = crypto.create_identities(
+            state, cfg, registry,
+            mask=None if ev.peers is None else _mask(cfg, ev.peers))
     elif isinstance(ev, Destroy):
         state = engine.create_messages(
             state, cfg, _mask(cfg, founder), META_DESTROY,
@@ -220,12 +240,19 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
             raise ValueError(
                 f"event {ev!r} scheduled at round {rnd}, outside the "
                 f"scenario's [0, {scenario.rounds}) range")
+        if isinstance(ev, Identity) and not cfg.identity_enabled:
+            # Fail before round 0, not when the event's round is reached
+            # — a late crash wastes every compiled round before it.
+            raise ValueError(
+                f"Identity event at round {rnd} requires "
+                "config.identity_enabled=True")
         by_round.setdefault(int(rnd), []).append(ev)
     tracked: dict[str, tuple] = {}
+    ctx: dict = {}
 
     for rnd in range(scenario.rounds):
         for ev in by_round.get(rnd, ()):
-            state, cfg = _apply(state, cfg, ev, tracked)
+            state, cfg = _apply(state, cfg, ev, tracked, ctx)
         state = engine.step(state, cfg)
         if rnd % scenario.snapshot_every == 0:
             covs = {f"cov_{label}": float(engine.coverage(state, *spec))

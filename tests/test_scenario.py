@@ -66,6 +66,24 @@ def test_scenario_end_to_end(tmp_path):
     assert int(mid.round_index) == 18
 
 
+def test_identity_event():
+    """The Identity event floods mid32-payload identity records that
+    verify against the real member registry (crypto conformance bridge)."""
+    from dispersy_tpu import crypto
+    from dispersy_tpu.config import META_IDENTITY
+    cfg = CFG.replace(timeline_enabled=False, protected_meta_mask=0,
+                      identity_enabled=True, n_peers=24, tracker_inbox=8)
+    sc = S.Scenario(rounds=12, events=[(0, S.Identity(peers=[5, 6, 7]))])
+    state, log = S.run(cfg, sc)
+    meta = np.asarray(state.store_meta)
+    assert (meta == META_IDENTITY).any()
+    registry = crypto.MemberRegistry(n_peers=cfg.n_peers)
+    assert crypto.verify_identities(state, cfg, registry) == 1.0
+    # the flood spread beyond the three authors
+    holders = ((meta == META_IDENTITY).any(axis=1)).sum()
+    assert holders > 6
+
+
 def test_scenario_cli(tmp_path):
     doc = {
         "config": {"n_peers": 32, "n_trackers": 2, "msg_capacity": 16,

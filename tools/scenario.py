@@ -44,6 +44,7 @@ EVENT_TYPES = {
     "revoke": S.Revoke,
     "undo": S.Undo,
     "dynamic_settings": S.DynamicSettings,
+    "identity": S.Identity,
     "destroy": S.Destroy,
     "set_fault": S.SetFault,
     "checkpoint": S.Checkpoint,

@@ -1,0 +1,47 @@
+#!/bin/bash
+# TPU artifact sweep — run when the axon tunnel is up.
+#
+# Serializes every TPU-touching run (only one process may hold the tunnel
+# grant; a killed holder wedges it) and bounds each with a timeout so a
+# wedged tunnel cannot stall the sweep. Artifacts land in artifacts/
+# with a _tpu suffix; each tool falls back to CPU or emits an error JSON
+# rather than hanging.
+#
+# Usage:  bash tools/tpu_runs.sh        # from the repo root
+
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts
+
+probe() {
+  timeout 120 python - <<'EOF'
+import jax, time
+t0 = time.time()
+d = jax.devices()[0]
+print(f"tpu probe ok: {d} ({time.time()-t0:.1f}s)")
+EOF
+}
+
+echo "== probe =="
+if ! probe; then
+  echo "TPU tunnel unreachable (probe hung/failed) — aborting sweep" >&2
+  exit 1
+fi
+
+echo "== bench (headline rounds/sec @ 1M peers) =="
+timeout 2000 python bench.py | tee artifacts/bench_tpu_manual.json
+
+echo "== config 3: 100k-peer bloom-sync, 1k backlog =="
+timeout 2400 python tools/convergence.py --config 3 \
+  --out artifacts/convergence_cfg3_tpu.json
+
+echo "== config 4: 1M-peer walker churn =="
+timeout 2400 python tools/convergence.py --config 4 \
+  --out artifacts/walker_churn_cfg4_tpu.json
+
+echo "== config 5: 1M peers x 8 communities + timeline =="
+timeout 2400 python tools/convergence.py --config 5 \
+  --out artifacts/communities_timeline_cfg5_tpu.json
+
+echo "== done; artifacts: =="
+ls -la artifacts/*tpu*

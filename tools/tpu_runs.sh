@@ -15,9 +15,14 @@ mkdir -p artifacts
 
 probe() {
   timeout 120 python - <<'EOF'
-import jax, time
+import sys, time
+import jax
 t0 = time.time()
 d = jax.devices()[0]
+if d.platform != "tpu":
+    print(f"probe resolved {d} (platform={d.platform!r}), not a TPU — "
+          "artifacts would be mislabeled", file=sys.stderr)
+    sys.exit(1)
 print(f"tpu probe ok: {d} ({time.time()-t0:.1f}s)")
 EOF
 }

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -131,20 +132,20 @@ def store_insert(store: StoreCols, new: StoreCols,
     # Also guard against EMPTY sentinel gt arriving as a "new" record.
     n_new_valid = count_valid(masked.gt)
 
-    cat = StoreCols(*(jnp.concatenate([a, b], axis=-1)
-                      for a, b in zip(store, masked)))
-    origin = jnp.concatenate(
-        [jnp.zeros_like(store.gt), jnp.ones_like(masked.gt)], axis=-1)
-
-    # Lexicographic sort; origin as 3rd key makes the existing entry the
-    # first of any (gt, member) duplicate group regardless of its
-    # (meta, payload) relative to the duplicate's.  aux is a key too:
-    # lax.sort is not stable, so two same-keyed records differing only in
-    # aux must still order deterministically for the oracle to replay.
-    gt, member, origin, meta, payload, aux, flags = lax.sort(
-        (cat.gt, cat.member, origin, cat.meta, cat.payload, cat.aux,
-         cat.flags),
-        dimension=-1, num_keys=6)
+    # Form choice is backend- and width-dependent, same pattern (and same
+    # measurements) as ops/bloom._auto_impl: TPU sorts are bitonic
+    # (O(w log² w), 7 operands) while its compare broadcasts fuse onto
+    # the VPU — merge wins at large widths; XLA:CPU sorts cheaply and
+    # MATERIALIZES the [N, B, M] compare tensors — sort wins there
+    # (measured: config #3 CPU run 204 s sort vs 319 s merge, identical
+    # outputs).  Both forms are bit-identical (cross-form tests).
+    if (store.gt.shape[-1] + masked.gt.shape[-1] > 128
+            and jax.default_backend() == "tpu"):
+        gt, member, origin, meta, payload, aux, flags = \
+            _merge_ordered(store, masked)
+    else:
+        gt, member, origin, meta, payload, aux, flags = \
+            _sort_ordered(store, masked)
 
     dup = jnp.zeros_like(gt, dtype=bool).at[..., 1:].set(
         (gt[..., 1:] == gt[..., :-1]) & (member[..., 1:] == member[..., :-1])
@@ -189,6 +190,68 @@ def store_insert(store: StoreCols, new: StoreCols,
     return InsertResult(store=out, n_inserted=n_inserted,
                         n_dropped=n_new_valid - n_inserted,
                         n_evicted=n_before - n_surviving_old)
+
+
+def _sort_ordered(store: StoreCols, masked: StoreCols):
+    """SORT form of the merge step (small stores): one lexicographic sort
+    over the concatenation.  Origin as 3rd key makes the existing entry
+    the first of any (gt, member) duplicate group regardless of its
+    (meta, payload) relative to the duplicate's.  aux is a key too:
+    lax.sort is not stable, so two same-keyed records differing only in
+    aux must still order deterministically for the oracle to replay."""
+    cat = StoreCols(*(jnp.concatenate([a, b], axis=-1)
+                      for a, b in zip(store, masked)))
+    origin = jnp.concatenate(
+        [jnp.zeros_like(store.gt), jnp.ones_like(masked.gt)], axis=-1)
+    return lax.sort(
+        (cat.gt, cat.member, origin, cat.meta, cat.payload, cat.aux,
+         cat.flags),
+        dimension=-1, num_keys=6)
+
+
+def _merge_ordered(store: StoreCols, masked: StoreCols):
+    """MERGE form (large stores), bit-identical to :func:`_sort_ordered`.
+
+    The store side is already sorted — the round invariant — so only the
+    [N, B] batch needs a sort; each side's output position is its own
+    rank plus a compare-and-count against the other side ([N, B, M]
+    reduces, the same shape class as the engine's in_store test).
+    Replaces the O((M+B) log²(M+B)) 7-operand bitonic sort with O(M·B)
+    fusable compares + two scatters — the store path's cost becomes
+    linear in capacity.  Ties between store and batch resolve
+    store-first, exactly what the sort form's origin key encodes; the
+    cross-form equality test and every oracle trace pin the identity.
+    """
+    b_gt, b_member, b_meta, b_payload, b_aux, b_flags = lax.sort(
+        (masked.gt, masked.member, masked.meta, masked.payload,
+         masked.aux, masked.flags), dimension=-1, num_keys=5)
+    s_gt, s_member = store.gt, store.member
+    # ONE [N, B, M] compare: store_key <= batch_key (equality counts:
+    # batch sorts after).  Its complement is batch_key < store_key, so
+    # both sides' counts come from the same tensor.
+    s_le_b = ((s_gt[..., None, :] < b_gt[..., :, None])
+              | ((s_gt[..., None, :] == b_gt[..., :, None])
+                 & (s_member[..., None, :] <= b_member[..., :, None])))
+    pos_b = (jnp.arange(b_gt.shape[-1])[None, :]
+             + jnp.sum(s_le_b, axis=-1))                      # [N, B]
+    pos_s = (jnp.arange(s_gt.shape[-1])[None, :]
+             + jnp.sum(~s_le_b, axis=-2))                     # [N, M]
+    rows = jnp.arange(s_gt.shape[0])[:, None]
+    width = s_gt.shape[-1] + b_gt.shape[-1]
+
+    def interleave(s_col, b_col):
+        out = jnp.zeros((s_gt.shape[0], width), s_col.dtype)
+        out = out.at[rows, pos_s].set(s_col)
+        return out.at[rows, pos_b].set(b_col)
+    origin = jnp.zeros((s_gt.shape[0], width), s_gt.dtype
+                       ).at[rows, pos_b].set(1)
+    return (interleave(store.gt, b_gt),
+            interleave(store.member, b_member),
+            origin,
+            interleave(store.meta, b_meta),
+            interleave(store.payload, b_payload),
+            interleave(store.aux, b_aux),
+            interleave(store.flags, b_flags))
 
 
 class SyncSlice(NamedTuple):

@@ -173,3 +173,94 @@ def test_slice_mask_time_high_bound():
                      offset=jnp.asarray([0], jnp.uint32))
     mask = np.asarray(st.slice_mask(store.gt, s))[0]
     assert list(mask[:3]) == [False, True, False] and not mask[3:].any()
+
+
+def _random_store_batch(rng, n, m, b, fill_max=None):
+    """A valid (sorted, UNIQUE(gt,member)) store plus a messy batch —
+    duplicate keys within the batch, keys colliding with the store,
+    EMPTY holes, varying fill levels (bounded by ``fill_max``)."""
+    s_cols = [np.full((n, m), EMPTY_U32, np.uint32) for _ in range(4)]
+    s_aux = np.zeros((n, m), np.uint32)
+    s_flags = np.zeros((n, m), np.uint32)
+    keys_per_row = []
+    for i in range(n):
+        fill = rng.integers(0, (fill_max or m) + 1)
+        keys = set()
+        while len(keys) < fill:
+            keys.add((int(rng.integers(1, 30)), int(rng.integers(0, 10))))
+        keys_per_row.append(sorted(keys))
+        for j, (g, mem) in enumerate(keys_per_row[i]):
+            s_cols[0][i, j] = g
+            s_cols[1][i, j] = mem
+            s_cols[2][i, j] = rng.integers(0, 5)
+            s_cols[3][i, j] = rng.integers(0, 1000)
+            s_aux[i, j] = rng.integers(0, 50)
+            s_flags[i, j] = rng.integers(0, 2)
+    store = st.StoreCols(*(jnp.asarray(c) for c in s_cols),
+                         jnp.asarray(s_aux), jnp.asarray(s_flags))
+    b_cols = [np.zeros((n, b), np.uint32) for _ in range(4)]
+    b_aux = np.asarray(rng.integers(0, 50, (n, b)), np.uint32)
+    b_flags = np.asarray(rng.integers(0, 2, (n, b)), np.uint32)
+    b_cols[0][:] = rng.integers(1, 30, (n, b))   # gts overlapping store's
+    b_cols[1][:] = rng.integers(0, 10, (n, b))
+    b_cols[2][:] = rng.integers(0, 5, (n, b))
+    b_cols[3][:] = rng.integers(0, 1000, (n, b))
+    batch = st.StoreCols(*(jnp.asarray(c) for c in b_cols),
+                         jnp.asarray(b_aux), jnp.asarray(b_flags))
+    mask = jnp.asarray(rng.random((n, b)) < 0.8)
+    return store, batch, mask
+
+
+def test_merge_form_equals_sort_form():
+    """The merge-based ordered interleave (large-store path) must be
+    bit-identical to the lexicographic-sort form on every column,
+    including ties between store and batch, duplicate keys inside the
+    batch, and EMPTY holes on both sides."""
+    rng = np.random.default_rng(9)
+    for trial in range(6):
+        store, batch, mask = _random_store_batch(rng, n=16, m=12, b=7)
+        empty = jnp.uint32(EMPTY_U32)
+        masked = st.StoreCols(
+            gt=jnp.where(mask, batch.gt, empty),
+            member=jnp.where(mask, batch.member, empty),
+            meta=jnp.where(mask, batch.meta, empty),
+            payload=jnp.where(mask, batch.payload, empty),
+            aux=jnp.where(mask, batch.aux, 0),
+            flags=jnp.where(mask, batch.flags, 0))
+        got_sort = st._sort_ordered(store, masked)
+        got_merge = st._merge_ordered(store, masked)
+        for name, a, b in zip(
+                ("gt", "member", "origin", "meta", "payload", "aux",
+                 "flags"), got_sort, got_merge):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"trial {trial}: column {name}")
+
+
+def test_insert_same_result_both_widths():
+    """store_insert results are width-invariant: inserting identical
+    records into a small store and a large store (extra capacity = EMPTY
+    holes) yields the same record multiset and counters.  (On TPU the
+    wide shape additionally switches to the merge form — whose
+    bit-identity to the sort form test_merge_form_equals_sort_form pins
+    directly, on every backend.)"""
+    rng = np.random.default_rng(10)
+    # capacity 30 with at most 10 filled: neither width can overflow, so
+    # the two paths must produce the same record multiset
+    store_s, batch, mask = _random_store_batch(rng, n=8, m=30, b=6,
+                                               fill_max=10)
+    pad = 130   # wide enough to cross store_insert's width threshold
+    wide = st.StoreCols(
+        *(jnp.concatenate(
+            [c, jnp.full((8, pad - 30), EMPTY_U32, jnp.uint32)], axis=1)
+          for c in (store_s.gt, store_s.member, store_s.meta,
+                    store_s.payload)),
+        jnp.concatenate([store_s.aux, jnp.zeros((8, pad - 30), jnp.uint32)],
+                        axis=1),
+        jnp.concatenate([store_s.flags,
+                         jnp.zeros((8, pad - 30), jnp.uint32)], axis=1))
+    res_small = st.store_insert(store_s, batch, mask)
+    res_wide = st.store_insert(wide, batch, mask)
+    assert store_as_sets(res_small.store) == store_as_sets(res_wide.store)
+    np.testing.assert_array_equal(np.asarray(res_small.n_inserted),
+                                  np.asarray(res_wide.n_inserted))

@@ -35,9 +35,39 @@ _REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 # TPU tunnel wedges during backend init (observed: >120 s hang).
 TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
 CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
+# The tunnel is intermittently up; one attempt per round wasted the r01/r02
+# captures.  Bounded retries with linear backoff, under one overall
+# deadline: whatever happens, the CPU fallback still gets its full
+# CPU_TIMEOUT_S inside TOTAL_BUDGET_S, so the driver always receives its
+# JSON line within ~TOTAL_BUDGET_S — retries can only *shrink* their own
+# slice of the budget, never push the capture past the driver's patience.
+TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", "3"))
+TPU_RETRY_BACKOFF_S = int(os.environ.get("BENCH_TPU_BACKOFF", "60"))
+TOTAL_BUDGET_S = int(os.environ.get("BENCH_TOTAL_BUDGET", "2700"))
+# A cheap backend probe before each full attempt: a wedged tunnel hangs
+# (timeout), a missing TPU resolves to cpu (conclusive — stop retrying).
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+
+
+def _probe_platform(env: dict) -> str:
+    """What platform does this env's JAX resolve?  'tpu' / 'cpu' / 'hang'."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            env=env, timeout=PROBE_TIMEOUT_S, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return "hang"
+    out = proc.stdout.strip().splitlines()
+    return out[-1] if proc.returncode == 0 and out else "hang"
 
 
 def _worker() -> None:
+    # Durable in-repo compile cache (shared with the dryrun; pre-warmed for
+    # CPU shapes, and TPU compiles cache themselves across attempts).
+    from dispersy_tpu.cpuenv import enable_repo_cache
+    enable_repo_cache()
+
     import jax
     import jax.numpy as jnp
 
@@ -145,22 +175,45 @@ def _try_worker(env: dict, timeout_s: int) -> dict | None:
 
 
 def main() -> None:
-    # Attempt 1: whatever the ambient environment resolves (the TPU tunnel
-    # when it is up).  Attempt 2: scrubbed CPU environment.
+    # The TPU tunnel is *intermittently* up (BENCH.md's optimization log
+    # got TPU runs through on the same day BENCH_r02 recorded a CPU
+    # fallback), so a single attempt wastes the round's one driver
+    # capture: probe + retry the TPU environment a few bounded times with
+    # backoff — inside one overall deadline — before surrendering to the
+    # CPU fallback.
+    deadline = time.monotonic() + TOTAL_BUDGET_S
     result = None
     if os.environ.get("JAX_PLATFORMS", "") != "cpu":
-        result = _try_worker(dict(os.environ), TPU_TIMEOUT_S)
-        if result is not None and result.get("platform") != "tpu":
-            # Ambient env quietly fell back to CPU at a tiny population —
-            # keep it only if the dedicated CPU attempt fails too.
-            cpu_result = result
+        for attempt in range(TPU_ATTEMPTS):
+            if attempt:
+                delay = TPU_RETRY_BACKOFF_S * attempt
+                print(f"bench: TPU attempt {attempt} failed; retrying in "
+                      f"{delay}s", file=sys.stderr)
+                time.sleep(delay)
+            # Whatever this attempt does, the CPU fallback must still fit.
+            slack = deadline - time.monotonic() - CPU_TIMEOUT_S
+            if slack < PROBE_TIMEOUT_S + 60:
+                print("bench: TPU budget exhausted; falling back",
+                      file=sys.stderr)
+                break
+            platform = _probe_platform(dict(os.environ))
+            print(f"bench: probe says {platform!r}", file=sys.stderr)
+            if platform == "cpu":
+                break   # conclusively no TPU in this env; don't burn runs
+            if platform != "tpu":
+                continue   # wedged tunnel: back off and re-probe
+            # Re-measure slack AFTER the probe: probe time comes out of
+            # the worker's slice, keeping the overall deadline hard.
+            slack = deadline - time.monotonic() - CPU_TIMEOUT_S
+            if slack < 60:
+                break
+            result = _try_worker(dict(os.environ),
+                                 min(TPU_TIMEOUT_S, int(slack)))
+            if result is not None and result.get("platform") == "tpu":
+                break
             result = None
-        else:
-            cpu_result = None
-    else:
-        cpu_result = None
     if result is None:
-        result = _try_worker(cpu_env(), CPU_TIMEOUT_S) or cpu_result
+        result = _try_worker(cpu_env(), CPU_TIMEOUT_S)
     if result is not None and result.get("platform") != "tpu":
         # Make a CPU-fallback line self-explanatory to whoever reads the
         # recorded artifact: the TPU attempt failed (tunnel down/wedged),

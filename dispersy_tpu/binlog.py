@@ -61,6 +61,10 @@ class BinaryLog:
     def append(self, row: dict) -> None:
         vals = [float(row.get(k, float("nan"))) for k in self.fields]
         self._f.write(struct.pack(self._fmt, *vals))
+        # Rows arrive at experiment rate (one per round), not event rate:
+        # flushing each keeps a killed run's loss to the one torn row
+        # decode() already tolerates, instead of a whole stdio buffer.
+        self._f.flush()
 
     def close(self) -> None:
         self._f.close()
@@ -81,23 +85,30 @@ def decode(path: str) -> tuple[dict, list[dict]]:
     """
     with open(path, "rb") as f:
         data = f.read()
-    if data[:4] != MAGIC:
+    if len(data) < 8 or data[:4] != MAGIC:
         raise ValueError(f"{path}: not a DTPL binary log")
     version, n_fields = struct.unpack_from("<HH", data, 4)
     if version != VERSION:
         raise ValueError(f"{path}: format version {version}, "
                          f"expected {VERSION}")
-    off = 8
-    fields = []
-    for _ in range(n_fields):
-        (nl,) = struct.unpack_from("<H", data, off)
-        off += 2
-        fields.append(data[off:off + nl].decode())
-        off += nl
-    (ml,) = struct.unpack_from("<I", data, off)
-    off += 4
-    meta = json.loads(data[off:off + ml].decode() or "{}")
-    off += ml
+    try:
+        # A file killed mid-header can end anywhere inside the name table
+        # or meta blob; surface every such truncation as ValueError.
+        off = 8
+        fields = []
+        for _ in range(n_fields):
+            (nl,) = struct.unpack_from("<H", data, off)
+            off += 2
+            fields.append(data[off:off + nl].decode())
+            off += nl
+        (ml,) = struct.unpack_from("<I", data, off)
+        off += 4
+        if off + ml > len(data):
+            raise ValueError("meta blob truncated")
+        meta = json.loads(data[off:off + ml].decode() or "{}")
+        off += ml
+    except (struct.error, UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"{path}: torn header ({e})") from e
     body = data[off:]
     row_bytes = 8 * n_fields
     if len(body) % row_bytes:
@@ -111,9 +122,9 @@ def decode(path: str) -> tuple[dict, list[dict]]:
         for k, v in zip(fields, r):
             if np.isnan(v):
                 row[k] = None
-            elif v == int(v):
+            elif np.isfinite(v) and v == int(v):
                 row[k] = int(v)
             else:
-                row[k] = float(v)
+                row[k] = float(v)  # incl. ±inf, which int() would reject
         rows.append(row)
     return meta, rows

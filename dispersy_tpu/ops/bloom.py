@@ -41,6 +41,14 @@ def _auto_impl(impl: str | None) -> str:
     config #3 scale (10k peers x M=1152 x W=77 x 7 hashes x 8 request
     slots) that is a ~200 GB allocation, observed OOM — whereas the
     gather/scatter forms stay at [..., M] / [..., bits].
+
+    Keyed off ``jax.default_backend()``, not the operands' committed
+    device: this repo runs ONE backend per process (cpuenv.py pins
+    JAX_PLATFORMS in every child; tests/conftest.py pins cpu), so default
+    backend == executing backend.  Mixing CPU-placed computations into a
+    TPU-default process would pick the compare form on CPU — pass
+    ``impl="gather"`` explicitly if that ever becomes a real
+    configuration.
     """
     if impl is not None:
         return impl

@@ -132,15 +132,7 @@ def store_insert(store: StoreCols, new: StoreCols,
     # Also guard against EMPTY sentinel gt arriving as a "new" record.
     n_new_valid = count_valid(masked.gt)
 
-    # Form choice is backend- and width-dependent, same pattern (and same
-    # measurements) as ops/bloom._auto_impl: TPU sorts are bitonic
-    # (O(w log² w), 7 operands) while its compare broadcasts fuse onto
-    # the VPU — merge wins at large widths; XLA:CPU sorts cheaply and
-    # MATERIALIZES the [N, B, M] compare tensors — sort wins there
-    # (measured: config #3 CPU run 204 s sort vs 319 s merge, identical
-    # outputs).  Both forms are bit-identical (cross-form tests).
-    if (store.gt.shape[-1] + masked.gt.shape[-1] > 128
-            and jax.default_backend() == "tpu"):
+    if _prefer_merge(store.gt.shape[-1] + masked.gt.shape[-1]):
         gt, member, origin, meta, payload, aux, flags = \
             _merge_ordered(store, masked)
     else:
@@ -192,6 +184,25 @@ def store_insert(store: StoreCols, new: StoreCols,
                         n_evicted=n_before - n_surviving_old)
 
 
+def _prefer_merge(width: int) -> bool:
+    """Pick the merge form of the ordered interleave for this width?
+
+    Backend- and width-dependent, same pattern (and same measurements) as
+    ops/bloom._auto_impl: TPU sorts are bitonic (O(w log² w), 7 operands)
+    while its compare broadcasts fuse onto the VPU — merge wins at large
+    widths; XLA:CPU sorts cheaply and MATERIALIZES the [N, B, M] compare
+    tensors — sort wins there (measured: config #3 CPU run 204 s sort vs
+    319 s merge, identical outputs).  Both forms are bit-identical
+    (cross-form tests, incl. the end-to-end forced-merge run in
+    tests/test_store.py that CPU CI executes above this width threshold).
+
+    Keyed off ``jax.default_backend()``, not the operands' device — the
+    repo pins one backend per process (cpuenv.py / conftest), the same
+    single-backend assumption ops/bloom documents.
+    """
+    return width > 128 and jax.default_backend() == "tpu"
+
+
 def _sort_ordered(store: StoreCols, masked: StoreCols):
     """SORT form of the merge step (small stores): one lexicographic sort
     over the concatenation.  Origin as 3rd key makes the existing entry
@@ -211,6 +222,15 @@ def _sort_ordered(store: StoreCols, masked: StoreCols):
 
 def _merge_ordered(store: StoreCols, masked: StoreCols):
     """MERGE form (large stores), bit-identical to :func:`_sort_ordered`.
+
+    PRECONDITION (unlike the sort form): the store side must already be
+    sorted by (gt, member) with EMPTY holes at the end — the round
+    invariant every store_insert output satisfies.  A caller handing in an
+    unsorted store corrupts silently; the forced-merge end-to-end test in
+    tests/test_store.py runs multi-round insert chains through this path
+    on CPU so a violated invariant cannot hide behind the TPU-only gate.
+    Columns are 2-D [N, W] (rank_compact likewise) — lax.sort's
+    arbitrary-leading-dims generality is not preserved here.
 
     The store side is already sorted — the round invariant — so only the
     [N, B] batch needs a sort; each side's output position is its own

@@ -6,6 +6,7 @@ and pinned against the JSON MetricsLog path.
 """
 
 import json
+import pytest
 import subprocess
 import sys
 
@@ -78,3 +79,34 @@ def test_ldecode_cli(tmp_path):
         [sys.executable, "tools/ldecode.py", path, "--meta"],
         capture_output=True, text=True, cwd="/root/repo", check=True)
     assert json.loads(out.stdout.strip()) == {"m": 1}
+
+
+def test_decode_inf_and_short_file(tmp_path):
+    """±inf round-trips as float (int() would raise OverflowError), and a
+    header shorter than the fixed prefix is a ValueError, not a
+    struct.error (ADVICE r2)."""
+    path = str(tmp_path / "inf.binlog")
+    with binlog.BinaryLog(path, ["a", "b"]) as log:
+        log.append({"a": float("inf"), "b": float("-inf")})
+        log.append({"a": 1.0, "b": 2})
+    _, rows = binlog.decode(path)
+    assert rows[0] == {"a": float("inf"), "b": float("-inf")}
+    assert rows[1] == {"a": 1, "b": 2}   # integral floats stay ints
+    short = tmp_path / "short.binlog"
+    short.write_bytes(b"DTPL\x01")       # magic prefix, torn header
+    with pytest.raises(ValueError):
+        binlog.decode(str(short))
+
+
+def test_append_is_flushed(tmp_path):
+    """Rows are readable without close(): a killed run loses at most the
+    one torn trailing row decode() already tolerates (ADVICE r2)."""
+    path = str(tmp_path / "flush.binlog")
+    log = binlog.BinaryLog(path, ["x"])
+    try:
+        for i in range(5):
+            log.append({"x": i})
+        _, rows = binlog.decode(path)   # file handle still open
+        assert [r["x"] for r in rows] == [0, 1, 2, 3, 4]
+    finally:
+        log.close()

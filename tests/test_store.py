@@ -237,6 +237,51 @@ def test_merge_form_equals_sort_form():
                 err_msg=f"trial {trial}: column {name}")
 
 
+def test_store_insert_forced_merge_end_to_end(monkeypatch):
+    """Run store_insert through the MERGE path on CPU, above the real
+    width threshold, over a multi-round insert chain — so the merge form's
+    store-side-already-sorted precondition is exercised end-to-end (each
+    round's output feeds the next round's merge), not just in the one-shot
+    unit test.  The TPU-only backend gate would otherwise leave this path
+    unreachable in CPU CI (ADVICE r2)."""
+    n, m, b = 8, 150, 16   # m + b = 166 > the 128 gate threshold
+
+    def chain(force_merge):
+        if force_merge:
+            monkeypatch.setattr(st, "_prefer_merge", lambda w: True)
+        else:
+            monkeypatch.setattr(st, "_prefer_merge", lambda w: False)
+        store = st.empty_records((n, m))
+        outs = []
+        rng_c = np.random.default_rng(12)   # same batches both runs
+        for _ in range(5):
+            gt = jnp.asarray(rng_c.integers(1, 60, (n, b)), jnp.uint32)
+            member = jnp.asarray(rng_c.integers(0, 12, (n, b)), jnp.uint32)
+            meta = jnp.asarray(rng_c.integers(0, 4, (n, b)), jnp.uint32)
+            payload = jnp.asarray(rng_c.integers(0, 999, (n, b)), jnp.uint32)
+            aux = jnp.asarray(rng_c.integers(0, 50, (n, b)), jnp.uint32)
+            flags = jnp.zeros((n, b), jnp.uint32)
+            mask = jnp.asarray(rng_c.random((n, b)) < 0.8)
+            res = st.store_insert(
+                store, st.StoreCols(gt, member, meta, payload, aux, flags),
+                new_mask=mask, history=(0, 2, 0, 1))
+            store = res.store
+            outs.append((np.asarray(res.n_inserted),
+                         np.asarray(res.n_dropped),
+                         np.asarray(res.n_evicted)))
+        return store, outs
+
+    merge_store, merge_outs = chain(True)
+    sort_store, sort_outs = chain(False)
+    for col_m, col_s, name in zip(merge_store, sort_store, st.StoreCols._fields):
+        np.testing.assert_array_equal(np.asarray(col_m), np.asarray(col_s),
+                                      err_msg=f"column {name}")
+    for r, (mo, so) in enumerate(zip(merge_outs, sort_outs)):
+        for a, bv, name in zip(mo, so, ("inserted", "dropped", "evicted")):
+            np.testing.assert_array_equal(a, bv,
+                                          err_msg=f"round {r} {name}")
+
+
 def test_insert_same_result_both_widths():
     """store_insert results are width-invariant: inserting identical
     records into a small store and a large store (extra capacity = EMPTY

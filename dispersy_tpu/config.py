@@ -40,7 +40,12 @@ CAT_INTRODUCED = 3
 #       aux = permit-permission bitmask over user meta ids
 #       (reference: message.py Authorize/RevokePayload carries
 #       [(member, message, permission)] triples; the bitmask is that list,
-#       TPU-packed)
+#       TPU-packed).  aux bit 30 (DELEGATE_BIT) additionally grants (or
+#       revokes) the *authorize permission itself* for the masked metas:
+#       the target may then issue authorize/revoke records for those metas
+#       — the reference's permission *chains* (timeline.py Timeline.check
+#       walks authorize proofs recursively; here chains grow one fold per
+#       round, unbounded across rounds — see ops/timeline.check_grant)
 #   dispersy-undo-own / dispersy-undo-other: payload = target member,
 #       aux = target global_time (reference: payload.py UndoPayload
 #       (member, global_time, packet))
@@ -61,9 +66,11 @@ META_DESTROY = 0xF5
 #   dispersy-identity: payload = mid32 (first 4 bytes of SHA1(pubkey));
 #       see dispersy_tpu/crypto.py create_identities.
 META_IDENTITY = 0xF6
-# Max user metas: permission bitmasks live in the low bits of a uint32 and
-# bit 31 flags a revoke row in the auth table.
+# Max user metas: permission bitmasks live in the low bits of a uint32;
+# bit 31 flags a revoke row in the auth table and bit 30 marks a grant as
+# carrying the authorize permission (delegation) as well as the permit.
 MAX_USER_META = 24
+DELEGATE_BIT = 1 << 30
 
 # Sync-response ordering priorities (reference: distribution.py — each
 # Distribution carries a `priority`; community.py gives the permission
@@ -277,6 +284,22 @@ class CommunityConfig:
     #      protocol — candidate timeouts, walk timeouts; SURVEY.md §5.3) ----
     churn_rate: float = 0.0             # fraction of peers replaced per round
     packet_loss: float = 0.0            # Bernoulli drop per logical packet
+    # ---- NAT model (reference: candidate.py ``connection_type`` —
+    #      u"public" vs u"symmetric-NAT", advertised in every
+    #      introduction request/response; community.py
+    #      dispersy_get_introduce_candidate never introduces two
+    #      symmetric-NAT peers to each other because the puncture
+    #      exchange cannot open a mapping between two address-dependent
+    #      NATs).  ``p_symmetric``: fraction of members behind a
+    #      symmetric NAT, assigned statically per identity (the NAT is
+    #      the router's property — it survives churn rebirth; trackers
+    #      are public infrastructure).  Effects when > 0: responders and
+    #      trackers never introduce symmetric<->symmetric, and a
+    #      puncture between two symmetric peers is dropped (so even a
+    #      stray pairing cannot hole-punch) — symmetric peers reach each
+    #      other's records via public intermediaries, exactly the
+    #      reference's behavior. ----
+    p_symmetric: float = 0.0
 
     # ---- identity (reference: member.py / dispersy-identity; see
     #      dispersy_tpu/crypto.py) ----
@@ -319,11 +342,12 @@ class CommunityConfig:
     # below it, replayed from the store exactly like the reference rebuilds
     # Timeline policy state from the database.
     dynamic_meta_mask: int = 0
-    # The community founder: implicit holder of every permission, and the
-    # only member whose authorize/revoke/undo-other records are accepted
-    # (reference: community.py master member — the permission root; the
-    # reference walks proof *chains* from it, the rebuild models one
-    # delegation level, which is how real Dispersy overlays used it).
+    # The community founder: implicit holder of every permission, the root
+    # of authority (reference: community.py master member).  Authorize/
+    # revoke records are accepted from the founder or from any member
+    # holding the delegated authorize permission (DELEGATE_BIT chains —
+    # ops/timeline.check_grant, mirroring Timeline.check's recursive
+    # proof walk); undo-other/dynamic-settings/destroy stay founder-only.
     # -1 = auto: the first non-tracker peer (index n_trackers).
     founder_member: int = -1
 
@@ -537,6 +561,8 @@ class CommunityConfig:
                 raise ValueError("timeline_enabled requires k_authorized >= 1")
         if self.malicious_enabled and self.k_malicious < 1:
             raise ValueError("malicious_enabled requires k_malicious >= 1")
+        if not (0.0 <= self.p_symmetric <= 1.0):
+            raise ValueError("p_symmetric must be in [0, 1]")
         if self.delay_inbox < 0:
             raise ValueError("delay_inbox must be >= 0")
         if self.delay_inbox > 0:

@@ -52,7 +52,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_U32,
+from dispersy_tpu.config import (CONTROL_PRIORITY, DELEGATE_BIT, EMPTY_U32,
                                  IDENTITY_PRIORITY,
                                  INTRO_REQUEST_BASE_BYTES,
                                  INTRO_RESPONSE_BYTES, META_AUTHORIZE,
@@ -63,6 +63,7 @@ from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_U32,
                                  SIGNATURE_REQUEST_BYTES,
                                  SIGNATURE_RESPONSE_BYTES, CommunityConfig)
 from dispersy_tpu.ops import bloom, candidates as cand, inbox, rng, store as st
+from dispersy_tpu.ops import intake as ik
 from dispersy_tpu.ops import timeline as tl
 from dispersy_tpu.ops.hashing import record_hash
 from dispersy_tpu.state import FLAG_UNDONE, NEVER, PeerState
@@ -198,24 +199,10 @@ def _priority_vec(cfg: CommunityConfig, meta: jnp.ndarray) -> jnp.ndarray:
                                jnp.uint32(CONTROL_PRIORITY)))
 
 
-def _flip_best(stc: "st.StoreCols", q_meta: jnp.ndarray,
-               q_gt: jnp.ndarray) -> jnp.ndarray:
-    """u32[N, Q]: per (meta, gt) query, the max ``gt*2 | policy`` key over
-    the stored dispersy-dynamic-settings flips at or below the query gt —
-    the DynamicResolution replay (0 = no flip applies).  One definition
-    serves the author gate, the countersigner check, and the intake check;
-    the oracle mirrors it in ``_linear_at``.
-
-    The [N, Q, M] broadcast never materializes: XLA fuses the
-    mask-compare into the reduce, the same pattern (and premise) as the
-    Bloom kernels and the intake's in_store check — all of which run at
-    1M peers in the measured bench without allocating the product shape
-    (ops/bloom.py module docstring; BENCH.md)."""
-    m = ((stc.meta[:, None, :] == jnp.uint32(META_DYNAMIC))
-         & (stc.payload[:, None, :] == q_meta[:, :, None])
-         & (stc.gt[:, None, :] <= q_gt[:, :, None]))
-    return jnp.max(jnp.where(
-        m, stc.gt[:, None, :] * 2 + (stc.aux[:, None, :] & 1), 0), axis=-1)
+# DynamicResolution flip replay: one definition (ops/intake.flip_best)
+# serves the author gate, the countersigner check, and the intake check;
+# the oracle mirrors it in ``_linear_at``.
+_flip_best = ik.flip_best
 
 
 def _author_linear(state: PeerState, cfg: CommunityConfig, meta: int,
@@ -327,6 +314,25 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         global_time, session = state.global_time, state.session
 
     alive = state.alive
+
+    if cfg.p_symmetric > 0.0:
+        # Connection types (reference: candidate.py ``connection_type``):
+        # symmetric-NAT membership is a static property of the identity
+        # (the router's, not the process's — it survives churn rebirth),
+        # drawn once from the round-0 counter stream; trackers are public
+        # infrastructure.  Used by the introduction filters and the
+        # puncture gate below.
+        nat_sym = ((rng.rand_uniform(seed, jnp.uint32(0), idx, rng.P_NAT)
+                    < cfg.p_symmetric) & (idx >= t))
+
+        def sym_of(peer):
+            """Gather connection types for a peer-index array (NO_PEER and
+            out-of-range entries read as public — they are masked out by
+            the callers' validity logic anyway)."""
+            safe = jnp.clip(peer.astype(jnp.int32), 0, n - 1)
+            return nat_sym[safe] & (peer.astype(jnp.int32) >= 0)
+    else:
+        nat_sym = None
 
     # Hard-kill state (reference: community.py HardKilledCommunity — once a
     # peer stores the founder's dispersy-destroy-community, its community
@@ -523,7 +529,10 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                               last_intro=tab.last_intro[:t])
         intro_ring = cand.sample_introductions(
             ttab, now, cfg, seed, rnd, tidx, exclude=tq_src_i,
-            salt_base=_TRACKER_INTRO_SALT)                   # [T, Rt]
+            salt_base=_TRACKER_INTRO_SALT,
+            req_sym=None if nat_sym is None else sym_of(tq_src_i),
+            slot_sym=None if nat_sym is None
+            else sym_of(ttab.peer))                          # [T, Rt]
         # Under a bootstrap flash-crowd the tracker's richest candidate pool
         # is this round's own inbox: introduce requester s to another
         # requester j != s (both just proved their addresses by knocking).
@@ -536,6 +545,12 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
               % jnp.uint32(max(rt - 1, 1))) % jnp.uint32(rt)).astype(jnp.int32)
         intro_inbox = jnp.take_along_axis(tq_src_i, j, axis=1)
         intro_inbox = jnp.where(intro_inbox == tq_src_i, NO_PEER, intro_inbox)
+        if nat_sym is not None:
+            # The inbox-introduction path is an introduction too: never
+            # pair two symmetric-NAT requesters (fall through to the
+            # filtered ring pick instead).
+            intro_inbox = jnp.where(sym_of(tq_src_i) & sym_of(intro_inbox),
+                                    NO_PEER, intro_inbox)
         intro_t = jnp.where(intro_inbox != NO_PEER, intro_inbox, intro_ring)
         global_time = global_time.at[:t].set(
             _fold_gt(global_time[:t], tq_gt, tq_ok,
@@ -552,8 +567,10 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     else:
         rt = 0
 
-    intro = cand.sample_introductions(tab, now, cfg, seed, rnd, idx,
-                                      exclude=rq_src_i)       # [N, R]
+    intro = cand.sample_introductions(
+        tab, now, cfg, seed, rnd, idx, exclude=rq_src_i,
+        req_sym=None if nat_sym is None else sym_of(rq_src_i),
+        slot_sym=None if nat_sym is None else sym_of(tab.peer))   # [N, R]
     bup = bup + jnp.sum(rq_ok & (intro != NO_PEER),
                         axis=1).astype(jnp.uint32) \
         * jnp.uint32(PUNCTURE_REQUEST_BYTES)
@@ -601,7 +618,14 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     salt_p = jnp.arange(p)[None, :]
     pu_lost = _lost(seed, rnd, idx[:, None], _LOSS_PUNCTURE, salt_p,
                     cfg.packet_loss)
-    pu_valid = (pq_ok & ~pu_lost).reshape(-1)
+    pu_ok_send = pq_ok & ~pu_lost
+    if nat_sym is not None:
+        # Two address-dependent NATs cannot hole-punch: a puncture from a
+        # symmetric C toward a symmetric requester never lands (modeled
+        # as delivery failure; the introduction filters make this pairing
+        # rare, this gate makes it impossible).
+        pu_ok_send = pu_ok_send & ~(nat_sym[:, None] & sym_of(pq_target))
+    pu_valid = pu_ok_send.reshape(-1)
     punc = inbox.deliver(
         dst=pq_target.reshape(-1).astype(jnp.int32),
         cols=[jnp.broadcast_to(idx[:, None].astype(jnp.uint32),
@@ -903,13 +927,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             # differing in content proves its author signed two messages
             # at one time.  Convict locally, then reject this batch's (and
             # every future) record by any convicted member.
-            same_mg = ((stc.member[:, None, :] == in_member[:, :, None])
-                       & (stc.gt[:, None, :] == in_gt[:, :, None])
-                       & (stc.gt[:, None, :] != jnp.uint32(EMPTY_U32)))
-            differs = ((stc.meta[:, None, :] != in_meta[:, :, None])
-                       | (stc.payload[:, None, :] != in_payload[:, :, None])
-                       | (stc.aux[:, None, :] != in_aux[:, :, None]))
-            conflict = in_ok & jnp.any(same_mg & differs, axis=-1)  # [N, B]
+            conflict = in_ok & ik.conflict(
+                stc, in_member, in_gt, in_meta, in_payload, in_aux)  # [N, B]
             mf = tl.fold_set(mal, in_member, valid=conflict)
             mal = mf.table
             stats = stats.replace(
@@ -925,14 +944,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         # Freshness (drives next round's forward batch): not already in the
         # store on the UNIQUE(member, global_time) identity, and not a
         # duplicate of an earlier record in this same batch.
-        in_store = jnp.any(
-            (stc.gt[:, None, :] == in_gt[:, :, None])
-            & (stc.member[:, None, :] == in_member[:, :, None]), axis=-1)
-        earlier = jnp.arange(bb)[None, :] < jnp.arange(bb)[:, None]  # [B, B]
-        dup_in_batch = jnp.any(
-            (in_gt[:, :, None] == in_gt[:, None, :])
-            & (in_member[:, :, None] == in_member[:, None, :])
-            & in_ok[:, None, :] & earlier[None, :, :], axis=-1)
+        in_store = ik.in_store(stc, in_member, in_gt)
+        dup_in_batch = ik.dup_earlier(in_member, in_gt, in_ok)
 
         in_flags = jnp.zeros_like(in_gt)
         if cfg.timeline_enabled:
@@ -949,22 +962,39 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             is_flip = in_meta == jnp.uint32(META_DYNAMIC)
             is_destroy = in_meta == jnp.uint32(META_DESTROY)
             is_ctrl = is_auth | is_rev | is_undo | is_flip | is_destroy
-            # authorize/revoke/undo-other/dynamic-settings/destroy:
-            # founder-only (one delegation level — see ops/timeline.py).
-            # undo-own: the author undoes itself.
-            ctrl_ok = jnp.where(is_undo_own, in_member == in_payload,
-                                in_member == founder)
+            # undo-other/dynamic-settings/destroy: founder-only.
+            # undo-own: the author undoes itself.  authorize/revoke:
+            # founder, or a member holding the delegated authorize
+            # permission for every meta in the grant (chains — pass B
+            # below; reference: Timeline.check's recursive proof walk).
+            ctrl_ok0 = jnp.where(is_undo_own, in_member == in_payload,
+                                 in_member == founder)
 
             # Fold freshly learned authorize/revoke records FIRST: a grant
             # and a granted record arriving in one batch must accept (the
             # reference's batch handler processes authorize metas before
-            # the messages they permit).
+            # the messages they permit).  Pass A folds root (founder)
+            # grants; pass B validates delegated grants against the
+            # updated table and folds those — so a chain link folds one
+            # level per round at worst, with Bloom re-offers carrying
+            # deeper links across rounds (ops/timeline.check_grant doc).
+            # Table rows keep DELEGATE_BIT so folded grants prove chains.
             fresh0 = in_ok & ~in_store & ~dup_in_batch
             user_bits = jnp.uint32((1 << cfg.n_meta) - 1)
-            fr = tl.fold(auth, target=in_payload, mask=in_aux & user_bits,
+            grant_mask = in_aux & (user_bits | jnp.uint32(DELEGATE_BIT))
+            fr = tl.fold(auth, target=in_payload, mask=grant_mask,
                          gt=in_gt, is_revoke=is_rev,
-                         valid=fresh0 & (is_auth | is_rev) & ctrl_ok)
+                         valid=fresh0 & (is_auth | is_rev) & ctrl_ok0)
             auth = fr.table
+            deleg_ok = ((is_auth | is_rev) & ~ctrl_ok0
+                        & tl.check_grant(auth, in_member,
+                                         in_aux & user_bits, in_gt,
+                                         cfg.n_meta))
+            fr2 = tl.fold(auth, target=in_payload, mask=grant_mask,
+                          gt=in_gt, is_revoke=is_rev,
+                          valid=fresh0 & deleg_ok)
+            auth = fr2.table
+            ctrl_ok = ctrl_ok0 | deleg_ok
 
             # LinearResolution check against the updated table.
             prot = jnp.uint32(cfg.protected_meta_mask)
@@ -981,7 +1011,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                 is_dyn = ((((dynm >> shift) & 1) == 1)
                           & (in_meta < cfg.n_meta))
                 best = _flip_best(stc, in_meta, in_gt)            # [N, B]
-                flip_ok = fresh0 & is_flip & ctrl_ok              # [N, B]
+                flip_ok = fresh0 & is_flip & ctrl_ok0             # [N, B]
                 flip_b = (flip_ok[:, None, :]
                           & (in_payload[:, None, :] == in_meta[:, :, None])
                           & (in_gt[:, None, :] <= in_gt[:, :, None]))
@@ -1005,12 +1035,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
 
             # Arriving records whose undo is already stored come in
             # pre-undone (the reference re-marks on re-insert attempts).
-            undo_rows = ((stc.meta == jnp.uint32(META_UNDO_OWN))
-                         | (stc.meta == jnp.uint32(META_UNDO_OTHER)))
-            pre_undone = (in_meta < 32) & jnp.any(
-                undo_rows[:, None, :]
-                & (stc.payload[:, None, :] == in_member[:, :, None])
-                & (stc.aux[:, None, :] == in_gt[:, :, None]), axis=-1)
+            pre_undone = ((in_meta < 32)
+                          & ik.undo_marked(stc, in_member, in_gt))
             in_flags = jnp.where(pre_undone, jnp.uint32(FLAG_UNDONE),
                                  jnp.uint32(0))
             if cfg.delay_enabled:
@@ -1035,7 +1061,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                 + jnp.sum(in_ok & ~accept & ~parked,
                           axis=1).astype(jnp.uint32),
                 msgs_dropped=stats.msgs_dropped
-                + fr.n_dropped.astype(jnp.uint32))
+                + fr.n_dropped.astype(jnp.uint32)
+                + fr2.n_dropped.astype(jnp.uint32))
         else:
             accept = in_ok
 
@@ -1052,11 +1079,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             # Re-deliveries of already-stored records bypass the chain test
             # (they are plain dups, handled by the UNIQUE insert).
             seq_check = is_seq & ~in_store
-            same_store = ((stc.member[:, None, :] == in_member[:, :, None])
-                          & (stc.meta[:, None, :] == in_meta[:, :, None])
-                          & (stc.gt[:, None, :] != jnp.uint32(EMPTY_U32)))
-            stored_max = jnp.max(
-                jnp.where(same_store, stc.aux[:, None, :], 0), axis=-1)
+            stored_max = ik.seq_stored_max(stc, in_member, in_meta)
 
             def seq_body(j, carry):
                 acc_max, ok = carry
@@ -1133,10 +1156,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             # Control rows are never markable — the reference forbids
             # undoing dispersy-* metas.
             batch_undo = accept & is_undo
-            hit = jnp.any(
-                batch_undo[:, None, :]
-                & (stc.member[:, :, None] == in_payload[:, None, :])
-                & (stc.gt[:, :, None] == in_aux[:, None, :]), axis=-1)
+            hit = ik.undo_hits_store(stc, in_payload, in_aux, batch_undo)
             hit = hit & (stc.meta < 32)
             stc = stc._replace(flags=jnp.where(
                 hit, stc.flags | jnp.uint32(FLAG_UNDONE), stc.flags))
@@ -1299,8 +1319,16 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
     if cfg.timeline_enabled:
         _, _, mem_base, _ = _layout_cols(cfg, jnp.arange(n, dtype=jnp.int32))
         founder_row = _founder_col(cfg, mem_base)
-        if meta in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER,
-                    META_DYNAMIC, META_DESTROY):
+        if meta in (META_AUTHORIZE, META_REVOKE):
+            # Founder, or a member holding the delegated authorize
+            # permission for every meta in the grant (Timeline.check's
+            # author-side gate on create — chains, see ops/timeline).
+            deleg = tl.check_grant(
+                auth, idx[:, None],
+                (aux & jnp.uint32((1 << cfg.n_meta) - 1))[:, None],
+                gt_new[:, None], cfg.n_meta)[:, 0]
+            allowed = (idx == founder_row) | deleg
+        elif meta in (META_UNDO_OTHER, META_DYNAMIC, META_DESTROY):
             allowed = idx == founder_row
         elif meta == META_UNDO_OWN:
             allowed = payload == idx
@@ -1338,7 +1366,8 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
     if cfg.timeline_enabled and meta in (META_AUTHORIZE, META_REVOKE):
         # The author's own table learns its own grant/revoke at create time.
         fr = tl.fold(auth, target=payload[:, None],
-                     mask=(aux & jnp.uint32((1 << cfg.n_meta) - 1))[:, None],
+                     mask=(aux & jnp.uint32((1 << cfg.n_meta) - 1
+                                            | DELEGATE_BIT))[:, None],
                      gt=gt_new[:, None],
                      is_revoke=jnp.full((n, 1), meta == META_REVOKE),
                      valid=author_mask[:, None])

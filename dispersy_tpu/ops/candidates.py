@@ -255,7 +255,9 @@ def sample_forward_targets(tab: CandTable, now: jnp.ndarray,
 def sample_introductions(tab: CandTable, now: jnp.ndarray, cfg: CommunityConfig,
                          seed: jnp.ndarray, round_index: jnp.ndarray,
                          self_idx: jnp.ndarray, exclude: jnp.ndarray,
-                         salt_base: int = 0) -> jnp.ndarray:
+                         salt_base: int = 0,
+                         req_sym: jnp.ndarray | None = None,
+                         slot_sym: jnp.ndarray | None = None) -> jnp.ndarray:
     """Third-peer picks for a batch of introduction responses.
 
     ``dispersy_get_introduce_candidate``: a uniformly random *verified*
@@ -265,12 +267,21 @@ def sample_introductions(tab: CandTable, now: jnp.ndarray, cfg: CommunityConfig,
     where the responder knows nobody else (the reference then sends a
     response carrying no introduction).  Draws for different slots use
     disjoint salts so they are independent.
+
+    ``req_sym`` (bool[N, S]) / ``slot_sym`` (bool[N, K]), when given, carry
+    the NAT connection types of the requesters and of the table's
+    candidates: a symmetric-NAT requester is never introduced to a
+    symmetric-NAT candidate (reference: candidate.py connection_type +
+    dispersy_get_introduce_candidate's filter — hole punching cannot work
+    between two address-dependent NATs).
     """
     n, k = tab.peer.shape
     s = exclude.shape[1]
     cats = categories(tab, now, cfg)
     verified = (cats == CAT_WALKED) | (cats == CAT_STUMBLED)     # [N, K]
     mask = verified[:, None, :] & (tab.peer[:, None, :] != exclude[:, :, None])
+    if req_sym is not None:
+        mask = mask & ~(req_sym[:, :, None] & slot_sym[:, None, :])
     salt = (jnp.arange(s)[:, None] * jnp.uint32(k)
             + jnp.arange(k)[None, :] + jnp.uint32(salt_base))    # [S, K]
     prio = rng.rand_u32(seed, round_index, self_idx[:, None, None],

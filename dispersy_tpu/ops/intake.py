@@ -1,0 +1,240 @@
+"""Intake-check kernels: batch-vs-store membership, conflict, dup tests.
+
+The receive pipeline (reference: dispersy.py ``_on_batch_cache`` — the
+check step before ``store_update_forward``) asks, for every arriving
+record, questions of the receiving peer's own store: *is this (member,
+global_time) already stored?*  *does a stored record conflict with it?*
+*did an earlier record in this same batch carry the same identity?* — plus
+the Timeline's DynamicResolution policy replay over stored flip records
+(reference: timeline.py ``Timeline.get_resolution_policy``) and undo
+bookkeeping (community.py ``on_undo`` marking sync rows ``undone``).
+
+Every one of these is a per-(batch-entry) reduction over the [N, M] store,
+and the natural XLA form is a broadcast compare over [N, B, M].  Whether
+that product shape ever *materializes* is backend-dependent — the same
+story as ops/bloom.py and ops/store.py:
+
+- **TPU**: the compare fuses into the reduce on the VPU; the product
+  tensor never exists.  This is the measured-at-1M-peers bench path.
+- **XLA:CPU**: fusion does NOT reliably happen; the [N, B, M] bool tensor
+  allocates (the 199.9 GB Bloom incident, BENCH.md r2).  At config #3
+  spec shape (N=100k, M=1152, B≈272) one such tensor is ~30 GB and the
+  intake needs several live at once.
+
+So each check has two bit-identical forms, picked per backend and size
+(:func:`_auto_impl`): ``"broadcast"`` as above, and ``"chunked"`` — a
+``lax.fori_loop`` over the batch axis computing one [N, M] compare-reduce
+per iteration, bounding live memory at O(N·M) regardless of B.  Reductions
+are order-independent (any/max), so the two forms are exactly equal;
+tests/test_intake.py pins it, and the engine-level forced-form test pins
+it through a full step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dispersy_tpu.config import (EMPTY_U32, META_DYNAMIC, META_UNDO_OTHER,
+                                 META_UNDO_OWN)
+
+# Live-memory bound for the broadcast form's product tensor, in elements.
+# 2**28 bools = 256 MB — comfortably under this host's RAM even with
+# several product tensors live, while keeping every test-size shape on the
+# (better-fusing, fewer-dispatch) broadcast path.
+_BROADCAST_ELEM_LIMIT = 1 << 28
+
+
+def _auto_impl(impl: str | None, product_elems: int) -> str:
+    """``"broadcast"`` or ``"chunked"`` (same selection story as
+    ops/bloom._auto_impl: one backend per process, trace-time static)."""
+    if impl is not None:
+        return impl
+    if jax.default_backend() == "tpu":
+        return "broadcast"
+    return "chunked" if product_elems > _BROADCAST_ELEM_LIMIT else "broadcast"
+
+
+def in_store(stc, member: jnp.ndarray, gt: jnp.ndarray,
+             impl: str | None = None) -> jnp.ndarray:
+    """bool[N, B]: is (member, gt) already a stored row?  (The UNIQUE
+    (member, global_time) identity — reference: the sync table's UNIQUE
+    constraint; an arriving duplicate is not fresh.)"""
+    n, b = member.shape
+    m = stc.gt.shape[-1]
+    if _auto_impl(impl, n * b * m) == "broadcast":
+        return jnp.any(
+            (stc.gt[:, None, :] == gt[:, :, None])
+            & (stc.member[:, None, :] == member[:, :, None]), axis=-1)
+
+    def body(j, out):
+        g = lax.dynamic_index_in_dim(gt, j, 1)          # [N, 1]
+        mb = lax.dynamic_index_in_dim(member, j, 1)
+        hit = jnp.any((stc.gt == g) & (stc.member == mb), axis=-1)
+        return lax.dynamic_update_index_in_dim(out, hit, j, 1)
+
+    return lax.fori_loop(0, b, body, jnp.zeros((n, b), bool))
+
+
+def conflict(stc, member: jnp.ndarray, gt: jnp.ndarray, meta: jnp.ndarray,
+             payload: jnp.ndarray, aux: jnp.ndarray,
+             impl: str | None = None) -> jnp.ndarray:
+    """bool[N, B]: does a stored row share (member, gt) but differ in
+    content?  (Double-sign conviction evidence — reference: dispersy.py
+    malicious-member bookkeeping / dispersy-malicious-proof.)"""
+    n, b = member.shape
+    m = stc.gt.shape[-1]
+    if _auto_impl(impl, n * b * m) == "broadcast":
+        same_mg = ((stc.member[:, None, :] == member[:, :, None])
+                   & (stc.gt[:, None, :] == gt[:, :, None])
+                   & (stc.gt[:, None, :] != jnp.uint32(EMPTY_U32)))
+        differs = ((stc.meta[:, None, :] != meta[:, :, None])
+                   | (stc.payload[:, None, :] != payload[:, :, None])
+                   | (stc.aux[:, None, :] != aux[:, :, None]))
+        return jnp.any(same_mg & differs, axis=-1)
+
+    def body(j, out):
+        mb = lax.dynamic_index_in_dim(member, j, 1)     # [N, 1]
+        g = lax.dynamic_index_in_dim(gt, j, 1)
+        mt = lax.dynamic_index_in_dim(meta, j, 1)
+        pl = lax.dynamic_index_in_dim(payload, j, 1)
+        ax = lax.dynamic_index_in_dim(aux, j, 1)
+        same = ((stc.member == mb) & (stc.gt == g)
+                & (stc.gt != jnp.uint32(EMPTY_U32)))
+        diff = (stc.meta != mt) | (stc.payload != pl) | (stc.aux != ax)
+        return lax.dynamic_update_index_in_dim(
+            out, jnp.any(same & diff, axis=-1), j, 1)
+
+    return lax.fori_loop(0, b, body, jnp.zeros((n, b), bool))
+
+
+def dup_earlier(member: jnp.ndarray, gt: jnp.ndarray, ok: jnp.ndarray,
+                impl: str | None = None) -> jnp.ndarray:
+    """bool[N, B]: does an EARLIER valid entry of this batch carry the same
+    (member, gt)?  (In-batch dedup: the reference's batch handler keeps
+    the first of identical-identity messages in one batch window.)"""
+    n, b = member.shape
+    if _auto_impl(impl, n * b * b) == "broadcast":
+        earlier = jnp.arange(b)[None, :] < jnp.arange(b)[:, None]  # [B, B]
+        return jnp.any(
+            (gt[:, :, None] == gt[:, None, :])
+            & (member[:, :, None] == member[:, None, :])
+            & ok[:, None, :] & earlier[None, :, :], axis=-1)
+
+    col = jnp.arange(b)
+
+    def body(j, out):
+        g = lax.dynamic_index_in_dim(gt, j, 1)          # [N, 1]
+        mb = lax.dynamic_index_in_dim(member, j, 1)
+        hit = jnp.any((gt == g) & (member == mb) & ok
+                      & (col < j)[None, :], axis=-1)
+        return lax.dynamic_update_index_in_dim(out, hit, j, 1)
+
+    return lax.fori_loop(0, b, body, jnp.zeros((n, b), bool))
+
+
+def flip_best(stc, q_meta: jnp.ndarray, q_gt: jnp.ndarray,
+              impl: str | None = None) -> jnp.ndarray:
+    """u32[N, Q]: per (meta, gt) query, the max ``gt*2 | policy`` key over
+    stored dispersy-dynamic-settings flips at or below the query gt — the
+    DynamicResolution replay (0 = no flip applies; reference: timeline.py
+    ``Timeline.get_resolution_policy`` walking the stored flip chain).
+    One definition serves the author gate, the countersigner check, and
+    the intake check; the oracle mirrors it in ``_linear_at``."""
+    n, q = q_meta.shape
+    m = stc.gt.shape[-1]
+    if _auto_impl(impl, n * q * m) == "broadcast":
+        hit = ((stc.meta[:, None, :] == jnp.uint32(META_DYNAMIC))
+               & (stc.payload[:, None, :] == q_meta[:, :, None])
+               & (stc.gt[:, None, :] <= q_gt[:, :, None]))
+        return jnp.max(jnp.where(
+            hit, stc.gt[:, None, :] * 2 + (stc.aux[:, None, :] & 1), 0),
+            axis=-1)
+
+    is_flip = stc.meta == jnp.uint32(META_DYNAMIC)       # [N, M]
+    key = stc.gt * 2 + (stc.aux & 1)
+
+    def body(j, out):
+        qm = lax.dynamic_index_in_dim(q_meta, j, 1)      # [N, 1]
+        qg = lax.dynamic_index_in_dim(q_gt, j, 1)
+        hit = is_flip & (stc.payload == qm) & (stc.gt <= qg)
+        best = jnp.max(jnp.where(hit, key, 0), axis=-1)
+        return lax.dynamic_update_index_in_dim(out, best, j, 1)
+
+    return lax.fori_loop(0, q, body, jnp.zeros((n, q), jnp.uint32))
+
+
+def undo_marked(stc, member: jnp.ndarray, gt: jnp.ndarray,
+                impl: str | None = None) -> jnp.ndarray:
+    """bool[N, B]: is a stored undo row targeting (member, gt) present?
+    (Arrivals whose undo already synced come in pre-undone — reference:
+    community.py re-marks on re-insert attempts.)"""
+    n, b = member.shape
+    m = stc.gt.shape[-1]
+    undo_rows = ((stc.meta == jnp.uint32(META_UNDO_OWN))
+                 | (stc.meta == jnp.uint32(META_UNDO_OTHER)))   # [N, M]
+    if _auto_impl(impl, n * b * m) == "broadcast":
+        return jnp.any(
+            undo_rows[:, None, :]
+            & (stc.payload[:, None, :] == member[:, :, None])
+            & (stc.aux[:, None, :] == gt[:, :, None]), axis=-1)
+
+    def body(j, out):
+        mb = lax.dynamic_index_in_dim(member, j, 1)      # [N, 1]
+        g = lax.dynamic_index_in_dim(gt, j, 1)
+        hit = jnp.any(undo_rows & (stc.payload == mb) & (stc.aux == g),
+                      axis=-1)
+        return lax.dynamic_update_index_in_dim(out, hit, j, 1)
+
+    return lax.fori_loop(0, b, body, jnp.zeros((n, b), bool))
+
+
+def undo_hits_store(stc, target_member: jnp.ndarray,
+                    target_gt: jnp.ndarray, valid: jnp.ndarray,
+                    impl: str | None = None) -> jnp.ndarray:
+    """bool[N, M]: which stored rows does this batch's accepted undo set
+    mark?  (The post-insert pass applying dispersy-undo-own/-other to the
+    store — reference: community.py ``on_undo`` setting ``sync.undone``.)
+    Control rows are excluded by the CALLER (meta < 32 check)."""
+    n, b = target_member.shape
+    m = stc.gt.shape[-1]
+    if _auto_impl(impl, n * b * m) == "broadcast":
+        return jnp.any(
+            valid[:, None, :]
+            & (stc.member[:, :, None] == target_member[:, None, :])
+            & (stc.gt[:, :, None] == target_gt[:, None, :]), axis=-1)
+
+    def body(j, out):
+        mb = lax.dynamic_index_in_dim(target_member, j, 1)   # [N, 1]
+        g = lax.dynamic_index_in_dim(target_gt, j, 1)
+        ok = lax.dynamic_index_in_dim(valid, j, 1)
+        return out | (ok & (stc.member == mb) & (stc.gt == g))
+
+    return lax.fori_loop(0, b, body, jnp.zeros((n, m), bool))
+
+
+def seq_stored_max(stc, member: jnp.ndarray, meta: jnp.ndarray,
+                   impl: str | None = None) -> jnp.ndarray:
+    """u32[N, B]: per batch entry, the highest stored sequence number
+    (``aux``) among rows with its (member, meta).  (The
+    enable_sequence_number chain base — reference: distribution.py
+    sequence numbers + the in-order intake recast, config.py
+    ``seq_meta_mask``.)"""
+    n, b = member.shape
+    m = stc.gt.shape[-1]
+    live = stc.gt != jnp.uint32(EMPTY_U32)               # [N, M]
+    if _auto_impl(impl, n * b * m) == "broadcast":
+        same = ((stc.member[:, None, :] == member[:, :, None])
+                & (stc.meta[:, None, :] == meta[:, :, None])
+                & live[:, None, :])
+        return jnp.max(jnp.where(same, stc.aux[:, None, :], 0), axis=-1)
+
+    def body(j, out):
+        mb = lax.dynamic_index_in_dim(member, j, 1)      # [N, 1]
+        mt = lax.dynamic_index_in_dim(meta, j, 1)
+        same = (stc.member == mb) & (stc.meta == mt) & live
+        mx = jnp.max(jnp.where(same, stc.aux, 0), axis=-1)
+        return lax.dynamic_update_index_in_dim(out, mx, j, 1)
+
+    return lax.fori_loop(0, b, body, jnp.zeros((n, b), jnp.uint32))

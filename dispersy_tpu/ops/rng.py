@@ -35,6 +35,9 @@ P_CHURN = 5      # does this peer churn out this round
 P_LOSS = 6       # per-packet Bernoulli loss
 P_GOSSIP = 7     # forwarding fan-out choice (CommunityDestination)
 P_SIGN = 8       # counterparty's countersign decision (allow_signature_func)
+P_NAT = 9        # connection-type assignment (public vs symmetric NAT);
+#                  drawn at round 0 so the type is static per identity —
+#                  NAT is the router's property, surviving churn rebirth
 
 
 def fold_seed(key: jnp.ndarray) -> jnp.ndarray:

@@ -22,9 +22,17 @@ and rejects on conflict; a deterministic revoke-wins rule is the simulation
 equivalent).
 
 The founder (``CommunityConfig.founder``) holds every permission implicitly
-and is the root of authority — the rebuild models one delegation level
-(founder authorizes members) rather than arbitrary proof chains; see
-config.py ``founder_member``.
+and is the root of authority.  Grants carrying ``DELEGATE_BIT`` convey the
+*authorize permission itself*, so chains (founder → A(authorize) →
+B(permit) → …) fold to arbitrary depth across rounds —
+:func:`check_grant` is the chain-link validity test, the bounded-table
+recast of ``Timeline.check``'s recursive proof walk.  One documented
+divergence from the reference's proof-chain walk: a link's validity is
+judged against the receiving peer's table *when the link folds*, not
+re-walked on every later check — a revoke that syncs after a grant it
+should have pre-dated does not retroactively unwind grants already folded
+from that granter (each peer's view converges to its own arrival order's
+fixed point; the reference re-validates chains lazily and can retro-reject).
 """
 
 from __future__ import annotations
@@ -34,7 +42,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 from jax import lax
 
-from dispersy_tpu.config import EMPTY_U32
+from dispersy_tpu.config import DELEGATE_BIT, EMPTY_U32
 
 # Bit 31 of a table row's mask marks a revoke row.  (Plain int, not a jnp
 # scalar: module import must not touch a JAX backend.)
@@ -79,6 +87,79 @@ def check(tab: AuthTable, member: jnp.ndarray, meta: jnp.ndarray,
                & ~jnp.any(at_best & is_revoke, axis=-1)
                & jnp.any(match, axis=-1))
     return granted | (member == jnp.asarray(founder, jnp.uint32))
+
+
+def check_grant(tab: AuthTable, member: jnp.ndarray, mask: jnp.ndarray,
+                gt: jnp.ndarray, n_meta: int,
+                impl: str | None = None) -> jnp.ndarray:
+    """May ``member`` issue an authorize/revoke covering ``mask`` at ``gt``?
+
+    The delegation chain check (reference: timeline.py ``Timeline.check``
+    walking authorize proofs — a member granted the *authorize* permission
+    for a meta can itself authorize others for it).  A grant row conveys
+    that permission only when it carries :data:`~dispersy_tpu.config.
+    DELEGATE_BIT`; per meta, the latest delegate-row at global_time <= gt
+    decides, revoke winning ties — the same latest-wins rule as
+    :func:`check`, evaluated on the delegate bit instead of the permit
+    bit.  The verdict requires EVERY meta bit set in ``mask`` (and a
+    non-empty mask: an empty grant proves nothing).  The founder shortcut
+    is the CALLER's (``founder-or-delegated``), keeping this function a
+    pure chain check.
+
+    Chains deepen one table-fold per round: a full chain arriving in one
+    batch folds its first link this round and the rest on re-offer —
+    deterministic, mirrored by the oracle, and converging because Bloom
+    sync keeps re-serving un-stored records (the same fixed-point argument
+    as the module docstring's missing-grant story).
+
+    ``member``/``mask``/``gt``: [N, B] query records.
+    """
+    from dispersy_tpu.ops.intake import _auto_impl  # shared backend gate
+
+    n, b = member.shape
+    a = tab.member.shape[-1]
+    deleg_rows = ((tab.mask & jnp.uint32(DELEGATE_BIT)) != 0)        # [N, A]
+    live = tab.member != jnp.uint32(EMPTY_U32)
+    is_rev = (tab.mask & jnp.uint32(REVOKE_BIT)) != 0
+
+    if _auto_impl(impl, n * b * a * n_meta) == "broadcast":
+        ok = mask != 0
+        for k in range(n_meta):
+            need = ((mask >> k) & jnp.uint32(1)) == 1                # [N, B]
+            rows_k = ((((tab.mask >> k) & jnp.uint32(1)) == 1)
+                      & deleg_rows & live)
+            match = (rows_k[:, None, :]
+                     & (tab.member[:, None, :] == member[:, :, None])
+                     & (tab.gt[:, None, :] <= gt[:, :, None]))       # [N,B,A]
+            row_gt = jnp.where(match, tab.gt[:, None, :], 0)
+            best = jnp.max(row_gt, axis=-1)
+            at_best = match & (row_gt == best[:, :, None])
+            granted_k = (jnp.any(at_best & ~is_rev[:, None, :], axis=-1)
+                         & ~jnp.any(at_best & is_rev[:, None, :], axis=-1))
+            ok = ok & (~need | granted_k)
+        return ok
+
+    # Chunked form (non-fusing backends at scale — the same memory story
+    # as ops/intake.py): one batch column at a time, O(N*A) live per meta.
+    def body(j, out):
+        mb = lax.dynamic_index_in_dim(member, j, 1)                  # [N, 1]
+        mk = lax.dynamic_index_in_dim(mask, j, 1)
+        g = lax.dynamic_index_in_dim(gt, j, 1)
+        ok_j = (mk != 0)[:, 0]
+        for k in range(n_meta):
+            need = (((mk >> k) & jnp.uint32(1)) == 1)[:, 0]          # [N]
+            rows_k = ((((tab.mask >> k) & jnp.uint32(1)) == 1)
+                      & deleg_rows & live)
+            match = rows_k & (tab.member == mb) & (tab.gt <= g)      # [N, A]
+            row_gt = jnp.where(match, tab.gt, 0)
+            best = jnp.max(row_gt, axis=-1)
+            at_best = match & (row_gt == best[:, None])
+            granted_k = (jnp.any(at_best & ~is_rev, axis=-1)
+                         & ~jnp.any(at_best & is_rev, axis=-1))
+            ok_j = ok_j & (~need | granted_k)
+        return lax.dynamic_update_index_in_dim(out, ok_j, j, 1)
+
+    return lax.fori_loop(0, b, body, jnp.zeros((n, b), bool))
 
 
 class FoldResult(NamedTuple):

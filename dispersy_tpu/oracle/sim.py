@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_U32,
+from dispersy_tpu.config import (CONTROL_PRIORITY, DELEGATE_BIT, EMPTY_U32,
                                  INTRO_REQUEST_BASE_BYTES,
                                  INTRO_RESPONSE_BYTES, META_AUTHORIZE,
                                  META_DESTROY, META_DYNAMIC, META_REVOKE,
@@ -58,7 +58,7 @@ _TRACKER_INTRO_SALT = 1 << 20
 
 # Purpose tags (ops/rng.py).
 P_CATEGORY, P_SLOT, P_INTRO, P_BOOTSTRAP = 1, 2, 3, 4
-P_CHURN, P_LOSS, P_GOSSIP, P_SIGN = 5, 6, 7, 8
+P_CHURN, P_LOSS, P_GOSSIP, P_SIGN, P_NAT = 5, 6, 7, 8, 9
 
 KIND_WALK, KIND_STUMBLE, KIND_INTRO = 0, 1, 2
 CAT_NONE, CAT_WALKED, CAT_STUMBLED, CAT_INTRODUCED = 0, 1, 2, 3
@@ -289,14 +289,28 @@ class OracleSim:
                 return p
         return NO_PEER
 
+    def _nat_sym(self, peer: int) -> bool:
+        """engine's ``nat_sym``/``sym_of`` mirror: symmetric-NAT iff the
+        static round-0 draw says so; trackers and NO_PEER read public."""
+        cfg = self.cfg
+        if cfg.p_symmetric <= 0.0 or peer < cfg.n_trackers:
+            return False
+        return (rand_uniform(self.seed, 0, peer, P_NAT)
+                < np.float32(cfg.p_symmetric))
+
     def _sample_intro(self, owner: int, slots: list[Slot], s_ix: int,
-                      exclude: int, salt_base: int) -> int:
-        """sample_introductions for one (owner, request-slot)."""
+                      exclude: int, salt_base: int,
+                      req_sym: bool = False) -> int:
+        """sample_introductions for one (owner, request-slot);
+        ``req_sym``: the requester is behind a symmetric NAT, so
+        symmetric candidates are filtered (engine's req_sym/slot_sym)."""
         k = len(slots)
         mask, prio = [], []
         for j, s in enumerate(slots):
             cat = self._category(s)
             ok = (cat in (CAT_WALKED, CAT_STUMBLED)) and s.peer != exclude
+            if ok and req_sym and self._nat_sym(s.peer):
+                ok = False
             mask.append(ok)
             prio.append(rand_u32(self.seed, self.rnd, owner, P_INTRO,
                                  s_ix * k + j + salt_base))
@@ -422,6 +436,31 @@ class OracleSim:
         revoke = any(r.mask & REVOKE_BIT for r in at_best)
         return grant and not revoke
 
+    def _auth_check_delegate(self, owner: int, member: int, meta: int,
+                             gt: int) -> bool:
+        """tl.check_grant's per-meta link test: latest DELEGATE row for
+        (member, meta) at or below gt decides, revoke winning ties.  No
+        founder shortcut — the caller composes founder-or-delegated."""
+        matches = [r for r in self.peers[owner].auth
+                   if r.member == member and (r.mask & DELEGATE_BIT)
+                   and ((r.mask >> meta) & 1) and r.gt <= gt]
+        if not matches:
+            return False
+        best = max(r.gt for r in matches)
+        at_best = [r for r in matches if r.gt == best]
+        grant = any(not (r.mask & REVOKE_BIT) for r in at_best)
+        revoke = any(r.mask & REVOKE_BIT for r in at_best)
+        return grant and not revoke
+
+    def _grant_ok(self, owner: int, member: int, mask: int, gt: int) -> bool:
+        """tl.check_grant mirror: may ``member`` issue an authorize/revoke
+        covering ``mask`` at ``gt``?  Every masked meta must be delegated;
+        an empty mask proves nothing."""
+        if mask == 0:
+            return False
+        return all(self._auth_check_delegate(owner, member, k, gt)
+                   for k in range(self.cfg.n_meta) if (mask >> k) & 1)
+
     def _auth_fold(self, owner: int, target: int, mask: int, gt: int,
                    is_revoke: bool) -> None:
         """tl.fold for one accepted authorize/revoke record."""
@@ -468,16 +507,20 @@ class OracleSim:
         return bool(best & 1) if best > 0 else linear
 
     def _intake_accept(self, owner: int, rec: Record,
-                       batch_flips=()) -> bool:
+                       batch_flips=(), deleg_ok: bool = False) -> bool:
         """The engine's timeline accept mask for one in_ok record.  Pure:
         the batch's fresh authorize/revoke records must already be folded
-        (the engine folds the whole batch before any check runs)."""
+        (the engine folds the whole batch before any check runs);
+        ``deleg_ok`` is this record's precomputed pass-B chain verdict
+        (engine: ``ctrl_ok = ctrl_ok0 | deleg_ok``, evaluated against the
+        post-pass-A table snapshot)."""
         cfg = self.cfg
         if not cfg.timeline_enabled:
             return True
         m = rec.meta
-        if m in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER,
-                 META_DYNAMIC, META_DESTROY):
+        if m in (META_AUTHORIZE, META_REVOKE):
+            return rec.member == self._founder(owner) or deleg_ok
+        if m in (META_UNDO_OTHER, META_DYNAMIC, META_DESTROY):
             return rec.member == self._founder(owner)
         if m == META_UNDO_OWN:
             return rec.member == rec.payload
@@ -507,8 +550,12 @@ class OracleSim:
             if cfg.timeline_enabled:
                 if any(r.meta == META_DESTROY for r in p.store):
                     continue          # hard-killed: community unloaded
-                if meta in (META_AUTHORIZE, META_REVOKE, META_UNDO_OTHER,
-                            META_DYNAMIC, META_DESTROY):
+                if meta in (META_AUTHORIZE, META_REVOKE):
+                    if (i != self._founder(i)
+                            and not self._grant_ok(
+                                i, i, av & ((1 << cfg.n_meta) - 1), gt)):
+                        continue
+                elif meta in (META_UNDO_OTHER, META_DYNAMIC, META_DESTROY):
                     if i != self._founder(i):
                         continue
                 elif meta == META_UNDO_OWN:
@@ -529,8 +576,9 @@ class OracleSim:
             if not (meta < cfg.n_meta and (cfg.direct_meta_mask >> meta) & 1):
                 self._store_insert(i, [rec], count_drops=False)
             if cfg.timeline_enabled and meta in (META_AUTHORIZE, META_REVOKE):
-                self._auth_fold(i, pv, av & ((1 << cfg.n_meta) - 1), gt,
-                                meta == META_REVOKE)
+                self._auth_fold(
+                    i, pv, av & (((1 << cfg.n_meta) - 1) | DELEGATE_BIT),
+                    gt, meta == META_REVOKE)
             if cfg.timeline_enabled and meta in (META_UNDO_OWN,
                                                  META_UNDO_OTHER):
                 for r in p.store:
@@ -761,8 +809,10 @@ class OracleSim:
                         s.stumble = self.now
                 # introduction picks for each served request
                 for s_ix, src in enumerate(tq_inbox[d]):
+                    src_m = src if tq_ok[d][s_ix] else NO_PEER
                     ring_pick = self._sample_intro(
-                        d, self.peers[d].slots, s_ix, src, _TRACKER_INTRO_SALT)
+                        d, self.peers[d].slots, s_ix, src, _TRACKER_INTRO_SALT,
+                        req_sym=self._nat_sym(src_m))
                     if rt > 1:
                         j = ((s_ix + 1 + rand_u32(seed, rnd, d, P_INTRO,
                                                   s_ix + _TRACKER_INTRO_SALT
@@ -774,6 +824,11 @@ class OracleSim:
                                   if j < len(tq_inbox[d]) and tq_ok[d][j]
                                   else NO_PEER)
                     if inbox_pick == src:
+                        inbox_pick = NO_PEER
+                    if (inbox_pick != NO_PEER and self._nat_sym(src_m)
+                            and self._nat_sym(inbox_pick)):
+                        # never pair two symmetric-NAT requesters (engine's
+                        # inbox-introduction NAT filter)
                         inbox_pick = NO_PEER
                     intro_t[d].append(inbox_pick if inbox_pick != NO_PEER
                                       else ring_pick)
@@ -793,7 +848,8 @@ class OracleSim:
             for s_ix, src in enumerate(req_inbox[d]):
                 ex = src if rq_ok[d][s_ix] else NO_PEER
                 intro[d].append(self._sample_intro(
-                    d, self.peers[d].slots, s_ix, ex, 0))
+                    d, self.peers[d].slots, s_ix, ex, 0,
+                    req_sym=self._nat_sym(ex)))
                 if rq_ok[d][s_ix] and intro[d][s_ix] != NO_PEER:
                     self.peers[d].bytes_up += PUNCTURE_REQUEST_BYTES
 
@@ -833,7 +889,10 @@ class OracleSim:
         pu_edges = []
         for c in range(n):
             for s_ix, a in enumerate(punc_req_inbox[c]):
-                if pq_ok[c][s_ix] and not self._lost(c, _LOSS_PUNCTURE, s_ix):
+                if (pq_ok[c][s_ix] and not self._lost(c, _LOSS_PUNCTURE, s_ix)
+                        and not (self._nat_sym(c) and self._nat_sym(a))):
+                    # symmetric<->symmetric punctures never land (engine's
+                    # puncture NAT gate)
                     pu_edges.append((a, c))
         punc_inbox: list[list[int]] = [[] for _ in range(n)]
         for a, c in pu_edges:
@@ -1060,25 +1119,40 @@ class OracleSim:
                 fresh0.append(k2 not in store_keys and k2 not in seen)
                 seen.add(k2)
             batch_flips = []
+            deleg_flags = [False] * len(ok_batch)
             if cfg.timeline_enabled:
                 # Fold the whole batch's fresh authorize/revoke records
                 # before any check runs (engine: tl.fold precedes tl.check).
+                # Pass A: root (founder) grants; pass B: delegated grants,
+                # ALL judged against the post-pass-A table snapshot, then
+                # folded in batch order (engine's fr/fr2 two-pass).
+                gmask = ((1 << cfg.n_meta) - 1) | DELEGATE_BIT
                 for rec, f0 in zip(ok_batch, fresh0):
                     if (rec.meta in (META_AUTHORIZE, META_REVOKE) and f0
                             and rec.member == self._founder(i)):
-                        self._auth_fold(i, rec.payload,
-                                        rec.aux & ((1 << cfg.n_meta) - 1),
+                        self._auth_fold(i, rec.payload, rec.aux & gmask,
+                                        rec.gt, rec.meta == META_REVOKE)
+                deleg_flags = [
+                    rec.meta in (META_AUTHORIZE, META_REVOKE)
+                    and rec.member != self._founder(i)
+                    and self._grant_ok(i, rec.member,
+                                       rec.aux & ((1 << cfg.n_meta) - 1),
+                                       rec.gt)
+                    for rec in ok_batch]
+                for rec, f0, dg in zip(ok_batch, fresh0, deleg_flags):
+                    if dg and f0:
+                        self._auth_fold(i, rec.payload, rec.aux & gmask,
                                         rec.gt, rec.meta == META_REVOKE)
                 if cfg.dynamic_meta_mask:
                     # this batch's fresh accepted dynamic-settings flips
-                    # (engine: flip_ok = fresh0 & is_flip & ctrl_ok)
+                    # (engine: flip_ok = fresh0 & is_flip & ctrl_ok0)
                     for rec, f0 in zip(ok_batch, fresh0):
                         if (rec.meta == META_DYNAMIC and f0
                                 and rec.member == self._founder(i)):
                             batch_flips.append((rec.gt, rec.payload,
                                                 rec.aux))
-            accept = [self._intake_accept(i, rec, batch_flips)
-                      for rec in ok_batch]
+            accept = [self._intake_accept(i, rec, batch_flips, dg)
+                      for rec, dg in zip(ok_batch, deleg_flags)]
             if delay_on:
                 # DelayMessageByProof pen (engine: waiting/parked masks).
                 # A non-control record failing only the permission check,

@@ -1,0 +1,98 @@
+"""Intake-check kernels: broadcast and chunked forms must be bit-identical.
+
+The chunked forms exist so non-fusing backends never materialize the
+[N, B, M] product tensors (the 199.9 GB Bloom incident's shape class —
+BENCH.md r2); correctness-wise the two forms are the same reductions in a
+different order of evaluation, so equality is exact, not approximate.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dispersy_tpu import engine
+from dispersy_tpu.config import (EMPTY_U32, META_DYNAMIC, META_UNDO_OWN,
+                                 CommunityConfig)
+from dispersy_tpu.ops import intake as ik
+from dispersy_tpu.ops import store as st
+from dispersy_tpu.state import init_state
+
+
+def _rand_store(rng, n, m):
+    """A store with realistic duplicates, control metas, and EMPTY holes."""
+    gt = rng.integers(1, 40, (n, m)).astype(np.uint32)
+    holes = rng.random((n, m)) < 0.25
+    gt[holes] = EMPTY_U32
+    meta = rng.integers(0, 6, (n, m)).astype(np.uint32)
+    meta[rng.random((n, m)) < 0.15] = META_DYNAMIC
+    meta[rng.random((n, m)) < 0.1] = META_UNDO_OWN
+    return st.StoreCols(
+        gt=jnp.asarray(gt),
+        member=jnp.asarray(rng.integers(0, 12, (n, m)), jnp.uint32),
+        meta=jnp.asarray(meta),
+        payload=jnp.asarray(rng.integers(0, 12, (n, m)), jnp.uint32),
+        aux=jnp.asarray(rng.integers(0, 30, (n, m)), jnp.uint32),
+        flags=jnp.zeros((n, m), jnp.uint32))
+
+
+def _rand_batch(rng, n, b):
+    return (jnp.asarray(rng.integers(0, 12, (n, b)), jnp.uint32),    # member
+            jnp.asarray(rng.integers(1, 40, (n, b)), jnp.uint32),    # gt
+            jnp.asarray(rng.integers(0, 8, (n, b)), jnp.uint32),     # meta
+            jnp.asarray(rng.integers(0, 12, (n, b)), jnp.uint32),    # payload
+            jnp.asarray(rng.integers(0, 30, (n, b)), jnp.uint32),    # aux
+            jnp.asarray(rng.random((n, b)) < 0.8))                   # ok
+
+
+def test_all_checks_cross_form_equal():
+    rng = np.random.default_rng(21)
+    for trial in range(4):
+        n, m, b = 10, 17, 9
+        stc = _rand_store(rng, n, m)
+        member, gt, meta, payload, aux, ok = _rand_batch(rng, n, b)
+        cases = {
+            "in_store": lambda i: ik.in_store(stc, member, gt, impl=i),
+            "conflict": lambda i: ik.conflict(stc, member, gt, meta,
+                                              payload, aux, impl=i),
+            "dup_earlier": lambda i: ik.dup_earlier(member, gt, ok, impl=i),
+            "flip_best": lambda i: ik.flip_best(stc, meta, gt, impl=i),
+            "undo_marked": lambda i: ik.undo_marked(stc, member, gt, impl=i),
+            "undo_hits_store": lambda i: ik.undo_hits_store(
+                stc, payload, aux, ok, impl=i),
+            "seq_stored_max": lambda i: ik.seq_stored_max(stc, member, meta,
+                                                          impl=i),
+        }
+        for name, fn in cases.items():
+            np.testing.assert_array_equal(
+                np.asarray(fn("broadcast")), np.asarray(fn("chunked")),
+                err_msg=f"trial {trial}: {name}")
+
+
+def test_engine_step_forced_chunked_matches_broadcast(monkeypatch):
+    """One full feature-rich round, every intake check forced through the
+    chunked form, must equal the broadcast-form round bit-for-bit (states
+    compared leaf-by-leaf).  Fresh jits per form: the forced selection is
+    trace-time state, so the cached compiled step must not be reused."""
+    cfg = CommunityConfig(
+        n_peers=48, n_trackers=2, k_candidates=8, msg_capacity=24,
+        bloom_capacity=16, request_inbox=4, tracker_inbox=16,
+        response_budget=4, timeline_enabled=True, protected_meta_mask=0b10,
+        dynamic_meta_mask=0b10, delay_inbox=2, malicious_enabled=True,
+        seq_meta_mask=0b100, double_meta_mask=0b1000, packet_loss=0.05)
+
+    def run(impl):
+        monkeypatch.setattr(ik, "_auto_impl", lambda i, e: impl)
+        state = init_state(cfg, jax.random.PRNGKey(3))
+        state = engine.seed_overlay(state, cfg, degree=6)
+        authors = jnp.arange(cfg.n_peers) % 5 == 4
+        state = engine.create_messages(
+            state, cfg, author_mask=authors, meta=0,
+            payload=jnp.arange(cfg.n_peers, dtype=jnp.uint32))
+        fn = jax.jit(lambda s: engine.step.__wrapped__(s, cfg))
+        for _ in range(4):
+            state = fn(state)
+        return jax.device_get(state)
+
+    a, b = run("broadcast"), run("chunked")
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))

@@ -1,0 +1,88 @@
+"""NAT connection-type semantics (reference: candidate.py connection_type).
+
+The reference tags every candidate ``public`` / ``symmetric-NAT`` and
+constrains introductions and punctures accordingly; the rebuild derives
+the type statically per identity (config.p_symmetric) and applies the same
+two constraints: no symmetric<->symmetric introductions, no
+symmetric<->symmetric punctures.  Engine and oracle must agree bit-for-bit
+with the model on, and symmetric peers must still converge via public
+intermediaries.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dispersy_tpu import engine as E
+from dispersy_tpu import state as S
+from dispersy_tpu.config import NO_PEER, CommunityConfig
+from dispersy_tpu.ops import candidates as cand
+from dispersy_tpu.ops import rng
+
+from test_oracle import run_both
+
+
+def test_trace_equality_with_symmetric_nat():
+    cfg = CommunityConfig(
+        n_peers=32, n_trackers=2, k_candidates=8, msg_capacity=16,
+        bloom_capacity=16, request_inbox=4, tracker_inbox=16,
+        response_budget=4, p_symmetric=0.3, packet_loss=0.05,
+        churn_rate=0.05)
+    run_both(cfg, rounds=12, author=5, warm=4)
+
+
+def test_intro_filter_blocks_symmetric_pairs():
+    """sample_introductions never hands a symmetric candidate to a
+    symmetric requester, and still serves public candidates to them."""
+    cfg = CommunityConfig(n_peers=16, n_trackers=1, k_candidates=4,
+                          p_symmetric=0.5)
+    now = jnp.float32(10.0)
+    # one responder (row 0) with 2 fresh walked candidates: 5 (sym), 6 (pub)
+    tab = cand.CandTable(
+        peer=jnp.asarray([[5, 6, NO_PEER, NO_PEER]], jnp.int32),
+        last_walk=jnp.full((1, 4), 9.0, jnp.float32),
+        last_stumble=jnp.full((1, 4), -1e9, jnp.float32),
+        last_intro=jnp.full((1, 4), -1e9, jnp.float32))
+    seed = jnp.uint32(7)
+    sym = jnp.asarray([[True, False, False, False]])   # candidate 5 is sym
+    for trial in range(8):
+        pick = cand.sample_introductions(
+            tab, now, cfg, seed, jnp.uint32(trial), jnp.asarray([0]),
+            exclude=jnp.asarray([[NO_PEER]], jnp.int32),
+            req_sym=jnp.asarray([[True]]), slot_sym=sym)
+        assert int(pick[0, 0]) == 6, "symmetric requester must get the public pick"
+    # a public requester can draw either candidate
+    seen = {int(cand.sample_introductions(
+        tab, now, cfg, seed, jnp.uint32(trial), jnp.asarray([0]),
+        exclude=jnp.asarray([[NO_PEER]], jnp.int32),
+        req_sym=jnp.asarray([[False]]), slot_sym=sym)[0, 0])
+        for trial in range(16)}
+    assert seen == {5, 6}
+
+
+def test_symmetric_peers_converge_via_public_intermediaries():
+    """30% symmetric peers: one record floods the whole overlay anyway —
+    symmetric peers learn it through public relays (the reference's NAT
+    story), and no symmetric<->symmetric pair hole-punches."""
+    cfg = CommunityConfig(
+        n_peers=64, n_trackers=2, k_candidates=8, msg_capacity=16,
+        bloom_capacity=16, request_inbox=8, tracker_inbox=32,
+        response_budget=8, p_symmetric=0.3)
+    state = S.init_state(cfg, jax.random.PRNGKey(2))
+    state = E.seed_overlay(state, cfg, degree=6)
+    author = cfg.n_trackers + 1
+    state = E.create_messages(
+        state, cfg, jnp.arange(cfg.n_peers) == author, meta=1,
+        payload=jnp.full(cfg.n_peers, 42, jnp.uint32))
+    gt = int(state.global_time[author])
+    for _ in range(40):
+        state = E.step(state, cfg)
+    cov = float(E.coverage(state, member=author, gt=gt, meta=1, payload=42))
+    assert cov >= 0.99, f"symmetric peers stalled: coverage {cov}"
+    # sanity: the population really is mixed
+    idx = jnp.arange(cfg.n_peers)
+    seed = rng.fold_seed(state.key)
+    sym = np.asarray(
+        (rng.rand_uniform(seed, jnp.uint32(0), idx, rng.P_NAT)
+         < cfg.p_symmetric) & (idx >= cfg.n_trackers))
+    assert 8 <= sym.sum() <= 30

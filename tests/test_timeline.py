@@ -259,3 +259,99 @@ def test_trace_undo_own_marks_everywhere():
     target = (sm == 9) & (sg == target_gt) & (sme == PROT)
     assert target.any(axis=1).sum() > 1          # replicated
     assert (sf[target] & S.FLAG_UNDONE).all()    # every replica marked
+
+
+def test_check_grant_unit():
+    """check_grant: delegate rows only, every masked meta required,
+    revoke-latest-wins per meta, empty mask never proves."""
+    from dispersy_tpu.config import DELEGATE_BIT
+    dele = (1 << PROT) | DELEGATE_BIT
+
+    def cg(tab, member, mask, gt):
+        out = tl.check_grant(tab, jnp.asarray([[member]], jnp.uint32),
+                             jnp.asarray([[mask]], jnp.uint32),
+                             jnp.asarray([[gt]], jnp.uint32), n_meta=8)
+        return bool(out[0, 0])
+
+    tab = mk_table([(7, dele, 5)])
+    assert cg(tab, 7, 1 << PROT, 5)
+    assert not cg(tab, 7, 1 << PROT, 4)      # before the delegation
+    assert not cg(tab, 7, 0, 50)             # empty mask proves nothing
+    assert not cg(tab, 7, (1 << PROT) | 1, 50)   # meta 0 not delegated
+    assert not cg(tab, 8, 1 << PROT, 50)     # other member
+    # a permit-only grant (no DELEGATE_BIT) conveys no authorize right
+    tab2 = mk_table([(7, 1 << PROT, 5)])
+    assert not cg(tab2, 7, 1 << PROT, 50)
+    # delegation revoked from gt 9 on; tie goes to the revoke
+    tab3 = mk_table([(7, dele, 5), (7, dele | tl.REVOKE_BIT, 9)])
+    assert cg(tab3, 7, 1 << PROT, 8)
+    assert not cg(tab3, 7, 1 << PROT, 9)
+
+
+def test_trace_delegation_chain():
+    """founder -> A (authorize w/ DELEGATE) -> A grants B (permit) -> B's
+    protected record spreads — the chain the reference walks as recursive
+    authorize proofs (timeline.py Timeline.check), engine==oracle at every
+    round."""
+    from dispersy_tpu.config import DELEGATE_BIT
+    A, B = 9, 12
+    script = {
+        0: [(FOUNDER, META_AUTHORIZE, A, (1 << PROT) | DELEGATE_BIT)],
+        5: [(A, META_AUTHORIZE, B, 1 << PROT)],
+        10: [(B, PROT, 444, 0)],
+    }
+    state, oracle = run_both_script(CFG, script, rounds=20)
+    holders = int(jnp.sum(jnp.any(
+        (state.store_payload == 444) & (state.store_member == B), axis=1)))
+    assert holders > 1, "delegated grant never validated B's record"
+
+
+def test_trace_revoke_mid_chain():
+    """Founder revokes A's delegation mid-chain: B's pre-revoke grant and
+    record stay valid (fold-time validity — ops/timeline.py docstring's
+    documented divergence), while A's post-revoke grants are refused at
+    create and rejected at intake, so the would-be grantee's record never
+    spreads.  Engine==oracle bit-for-bit throughout."""
+    from dispersy_tpu.config import DELEGATE_BIT
+    A, B, C = 9, 12, 13
+    dele = (1 << PROT) | DELEGATE_BIT
+    script = {
+        0: [(FOUNDER, META_AUTHORIZE, A, dele)],
+        5: [(A, META_AUTHORIZE, B, 1 << PROT)],
+        9: [(B, PROT, 555, 0)],
+        12: [(FOUNDER, META_REVOKE, A, dele)],
+        16: [(A, META_AUTHORIZE, C, 1 << PROT)],
+        18: [(C, PROT, 666, 0)],
+    }
+    state, oracle = run_both_script(CFG, script, rounds=24)
+    early = int(jnp.sum(jnp.any(
+        (state.store_payload == 555) & (state.store_member == B), axis=1)))
+    assert early > 1, "pre-revoke chain record should keep spreading"
+    late = int(jnp.sum(jnp.any(
+        (state.store_payload == 666) & (state.store_member == C), axis=1)))
+    assert late <= 1, "post-revoke grant must not validate new records"
+
+
+def test_check_grant_cross_form_equal():
+    """check_grant's broadcast and chunked forms are bit-identical on
+    random tables with delegate/revoke rows and EMPTY holes."""
+    from dispersy_tpu.config import DELEGATE_BIT
+    rng = np.random.default_rng(31)
+    n, a, b, n_meta = 9, 6, 7, 8
+    for trial in range(5):
+        member = rng.integers(0, 8, (n, a)).astype(np.uint32)
+        member[rng.random((n, a)) < 0.3] = EMPTY_U32
+        mask = rng.integers(0, 1 << n_meta, (n, a)).astype(np.uint32)
+        mask |= np.where(rng.random((n, a)) < 0.5, DELEGATE_BIT, 0).astype(np.uint32)
+        mask |= np.where(rng.random((n, a)) < 0.3, tl.REVOKE_BIT, 0).astype(np.uint32)
+        tab = tl.AuthTable(member=jnp.asarray(member), mask=jnp.asarray(mask),
+                           gt=jnp.asarray(rng.integers(1, 20, (n, a)), jnp.uint32))
+        q_member = jnp.asarray(rng.integers(0, 8, (n, b)), jnp.uint32)
+        q_mask = jnp.asarray(rng.integers(0, 1 << n_meta, (n, b)), jnp.uint32)
+        q_gt = jnp.asarray(rng.integers(1, 20, (n, b)), jnp.uint32)
+        got_b = tl.check_grant(tab, q_member, q_mask, q_gt, n_meta,
+                               impl="broadcast")
+        got_c = tl.check_grant(tab, q_member, q_mask, q_gt, n_meta,
+                               impl="chunked")
+        np.testing.assert_array_equal(np.asarray(got_b), np.asarray(got_c),
+                                      err_msg=f"trial {trial}")

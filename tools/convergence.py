@@ -42,7 +42,9 @@ def broadcast_curve(n_peers: int = 10_000, degree: int = 8,
                     max_rounds: int = 120, target: float = 0.99,
                     seed: int = 0, **overrides) -> dict:
     """Config #2: one author's record floods the overlay; returns the
-    per-round coverage curve and rounds-to-target."""
+    per-round coverage curve and rounds-to-target.  ``overrides`` reach
+    the config — e.g. ``p_symmetric=0.3`` for the NAT-mix run (symmetric
+    peers must converge via public intermediaries)."""
     cfg = CommunityConfig(
         n_peers=n_peers, n_trackers=2, k_candidates=16, msg_capacity=16,
         bloom_capacity=16, request_inbox=8,
@@ -72,6 +74,7 @@ def broadcast_curve(n_peers: int = 10_000, degree: int = 8,
     return {
         "config": "broadcast_cfg2",
         "n_peers": n_peers, "degree": degree, "seed": seed,
+        "p_symmetric": cfg.p_symmetric,
         "target": target,
         "rounds_to_target": rounds_to_target,
         "rounds_run": len(curve),
@@ -306,6 +309,9 @@ def main() -> None:
     ap.add_argument("--scale", type=float, default=1.0,
                     help="population scale factor (CPU-sized runs)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--symmetric", type=float, default=0.0,
+                    help="config #2 only: fraction of symmetric-NAT peers "
+                         "(candidate.py connection_type model)")
     ap.add_argument("--dispatch", choices=("per-call", "multi"),
                     default="per-call",
                     help="config #4 stepping: 'multi' = one fused "
@@ -315,7 +321,8 @@ def main() -> None:
     args = ap.parse_args()
     if args.config == 2:
         out = broadcast_curve(n_peers=int(10_000 * args.scale),
-                              seed=args.seed)
+                              seed=args.seed,
+                              p_symmetric=args.symmetric)
     elif args.config == 4:
         out = walker_churn_health(n_peers=int(1_000_000 * args.scale),
                                   seed=args.seed, dispatch=args.dispatch)

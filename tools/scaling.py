@@ -1,0 +1,151 @@
+"""Sharded-step scaling measurement: 1/2/4/8-device mesh at real scale.
+
+SURVEY §6's north star is a *multi-chip* number (≥10k rounds/s @ 1M peers
+on a v5e-8 — 8 chips); this environment exposes one TPU chip through an
+intermittent tunnel, so the multi-device evidence comes from the virtual
+CPU mesh (``xla_force_host_platform_device_count``), same as the test
+suite and the driver's dryrun.
+
+**What a virtual mesh can and cannot show** (this host has ONE physical
+core): all D virtual devices timeshare that core, so wall time cannot
+*drop* with D — ideal SPMD partitioning keeps it FLAT (total work is
+conserved; per-device arrays shrink by 1/D).  The honest scaling metric
+here is ``overhead_vs_1dev = t_D / t_1``: the partition + collective cost
+factor the sharded program pays on top of the single-device program.  On
+real chips, projected throughput ≈ D × single-chip rate / overhead — the
+replacement for round 2's unmeasured "linear scaling ⇒ ~8x" prose
+(VERDICT r2 "what's missing" #3).
+
+The delivery sort-by-receiver (ops/inbox.py — the UDP seam, the step's
+ONLY cross-shard exchange) is timed standalone at the step's exact shapes
+via tools/profile.py's kernel proxies, so the artifact records how much of
+the step the collective seam costs at each mesh size.
+
+Each mesh size runs in its own bounded subprocess (cpu_env pins the
+backend and the device count; the axon tunnel discipline).
+
+Usage:
+    python tools/scaling.py --peers 65536 --out artifacts/scaling_virtual8.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dispersy_tpu.cpuenv import cpu_env  # jax-free import
+
+WORKER_TIMEOUT_S = int(os.environ.get("SCALING_TIMEOUT", "3600"))
+
+
+def _worker(args) -> None:
+    import jax
+
+    from dispersy_tpu import engine
+    from dispersy_tpu.cpuenv import enable_repo_cache
+    from dispersy_tpu.parallel import make_mesh
+    from tools.profile import _bench_cfg, _prepared, kernel_proxies
+
+    enable_repo_cache()
+    d = args.devices
+    mesh = make_mesh(d) if d > 1 else None
+    cfg = _bench_cfg(args.peers)
+    state = _prepared(cfg, mesh)
+    for _ in range(2):   # compile + warm stores
+        state = engine.step(state, cfg)
+        jax.block_until_ready(state)   # virtual-mesh serialization caveat
+
+    t0 = time.perf_counter()
+    for _ in range(args.rounds):
+        state = engine.step(state, cfg)
+        jax.block_until_ready(state)
+    step_s = (time.perf_counter() - t0) / args.rounds
+
+    proxies = kernel_proxies(cfg, state, mesh)
+    deliver_s = proxies["deliver_request"] + proxies.get("deliver_push", 0.0)
+    print("SCALING_JSON:" + json.dumps({
+        "devices": d,
+        "rounds_per_sec": round(1.0 / step_s, 4),
+        "step_seconds": round(step_s, 4),
+        "deliver_seconds": round(deliver_s, 4),
+        "deliver_share_of_step": round(deliver_s / step_s, 4),
+        "kernels": {k: round(v, 4) for k, v in proxies.items()},
+    }))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--peers", type=int, default=65536)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--devices", type=int, default=0,
+                    help="worker-only: one mesh size")
+    ap.add_argument("--mesh-sizes", type=str, default="1,2,4,8")
+    ap.add_argument("--out", default="artifacts/scaling_virtual8.json")
+    ap.add_argument("--worker", action="store_true")
+    args = ap.parse_args()
+    if args.worker:
+        _worker(args)
+        return
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results = []
+    for d in [int(x) for x in args.mesh_sizes.split(",")]:
+        cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+               "--peers", str(args.peers), "--rounds", str(args.rounds),
+               "--devices", str(d)]
+        t0 = time.time()
+        try:
+            proc = subprocess.run(cmd, env=cpu_env(max(d, 1)), cwd=repo,
+                                  timeout=WORKER_TIMEOUT_S,
+                                  capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            print(f"mesh size {d}: TIMEOUT", file=sys.stderr)
+            results.append({"devices": d, "error": "timeout"})
+            continue
+        row = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("SCALING_JSON:"):
+                row = json.loads(line[len("SCALING_JSON:"):])
+        if row is None:
+            sys.stderr.write(proc.stderr[-2000:])
+            results.append({"devices": d, "error": f"rc={proc.returncode}"})
+            continue
+        row["wall_seconds"] = round(time.time() - t0, 1)
+        results.append(row)
+        print(f"mesh size {d}: {row['rounds_per_sec']} r/s "
+              f"(deliver {row['deliver_share_of_step']:.0%} of step)",
+              file=sys.stderr, flush=True)
+
+    base = next((r.get("step_seconds") for r in results
+                 if r.get("devices") == 1 and "step_seconds" in r), None)
+    for r in results:
+        if base and "step_seconds" in r:
+            r["overhead_vs_1dev"] = round(r["step_seconds"] / base, 4)
+    out = {
+        "n_peers": args.peers,
+        "rounds_per_point": args.rounds,
+        "platform": "cpu-virtual-mesh",
+        "host_physical_cores": os.cpu_count(),
+        "results": results,
+        "note": (
+            "All mesh sizes timeshare the same physical core(s): ideal "
+            "SPMD keeps step time FLAT vs 1 device; overhead_vs_1dev is "
+            "the partition+collective cost factor.  Projected multi-chip "
+            "throughput = devices x single-chip rate / overhead."),
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "results"}))
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,7 +42,9 @@ from dispersy_tpu.state import NEVER, PeerState, init_state
 # sig_signed/sig_done/sig_expired counters — v1 archives lack those leaves.
 # v3: + the malicious-member blacklist (mal_member) and conflicts counter.
 # v4: + the delayed-message pen (dly_*) and msgs_delayed counter.
-FORMAT_VERSION = 4
+# v5: + the pen's deliverer column (dly_src) and the proof_requests /
+#     proof_records counters (active missing-proof round trips).
+FORMAT_VERSION = 5
 
 
 def _fingerprint(cfg: CommunityConfig) -> str:
@@ -65,12 +67,7 @@ def save(path: str, state: PeerState, cfg: CommunityConfig) -> None:
     arrays["meta:version"] = np.asarray(FORMAT_VERSION)
     arrays["meta:config"] = np.frombuffer(
         _fingerprint(cfg).encode(), dtype=np.uint8)
-    buf = io.BytesIO()
-    np.savez_compressed(buf, **arrays)
-    tmp = f"{path}.tmp"
-    with open(tmp, "wb") as f:       # atomic-ish: no torn checkpoint files
-        f.write(buf.getvalue())
-    os.replace(tmp, path)
+    _atomic_npz(path, arrays)
 
 
 def restore(path: str, cfg: CommunityConfig,
@@ -108,35 +105,169 @@ def restore(path: str, cfg: CommunityConfig,
             leaves.append(arr)
     state = jax.tree_util.tree_unflatten(treedef, leaves)
     if fresh_candidates:
-        # Reference restart semantics: everything that lives in process
-        # memory (not the database) is ephemeral — candidates (the walker
-        # re-bootstraps from trackers, SURVEY §5.4), the signature
-        # RequestCache, the delayed-message pen, and malicious-member
-        # convictions all die with the process, exactly as the engine's
-        # churn rebirth models.
-        n, k, d = cfg.n_peers, cfg.k_candidates, cfg.delay_inbox
-        f = cfg.forward_buffer
-        never = np.full((n, k), NEVER, np.float32)
-        state = state.replace(
-            cand_peer=np.full((n, k), NO_PEER, np.int32),
-            cand_last_walk=never,
-            cand_last_stumble=never.copy(),
-            cand_last_intro=never.copy(),
-            fwd_gt=np.full((n, f), EMPTY_U32, np.uint32),
-            fwd_member=np.full((n, f), EMPTY_U32, np.uint32),
-            fwd_meta=np.full((n, f), EMPTY_U32, np.uint32),
-            fwd_payload=np.full((n, f), EMPTY_U32, np.uint32),
-            fwd_aux=np.full((n, f), EMPTY_U32, np.uint32),
-            sig_target=np.full((n,), NO_PEER, np.int32),
-            sig_meta=np.zeros((n,), np.uint32),
-            sig_payload=np.zeros((n,), np.uint32),
-            sig_gt=np.zeros((n,), np.uint32),
-            sig_since=np.zeros((n,), np.uint32),
-            mal_member=np.full((n, cfg.k_malicious), EMPTY_U32, np.uint32),
-            dly_gt=np.full((n, d), EMPTY_U32, np.uint32),
-            dly_member=np.full((n, d), EMPTY_U32, np.uint32),
-            dly_meta=np.full((n, d), EMPTY_U32, np.uint32),
-            dly_payload=np.full((n, d), EMPTY_U32, np.uint32),
-            dly_aux=np.zeros((n, d), np.uint32),
-            dly_since=np.zeros((n, d), np.uint32))
+        state = _wipe_ephemeral(state, cfg)
+    return state
+
+
+def _wipe_ephemeral(state: PeerState, cfg: CommunityConfig) -> PeerState:
+    """Reference restart semantics: everything that lives in process
+    memory (not the database) is ephemeral — candidates (the walker
+    re-bootstraps from trackers, SURVEY §5.4), the signature
+    RequestCache, the delayed-message pen, and malicious-member
+    convictions all die with the process, exactly as the engine's
+    churn rebirth models."""
+    n, k, d = cfg.n_peers, cfg.k_candidates, cfg.delay_inbox
+    f = cfg.forward_buffer
+    never = np.full((n, k), NEVER, np.float32)
+    return state.replace(
+        cand_peer=np.full((n, k), NO_PEER, np.int32),
+        cand_last_walk=never,
+        cand_last_stumble=never.copy(),
+        cand_last_intro=never.copy(),
+        fwd_gt=np.full((n, f), EMPTY_U32, np.uint32),
+        fwd_member=np.full((n, f), EMPTY_U32, np.uint32),
+        fwd_meta=np.full((n, f), EMPTY_U32, np.uint32),
+        fwd_payload=np.full((n, f), EMPTY_U32, np.uint32),
+        fwd_aux=np.full((n, f), EMPTY_U32, np.uint32),
+        sig_target=np.full((n,), NO_PEER, np.int32),
+        sig_meta=np.zeros((n,), np.uint32),
+        sig_payload=np.zeros((n,), np.uint32),
+        sig_gt=np.zeros((n,), np.uint32),
+        sig_since=np.zeros((n,), np.uint32),
+        mal_member=np.full((n, cfg.k_malicious), EMPTY_U32, np.uint32),
+        dly_gt=np.full((n, d), EMPTY_U32, np.uint32),
+        dly_member=np.full((n, d), EMPTY_U32, np.uint32),
+        dly_meta=np.full((n, d), EMPTY_U32, np.uint32),
+        dly_payload=np.full((n, d), EMPTY_U32, np.uint32),
+        dly_aux=np.zeros((n, d), np.uint32),
+        dly_since=np.zeros((n, d), np.uint32),
+        dly_src=np.full((n, d), NO_PEER, np.int32))
+
+
+def _atomic_npz(path: str, arrays: dict) -> None:
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:       # atomic-ish: no torn checkpoint files
+        f.write(buf.getvalue())
+    os.replace(tmp, path)
+
+
+def save_sharded(dirpath: str, state: PeerState,
+                 cfg: CommunityConfig) -> None:
+    """Multi-host sharded layout: one file per device holding only that
+    device's addressable shards of the peer-axis leaves.
+
+    Shard keys carry the GLOBAL row range (``leaf:<name>:rows<lo>_<hi>``),
+    so reassembly is mesh-shape-agnostic: a checkpoint saved on an 8-way
+    mesh restores onto 4-way, 2-way, or a single device bit-exactly
+    (:func:`restore_sharded`).  On a real multi-host pod each process
+    calls this against a shared directory and writes only its own
+    addressable shards — the union of the per-host files is the
+    checkpoint, orbax-style; replicated leaves (clock scalars, the RNG
+    key) land in ``meta.npz``, written once.  (Single-process virtual
+    meshes write every shard file themselves, which is the tested path
+    in this environment.)
+    """
+    import glob as _glob
+
+    os.makedirs(dirpath, exist_ok=True)
+    # A reused directory may hold MORE shard files than this mesh writes
+    # (e.g. an older 8-way save overwritten by a 4-way one); stale files
+    # would silently win over fresh rows at restore.  Clear them first.
+    for old in _glob.glob(os.path.join(dirpath, "shard_*.npz")):
+        os.remove(old)
+    names, leaves, _ = _leaves_with_paths(state)
+    n = cfg.n_peers
+    meta = {"meta:version": np.asarray(FORMAT_VERSION),
+            "meta:config": np.frombuffer(_fingerprint(cfg).encode(),
+                                         dtype=np.uint8)}
+    per_dev: dict[int, dict] = {}
+    for name, leaf in zip(names, leaves):
+        peer_sharded = (hasattr(leaf, "addressable_shards")
+                        and getattr(leaf, "ndim", 0) >= 1
+                        and leaf.shape[0] == n and n > 2)
+        if not peer_sharded:
+            meta[f"leaf:{name}"] = np.asarray(jax.device_get(leaf))
+            continue
+        for sh in leaf.addressable_shards:
+            sl = sh.index[0] if sh.index else slice(None)
+            lo = 0 if sl.start is None else int(sl.start)
+            hi = n if sl.stop is None else int(sl.stop)
+            per_dev.setdefault(sh.device.id, {})[
+                f"leaf:{name}:rows{lo}_{hi}"] = np.asarray(sh.data)
+    _atomic_npz(os.path.join(dirpath, "meta.npz"), meta)
+    for dev_id, arrays in per_dev.items():
+        _atomic_npz(os.path.join(dirpath, f"shard_{dev_id:05d}.npz"),
+                    arrays)
+
+
+def restore_sharded(dirpath: str, cfg: CommunityConfig,
+                    fresh_candidates: bool = False) -> PeerState:
+    """Reassemble a :func:`save_sharded` checkpoint (any mesh shape).
+
+    Returns host arrays; re-shard onto the target mesh with
+    ``parallel.shard_state`` — the row-range keys make the source mesh
+    width irrelevant.  Raises ValueError on version/config mismatch,
+    missing rows (a lost host's shard file), or shape conflicts.
+    """
+    import glob as _glob
+
+    with np.load(os.path.join(dirpath, "meta.npz")) as z:
+        version = int(z["meta:version"])
+        if version != FORMAT_VERSION:
+            raise ValueError(f"checkpoint format {version}, "
+                             f"expected {FORMAT_VERSION}")
+        stored_cfg = bytes(z["meta:config"]).decode()
+        if stored_cfg != _fingerprint(cfg):
+            raise ValueError(
+                "checkpoint was written under a different config:\n"
+                f"  stored: {stored_cfg}\n  given:  {_fingerprint(cfg)}")
+        meta_leaves = {k[len("leaf:"):]: z[k] for k in z.files
+                      if k.startswith("leaf:")}
+    template = init_state(cfg, jax.random.PRNGKey(0))
+    names, t_leaves, treedef = _leaves_with_paths(template)
+    n = cfg.n_peers
+    filled: dict[str, np.ndarray] = {}
+    covered: dict[str, np.ndarray] = {}
+    for name, t in zip(names, t_leaves):
+        if name not in meta_leaves:
+            filled[name] = np.empty(t.shape, t.dtype)
+            covered[name] = np.zeros((n,), bool)
+    for spath in sorted(_glob.glob(os.path.join(dirpath, "shard_*.npz"))):
+        with np.load(spath) as z:
+            for key in z.files:
+                body = key[len("leaf:"):]
+                name, _, rng_part = body.rpartition(":rows")
+                lo, hi = (int(x) for x in rng_part.split("_"))
+                if name not in filled:
+                    raise ValueError(f"{spath}: unknown leaf {name}")
+                arr = z[key]
+                want = filled[name]
+                if arr.shape[1:] != want.shape[1:] or arr.dtype != want.dtype:
+                    raise ValueError(
+                        f"field {name} rows [{lo},{hi}): shard "
+                        f"{arr.shape}/{arr.dtype} vs config "
+                        f"{want.shape}/{want.dtype}")
+                want[lo:hi] = arr
+                covered[name][lo:hi] = True
+    leaves = []
+    for name, t in zip(names, t_leaves):
+        if name in meta_leaves:
+            arr = meta_leaves[name]
+            if arr.shape != t.shape or arr.dtype != t.dtype:
+                raise ValueError(
+                    f"field {name}: checkpoint {arr.shape}/{arr.dtype} vs "
+                    f"config {t.shape}/{t.dtype}")
+            leaves.append(arr)
+        else:
+            if not covered[name].all():
+                missing = int((~covered[name]).sum())
+                raise ValueError(
+                    f"field {name}: {missing} peer rows missing from the "
+                    "shard files (lost host?)")
+            leaves.append(filled[name])
+    state = jax.tree_util.tree_unflatten(treedef, leaves)
+    if fresh_candidates:
+        state = _wipe_ephemeral(state, cfg)
     return state

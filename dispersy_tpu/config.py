@@ -103,6 +103,9 @@ PUNCTURE_REQUEST_BYTES = HEADER_BYTES + 2 * ADDR_BYTES + 2
 PUNCTURE_BYTES = HEADER_BYTES + 2 * ADDR_BYTES + 2
 # one sync record on the wire: header + 5 uint32 columns.
 RECORD_BYTES = HEADER_BYTES + 20
+# missing-proof request: header + 2 B identifier + (member, global_time)
+# (reference: payload.py MissingProofPayload).
+MISSING_PROOF_BYTES = HEADER_BYTES + 2 + 8
 # signature-request: header + 2 B identifier + the draft record's columns
 # (reference: conversion.py packs the half-signed message inside
 # dispersy-signature-request; the response carries it back countersigned).
@@ -275,6 +278,17 @@ class CommunityConfig:
     delay_timeout: float = 52.5         # seconds a record may wait
     #   (reference: DelayMessage lifetimes are request-cache timeouts;
     #    10.5 s x ~5 retries is the missing-proof retry window)
+    # Active missing-proof round trips (reference: community.py
+    # on_missing_proof / the dispersy-missing-proof exchange): each round
+    # a peer with parked records asks each record's DELIVERING peer for
+    # the author's grant chain; the server answers with its stored
+    # authorize/revoke records targeting that author, returned by receipt
+    # in the same round — pen residence becomes one round trip instead of
+    # Bloom re-offer luck.  Off by default (the passive pen alone matches
+    # the r2 semantics; this knob adds the reference's active request).
+    proof_requests: bool = False
+    proof_inbox: int = 4                # proof requests served per round
+    proof_budget: int = 2               # control records returned per request
 
     # ---- clock (reference: community.py claim_global_time /
     #      dispersy_acceptable_global_time_range) ----
@@ -572,6 +586,13 @@ class CommunityConfig:
                                  "delayable — DelayMessageByProof)")
             if self.delay_timeout_rounds < 1:
                 raise ValueError("delay_timeout must cover >= 1 round")
+        if self.proof_requests:
+            if not self.delay_enabled:
+                raise ValueError("proof_requests requires delay_inbox > 0 "
+                                 "(only parked records request proofs)")
+            if self.proof_inbox < 1 or self.proof_budget < 1:
+                raise ValueError("proof_requests requires proof_inbox >= 1 "
+                                 "and proof_budget >= 1")
 
     def replace(self, **kw) -> "CommunityConfig":
         return dataclasses.replace(self, **kw)

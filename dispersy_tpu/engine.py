@@ -58,6 +58,7 @@ from dispersy_tpu.config import (CONTROL_PRIORITY, DELEGATE_BIT, EMPTY_U32,
                                  INTRO_RESPONSE_BYTES, META_AUTHORIZE,
                                  META_DESTROY, META_DYNAMIC, META_IDENTITY,
                                  META_REVOKE, META_UNDO_OTHER, META_UNDO_OWN,
+                                 MISSING_PROOF_BYTES,
                                  NO_PEER, PUNCTURE_BYTES,
                                  PUNCTURE_REQUEST_BYTES, RECORD_BYTES,
                                  SIGNATURE_REQUEST_BYTES,
@@ -79,6 +80,8 @@ _LOSS_SYNC = 4 << 16
 _LOSS_FORWARD = 5 << 16
 _LOSS_SIGREQ = 6 << 16
 _LOSS_SIGRESP = 7 << 16
+_LOSS_PROOF_REQ = 8 << 16
+_LOSS_PROOF_RESP = 9 << 16
 _TRACKER_SALT = 1 << 15
 _TRACKER_INTRO_SALT = 1 << 20
 
@@ -282,7 +285,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                jnp.where(r1, jnp.uint32(EMPTY_U32), state.dly_meta),
                jnp.where(r1, jnp.uint32(EMPTY_U32), state.dly_payload),
                jnp.where(r1, jnp.uint32(0), state.dly_aux),
-               jnp.where(r1, jnp.uint32(0), state.dly_since))
+               jnp.where(r1, jnp.uint32(0), state.dly_since),
+               jnp.where(r1, NO_PEER, state.dly_src))
         # The auth table is folded from the (wiped) store, so it wipes too:
         # a reborn peer re-learns permissions as authorize records re-sync
         # (reference: Timeline is rebuilt from the database on load).
@@ -306,7 +310,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         fwd = (state.fwd_gt, state.fwd_member, state.fwd_meta,
                state.fwd_payload, state.fwd_aux)
         dly = (state.dly_gt, state.dly_member, state.dly_meta,
-               state.dly_payload, state.dly_aux, state.dly_since)
+               state.dly_payload, state.dly_aux, state.dly_since,
+               state.dly_src)
         auth = _auth(state)
         sig = (state.sig_target, state.sig_meta, state.sig_payload,
                state.sig_gt, state.sig_since)
@@ -411,14 +416,22 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
 
         def bcast(col):
             return jnp.broadcast_to(col[:, :, None], (n, f, c)).reshape(-1)
+        push_cols = [bcast(fwd_gt), bcast(fwd_member), bcast(fwd_meta),
+                     bcast(fwd_payload), bcast(fwd_aux)]
+        if cfg.delay_enabled:
+            # The pen tracks each record's deliverer (the missing-proof
+            # request target), so pushes carry their sender.
+            push_cols.append(jnp.broadcast_to(
+                idx[:, None, None].astype(jnp.uint32), (n, f, c)).reshape(-1))
         push = inbox.deliver(
-            dst=push_dst.reshape(-1),
-            cols=[bcast(fwd_gt), bcast(fwd_member), bcast(fwd_meta),
-                  bcast(fwd_payload), bcast(fwd_aux)],
+            dst=push_dst.reshape(-1), cols=push_cols,
             valid=push_valid.reshape(-1), n_peers=n,
             inbox_size=cfg.push_inbox)
-        ph_gt, ph_member, ph_meta, ph_payload, ph_aux = push.inbox  # [N, P]
+        ph_gt, ph_member, ph_meta, ph_payload, ph_aux = push.inbox[:5]
         ph_ok = push.inbox_valid & alive[:, None]
+        if cfg.delay_enabled:
+            ph_src = jnp.where(ph_ok, push.inbox[5].astype(jnp.int32),
+                               NO_PEER)
         stats = stats.replace(
             msgs_forwarded=stats.msgs_forwarded
             + jnp.sum(push_valid, axis=(1, 2)).astype(jnp.uint32),
@@ -433,6 +446,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         p0 = jnp.zeros((n, 0), jnp.uint32)
         ph_gt = ph_member = ph_meta = ph_payload = ph_aux = p0
         ph_ok = jnp.zeros((n, 0), bool)
+        ph_src = jnp.zeros((n, 0), jnp.int32)
 
     req_lost = _lost(seed, rnd, idx, _LOSS_REQUEST, 0, cfg.packet_loss)
     # target is already NO_PEER for dead/tracker/killed peers (phase 1).
@@ -865,29 +879,118 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         sy_gt = sy_member = sy_meta = sy_payload = sy_aux = s0
         sy_ok = jnp.zeros((n, 0), bool)
 
-    # ---- phase 5: combined intake (delayed pen + sync pull + push +
-    # completed double-signed) -> store.  One batch per round: the pen's
-    # waiting records first (they were delivered in an earlier round —
-    # the reference re-processes a delayed batch ahead of fresh arrivals
-    # when its proof lands), then sync records, then pushed records, then
-    # this round's countersigned completion, in delivery order — mirroring
-    # the reference's _on_batch_cache handling one grouped batch per meta
-    # per window.
     if cfg.delay_enabled:
-        dl_gt, dl_member, dl_meta, dl_payload, dl_aux, dl_since = dly
+        dl_gt, dl_member, dl_meta, dl_payload, dl_aux, dl_since, dl_src = dly
         dl_ok = (dl_gt != jnp.uint32(EMPTY_U32)) & alive[:, None]
     else:
         z0 = jnp.zeros((n, 0), jnp.uint32)
         dl_gt = dl_member = dl_meta = dl_payload = dl_aux = dl_since = z0
+        dl_src = jnp.zeros((n, 0), jnp.int32)
         dl_ok = jnp.zeros((n, 0), bool)
-    in_gt = jnp.concatenate([dl_gt, sy_gt, ph_gt, db_gt], axis=1)  # [N, B]
-    in_member = jnp.concatenate([dl_member, sy_member, ph_member, db_member],
-                                axis=1)
-    in_meta = jnp.concatenate([dl_meta, sy_meta, ph_meta, db_meta], axis=1)
+
+    # ---- phase 4p: active missing-proof round trip ---------------------
+    # (reference: community.py on_missing_proof — a receiver that delayed
+    # a message for its proof sends dispersy-missing-proof(member,
+    # global_time) to the message's SENDER, which answers with the stored
+    # authorize chain justifying it.)  Round-synchronous recast: each
+    # parked record's original deliverer is asked this round; its stored
+    # authorize/revoke records targeting the parked record's author ride
+    # back by receipt and join THIS round's intake batch — where the
+    # parked record (leading the batch via the pen segment) is re-checked
+    # against the batch-folded grants — so pen residence is one round
+    # trip, not Bloom re-offer luck (config.proof_requests).
+    if cfg.delay_enabled and cfg.proof_requests:
+        dd_, pb = cfg.delay_inbox, cfg.proof_budget
+        have_pen = dl_ok & (dl_src != NO_PEER)                  # [N, D]
+        prq_lost = _lost(seed, rnd, idx[:, None], _LOSS_PROOF_REQ,
+                         jnp.arange(dd_)[None, :], cfg.packet_loss)
+        bup = bup + jnp.sum(have_pen, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(MISSING_PROOF_BYTES)
+        preq = inbox.deliver(
+            dst=dl_src.reshape(-1), cols=[dl_member.reshape(-1)],
+            valid=(have_pen & ~prq_lost).reshape(-1), n_peers=n,
+            inbox_size=cfg.proof_inbox)
+        (pq_author,) = preq.inbox                               # [N, Pi]
+        pq_pok = preq.inbox_valid & alive[:, None]
+        if cfg.timeline_enabled:
+            pq_pok = pq_pok & ~killed[:, None]
+        stats = stats.replace(
+            proof_requests=stats.proof_requests
+            + jnp.sum(pq_pok, axis=1).astype(jnp.uint32),
+            requests_dropped=stats.requests_dropped
+            + preq.n_dropped.astype(jnp.uint32))
+        bdown = bdown + jnp.sum(pq_pok, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(MISSING_PROOF_BYTES)
+        # Serve: per request, the proof_budget HIGHEST-global_time stored
+        # authorize/revoke rows targeting the author (the store is sorted
+        # ascending, so rank from the end — newest proof first, exactly
+        # the rows Timeline.check's latest-wins rule needs).
+        is_proof_row = ((stc.meta == jnp.uint32(META_AUTHORIZE))
+                        | (stc.meta == jnp.uint32(META_REVOKE)))  # [N, M]
+        pouts = []
+        for s in range(cfg.proof_inbox):
+            m_s = (is_proof_row & pq_pok[:, s:s + 1]
+                   & (stc.payload == pq_author[:, s:s + 1]))    # [N, M]
+            from_end = jnp.cumsum(m_s[:, ::-1].astype(jnp.int32),
+                                  axis=1)[:, ::-1] - 1
+            pslot = jnp.where(m_s & (from_end < pb), from_end, pb)
+            pouts.append(tuple(st.rank_compact(col, pslot, pb, fill)
+                               for col, fill in
+                               ((stc.gt, EMPTY_U32), (stc.member, EMPTY_U32),
+                                (stc.meta, EMPTY_U32),
+                                (stc.payload, EMPTY_U32), (stc.aux, 0),
+                                (m_s, False))))
+        pbox = [jnp.stack([o[i] for o in pouts], axis=1)
+                for i in range(6)]                              # [N, Pi, pb]
+        n_served = jnp.sum(pbox[5], axis=(1, 2)).astype(jnp.uint32)
+        bup = bup + n_served * jnp.uint32(RECORD_BYTES)
+        # Pickup by receipt at the requester: pen slot (i, d)'s reply sits
+        # at edge_slot[i*D + d] of server dl_src[i, d]'s outbox.
+        src_flat = jnp.maximum(dl_src.reshape(-1), 0)           # [N*D]
+        eslot = jnp.maximum(preq.edge_slot, 0)
+        got = ((preq.edge_slot >= 0)
+               & pq_pok[src_flat, eslot]).reshape(n, dd_)       # [N, D]
+
+        def pick(col):
+            return col[src_flat, eslot].reshape(n, dd_ * pb)
+        pr_gt, pr_member, pr_meta, pr_payload, pr_aux = (
+            pick(c) for c in pbox[:5])
+        prs_lost = _lost(seed, rnd, idx[:, None], _LOSS_PROOF_RESP,
+                         jnp.arange(dd_ * pb)[None, :], cfg.packet_loss)
+        pr_ok = (pick(pbox[5])
+                 & jnp.repeat(got, pb, axis=1)
+                 & alive[:, None] & ~prs_lost)
+        pr_src = jnp.repeat(dl_src, pb, axis=1)
+        stats = stats.replace(
+            proof_records=stats.proof_records
+            + jnp.sum(pr_ok, axis=1).astype(jnp.uint32))
+        bdown = bdown + jnp.sum(pr_ok, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(RECORD_BYTES)
+    else:
+        q0 = jnp.zeros((n, 0), jnp.uint32)
+        pr_gt = pr_member = pr_meta = pr_payload = pr_aux = q0
+        pr_ok = jnp.zeros((n, 0), bool)
+        pr_src = jnp.zeros((n, 0), jnp.int32)
+
+    # ---- phase 5: combined intake (delayed pen + sync pull + push +
+    # completed double-signed + returned proofs) -> store.  One batch per
+    # round: the pen's waiting records first (they were delivered in an
+    # earlier round — the reference re-processes a delayed batch ahead of
+    # fresh arrivals when its proof lands), then sync records, then pushed
+    # records, then this round's countersigned completion, then the
+    # missing-proof replies, in delivery order — mirroring the reference's
+    # _on_batch_cache handling one grouped batch per meta per window.
+    in_gt = jnp.concatenate([dl_gt, sy_gt, ph_gt, db_gt, pr_gt],
+                            axis=1)                            # [N, B]
+    in_member = jnp.concatenate([dl_member, sy_member, ph_member, db_member,
+                                 pr_member], axis=1)
+    in_meta = jnp.concatenate([dl_meta, sy_meta, ph_meta, db_meta, pr_meta],
+                              axis=1)
     in_payload = jnp.concatenate([dl_payload, sy_payload, ph_payload,
-                                  db_payload], axis=1)
-    in_aux = jnp.concatenate([dl_aux, sy_aux, ph_aux, db_aux], axis=1)
-    in_ok = jnp.concatenate([dl_ok, sy_ok, ph_ok, db_ok], axis=1)
+                                  db_payload, pr_payload], axis=1)
+    in_aux = jnp.concatenate([dl_aux, sy_aux, ph_aux, db_aux, pr_aux],
+                             axis=1)
+    in_ok = jnp.concatenate([dl_ok, sy_ok, ph_ok, db_ok, pr_ok], axis=1)
     bb = in_gt.shape[1]
     if cfg.delay_enabled:
         # Round each batch entry was (first) delivered: pen entries keep
@@ -895,6 +998,19 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         in_since = jnp.concatenate(
             [dl_since, jnp.broadcast_to(rnd, (n, bb - dl_since.shape[1]))],
             axis=1).astype(jnp.uint32)
+        # Each entry's deliverer — the future missing-proof target should
+        # it park (sync pulls come from the walk target; pushes carry
+        # their sender; a completed double-signed record came back from
+        # its countersigner; proof replies from the serving peer).
+        sy_src = jnp.where(sy_ok, jnp.broadcast_to(
+            target[:, None], sy_ok.shape), NO_PEER)
+        # sg_target is the PRE-clear cache target (the cache frees on
+        # completion, exactly when the record exists).
+        db_src = (jnp.where(db_ok, sg_target[:, None], NO_PEER)
+                  if db_ok.shape[1] else
+                  jnp.zeros((n, 0), jnp.int32))
+        in_src = jnp.concatenate(
+            [dl_src, sy_src, ph_src, db_src, pr_src], axis=1)
     if bb > 0:
         # Clock-jump defense before the store accepts anything.
         in_ok = in_ok & (in_gt <= global_time[:, None] + jnp.uint32(
@@ -1196,7 +1312,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                    st.rank_compact(in_meta, dslot, dd, EMPTY_U32),
                    st.rank_compact(in_payload, dslot, dd, EMPTY_U32),
                    st.rank_compact(in_aux, dslot, dd, 0),
-                   st.rank_compact(in_since, dslot, dd, 0))
+                   st.rank_compact(in_since, dslot, dd, 0),
+                   st.rank_compact(in_src, dslot, dd, NO_PEER))
             stats = stats.replace(
                 msgs_delayed=stats.msgs_delayed
                 + jnp.sum(parked & (in_since == rnd),
@@ -1229,7 +1346,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         fwd_gt=fwd[0], fwd_member=fwd[1], fwd_meta=fwd[2], fwd_payload=fwd[3],
         fwd_aux=fwd[4],
         dly_gt=dly[0], dly_member=dly[1], dly_meta=dly[2], dly_payload=dly[3],
-        dly_aux=dly[4], dly_since=dly[5],
+        dly_aux=dly[4], dly_since=dly[5], dly_src=dly[6],
         auth_member=auth.member, auth_mask=auth.mask, auth_gt=auth.gt,
         sig_target=sig[0], sig_meta=sig[1], sig_payload=sig[2],
         sig_gt=sig[3], sig_since=sig[4],

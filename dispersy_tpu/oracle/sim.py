@@ -29,7 +29,8 @@ from dispersy_tpu.config import (CONTROL_PRIORITY, DELEGATE_BIT, EMPTY_U32,
                                  INTRO_REQUEST_BASE_BYTES,
                                  INTRO_RESPONSE_BYTES, META_AUTHORIZE,
                                  META_DESTROY, META_DYNAMIC, META_REVOKE,
-                                 META_UNDO_OTHER, META_UNDO_OWN, NO_PEER,
+                                 META_UNDO_OTHER, META_UNDO_OWN,
+                                 MISSING_PROOF_BYTES, NO_PEER,
                                  PUNCTURE_BYTES, PUNCTURE_REQUEST_BYTES,
                                  RECORD_BYTES, SIGNATURE_REQUEST_BYTES,
                                  SIGNATURE_RESPONSE_BYTES, CommunityConfig,
@@ -53,6 +54,8 @@ _LOSS_SYNC = 4 << 16
 _LOSS_FORWARD = 5 << 16
 _LOSS_SIGREQ = 6 << 16
 _LOSS_SIGRESP = 7 << 16
+_LOSS_PROOF_REQ = 8 << 16
+_LOSS_PROOF_RESP = 9 << 16
 _TRACKER_SALT = 1 << 15
 _TRACKER_INTRO_SALT = 1 << 20
 
@@ -149,9 +152,10 @@ class OraclePeer:
         self.store: list[Record] = []   # kept sorted by Record.key()
         self.fwd: list[Record] = []     # forward batch for next round
         self.auth: list[AuthRow] = []   # bounded at cfg.k_authorized
-        # delayed-message pen: (record, round first parked) pairs, bounded
-        # at cfg.delay_inbox (engine dly_* fields)
-        self.delay: list[tuple[Record, int]] = []
+        # delayed-message pen: (record, round first parked, delivering
+        # peer) triples, bounded at cfg.delay_inbox (engine dly_* fields,
+        # incl. dly_src — the missing-proof request target)
+        self.delay: list[tuple[Record, int, int]] = []
         # signature request cache (one in flight; engine sig_* fields)
         self.sig_target = NO_PEER
         self.sig_meta = self.sig_payload = 0
@@ -165,6 +169,7 @@ class OraclePeer:
         self.msgs_forwarded = self.msgs_rejected = 0
         self.msgs_direct = 0
         self.msgs_delayed = 0
+        self.proof_requests = self.proof_records = 0
         self.sig_signed = self.sig_done = self.sig_expired = 0
         self.conflicts = 0
         self.bytes_up = self.bytes_down = 0          # wrap mod 2^32
@@ -710,7 +715,9 @@ class OracleSim:
         # phase 1f: push forwarding (engine phase 1f — last round's fresh
         # records to forward_fanout distinct verified candidates, targets
         # sampled from the pre-stumble candidate table)
-        push_inbox: list[list[Record]] = [[] for _ in range(n)]
+        # entries are (record, sender) — the sender is the pen's
+        # missing-proof target should the record park (engine ph_src)
+        push_inbox: list[list[tuple[Record, int]]] = [[] for _ in range(n)]
         if cfg.forward_fanout > 0:
             cc = cfg.forward_fanout
             k = cfg.k_candidates
@@ -735,7 +742,7 @@ class OracleSim:
                                               fi * cc + ci):
                                 sent += 1
                                 if len(push_inbox[tc]) < cfg.push_inbox:
-                                    push_inbox[tc].append(rec)
+                                    push_inbox[tc].append((rec, i))
                                     if self.peers[tc].alive:
                                         self.peers[tc].bytes_down += \
                                             RECORD_BYTES
@@ -1049,36 +1056,87 @@ class OracleSim:
                     # counts obox_ok at the sender)
                     self.peers[d].bytes_up += len(sel) * RECORD_BYTES
 
+        # phase 4p: active missing-proof round trip (engine phase 4p) —
+        # computed for ALL peers against the pre-intake stores before any
+        # intake mutation, exactly like the fused engine phase.
+        delay_on = cfg.delay_inbox > 0
+        pr_batch: list[list[tuple[Record, int]]] = [[] for _ in range(n)]
+        if delay_on and cfg.proof_requests:
+            proof_inbox: list[list[tuple[int, int]]] = [[] for _ in range(n)]
+            for i in range(n):
+                p = self.peers[i]
+                for d, (rec, since, src) in enumerate(p.delay):
+                    if not p.alive or src == NO_PEER:
+                        continue
+                    p.bytes_up += MISSING_PROOF_BYTES       # sendto, pre-loss
+                    if self._lost(i, _LOSS_PROOF_REQ, d):
+                        continue
+                    if 0 <= src < n:
+                        if len(proof_inbox[src]) < cfg.proof_inbox:
+                            proof_inbox[src].append((i, d))
+                        else:
+                            self.peers[src].requests_dropped += 1
+            replies: dict[tuple[int, int], list[Record]] = {}
+            for sv in range(n):
+                psv = self.peers[sv]
+                if not psv.alive or (cfg.timeline_enabled and killed[sv]):
+                    continue
+                for (ri, d_slot) in proof_inbox[sv]:
+                    psv.proof_requests += 1
+                    psv.bytes_down += MISSING_PROOF_BYTES
+                    author = self.peers[ri].delay[d_slot][0].member
+                    served = [r for r in reversed(psv.store)
+                              if r.meta in (META_AUTHORIZE, META_REVOKE)
+                              and r.payload == author][:cfg.proof_budget]
+                    psv.bytes_up += len(served) * RECORD_BYTES
+                    replies[(ri, d_slot)] = served
+            for i in range(n):
+                p = self.peers[i]
+                for d, entry in enumerate(p.delay):
+                    for b_ix, r in enumerate(replies.get((i, d), [])):
+                        if not p.alive or self._lost(
+                                i, _LOSS_PROOF_RESP,
+                                d * cfg.proof_budget + b_ix):
+                            continue
+                        pr_batch[i].append(
+                            (Record(r.gt, r.member, r.meta, r.payload,
+                                    r.aux), entry[2]))
+                        p.proof_records += 1
+                        p.bytes_down += RECORD_BYTES
+
         # phase 5: combined intake (delayed pen + sync pull + push) ->
         # store + fwd batch + rebuilt pen
-        delay_on = cfg.delay_inbox > 0
         for i in range(n):
             p = self.peers[i]
             # On-the-wire records: (gt, member, meta, payload, aux) — flags
             # are receiver-local and never travel (engine sends 5 columns).
-            # Each batch entry pairs the record with the round it (first)
-            # arrived: pen entries keep their parking round (engine
-            # in_since), fresh deliveries stamp this round.
-            batch: list[tuple[Record, int]] = []
+            # Each batch entry carries the record, the round it (first)
+            # arrived (pen entries keep their parking round — engine
+            # in_since), and its deliverer (engine in_src; the future
+            # missing-proof target should it park).
+            batch: list[tuple[Record, int, int]] = []
             if delay_on and p.alive:
                 # pen first (engine: dl segment leads the concat)
-                batch.extend((rec, since) for rec, since in p.delay)
+                batch.extend(p.delay)
             if cfg.sync_enabled and p.alive and req_slot[i] >= 0:
                 recs = outbox.get((targets[i], req_slot[i]), [])
                 for j, r in enumerate(recs):
                     if not self._lost(i, _LOSS_SYNC, j):
                         batch.append((Record(r.gt, r.member, r.meta,
-                                             r.payload, r.aux), rnd))
+                                             r.payload, r.aux), rnd,
+                                      targets[i]))
                         p.bytes_down += RECORD_BYTES
             if p.alive:
                 batch.extend((Record(r.gt, r.member, r.meta, r.payload,
-                                     r.aux), rnd)
-                             for r in push_inbox[i])
+                                     r.aux), rnd, src)
+                             for r, src in push_inbox[i])
             if sig_completed[i] is not None:
-                batch.append((sig_completed[i], rnd))
+                # the record's aux IS the countersigner it came back from
+                batch.append((sig_completed[i], rnd, sig_completed[i].aux))
+            batch.extend((rec, rnd, src) for rec, src in pr_batch[i])
             # clock-jump defense (engine: post-walk-fold clock), plus the
             # structural countersigner check for double-signed metas
-            ok_pairs = [(rec, s) for rec, s in batch
+            ok_pairs = [(rec, s, sc) for rec, s, sc in batch
                         if rec.gt <= (p.global_time
                                       + cfg.acceptable_global_time_range)
                         and self._dbl_struct_ok(i, rec)]
@@ -1091,7 +1149,7 @@ class OracleSim:
                 # engine: conviction + blacklist run AFTER the killed gate
                 # (a killed peer's emptied batch convicts nobody), in
                 # batch order (fold_set semantics)
-                for rec, _ in ok_pairs:
+                for rec, *_ in ok_pairs:
                     conflict = any(
                         r.member == rec.member and r.gt == rec.gt
                         and (r.meta != rec.meta or r.payload != rec.payload
@@ -1103,13 +1161,14 @@ class OracleSim:
                             p.conflicts += 1
                         else:
                             p.msgs_dropped += 1
-                n_black = sum(1 for rec, _ in ok_pairs
+                n_black = sum(1 for rec, *_ in ok_pairs
                               if rec.member in p.mal)
                 p.msgs_rejected += n_black
-                ok_pairs = [(rec, s) for rec, s in ok_pairs
+                ok_pairs = [(rec, s, sc) for rec, s, sc in ok_pairs
                             if rec.member not in p.mal]
-            ok_batch = [rec for rec, _ in ok_pairs]
-            ok_since = [s for _, s in ok_pairs]
+            ok_batch = [rec for rec, *_ in ok_pairs]
+            ok_since = [s for _, s, _ in ok_pairs]
+            ok_src = [sc for *_, sc in ok_pairs]
             # freshness: not stored yet, not a dup of an earlier batch entry
             store_keys = {(r.gt, r.member) for r in p.store}
             fresh0: list[bool] = []
@@ -1160,17 +1219,17 @@ class OracleSim:
                 # waiting window parks; first-fit into the bounded pen.
                 ctrl = (META_AUTHORIZE, META_REVOKE, META_UNDO_OWN,
                         META_UNDO_OTHER, META_DYNAMIC, META_DESTROY)
-                new_delay: list[tuple[Record, int]] = []
+                new_delay: list[tuple[Record, int, int]] = []
                 parked_flags: list[bool] = []
-                for rec, s, a, f0 in zip(ok_batch, ok_since, accept,
-                                         fresh0):
+                for rec, s, sc, a, f0 in zip(ok_batch, ok_since, ok_src,
+                                             accept, fresh0):
                     waiting = (not a and rec.meta not in ctrl and f0
                                and rnd - s < cfg.delay_timeout_rounds)
                     parked = waiting and len(new_delay) < cfg.delay_inbox
                     if parked:
                         new_delay.append(
                             (Record(rec.gt, rec.member, rec.meta,
-                                    rec.payload, rec.aux), s))
+                                    rec.payload, rec.aux), s, sc))
                         if s == rnd:
                             p.msgs_delayed += 1
                     parked_flags.append(parked)
@@ -1319,6 +1378,11 @@ class OracleSim:
                                    np.uint32),
             "dly_aux": np.zeros((n, cfg.delay_inbox), np.uint32),
             "dly_since": np.zeros((n, cfg.delay_inbox), np.uint32),
+            "dly_src": np.full((n, cfg.delay_inbox), NO_PEER, np.int32),
+            "proof_requests": np.array(
+                [p.proof_requests for p in self.peers], np.uint32),
+            "proof_records": np.array(
+                [p.proof_records for p in self.peers], np.uint32),
             "msgs_delayed": np.array([p.msgs_delayed for p in self.peers],
                                      np.uint32),
             "mal_member": np.full((n, cfg.k_malicious), EMPTY_U32, np.uint32),
@@ -1383,13 +1447,14 @@ class OracleSim:
                 out["auth_member"][i, j] = row.member
                 out["auth_mask"][i, j] = row.mask
                 out["auth_gt"][i, j] = row.gt
-            for j, (rec, since) in enumerate(p.delay):
+            for j, (rec, since, src) in enumerate(p.delay):
                 out["dly_gt"][i, j] = rec.gt
                 out["dly_member"][i, j] = rec.member
                 out["dly_meta"][i, j] = rec.meta
                 out["dly_payload"][i, j] = rec.payload
                 out["dly_aux"][i, j] = rec.aux
                 out["dly_since"][i, j] = since
+                out["dly_src"][i, j] = src
             for j, mb in enumerate(p.mal):
                 out["mal_member"][i, j] = mb
         return out

@@ -53,6 +53,11 @@ class Stats:
     msgs_delayed: jnp.ndarray     # u32[N] records parked awaiting a
     #   permission proof (reference: statistics.py delay counts from
     #   check_callback DelayMessageByProof outcomes; config.delay_inbox)
+    # Active missing-proof round trips (reference: community.py
+    # on_missing_proof serving dispersy-missing-proof requests;
+    # config.proof_requests):
+    proof_requests: jnp.ndarray   # u32[N] missing-proof requests served
+    proof_records: jnp.ndarray    # u32[N] proof records received back
     # Double-signed flow counters (reference: statistics.py counts
     # signature-request/-response traffic; SURVEY §3.5):
     sig_signed: jnp.ndarray       # u32[N] countersignatures granted (B side)
@@ -125,6 +130,9 @@ class PeerState:
     dly_payload: jnp.ndarray  # u32
     dly_aux: jnp.ndarray      # u32
     dly_since: jnp.ndarray    # u32 round the record was first parked
+    dly_src: jnp.ndarray      # i32 delivering peer of the parked record —
+    #   the dispersy-missing-proof request target (config.proof_requests);
+    #   NO_PEER when unknown
 
     # ---- outstanding signature request (reference: requestcache.py — the
     #      dispersy-signature-request cache entry; one in flight per peer,
@@ -154,7 +162,7 @@ def init_stats(n: int, n_meta: int = 8) -> Stats:
     return Stats(walk_success=z(), walk_fail=z(), msgs_stored=z(),
                  msgs_dropped=z(), requests_dropped=z(), punctures=z(),
                  msgs_forwarded=z(), msgs_rejected=z(), msgs_direct=z(),
-                 msgs_delayed=z(),
+                 msgs_delayed=z(), proof_requests=z(), proof_records=z(),
                  sig_signed=z(), sig_done=z(), sig_expired=z(),
                  conflicts=z(),
                  bytes_up=z(), bytes_down=z(),
@@ -200,6 +208,7 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         dly_payload=jnp.full((n, config.delay_inbox), EMPTY_U32, jnp.uint32),
         dly_aux=jnp.zeros((n, config.delay_inbox), jnp.uint32),
         dly_since=jnp.zeros((n, config.delay_inbox), jnp.uint32),
+        dly_src=jnp.full((n, config.delay_inbox), NO_PEER, jnp.int32),
         auth_member=jnp.full((n, a), EMPTY_U32, jnp.uint32),
         auth_mask=jnp.zeros((n, a), jnp.uint32),
         auth_gt=jnp.zeros((n, a), jnp.uint32),

@@ -28,12 +28,13 @@ FIELDS = ["alive", "session", "global_time",
           "store_aux", "store_flags",
           "fwd_gt", "fwd_member", "fwd_meta", "fwd_payload", "fwd_aux",
           "dly_gt", "dly_member", "dly_meta", "dly_payload", "dly_aux",
-          "dly_since",
+          "dly_since", "dly_src",
           "auth_member", "auth_mask", "auth_gt", "mal_member",
           "sig_target", "sig_meta", "sig_payload", "sig_gt", "sig_since"]
 STAT_FIELDS = ["walk_success", "walk_fail", "msgs_stored", "msgs_dropped",
                "requests_dropped", "punctures", "msgs_forwarded",
                "msgs_rejected", "msgs_direct", "msgs_delayed",
+               "proof_requests", "proof_records",
                "sig_signed", "sig_done", "sig_expired", "conflicts",
                "bytes_up", "bytes_down", "accepted_by_meta"]
 

@@ -36,6 +36,7 @@ import jax
 import numpy as np
 
 from dispersy_tpu.config import EMPTY_U32, CommunityConfig, NO_PEER
+from dispersy_tpu.exceptions import CheckpointError
 from dispersy_tpu.state import NEVER, PeerState, init_state
 
 # v2: PeerState gained the signature request cache (sig_*) and Stats the
@@ -82,11 +83,11 @@ def restore(path: str, cfg: CommunityConfig,
     with np.load(path) as z:
         version = int(z["meta:version"])
         if version != FORMAT_VERSION:
-            raise ValueError(f"checkpoint format {version}, "
+            raise CheckpointError(f"checkpoint format {version}, "
                              f"expected {FORMAT_VERSION}")
         stored_cfg = bytes(z["meta:config"]).decode()
         if stored_cfg != _fingerprint(cfg):
-            raise ValueError(
+            raise CheckpointError(
                 "checkpoint was written under a different config:\n"
                 f"  stored: {stored_cfg}\n  given:  {_fingerprint(cfg)}")
         # Template provides the treedef (and validates shapes below).
@@ -96,10 +97,10 @@ def restore(path: str, cfg: CommunityConfig,
         for n, t in zip(names, t_leaves):
             key = f"leaf:{n}"
             if key not in z:
-                raise ValueError(f"checkpoint missing field {n}")
+                raise CheckpointError(f"checkpoint missing field {n}")
             arr = z[key]
             if arr.shape != t.shape or arr.dtype != t.dtype:
-                raise ValueError(
+                raise CheckpointError(
                     f"field {n}: checkpoint {arr.shape}/{arr.dtype} vs "
                     f"config {t.shape}/{t.dtype}")
             leaves.append(arr)
@@ -216,11 +217,11 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
     with np.load(os.path.join(dirpath, "meta.npz")) as z:
         version = int(z["meta:version"])
         if version != FORMAT_VERSION:
-            raise ValueError(f"checkpoint format {version}, "
+            raise CheckpointError(f"checkpoint format {version}, "
                              f"expected {FORMAT_VERSION}")
         stored_cfg = bytes(z["meta:config"]).decode()
         if stored_cfg != _fingerprint(cfg):
-            raise ValueError(
+            raise CheckpointError(
                 "checkpoint was written under a different config:\n"
                 f"  stored: {stored_cfg}\n  given:  {_fingerprint(cfg)}")
         meta_leaves = {k[len("leaf:"):]: z[k] for k in z.files
@@ -241,11 +242,11 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
                 name, _, rng_part = body.rpartition(":rows")
                 lo, hi = (int(x) for x in rng_part.split("_"))
                 if name not in filled:
-                    raise ValueError(f"{spath}: unknown leaf {name}")
+                    raise CheckpointError(f"{spath}: unknown leaf {name}")
                 arr = z[key]
                 want = filled[name]
                 if arr.shape[1:] != want.shape[1:] or arr.dtype != want.dtype:
-                    raise ValueError(
+                    raise CheckpointError(
                         f"field {name} rows [{lo},{hi}): shard "
                         f"{arr.shape}/{arr.dtype} vs config "
                         f"{want.shape}/{want.dtype}")
@@ -256,14 +257,14 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
         if name in meta_leaves:
             arr = meta_leaves[name]
             if arr.shape != t.shape or arr.dtype != t.dtype:
-                raise ValueError(
+                raise CheckpointError(
                     f"field {name}: checkpoint {arr.shape}/{arr.dtype} vs "
                     f"config {t.shape}/{t.dtype}")
             leaves.append(arr)
         else:
             if not covered[name].all():
                 missing = int((~covered[name]).sum())
-                raise ValueError(
+                raise CheckpointError(
                     f"field {name}: {missing} peer rows missing from the "
                     "shard files (lost host?)")
             leaves.append(filled[name])

@@ -37,6 +37,7 @@ import dataclasses
 import jax
 
 from dispersy_tpu import engine
+from dispersy_tpu.exceptions import ConfigError, MetaNotFoundError
 from dispersy_tpu.config import (MAX_USER_META, META_AUTHORIZE, META_DESTROY,
                                  META_DYNAMIC, META_REVOKE, META_UNDO_OTHER,
                                  META_UNDO_OWN, CommunityConfig,
@@ -87,7 +88,7 @@ class DynamicResolution:
             policies = (PublicResolution(), LinearResolution())
         if not all(isinstance(p, (PublicResolution, LinearResolution))
                    for p in policies):
-            raise ValueError("DynamicResolution candidates must be "
+            raise ConfigError("DynamicResolution candidates must be "
                              "Public/LinearResolution instances")
         self.policies = policies
 
@@ -97,7 +98,7 @@ class FullSyncDistribution:
                  synchronization_direction: str = "ASC",
                  priority: int = DEFAULT_PRIORITY):
         if synchronization_direction not in ("ASC", "DESC"):
-            raise ValueError("synchronization_direction must be ASC|DESC")
+            raise ConfigError("synchronization_direction must be ASC|DESC")
         self.enable_sequence_number = enable_sequence_number
         self.synchronization_direction = synchronization_direction
         self.priority = priority
@@ -107,7 +108,7 @@ class LastSyncDistribution:
     def __init__(self, history_size: int,
                  priority: int = DEFAULT_PRIORITY):
         if history_size < 1:
-            raise ValueError("history_size must be >= 1")
+            raise ConfigError("history_size must be >= 1")
         self.history_size = history_size
         self.priority = priority
 
@@ -152,10 +153,10 @@ class Community:
     def __init__(self, n_peers: int, **overrides):
         metas = self.initiate_meta_messages()
         if len(metas) > MAX_USER_META:
-            raise ValueError(f"at most {MAX_USER_META} user metas")
+            raise ConfigError(f"at most {MAX_USER_META} user metas")
         names = [m.name for m in metas]
         if len(set(names)) != len(names):
-            raise ValueError(f"duplicate meta names: {names}")
+            raise ConfigError(f"duplicate meta names: {names}")
         self.meta_ids = {m.name: i for i, m in enumerate(metas)}
         self.metas = {m.name: m for m in metas}
 
@@ -189,7 +190,7 @@ class Community:
             elif isinstance(d, (DirectDistribution, CandidateDestination)):
                 direct |= 1 << i
             else:
-                raise ValueError(f"unknown distribution for {m.name}: {d}")
+                raise ConfigError(f"unknown distribution for {m.name}: {d}")
             if isinstance(m.destination, CommunityDestination):
                 fanout = max(fanout, m.destination.node_count)
             if isinstance(m.destination, CandidateDestination):
@@ -198,9 +199,9 @@ class Community:
         fields = {f.name for f in dataclasses.fields(CommunityConfig)}
         bad = set(overrides) - fields
         if bad:
-            raise ValueError(f"unknown config overrides: {sorted(bad)}")
+            raise ConfigError(f"unknown config overrides: {sorted(bad)}")
         if len(sign_rates) > 1:
-            raise ValueError("all DoubleMemberAuthentication metas must "
+            raise ConfigError("all DoubleMemberAuthentication metas must "
                              "share one allow_signature_rate (the kernel "
                              "compiles a single countersign_rate)")
         compiled = dict(
@@ -224,7 +225,7 @@ class Community:
             compiled["forward_fanout"] = min(fanout, k_cand)
         conflict = set(compiled) & set(overrides) - {"n_peers"}
         if conflict:
-            raise ValueError(
+            raise ConfigError(
                 f"{sorted(conflict)} are compiled from the meta-message "
                 "declarations; override the declarations instead")
         self.config = CommunityConfig(**{**compiled, **overrides})
@@ -253,7 +254,7 @@ class Community:
                    "dispersy-destroy-community": META_DESTROY}
         if name in control:
             return control[name]
-        raise KeyError(f"unknown meta {name!r}; "
+        raise MetaNotFoundError(f"unknown meta {name!r}; "
                        f"declared: {sorted(self.meta_ids)}")
 
     def create(self, state: PeerState, name: str, author_mask, payload,

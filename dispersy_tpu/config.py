@@ -18,6 +18,8 @@ from __future__ import annotations
 import dataclasses
 import math
 
+from dispersy_tpu.exceptions import ConfigError
+
 # Sentinel for "empty slot" in uint32 record fields: sorts after every real
 # global_time, so ascending sort pushes holes to the end of the store ring.
 EMPTY_U32 = 0xFFFFFFFF
@@ -132,9 +134,9 @@ def bloom_size_for(error_rate: float, capacity: int) -> tuple[int, int]:
     32 so the bitset packs exactly into uint32 words.
     """
     if not (0.0 < error_rate < 1.0):
-        raise ValueError(f"error_rate must be in (0,1), got {error_rate}")
+        raise ConfigError(f"error_rate must be in (0,1), got {error_rate}")
     if capacity <= 0:
-        raise ValueError(f"capacity must be positive, got {capacity}")
+        raise ConfigError(f"capacity must be positive, got {capacity}")
     m = -capacity * math.log(error_rate) / (math.log(2) ** 2)
     n_bits = int(math.ceil(m / 32.0)) * 32
     k = max(1, int(round(n_bits / capacity * math.log(2))))
@@ -492,28 +494,28 @@ class CommunityConfig:
 
     def __post_init__(self) -> None:
         if self.n_peers <= 0:
-            raise ValueError("n_peers must be positive")
+            raise ConfigError("n_peers must be positive")
         if not (0 <= self.n_trackers <= self.n_peers):
-            raise ValueError("n_trackers must be in [0, n_peers]")
+            raise ConfigError("n_trackers must be in [0, n_peers]")
         p = (self.p_revisit_walked + self.p_stumbled + self.p_introduced
              + self.p_bootstrap)
         if abs(p - 1.0) > 1e-6:
-            raise ValueError(f"walk category probabilities sum to {p}, not 1")
+            raise ConfigError(f"walk category probabilities sum to {p}, not 1")
         if self.forward_fanout > self.k_candidates:
-            raise ValueError("forward_fanout cannot exceed k_candidates")
+            raise ConfigError("forward_fanout cannot exceed k_candidates")
         if self.forward_fanout > 0 and (self.forward_buffer < 1
                                         or self.push_inbox < 1):
-            raise ValueError("forward_fanout > 0 requires forward_buffer >= 1 "
+            raise ConfigError("forward_fanout > 0 requires forward_buffer >= 1 "
                              "and push_inbox >= 1")
         if not (1 <= self.n_meta <= MAX_USER_META):
-            raise ValueError(f"n_meta must be in [1, {MAX_USER_META}]")
+            raise ConfigError(f"n_meta must be in [1, {MAX_USER_META}]")
         if self.protected_meta_mask >> self.n_meta:
-            raise ValueError("protected_meta_mask has bits above n_meta")
+            raise ConfigError("protected_meta_mask has bits above n_meta")
         if self.dynamic_meta_mask:
             if self.dynamic_meta_mask >> self.n_meta:
-                raise ValueError("dynamic_meta_mask has bits above n_meta")
+                raise ConfigError("dynamic_meta_mask has bits above n_meta")
             if not self.timeline_enabled:
-                raise ValueError("dynamic_meta_mask requires "
+                raise ConfigError("dynamic_meta_mask requires "
                                  "timeline_enabled (policy flips are "
                                  "timeline state)")
         for name, mask in (("seq_meta_mask", self.seq_meta_mask),
@@ -521,77 +523,77 @@ class CommunityConfig:
                            ("desc_meta_mask", self.desc_meta_mask),
                            ("double_meta_mask", self.double_meta_mask)):
             if mask >> self.n_meta:
-                raise ValueError(f"{name} has bits above n_meta")
+                raise ConfigError(f"{name} has bits above n_meta")
         if self.seq_meta_mask & self.direct_meta_mask:
-            raise ValueError("a meta cannot be both sequenced and direct")
+            raise ConfigError("a meta cannot be both sequenced and direct")
         if self.double_meta_mask & (self.seq_meta_mask
                                     | self.direct_meta_mask):
             # aux carries the countersigner for double metas, so it cannot
             # also carry a sequence number; Direct never stores, so a
             # double signature would protect nothing.
-            raise ValueError("a double-signed meta cannot be sequenced or "
+            raise ConfigError("a double-signed meta cannot be sequenced or "
                              "direct")
         if self.double_meta_mask:
             if self.sig_inbox < 1:
-                raise ValueError("double_meta_mask requires sig_inbox >= 1")
+                raise ConfigError("double_meta_mask requires sig_inbox >= 1")
             if self.sig_timeout_rounds < 1:
-                raise ValueError("sig_timeout must cover >= 1 round")
+                raise ConfigError("sig_timeout must cover >= 1 round")
             if not (0.0 <= self.countersign_rate <= 1.0):
-                raise ValueError("countersign_rate must be in [0, 1]")
+                raise ConfigError("countersign_rate must be in [0, 1]")
         if self.seq_meta_mask & self.desc_meta_mask:
             # DESC would deliver newest-first and leave permanent sequence
             # gaps; the reference pairs enable_sequence_number with ASC.
-            raise ValueError("sequenced metas must sync ASC")
+            raise ConfigError("sequenced metas must sync ASC")
         if self.last_sync_history and len(self.last_sync_history) != self.n_meta:
-            raise ValueError("last_sync_history length must equal n_meta")
+            raise ConfigError("last_sync_history length must equal n_meta")
         if self.meta_priority and len(self.meta_priority) != self.n_meta:
-            raise ValueError("meta_priority length must equal n_meta")
+            raise ConfigError("meta_priority length must equal n_meta")
         if any(not (0 <= p <= 255) for p in self.priorities):
-            raise ValueError("meta_priority entries must be in [0, 255]")
+            raise ConfigError("meta_priority entries must be in [0, 255]")
         for i, k in enumerate(self.history):
             if k < 0:
-                raise ValueError("last_sync_history entries must be >= 0")
+                raise ConfigError("last_sync_history entries must be >= 0")
             if k > 0 and ((self.seq_meta_mask >> i) & 1
                           or (self.direct_meta_mask >> i) & 1):
-                raise ValueError("a LastSync meta cannot be sequenced/direct")
+                raise ConfigError("a LastSync meta cannot be sequenced/direct")
         if self.communities:
             if any(m < 0 or t < 0 for m, t in self.communities):
-                raise ValueError("community sizes must be non-negative")
+                raise ConfigError("community sizes must be non-negative")
             if sum(m + t for m, t in self.communities) != self.n_peers:
-                raise ValueError("community blocks must sum to n_peers")
+                raise ConfigError("community blocks must sum to n_peers")
             if sum(t for _, t in self.communities) != self.n_trackers:
-                raise ValueError(
+                raise ConfigError(
                     "community tracker counts must sum to n_trackers")
             if self.timeline_enabled and self.founder_member >= 0:
-                raise ValueError(
+                raise ConfigError(
                     "multi-community timelines use per-community founders "
                     "(each block's first member); founder_member must stay "
                     "auto (-1)")
         if self.timeline_enabled:
             f = self.founder
             if not (self.n_trackers <= f < self.n_peers):
-                raise ValueError("founder_member must be a non-tracker peer")
+                raise ConfigError("founder_member must be a non-tracker peer")
             if self.k_authorized < 1:
-                raise ValueError("timeline_enabled requires k_authorized >= 1")
+                raise ConfigError("timeline_enabled requires k_authorized >= 1")
         if self.malicious_enabled and self.k_malicious < 1:
-            raise ValueError("malicious_enabled requires k_malicious >= 1")
+            raise ConfigError("malicious_enabled requires k_malicious >= 1")
         if not (0.0 <= self.p_symmetric <= 1.0):
-            raise ValueError("p_symmetric must be in [0, 1]")
+            raise ConfigError("p_symmetric must be in [0, 1]")
         if self.delay_inbox < 0:
-            raise ValueError("delay_inbox must be >= 0")
+            raise ConfigError("delay_inbox must be >= 0")
         if self.delay_inbox > 0:
             if not self.timeline_enabled:
-                raise ValueError("delay_inbox requires timeline_enabled "
+                raise ConfigError("delay_inbox requires timeline_enabled "
                                  "(only permission-rejected records are "
                                  "delayable — DelayMessageByProof)")
             if self.delay_timeout_rounds < 1:
-                raise ValueError("delay_timeout must cover >= 1 round")
+                raise ConfigError("delay_timeout must cover >= 1 round")
         if self.proof_requests:
             if not self.delay_enabled:
-                raise ValueError("proof_requests requires delay_inbox > 0 "
+                raise ConfigError("proof_requests requires delay_inbox > 0 "
                                  "(only parked records request proofs)")
             if self.proof_inbox < 1 or self.proof_budget < 1:
-                raise ValueError("proof_requests requires proof_inbox >= 1 "
+                raise ConfigError("proof_requests requires proof_inbox >= 1 "
                                  "and proof_budget >= 1")
 
     def replace(self, **kw) -> "CommunityConfig":

@@ -1,0 +1,31 @@
+"""Typed exceptions (reference: exception.py — CommunityNotFoundException,
+ConversionNotFoundException, MetaNotFoundException).
+
+The rebuild's error surface is validation-shaped rather than
+lookup-shaped (static configs fail at construction, not at dispatch), so
+each class subclasses the builtin its call sites historically raised —
+existing ``except ValueError`` / ``except KeyError`` callers keep
+working while new code can catch the precise type.
+"""
+
+from __future__ import annotations
+
+
+class ConfigError(ValueError):
+    """An invalid CommunityConfig (config.py __post_init__) or rim
+    declaration (community.py policy compilation)."""
+
+
+class MetaNotFoundError(KeyError):
+    """A message name not declared by this community (reference:
+    MetaNotFoundException from Community.get_meta_message)."""
+
+    def __str__(self) -> str:
+        # KeyError.__str__ repr-quotes its argument, which mangles the
+        # long declared-metas message; render it plainly.
+        return str(self.args[0]) if self.args else ""
+
+
+class CheckpointError(ValueError):
+    """A checkpoint that cannot be restored: version/config mismatch,
+    missing leaves or shard rows, shape conflicts (checkpoint.py)."""

@@ -1,0 +1,59 @@
+"""Logging configuration (reference: logger.py — the std-logging config
+helper every Dispersy module pulled its per-module logger from).
+
+The hot path cannot log (everything under jit traces once), so loggers
+live at the *host* boundary: tools, the scenario driver, checkpointing,
+and per-round metric snapshots.  ``get_logger`` hands out namespaced
+per-module loggers; ``configure`` is the one-call setup the reference's
+logger.py provided (idempotent, so tools can all call it).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_ROOT = "dispersy_tpu"
+_handler: logging.Handler | None = None
+
+
+def get_logger(name: str | None = None) -> logging.Logger:
+    """A logger under the ``dispersy_tpu`` namespace (reference: each
+    module's ``logger = get_logger(__name__)``)."""
+    if not name:
+        return logging.getLogger(_ROOT)
+    if not name.startswith(_ROOT):
+        name = f"{_ROOT}.{name}"
+    return logging.getLogger(name)
+
+
+def configure(level: int | str = logging.INFO, stream=None,
+              fmt: str = "%(asctime)s %(levelname).1s %(name)s: %(message)s"
+              ) -> logging.Logger:
+    """(Re)attach the package stream handler and set the root level.
+
+    Safe to call repeatedly: each call replaces the handler this module
+    previously installed (so later streams/formats WIN — no silent
+    ignore), never touching handlers the embedding application added
+    itself.  Returns the root package logger.  Tools call this at
+    startup; library code only ever calls :func:`get_logger` and inherits
+    whatever was configured — the same contract as the reference's
+    logger.py.
+    """
+    global _handler
+    root = logging.getLogger(_ROOT)
+    root.setLevel(level)
+    if _handler is not None:
+        root.removeHandler(_handler)
+    _handler = logging.StreamHandler(stream or sys.stderr)
+    _handler.setFormatter(logging.Formatter(fmt))
+    root.addHandler(_handler)
+    root.propagate = False
+    return root
+
+
+def log_round(logger: logging.Logger, rnd: int, **fields) -> None:
+    """One structured per-round INFO line (the observability glue between
+    the metrics snapshots and a human tail -f)."""
+    body = " ".join(f"{k}={v}" for k, v in fields.items())
+    logger.info("round %d: %s", rnd, body)

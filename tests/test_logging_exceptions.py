@@ -1,0 +1,81 @@
+"""Typed exceptions + logging layer (reference: exception.py, logger.py).
+
+Each typed exception subclasses the builtin its call sites historically
+raised, so both the precise and the legacy catch styles work.
+"""
+
+import logging
+
+import pytest
+
+from dispersy_tpu import checkpoint as ckpt
+from dispersy_tpu import logutil
+from dispersy_tpu.community import (Community, CommunityDestination,
+                                    FullSyncDistribution,
+                                    MemberAuthentication, Message,
+                                    PublicResolution)
+from dispersy_tpu.config import CommunityConfig
+from dispersy_tpu.exceptions import (CheckpointError, ConfigError,
+                                     MetaNotFoundError)
+
+
+class _C(Community):
+    def initiate_meta_messages(self):
+        return [Message("post", MemberAuthentication(), PublicResolution(),
+                        FullSyncDistribution(),
+                        CommunityDestination(node_count=3))]
+
+
+def test_config_error_is_value_error():
+    with pytest.raises(ConfigError):
+        CommunityConfig(n_peers=0)
+    with pytest.raises(ValueError):        # legacy catch style
+        CommunityConfig(n_trackers=5, n_peers=3)
+
+
+def test_meta_not_found_is_key_error():
+    c = _C(n_peers=32)
+    with pytest.raises(MetaNotFoundError):
+        c.meta_id("nope")
+    with pytest.raises(KeyError):
+        c.meta_id("nope")
+
+
+def test_checkpoint_error_on_garbage(tmp_path):
+    import numpy as np
+    path = str(tmp_path / "bad.npz")
+    np.savez(path, **{"meta:version": np.asarray(999)})
+    with pytest.raises(CheckpointError):
+        ckpt.restore(path, CommunityConfig(n_peers=8))
+
+
+def test_logutil_configure_and_round_line():
+    import io
+    buf = io.StringIO()
+    try:
+        log = logutil.configure(logging.DEBUG, stream=buf)
+        logutil.log_round(logutil.get_logger("tools.test"), 7,
+                          coverage=0.5, parks=1)
+        assert logutil.configure(logging.DEBUG, stream=buf) is log
+        out = buf.getvalue()
+        assert "dispersy_tpu.tools.test" in out
+        assert "round 7: coverage=0.5 parks=1" in out
+        buf2 = io.StringIO()
+        logutil.configure(logging.INFO, stream=buf2)   # later stream WINS
+        logutil.get_logger("tools.test").info("redirected")
+        assert "redirected" in buf2.getvalue()
+        assert "redirected" not in buf.getvalue()
+        # namespacing: bare and dotted names resolve under the package root
+        assert logutil.get_logger().name == "dispersy_tpu"
+        assert logutil.get_logger("x").name == "dispersy_tpu.x"
+    finally:
+        # restore default logging state for the rest of the session
+        logutil.configure(logging.INFO)
+
+
+def test_meta_not_found_str_is_plain():
+    c = _C(n_peers=32)
+    try:
+        c.meta_id("nope")
+    except MetaNotFoundError as e:
+        assert str(e).startswith("unknown meta 'nope'")   # no repr-quoting

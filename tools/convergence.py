@@ -34,8 +34,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from dispersy_tpu import engine
+from dispersy_tpu.logutil import (configure as _configure_logging,
+                                  get_logger, log_round)
 from dispersy_tpu.config import META_AUTHORIZE, CommunityConfig
 from dispersy_tpu.state import init_state
+
+
+_LOG = get_logger("tools.convergence")
 
 
 def broadcast_curve(n_peers: int = 10_000, degree: int = 8,
@@ -45,6 +50,7 @@ def broadcast_curve(n_peers: int = 10_000, degree: int = 8,
     per-round coverage curve and rounds-to-target.  ``overrides`` reach
     the config — e.g. ``p_symmetric=0.3`` for the NAT-mix run (symmetric
     peers must converge via public intermediaries)."""
+    _configure_logging()
     cfg = CommunityConfig(
         n_peers=n_peers, n_trackers=2, k_candidates=16, msg_capacity=16,
         bloom_capacity=16, request_inbox=8,
@@ -66,7 +72,7 @@ def broadcast_curve(n_peers: int = 10_000, degree: int = 8,
         cov = float(engine.coverage(state, member=author, gt=gt, meta=1,
                                     payload=42))
         curve.append(round(cov, 6))
-        print(f"round {rnd}: coverage {cov:.4f}", file=sys.stderr, flush=True)
+        log_round(_LOG, rnd, coverage=round(cov, 4))
         if rounds_to_target is None and cov >= target:
             rounds_to_target = rnd
             break
@@ -96,6 +102,7 @@ def backlog_curve(n_peers: int = 100_000, backlog: int = 1000,
     backlog across rounds exactly as
     ``_dispersy_claim_sync_bloom_filter_modulo`` does.
     """
+    _configure_logging()
     cfg = CommunityConfig(
         n_peers=n_peers, n_trackers=2, k_candidates=16,
         msg_capacity=msg_capacity, bloom_capacity=256, request_inbox=8,
@@ -128,8 +135,7 @@ def backlog_curve(n_peers: int = 100_000, backlog: int = 1000,
         state = engine.step(state, cfg)
         cov = corpus_coverage(state)
         curve.append(round(cov, 6))
-        print(f"round {rnd}: corpus coverage {cov:.4f}", file=sys.stderr,
-              flush=True)
+        log_round(_LOG, rnd, corpus_coverage=round(cov, 4))
         if rounds_to_target is None and cov >= target:
             rounds_to_target = rnd
             break
@@ -158,6 +164,7 @@ def walker_churn_health(n_peers: int = 1_000_000, churn: float = 0.05,
     behavior under real churn (SURVEY §5.3); this makes it a reproducible
     artifact.
     """
+    _configure_logging()
     cfg = CommunityConfig(
         n_peers=n_peers, n_trackers=max(4, n_peers // 65536),
         k_candidates=16, sync_enabled=False, forward_fanout=0,
@@ -213,6 +220,7 @@ def communities_timeline_curve(n_peers: int = 1_000_000,
     t_per = 1
     n_c = n_peers // n_communities
     n_peers = n_c * n_communities     # blocks must tile the row axis
+    _configure_logging()
     cfg = CommunityConfig(
         n_peers=n_peers, n_trackers=n_communities * t_per,
         communities=((n_c - t_per, t_per),) * n_communities,
@@ -282,8 +290,7 @@ def communities_timeline_curve(n_peers: int = 1_000_000,
             worst = 0.0               # records don't exist yet
         # curve[k] is round k+1, exactly like the cfg2/cfg3 artifacts
         curve.append(round(worst, 6))
-        print(f"round {rnd}: worst community coverage {worst:.4f}",
-              file=sys.stderr, flush=True)
+        log_round(_LOG, rnd, worst_community_coverage=round(worst, 4))
         if rounds_to_target is None and worst >= target:
             rounds_to_target = rnd
             break
@@ -302,6 +309,7 @@ def communities_timeline_curve(n_peers: int = 1_000_000,
 
 
 def main() -> None:
+    _configure_logging()
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=(2, 3, 4, 5),
                     required=True)

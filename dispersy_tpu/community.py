@@ -35,10 +35,12 @@ from __future__ import annotations
 import dataclasses
 
 import jax
+import jax.numpy as jnp
 
 from dispersy_tpu import engine
 from dispersy_tpu.exceptions import ConfigError, MetaNotFoundError
-from dispersy_tpu.config import (MAX_USER_META, META_AUTHORIZE, META_DESTROY,
+from dispersy_tpu.config import (DELEGATE_BIT, MAX_USER_META, META_AUTHORIZE,
+                                 META_DESTROY,
                                  META_DYNAMIC, META_REVOKE, META_UNDO_OTHER,
                                  META_UNDO_OWN, CommunityConfig,
                                  DEFAULT_PRIORITY)
@@ -262,6 +264,97 @@ class Community:
         """``Community.create_<name>`` — author one record per masked peer."""
         return engine.create_messages(state, self.config, author_mask,
                                       self.meta_id(name), payload, aux)
+
+    # ---- dedicated control-message constructors (reference: community.py
+    # create_authorize / create_revoke / create_undo /
+    # create_dynamic_settings / create_dispersy_destroy_community — thin
+    # typed fronts over the generic create path) ----
+    def _permission_mask(self, meta_names, delegate: bool) -> int:
+        mask = 0
+        for nm in ([meta_names] if isinstance(meta_names, str)
+                   else meta_names):
+            mid = self.meta_id(nm)
+            if mid >= self.config.n_meta:
+                raise ConfigError(f"cannot grant permissions on control "
+                                  f"meta {nm!r}")
+            mask |= 1 << mid
+        if not mask:
+            # an empty grant/revoke proves and changes nothing
+            # (check_grant rejects it too) — refuse to author one
+            raise ConfigError("meta_names must name at least one meta")
+        if delegate:
+            mask |= DELEGATE_BIT
+        return mask
+
+    def create_authorize(self, state: PeerState, author_mask, target,
+                         meta_names, delegate: bool = False) -> PeerState:
+        """Grant ``target`` the permit permission for ``meta_names``
+        (str or iterable of str); ``delegate=True`` additionally conveys
+        the authorize permission itself, so the target can extend the
+        chain (reference: Community.create_authorize with
+        [(member, message, permission)] triples; ops/timeline.check_grant
+        for the chain semantics)."""
+        n = self.config.n_peers
+        mask = self._permission_mask(meta_names, delegate)
+        return self.create(state, "dispersy-authorize", author_mask,
+                           payload=jnp.full(n, target, jnp.uint32),
+                           aux=jnp.full(n, mask, jnp.uint32))
+
+    def create_revoke(self, state: PeerState, author_mask, target,
+                      meta_names, delegate: bool = False) -> PeerState:
+        """Revoke ``target``'s permissions for ``meta_names`` from the
+        author's next global_time on (reference: Community.create_revoke)."""
+        n = self.config.n_peers
+        mask = self._permission_mask(meta_names, delegate)
+        return self.create(state, "dispersy-revoke", author_mask,
+                           payload=jnp.full(n, target, jnp.uint32),
+                           aux=jnp.full(n, mask, jnp.uint32))
+
+    def create_undo_own(self, state: PeerState, author_mask,
+                        target_gt) -> PeerState:
+        """Each masked author undoes ITS OWN record at ``target_gt``
+        (reference: Community.create_undo on an own message ->
+        dispersy-undo-own)."""
+        n = self.config.n_peers
+        return self.create(
+            state, "dispersy-undo-own", author_mask,
+            payload=jnp.arange(n, dtype=jnp.uint32),
+            aux=jnp.broadcast_to(jnp.asarray(target_gt, jnp.uint32), (n,)))
+
+    def create_undo_other(self, state: PeerState, author_mask, member,
+                          target_gt) -> PeerState:
+        """Undo another member's record at (member, target_gt) — founder
+        authority (reference: dispersy-undo-other)."""
+        n = self.config.n_peers
+        return self.create(
+            state, "dispersy-undo-other", author_mask,
+            payload=jnp.full(n, member, jnp.uint32),
+            aux=jnp.full(n, target_gt, jnp.uint32))
+
+    def create_dynamic_settings(self, state: PeerState, author_mask,
+                                meta_name: str, policy: str) -> PeerState:
+        """Flip ``meta_name``'s resolution policy from the author's next
+        global_time on; ``policy`` is "public" or "linear" (reference:
+        Community.create_dynamic_settings with [(meta, policy)] pairs)."""
+        if policy not in ("public", "linear"):
+            raise ConfigError(f"policy must be 'public' or 'linear', "
+                              f"got {policy!r}")
+        mid = self.meta_id(meta_name)
+        if not (self.config.dynamic_meta_mask >> mid) & 1:
+            raise ConfigError(f"meta {meta_name!r} is not DynamicResolution")
+        n = self.config.n_peers
+        return self.create(
+            state, "dispersy-dynamic-settings", author_mask,
+            payload=jnp.full(n, mid, jnp.uint32),
+            aux=jnp.full(n, 1 if policy == "linear" else 0, jnp.uint32))
+
+    def create_destroy_community(self, state: PeerState,
+                                 author_mask) -> PeerState:
+        """Hard-kill the community (reference:
+        Community.create_dispersy_destroy_community)."""
+        n = self.config.n_peers
+        return self.create(state, "dispersy-destroy-community", author_mask,
+                           payload=jnp.zeros(n, jnp.uint32))
 
     def create_signature_request(self, state: PeerState, name: str,
                                  author_mask, counterparty,

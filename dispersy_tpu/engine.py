@@ -1128,13 +1128,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                           & (in_meta < cfg.n_meta))
                 best = _flip_best(stc, in_meta, in_gt)            # [N, B]
                 flip_ok = fresh0 & is_flip & ctrl_ok0             # [N, B]
-                flip_b = (flip_ok[:, None, :]
-                          & (in_payload[:, None, :] == in_meta[:, :, None])
-                          & (in_gt[:, None, :] <= in_gt[:, :, None]))
-                key_b = jnp.where(
-                    flip_b, in_gt[:, None, :] * 2 + (in_aux[:, None, :] & 1),
-                    0)
-                best = jnp.maximum(best, jnp.max(key_b, axis=-1))
+                best = jnp.maximum(best, ik.flip_best_batch(
+                    flip_ok, in_payload, in_gt, in_aux, in_meta, in_gt))
                 linear_now = jnp.where(best > 0, (best & 1) == 1, protected)
                 protected = jnp.where(is_dyn, linear_now, protected)
             permitted = tl.check(auth, in_member, in_meta, in_gt, founder)

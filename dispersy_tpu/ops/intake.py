@@ -141,28 +141,42 @@ def flip_best(stc, q_meta: jnp.ndarray, q_gt: jnp.ndarray,
     DynamicResolution replay (0 = no flip applies; reference: timeline.py
     ``Timeline.get_resolution_policy`` walking the stored flip chain).
     One definition serves the author gate, the countersigner check, and
-    the intake check; the oracle mirrors it in ``_linear_at``."""
-    n, q = q_meta.shape
-    m = stc.gt.shape[-1]
-    if _auto_impl(impl, n * q * m) == "broadcast":
-        hit = ((stc.meta[:, None, :] == jnp.uint32(META_DYNAMIC))
-               & (stc.payload[:, None, :] == q_meta[:, :, None])
-               & (stc.gt[:, None, :] <= q_gt[:, :, None]))
-        return jnp.max(jnp.where(
-            hit, stc.gt[:, None, :] * 2 + (stc.aux[:, None, :] & 1), 0),
+    the intake check; the oracle mirrors it in ``_linear_at``.  The
+    store-side replay IS the batch-side one evaluated over store rows —
+    one kernel, two views."""
+    return flip_best_batch(
+        stc.meta == jnp.uint32(META_DYNAMIC), stc.payload, stc.gt,
+        stc.aux, q_meta, q_gt, impl=impl)
+
+
+def flip_best_batch(flip_ok: jnp.ndarray, payload: jnp.ndarray,
+                    gt: jnp.ndarray, aux: jnp.ndarray,
+                    q_meta: jnp.ndarray, q_gt: jnp.ndarray,
+                    impl: str | None = None) -> jnp.ndarray:
+    """u32[N, B]: :func:`flip_best` over THIS BATCH's fresh accepted
+    dynamic-settings flips instead of the store — the same-round half of
+    the DynamicResolution replay (a flip and a record it governs arriving
+    together must still interact; engine intake pairs this max with the
+    store-side one)."""
+    n, b = q_meta.shape
+    if _auto_impl(impl, n * b * b) == "broadcast":
+        hit = (flip_ok[:, None, :]
+               & (payload[:, None, :] == q_meta[:, :, None])
+               & (gt[:, None, :] <= q_gt[:, :, None]))
+        return jnp.max(
+            jnp.where(hit, gt[:, None, :] * 2 + (aux[:, None, :] & 1), 0),
             axis=-1)
 
-    is_flip = stc.meta == jnp.uint32(META_DYNAMIC)       # [N, M]
-    key = stc.gt * 2 + (stc.aux & 1)
+    key = gt * 2 + (aux & 1)
 
     def body(j, out):
         qm = lax.dynamic_index_in_dim(q_meta, j, 1)      # [N, 1]
         qg = lax.dynamic_index_in_dim(q_gt, j, 1)
-        hit = is_flip & (stc.payload == qm) & (stc.gt <= qg)
+        hit = flip_ok & (payload == qm) & (gt <= qg)
         best = jnp.max(jnp.where(hit, key, 0), axis=-1)
         return lax.dynamic_update_index_in_dim(out, best, j, 1)
 
-    return lax.fori_loop(0, q, body, jnp.zeros((n, q), jnp.uint32))
+    return lax.fori_loop(0, b, body, jnp.zeros((n, b), jnp.uint32))
 
 
 def undo_marked(stc, member: jnp.ndarray, gt: jnp.ndarray,

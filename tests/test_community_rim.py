@@ -165,3 +165,85 @@ def test_candidate_destination_routes_like_direct():
           k_candidates=8, request_inbox=4, tracker_inbox=8,
           response_budget=4)
     assert c.config.direct_meta_mask == 0b1
+
+def test_control_constructors_end_to_end():
+    """The dedicated create_authorize/revoke/undo/dynamic-settings/destroy
+    fronts (reference: Community.create_* control helpers) drive the full
+    permission lifecycle through the rim alone."""
+    from dispersy_tpu.community import DynamicResolution
+
+    class ChainCommunity(Community):
+        def initiate_meta_messages(self):
+            return [
+                Message("full-sync-text", MemberAuthentication(),
+                        PublicResolution(), FullSyncDistribution(),
+                        CommunityDestination(node_count=3)),
+                Message("protected-full-sync-text", MemberAuthentication(),
+                        DynamicResolution(LinearResolution(),
+                                          PublicResolution()),
+                        FullSyncDistribution(priority=160),
+                        CommunityDestination(node_count=3)),
+            ]
+
+    c = ChainCommunity(
+        64, n_trackers=2, msg_capacity=32, bloom_capacity=16,
+        k_candidates=8, request_inbox=4, tracker_inbox=16,
+        response_budget=4, delay_inbox=2, proof_requests=True)
+    F = c.config.founder
+    A, B = F + 1, F + 2
+    fm = np.arange(64) == F
+    state = c.initialize(seed_degree=6)
+
+    # founder delegates to A; A grants B; B authors a protected record
+    state = c.create_authorize(state, fm, A, "protected-full-sync-text",
+                               delegate=True)
+    for _ in range(5):
+        state = c.step(state)
+    state = c.create_authorize(state, np.arange(64) == A, B,
+                               "protected-full-sync-text")
+    for _ in range(5):
+        state = c.step(state)
+    state = c.create(state, "protected-full-sync-text", np.arange(64) == B,
+                     payload=jnp.full(64, 7, jnp.uint32))
+    gt_b = int(state.global_time[B])
+    for _ in range(8):
+        state = c.step(state)
+    assert float(c.coverage(state, B, gt_b, "protected-full-sync-text",
+                            7)) > 0.9
+
+    # B undoes its own record; replicas flip FLAG_UNDONE everywhere
+    state = c.create_undo_own(state, np.arange(64) == B, gt_b)
+    for _ in range(8):
+        state = c.step(state)
+    undone = ((np.asarray(state.store_member) == B)
+              & (np.asarray(state.store_gt) == gt_b)
+              & ((np.asarray(state.store_flags) & 1) == 1))
+    assert undone.any(axis=1).sum() > 40
+
+    # founder flips the dynamic meta's policy, then revokes A's chain
+    state = c.create_dynamic_settings(state, fm,
+                                      "protected-full-sync-text", "public")
+    state = c.create_revoke(state, fm, A, "protected-full-sync-text",
+                            delegate=True)
+    for _ in range(4):
+        state = c.step(state)
+
+    # destroy: the community hard-kills epidemically
+    state = c.create_destroy_community(state, fm)
+    for _ in range(10):
+        state = c.step(state)
+    from dispersy_tpu.engine import killed_mask
+    killed = np.asarray(killed_mask(state.store_meta))
+    assert killed[c.config.n_trackers:].mean() > 0.9
+
+
+def test_control_constructor_validation():
+    from dispersy_tpu.exceptions import ConfigError
+    c = mk(16)
+    with pytest.raises(ConfigError):
+        c.create_dynamic_settings(c.initialize(), np.arange(16) == 2,
+                                  "full-sync-text", "linear")  # not dynamic
+    with pytest.raises(ConfigError):
+        c._permission_mask("dispersy-authorize", False)  # control meta
+    with pytest.raises(ConfigError):
+        c._permission_mask([], delegate=True)            # empty grant

@@ -56,6 +56,8 @@ def test_all_checks_cross_form_equal():
                                               payload, aux, impl=i),
             "dup_earlier": lambda i: ik.dup_earlier(member, gt, ok, impl=i),
             "flip_best": lambda i: ik.flip_best(stc, meta, gt, impl=i),
+            "flip_best_batch": lambda i: ik.flip_best_batch(
+                ok, payload, gt, aux, meta, gt, impl=i),
             "undo_marked": lambda i: ik.undo_marked(stc, member, gt, impl=i),
             "undo_hits_store": lambda i: ik.undo_hits_store(
                 stc, payload, aux, ok, impl=i),

@@ -157,9 +157,13 @@ def flip_best_batch(flip_ok: jnp.ndarray, payload: jnp.ndarray,
     dynamic-settings flips instead of the store — the same-round half of
     the DynamicResolution replay (a flip and a record it governs arriving
     together must still interact; engine intake pairs this max with the
-    store-side one)."""
+    store-side one).  The reduce axis is ``payload``'s last dim — B for
+    the engine's batch-vs-batch call, M when :func:`flip_best` delegates
+    its store-side replay here — so the product estimate must use it,
+    not the query count."""
     n, b = q_meta.shape
-    if _auto_impl(impl, n * b * b) == "broadcast":
+    m = payload.shape[-1]
+    if _auto_impl(impl, n * b * m) == "broadcast":
         hit = (flip_ok[:, None, :]
                & (payload[:, None, :] == q_meta[:, :, None])
                & (gt[:, None, :] <= q_gt[:, :, None]))

@@ -61,13 +61,17 @@ def test_sharded_step_matches_single_device_full_features():
     timeline + delay pen + double-signed + malicious bookkeeping (the
     pen's [N, D] arrays and the auth/sig/mal tables must all shard on the
     peer axis without changing any outcome)."""
+    # Must stay a superset of __graft_entry__'s everything-on dryrun
+    # config: that docstring cites THIS test as the bit-equality pin.
     fcfg = CommunityConfig(
         n_peers=64, n_trackers=2, k_candidates=8, msg_capacity=32,
         bloom_capacity=32, request_inbox=4, tracker_inbox=32,
         response_budget=8, churn_rate=0.05, packet_loss=0.2,
-        timeline_enabled=True, protected_meta_mask=0b10, n_meta=8,
-        k_authorized=8, delay_inbox=2, double_meta_mask=0b100,
-        malicious_enabled=True)
+        timeline_enabled=True, protected_meta_mask=0b10,
+        dynamic_meta_mask=0b10, n_meta=8, k_authorized=8, delay_inbox=2,
+        proof_requests=True, double_meta_mask=0b100,
+        malicious_enabled=True, seq_meta_mask=0b1000, p_symmetric=0.3,
+        identity_enabled=True)
     single = _prepared(fcfg)
     mesh = make_mesh(8)
     sharded = shard_state(_prepared(fcfg), mesh, fcfg.n_peers)

@@ -1,0 +1,135 @@
+"""Pen-residence measurement: passive Bloom-luck vs active missing-proof.
+
+VERDICT r2 #7's acceptance metric: the active dispersy-missing-proof
+round trip (config.proof_requests) must DROP the median time a
+DelayMessageByProof-parked record spends in the pen.  This tool runs the
+same seeded scenario twice — proof requests off, then on — and tracks
+every pen entry's lifetime by scanning the (small) dly_* arrays each
+round on the host: an entry identified by (peer, member, gt) enters at
+its ``since`` round and leaves when it disappears from the pen
+(accepted or expired).
+
+Scenario: a timeline community under packet loss where the founder's
+grant and the granted author's records race each other, so receivers
+keep parking records whose proof is still in flight.
+
+Usage:
+    python tools/proof_latency.py --out artifacts/proof_latency.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from dispersy_tpu.logutil import configure as _configure_logging, get_logger
+
+_LOG = get_logger("tools.proof_latency")
+
+
+def run_once(proof_requests: bool, n_peers: int = 1024, rounds: int = 50,
+             seed: int = 3) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dispersy_tpu import engine
+    from dispersy_tpu.config import META_AUTHORIZE, EMPTY_U32, CommunityConfig
+    from dispersy_tpu.state import init_state
+
+    _configure_logging()
+    cfg = CommunityConfig(
+        n_peers=n_peers, n_trackers=2, k_candidates=8, msg_capacity=64,
+        bloom_capacity=32, request_inbox=4,
+        tracker_inbox=max(32, n_peers // 16), response_budget=4,
+        timeline_enabled=True, protected_meta_mask=0b10, n_meta=8,
+        k_authorized=8, delay_inbox=3, proof_requests=proof_requests,
+        packet_loss=0.35)
+    state = init_state(cfg, jax.random.PRNGKey(seed))
+    state = engine.seed_overlay(state, cfg, degree=6)
+    F = cfg.founder
+    n = cfg.n_peers
+    # Six granted authors (bounded by k_authorized), each emitting one
+    # protected record per round for 20 rounds: fresh records keep racing
+    # the six lossily-spreading grants, so receivers park continuously
+    # while grant coverage grows.
+    authors = [F + 1 + i for i in range(6)]
+    for a in authors:
+        state = engine.create_messages(
+            state, cfg, jnp.arange(n) == F, META_AUTHORIZE,
+            jnp.full(n, a, jnp.uint32), jnp.full(n, 0b10, jnp.uint32))
+    live: dict[tuple, int] = {}    # (peer, member, gt) -> since round
+    durations: list[int] = []
+
+    def scan(state, rnd):
+        gts = np.asarray(state.dly_gt)
+        members = np.asarray(state.dly_member)
+        since = np.asarray(state.dly_since)
+        now_keys = set()
+        for p, s in zip(*np.nonzero(gts != EMPTY_U32)):
+            key = (int(p), int(members[p, s]), int(gts[p, s]))
+            now_keys.add(key)
+            live.setdefault(key, int(since[p, s]))
+        for key in list(live):
+            if key not in now_keys:          # left the pen this round
+                durations.append(rnd - live.pop(key))
+
+    author_mask = np.isin(np.arange(n), authors)
+    author_mask_j = jnp.asarray(author_mask)
+    for rnd in range(1, rounds + 1):
+        if rnd <= 20:
+            state = engine.create_messages(
+                state, cfg, author_mask_j, 1,
+                jnp.full(n, 100 + rnd, jnp.uint32))
+        state = engine.step(state, cfg)
+        scan(state, rnd)
+    parked = int(np.asarray(state.stats.msgs_delayed).sum())
+    return {
+        "proof_requests": proof_requests,
+        "parks": parked,
+        "releases_tracked": len(durations),
+        # right-censored: still in the pen when the run ended — reported
+        # separately, NOT folded into the duration percentiles
+        "still_parked_at_end": len(live),
+        "median_park_rounds": float(np.median(durations)) if durations
+        else None,
+        "mean_park_rounds": round(float(np.mean(durations)), 3)
+        if durations else None,
+        "p90_park_rounds": float(np.percentile(durations, 90))
+        if durations else None,
+        "proof_requests_served": int(
+            np.asarray(state.stats.proof_requests).sum()),
+        "proof_records_returned": int(
+            np.asarray(state.stats.proof_records).sum()),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--peers", type=int, default=1024)
+    ap.add_argument("--rounds", type=int, default=50)
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--out", default="artifacts/proof_latency.json")
+    args = ap.parse_args()
+    _configure_logging()
+    results = []
+    for flag in (False, True):
+        r = run_once(flag, args.peers, args.rounds, args.seed)
+        _LOG.info("proof_requests=%s: %s parks, median %s rounds in pen",
+                  flag, r["parks"], r["median_park_rounds"])
+        results.append(r)
+    out = {"n_peers": args.peers, "rounds": args.rounds, "seed": args.seed,
+           "passive": results[0], "active": results[1]}
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

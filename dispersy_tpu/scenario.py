@@ -83,15 +83,21 @@ class SignatureRequest:
 
 @dataclasses.dataclass
 class Authorize:
-    """Founder grants `metas` (bitmask) to `members`."""
+    """Grant `metas` (bitmask; may include config.DELEGATE_BIT to convey
+    the authorize permission itself — chains) to `members`.  ``by`` picks
+    the granting member (default: the founder); a non-founder granter
+    must hold the delegated authorize permission or the engine's author
+    gate refuses the create, exactly like a live overlay."""
     members: Sequence[int]
     metas: int
+    by: int | None = None
 
 
 @dataclasses.dataclass
 class Revoke:
     members: Sequence[int]
     metas: int
+    by: int | None = None
 
 
 @dataclasses.dataclass
@@ -179,9 +185,10 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
             _full(cfg, ev.payload))
     elif isinstance(ev, (Authorize, Revoke)):
         meta = META_AUTHORIZE if isinstance(ev, Authorize) else META_REVOKE
+        granter = founder if ev.by is None else ev.by
         for member in ev.members:   # one record per target member
             state = engine.create_messages(
-                state, cfg, _mask(cfg, founder), meta,
+                state, cfg, _mask(cfg, granter), meta,
                 _full(cfg, member), _full(cfg, ev.metas))
     elif isinstance(ev, Undo):
         meta = META_UNDO_OWN if ev.own else META_UNDO_OTHER

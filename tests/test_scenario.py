@@ -154,3 +154,22 @@ def test_tracked_refused_create_fails_loud():
     with pytest.raises(ValueError, match="empty author set"):
         S.run(CFG, S.Scenario(rounds=2, events=[
             (0, S.Create(meta=0, authors=[], payload=1, track="x"))]))
+
+
+def test_authorize_by_delegated_member():
+    """Authorize(by=...): a delegated member extends the chain through
+    the scenario driver; a non-delegated `by` is refused at the author
+    gate (its grant validates nothing)."""
+    from dispersy_tpu.config import DELEGATE_BIT
+    sc = S.Scenario(rounds=26, events=[
+        (0, S.Authorize(members=[5], metas=0b10 | DELEGATE_BIT)),
+        (8, S.Authorize(members=[9], metas=0b10, by=5)),
+        (14, S.Create(meta=1, authors=[9], payload=21, track="chained")),
+        # member 11 holds nothing: its grant is refused at create, so 12
+        # never becomes permitted and this create is silently refused
+        (8, S.Authorize(members=[12], metas=0b10, by=11)),
+        (14, S.Create(meta=1, authors=[12], payload=22)),
+    ])
+    state, log = S.run(CFG, sc)
+    assert log.series("cov_chained")[-1] > 0.5
+    assert not (np.asarray(state.store_payload) == 22).any()

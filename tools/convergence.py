@@ -42,6 +42,21 @@ from dispersy_tpu.state import init_state
 
 _LOG = get_logger("tools.convergence")
 
+# Incremental artifact sink: long runs (hours at spec scales) must leave a
+# usable partial curve if killed — the 2026-07-30 cfg3@0.5 run lost 3.9 h
+# of compute by writing only at completion.  main() points this at the
+# --out path; curve loops dump through it every round.
+_PARTIAL_SINK: str | None = None
+
+
+def _write_partial(doc: dict) -> None:
+    if _PARTIAL_SINK is None:
+        return
+    tmp = _PARTIAL_SINK + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, _PARTIAL_SINK)
+
 
 def broadcast_curve(n_peers: int = 10_000, degree: int = 8,
                     max_rounds: int = 120, target: float = 0.99,
@@ -73,6 +88,8 @@ def broadcast_curve(n_peers: int = 10_000, degree: int = 8,
                                     payload=42))
         curve.append(round(cov, 6))
         log_round(_LOG, rnd, coverage=round(cov, 4))
+        _write_partial({"config": "broadcast_cfg2", "partial": True,
+                        "n_peers": n_peers, "seed": seed, "curve": curve})
         if rounds_to_target is None and cov >= target:
             rounds_to_target = rnd
             break
@@ -136,6 +153,10 @@ def backlog_curve(n_peers: int = 100_000, backlog: int = 1000,
         cov = corpus_coverage(state)
         curve.append(round(cov, 6))
         log_round(_LOG, rnd, corpus_coverage=round(cov, 4))
+        _write_partial({"config": "backlog_cfg3", "partial": True,
+                        "n_peers": n_peers, "backlog": n_msgs,
+                        "seed": seed, "curve": curve,
+                        "wall_seconds": round(time.perf_counter() - t0, 1)})
         if rounds_to_target is None and cov >= target:
             rounds_to_target = rnd
             break
@@ -291,6 +312,9 @@ def communities_timeline_curve(n_peers: int = 1_000_000,
         # curve[k] is round k+1, exactly like the cfg2/cfg3 artifacts
         curve.append(round(worst, 6))
         log_round(_LOG, rnd, worst_community_coverage=round(worst, 4))
+        _write_partial({"config": "communities_timeline_cfg5",
+                        "partial": True, "n_peers": n_peers, "seed": seed,
+                        "curve": curve})
         if rounds_to_target is None and worst >= target:
             rounds_to_target = rnd
             break
@@ -327,6 +351,10 @@ def main() -> None:
                          "'per-call' = async per-round dispatch (default; "
                          "required on the axon tunnel, see BENCH.md)")
     args = ap.parse_args()
+    global _PARTIAL_SINK
+    _PARTIAL_SINK = (args.out
+                     or f"artifacts/convergence_cfg{args.config}.json")
+    os.makedirs(os.path.dirname(_PARTIAL_SINK) or ".", exist_ok=True)
     if args.config == 2:
         out = broadcast_curve(n_peers=int(10_000 * args.scale),
                               seed=args.seed,
@@ -341,10 +369,11 @@ def main() -> None:
         out = backlog_curve(n_peers=int(100_000 * args.scale),
                             backlog=int(1000 * min(args.scale * 10, 1.0)),
                             seed=args.seed)
-    path = args.out or f"artifacts/convergence_cfg{args.config}.json"
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1)
+    # Final artifact rides the same atomic tmp+replace path as the
+    # per-round partials — a kill mid-dump must never truncate the last
+    # good partial (_PARTIAL_SINK was set and its directory created at
+    # the top of main()).
+    _write_partial(out)
     print(json.dumps({k: v for k, v in out.items() if k != "curve"}))
 
 

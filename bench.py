@@ -63,8 +63,11 @@ def _probe_platform(env: dict) -> str:
 
 
 def _worker() -> None:
-    # Durable in-repo compile cache (shared with the dryrun; pre-warmed for
-    # CPU shapes, and TPU compiles cache themselves across attempts).
+    # Durable in-repo compile cache on TPU only (entries target the chip,
+    # so they survive across attempts and rounds).  On CPU this is a
+    # no-op: the CPU fallback compiles cold, trading ~1 min of compile
+    # inside the 900 s budget for a tail free of the XLA:CPU AOT loader's
+    # cross-host SIGILL hazard (see dispersy_tpu/cpuenv.py).
     from dispersy_tpu.cpuenv import enable_repo_cache
     enable_repo_cache()
 

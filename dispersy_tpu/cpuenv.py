@@ -7,8 +7,8 @@ PYTHONPATH *prepends* itself to ``jax_platforms`` and whose backend init
 can hang when the tunnel is half-up (the round-1 driver artifacts recorded
 exactly that: BENCH_r01 rc=1, MULTICHIP_r01 rc=124).
 
-This module must not import jax: it runs in parent processes that may have
-no usable backend at all.
+This module must not import jax at top level: it runs in parent processes
+that may have no usable backend at all.
 """
 
 from __future__ import annotations
@@ -17,26 +17,70 @@ import os
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# Durable in-repo compile cache, pre-warmed at commit time so a driver
-# cold start compiles from cache (a /tmp cache does not survive between
-# the builder's session and the driver's run).
-CACHE_DIR = os.path.join(REPO_ROOT, "artifacts", "jax_cache")
+# Durable in-repo compile cache — TPU ONLY.  TPU executables target the
+# chip, so a committed entry is valid wherever the same chip type sits
+# behind the tunnel.  XLA:CPU executables instead bake in compile-host
+# machine features (including pseudo-features like `+prefer-no-gather`
+# that no host ever reports), so every persistent-cache CPU load tripped
+# the loader's "could lead to SIGILL" warning in the driver tail — on a
+# *different* host it is a real SIGILL risk, and rounds 1-3 committed
+# exactly such entries.  The CPU path now always compiles cold in driver
+# runs: the full 8-device dry run costs ~58 s cold on a 1-core box,
+# ~15x inside its 900 s timeout.  (The test suite keeps its own
+# same-session /tmp cache via tests/conftest.py env vars, which
+# subprocesses inherit.)
+TPU_CACHE_DIR = os.path.join(REPO_ROOT, "artifacts", "jax_cache", "tpu")
 CACHE_MIN_COMPILE_SECS = 0.5
+
+# XLA:CPU's parallel LLVM codegen intermittently segfaults mid-compile on
+# this 1-core image (observed twice on 2026-07-30, stacks ending in
+# backend_compile_and_load; different test each time).  Single-split
+# codegen costs nothing on one core and removes the raciest path.  Shared
+# by tests/conftest.py and cpu_env so the suite and driver children can
+# never drift onto different codegen settings.
+CODEGEN_SPLIT_FLAG = "--xla_cpu_parallel_codegen_split_count=1"
+
+
+def with_codegen_split(flags: str) -> str:
+    """Append the single-split codegen mitigation if not already set."""
+    if "xla_cpu_parallel_codegen_split_count" in flags:
+        return flags
+    return (flags + " " + CODEGEN_SPLIT_FLAG).strip()
+
+
+def _enable_cache(path: str) -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      CACHE_MIN_COMPILE_SECS)
 
 
 def enable_repo_cache() -> None:
-    """Point this process's JAX at the durable in-repo compile cache.
+    """Point this process's JAX at the durable in-repo TPU compile cache.
 
-    For processes that already hold the right backend (bench worker, the
-    in-process dryrun); subprocess paths get the same cache via
-    :func:`cpu_env`'s environment variables.  Imports jax lazily — this
-    module must stay importable without a usable backend.
+    No-op on non-TPU backends (see the cache note above): a CPU process
+    uses whatever ``JAX_COMPILATION_CACHE_DIR`` its environment already
+    carries, or compiles cold.  Imports jax lazily — this module must
+    stay importable without a usable backend.
     """
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                      CACHE_MIN_COMPILE_SECS)
+    if jax.default_backend() == "tpu":
+        _enable_cache(TPU_CACHE_DIR)
+
+
+def enable_tool_cache(path: str = "/tmp/jax_cache") -> None:
+    """Compile cache for local tools (scaling/profile sweeps).
+
+    On TPU: the durable in-repo chip cache.  Elsewhere: a same-session
+    /tmp cache — safe because it never crosses hosts, unlike the
+    committed CPU cache the driver paths no longer use.  Imports jax
+    lazily.
+    """
+    import jax
+
+    _enable_cache(TPU_CACHE_DIR if jax.default_backend() == "tpu" else path)
 
 
 def cpu_env(n_devices: int | None = None) -> dict:
@@ -56,8 +100,11 @@ def cpu_env(n_devices: int | None = None) -> dict:
                  if "xla_force_host_platform_device_count" not in f]
         flags.append(f"--xla_force_host_platform_device_count={n_devices}")
         env["XLA_FLAGS"] = " ".join(flags)
-    # Re-use compile caches across driver invocations (see CACHE_DIR).
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
-    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
-                   str(CACHE_MIN_COMPILE_SECS))
+    # With the persistent CPU cache gone, driver children compile fresh —
+    # they need the same codegen-segfault mitigation the suite uses.
+    env["XLA_FLAGS"] = with_codegen_split(env.get("XLA_FLAGS", ""))
+    # No cache vars are set here: a CPU child caches only if the caller's
+    # environment already asks for it (the test suite does, via conftest;
+    # driver runs don't, so their tails stay free of the CPU AOT loader's
+    # SIGILL warning — see the TPU_CACHE_DIR note above).
     return env

@@ -16,17 +16,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # Persistent compile cache: JAX CPU first-compiles dominate test wall-clock.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+from dispersy_tpu.cpuenv import with_codegen_split  # noqa: E402 — no jax
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
-if "xla_cpu_parallel_codegen_split_count" not in _flags:
-    # XLA:CPU's parallel LLVM codegen intermittently segfaults long
-    # suite processes mid-compile (observed twice on 2026-07-30, stacks
-    # ending in backend_compile_and_load; different test each time).
-    # This box has one core, so single-split codegen costs nothing and
-    # removes the raciest path.
-    _flags = (_flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
-os.environ["XLA_FLAGS"] = _flags
+# Codegen-segfault mitigation shared with driver children (see cpuenv).
+os.environ["XLA_FLAGS"] = with_codegen_split(_flags)
 
 # The axon TPU-tunnel sitecustomize registers its backend at interpreter
 # start and *prepends* "axon," to jax_platforms, so the env var alone is not

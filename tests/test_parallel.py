@@ -109,3 +109,38 @@ def test_graft_entry_compiles():
 
 def test_graft_dryrun_multichip():
     graft.dryrun_multichip(8)
+
+
+def test_dryrun_parent_never_imports_jax(monkeypatch):
+    """The parent path of dryrun_multichip must not import jax.
+
+    Three rounds of driver rc=124 traced to a parent-side in-process
+    ``jax.devices`` probe: the axon sitecustomize monkey-patches JAX's
+    backend getter, so any parent jax import can hang on a half-up
+    tunnel, env vars notwithstanding.  Booby-trap the import (a None
+    sys.modules entry makes ``import jax`` raise ImportError) and fake
+    the child: the parent must still succeed, and must hand the child a
+    scrubbed CPU-pinned environment.
+    """
+    import subprocess as sp
+    import sys
+
+    monkeypatch.setitem(sys.modules, "jax", None)
+    monkeypatch.setitem(sys.modules, "jax.numpy", None)
+    seen = {}
+
+    class FakeProc:
+        def poll(self):
+            return 0
+
+    def fake_popen(cmd, cwd=None, env=None):
+        seen["cmd"], seen["env"] = cmd, env
+        return FakeProc()
+
+    monkeypatch.setattr(sp, "Popen", fake_popen)
+    graft.dryrun_multichip(8)
+
+    assert seen["env"]["JAX_PLATFORMS"] == "cpu"
+    assert "axon" not in seen["env"].get("PYTHONPATH", "")
+    assert "--xla_force_host_platform_device_count=8" in seen["env"]["XLA_FLAGS"]
+    assert "_dryrun_impl(8)" in seen["cmd"][-1]

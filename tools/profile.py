@@ -174,8 +174,8 @@ def _worker(args) -> None:
     import jax
 
     from dispersy_tpu import engine
-    from dispersy_tpu.cpuenv import enable_repo_cache
-    enable_repo_cache()
+    from dispersy_tpu.cpuenv import enable_tool_cache
+    enable_tool_cache()
 
     mesh = None
     if args.devices > 1:

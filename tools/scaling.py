@@ -48,11 +48,11 @@ def _worker(args) -> None:
     import jax
 
     from dispersy_tpu import engine
-    from dispersy_tpu.cpuenv import enable_repo_cache
+    from dispersy_tpu.cpuenv import enable_tool_cache
     from dispersy_tpu.parallel import make_mesh
     from tools.profile import _bench_cfg, _prepared, kernel_proxies
 
-    enable_repo_cache()
+    enable_tool_cache()
     d = args.devices
     mesh = make_mesh(d) if d > 1 else None
     cfg = _bench_cfg(args.peers)

@@ -39,11 +39,11 @@ import jax.numpy as jnp
 
 from dispersy_tpu import engine
 from dispersy_tpu.exceptions import ConfigError, MetaNotFoundError
-from dispersy_tpu.config import (DELEGATE_BIT, MAX_USER_META, META_AUTHORIZE,
+from dispersy_tpu.config import (MAX_USER_META, META_AUTHORIZE,
                                  META_DESTROY,
                                  META_DYNAMIC, META_REVOKE, META_UNDO_OTHER,
                                  META_UNDO_OWN, CommunityConfig,
-                                 DEFAULT_PRIORITY)
+                                 DEFAULT_PRIORITY, perm_mask)
 from dispersy_tpu.state import PeerState, init_state
 
 
@@ -269,46 +269,61 @@ class Community:
     # create_authorize / create_revoke / create_undo /
     # create_dynamic_settings / create_dispersy_destroy_community — thin
     # typed fronts over the generic create path) ----
-    def _permission_mask(self, meta_names, delegate: bool) -> int:
-        mask = 0
-        for nm in ([meta_names] if isinstance(meta_names, str)
-                   else meta_names):
-            mid = self.meta_id(nm)
+    def _grant_masks(self, triples) -> dict[int, int]:
+        """[(target, meta_name[, permission])] -> {target: nibble mask}.
+
+        Each triple names one permission type from the reference's
+        quadruple (u"permit" / u"authorize" / u"revoke" / u"undo",
+        timeline.py); a 2-tuple defaults to "permit".  Grants for one
+        target pack into one nibble mask (config.perm_mask)."""
+        by_target: dict[int, list] = {}
+        for t in triples:
+            target, name = t[0], t[1]
+            perm = t[2] if len(t) > 2 else "permit"
+            mid = self.meta_id(name)
             if mid >= self.config.n_meta:
                 raise ConfigError(f"cannot grant permissions on control "
-                                  f"meta {nm!r}")
-            mask |= 1 << mid
-        if not mask:
+                                  f"meta {name!r}")
+            by_target.setdefault(int(target), []).append((mid, perm))
+        if not by_target:
             # an empty grant/revoke proves and changes nothing
             # (check_grant rejects it too) — refuse to author one
-            raise ConfigError("meta_names must name at least one meta")
-        if delegate:
-            mask |= DELEGATE_BIT
-        return mask
+            raise ConfigError("triples must name at least one grant")
+        return {t: perm_mask(pairs) for t, pairs in by_target.items()}
 
-    def create_authorize(self, state: PeerState, author_mask, target,
-                         meta_names, delegate: bool = False) -> PeerState:
-        """Grant ``target`` the permit permission for ``meta_names``
-        (str or iterable of str); ``delegate=True`` additionally conveys
-        the authorize permission itself, so the target can extend the
-        chain (reference: Community.create_authorize with
-        [(member, message, permission)] triples; ops/timeline.check_grant
-        for the chain semantics)."""
+    def create_authorize(self, state: PeerState, author_mask,
+                         triples) -> PeerState:
+        """Grant permissions by [(target_member, meta_name[, permission])]
+        triples — the reference's ``Community.create_authorize``
+        ([(member, message, permission)]) shape; permission defaults to
+        "permit".  Granting "authorize" lets the target extend the chain
+        (ops/timeline.check_grant); "revoke" and "undo" convey those
+        authorities separably.  Triples for one target pack into ONE
+        dispersy-authorize record; distinct targets author consecutive
+        records (the packed wire record names a single target — the
+        reference packs the whole list into one message; same resulting
+        Timeline state)."""
         n = self.config.n_peers
-        mask = self._permission_mask(meta_names, delegate)
-        return self.create(state, "dispersy-authorize", author_mask,
-                           payload=jnp.full(n, target, jnp.uint32),
-                           aux=jnp.full(n, mask, jnp.uint32))
+        for target, mask in sorted(self._grant_masks(triples).items()):
+            state = self.create(state, "dispersy-authorize", author_mask,
+                                payload=jnp.full(n, target, jnp.uint32),
+                                aux=jnp.full(n, mask, jnp.uint32))
+        return state
 
-    def create_revoke(self, state: PeerState, author_mask, target,
-                      meta_names, delegate: bool = False) -> PeerState:
-        """Revoke ``target``'s permissions for ``meta_names`` from the
-        author's next global_time on (reference: Community.create_revoke)."""
+    def create_revoke(self, state: PeerState, author_mask,
+                      triples) -> PeerState:
+        """Revoke permissions by [(target_member, meta_name[, permission])]
+        triples from the author's next global_time on (reference:
+        Community.create_revoke).  Issuing a revoke needs the REVOKE
+        authority on every named meta (or the founder) — separable from
+        the authorize authority, exactly the reference's u"revoke"
+        permission type."""
         n = self.config.n_peers
-        mask = self._permission_mask(meta_names, delegate)
-        return self.create(state, "dispersy-revoke", author_mask,
-                           payload=jnp.full(n, target, jnp.uint32),
-                           aux=jnp.full(n, mask, jnp.uint32))
+        for target, mask in sorted(self._grant_masks(triples).items()):
+            state = self.create(state, "dispersy-revoke", author_mask,
+                                payload=jnp.full(n, target, jnp.uint32),
+                                aux=jnp.full(n, mask, jnp.uint32))
+        return state
 
     def create_undo_own(self, state: PeerState, author_mask,
                         target_gt) -> PeerState:
@@ -324,7 +339,9 @@ class Community:
     def create_undo_other(self, state: PeerState, author_mask, member,
                           target_gt) -> PeerState:
         """Undo another member's record at (member, target_gt) — founder
-        authority (reference: dispersy-undo-other)."""
+        authority, or the UNDO permission on the target record's meta
+        (reference: dispersy-undo-other; timeline.py checks u"undo"
+        against the target message's meta)."""
         n = self.config.n_peers
         return self.create(
             state, "dispersy-undo-other", author_mask,

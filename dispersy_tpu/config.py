@@ -39,15 +39,20 @@ CAT_INTRODUCED = 3
 # a reserved band well above them).  A record's columns are overloaded per
 # meta:
 #   dispersy-authorize / dispersy-revoke: payload = target member,
-#       aux = permit-permission bitmask over user meta ids
-#       (reference: message.py Authorize/RevokePayload carries
-#       [(member, message, permission)] triples; the bitmask is that list,
-#       TPU-packed).  aux bit 30 (DELEGATE_BIT) additionally grants (or
-#       revokes) the *authorize permission itself* for the masked metas:
-#       the target may then issue authorize/revoke records for those metas
-#       — the reference's permission *chains* (timeline.py Timeline.check
-#       walks authorize proofs recursively; here chains grow one fold per
-#       round, unbounded across rounds — see ops/timeline.check_grant)
+#       aux = per-meta permission NIBBLES over user meta ids: bit
+#       (4*meta + p) grants (or revokes) permission p for that meta, with
+#       p in {0=permit, 1=authorize, 2=revoke, 3=undo} — the reference's
+#       four permission types (timeline.py Timeline.check resolves
+#       (member, message, permission) triples; message.py Authorize/
+#       RevokePayload carries [(member, message, permission)] lists,
+#       TPU-packed here as one nibble mask per target).  The AUTHORIZE
+#       bit for a meta lets its holder issue further authorize records
+#       covering that meta — the reference's permission *chains*
+#       (timeline.py Timeline.check walks authorize proofs recursively;
+#       here chains grow one fold per round, unbounded across rounds —
+#       see ops/timeline.check_grant); the REVOKE bit gates issuing
+#       revoke records for that meta, separably from AUTHORIZE; the UNDO
+#       bit gates dispersy-undo-other on that meta's records.
 #   dispersy-undo-own / dispersy-undo-other: payload = target member,
 #       aux = target global_time (reference: payload.py UndoPayload
 #       (member, global_time, packet))
@@ -68,11 +73,67 @@ META_DESTROY = 0xF5
 #   dispersy-identity: payload = mid32 (first 4 bytes of SHA1(pubkey));
 #       see dispersy_tpu/crypto.py create_identities.
 META_IDENTITY = 0xF6
-# Max user metas: permission bitmasks live in the low bits of a uint32;
-# bit 31 flags a revoke row in the auth table and bit 30 marks a grant as
-# carrying the authorize permission (delegation) as well as the permit.
+#   dispersy-malicious-proof: payload = the convicted member, aux = the
+#       global_time at which it provably double-signed.  Authored by an
+#       EYEWITNESS the moment it observes a conflicting pair (a record
+#       matching a stored row's (member, global_time) with different
+#       content) and spread at CONTROL_PRIORITY, so convictions converge
+#       network-wide instead of staying per-observer (reference:
+#       dispersy.py malicious-member machinery spreads the conflicting
+#       packet pair).  Structural-trust divergence, documented: the
+#       reference's proof carries both signed packets for receivers to
+#       re-verify; this simulation's wire records carry no signatures to
+#       re-check (identity is structural everywhere — SURVEY §7 stage 9),
+#       so the claim record IS the recast of the verified pair.
+META_MALICIOUS = 0xF7
+# Max user metas: per-meta config bitmasks (seq/double/direct/protected)
+# live in the low bits of a uint32.
 MAX_USER_META = 24
-DELEGATE_BIT = 1 << 30
+# Timeline grants pack FOUR bits per meta (the permission quadruple below)
+# into a u32 table mask, capping timeline communities at 8 user metas.
+MAX_TIMELINE_META = 8
+
+# Permission types within one grant nibble (reference: timeline.py
+# resolves u"permit" / u"authorize" / u"revoke" / u"undo" per meta).
+PERM_PERMIT = 0
+PERM_AUTHORIZE = 1
+PERM_REVOKE = 2
+PERM_UNDO = 3
+PERM_NAMES = {"permit": PERM_PERMIT, "authorize": PERM_AUTHORIZE,
+              "revoke": PERM_REVOKE, "undo": PERM_UNDO}
+
+
+def perm_bit(meta: int, perm) -> int:
+    """The aux/table-mask bit granting ``perm`` for user meta ``meta``;
+    ``perm`` is a PERM_* id or one of the reference's permission strings
+    (timeline.py u"permit" etc.)."""
+    if isinstance(perm, str):
+        try:
+            perm = PERM_NAMES[perm]
+        except KeyError:
+            raise ConfigError(
+                f"unknown permission {perm!r}; expected one of "
+                f"{sorted(PERM_NAMES)}") from None
+    if not 0 <= meta < MAX_TIMELINE_META:
+        raise ConfigError(
+            f"timeline permissions cover metas [0, {MAX_TIMELINE_META}), "
+            f"got {meta}")
+    if not 0 <= perm <= PERM_UNDO:
+        raise ConfigError(f"unknown permission id {perm}")
+    return 1 << (4 * meta + perm)
+
+
+def perm_mask(pairs) -> int:
+    """Nibble mask from [(meta_id, perm)] pairs (see :func:`perm_bit`)."""
+    mask = 0
+    for meta, perm in pairs:
+        mask |= perm_bit(meta, perm)
+    return mask
+
+
+def user_perm_mask(n_meta: int) -> int:
+    """All grantable nibble bits for ``n_meta`` user metas."""
+    return (1 << (4 * min(n_meta, MAX_TIMELINE_META))) - 1
 
 # Sync-response ordering priorities (reference: distribution.py — each
 # Distribution carries a `priority`; community.py gives the permission
@@ -108,6 +169,10 @@ RECORD_BYTES = HEADER_BYTES + 20
 # missing-proof request: header + 2 B identifier + (member, global_time)
 # (reference: payload.py MissingProofPayload).
 MISSING_PROOF_BYTES = HEADER_BYTES + 2 + 8
+# missing-sequence request: header + 2 B identifier + member + 1 B meta +
+# (missing_low, missing_high) (reference: payload.py
+# MissingSequencePayload (member, message, missing_low, missing_high)).
+MISSING_SEQ_BYTES = HEADER_BYTES + 2 + 4 + 1 + 8
 # signature-request: header + 2 B identifier + the draft record's columns
 # (reference: conversion.py packs the half-signed message inside
 # dispersy-signature-request; the response carries it back countersigned).
@@ -291,6 +356,16 @@ class CommunityConfig:
     proof_requests: bool = False
     proof_inbox: int = 4                # proof requests served per round
     proof_budget: int = 2               # control records returned per request
+    # Active missing-sequence round trips (reference: community.py
+    # on_missing_sequence / message.py DelayMessageBySequence): a
+    # sequence-gapped record PARKS in the same pen instead of being
+    # rejected, and each round its deliverer is asked for the missing
+    # range [holder's max+1, gap-1]; the server answers with its stored
+    # in-range records (ascending — chains accept bottom-up), returned by
+    # receipt in the same round.  Gap-fill latency becomes a round trip
+    # instead of Bloom re-offer luck.  Shares the pen and the
+    # proof_inbox/proof_budget channel bounds.
+    seq_requests: bool = False
 
     # ---- clock (reference: community.py claim_global_time /
     #      dispersy_acceptable_global_time_range) ----
@@ -328,16 +403,18 @@ class CommunityConfig:
     # ---- malicious-member bookkeeping (reference: dispersy.py's
     #      malicious-member machinery + dispersy-malicious-proof: a member
     #      provably signing two DIFFERENT messages at one global_time is
-    #      blacklisted).  Here detection is local-per-peer: a conflicting
+    #      blacklisted).  Detection is local-per-peer: a conflicting
     #      arrival against the store convicts the author on the receiving
     #      peer, which then rejects all its records at intake and ejects
-    #      it from the candidate table.  The reference additionally
-    #      *spreads* the proof (both packets) and drops the member's
-    #      control traffic too; the simulation models conviction and the
-    #      store/candidate consequences, not proof gossip — blacklists
-    #      converge as each peer observes a conflict itself. ----
+    #      it from the candidate table.  With malicious_gossip on, an
+    #      eyewitness additionally AUTHORS a dispersy-malicious-proof
+    #      record (META_MALICIOUS: the reference spreads the conflicting
+    #      packet pair) that sync-spreads at CONTROL_PRIORITY; accepting
+    #      peers convict too, so blacklists converge network-wide instead
+    #      of per-observer. ----
     malicious_enabled: bool = False
     k_malicious: int = 8                # blacklist slots per peer
+    malicious_gossip: bool = False      # spread convictions as records
 
     # ---- permissions (reference: timeline.py; bounded table of authorized
     #      members — real overlays authorize a handful of members) ----
@@ -361,9 +438,12 @@ class CommunityConfig:
     # The community founder: implicit holder of every permission, the root
     # of authority (reference: community.py master member).  Authorize/
     # revoke records are accepted from the founder or from any member
-    # holding the delegated authorize permission (DELEGATE_BIT chains —
-    # ops/timeline.check_grant, mirroring Timeline.check's recursive
-    # proof walk); undo-other/dynamic-settings/destroy stay founder-only.
+    # holding the AUTHORIZE/REVOKE permission for every granted meta
+    # (nibble grants — ops/timeline.check_grant, mirroring
+    # Timeline.check's recursive proof walk); undo-other needs the UNDO
+    # permission on the target's meta, dynamic-settings the AUTHORIZE
+    # permission on the flipped meta; destroy stays founder-only
+    # (reference: the master member signs dispersy-destroy-community).
     # -1 = auto: the first non-tracker peer (index n_trackers).
     founder_member: int = -1
 
@@ -575,8 +655,17 @@ class CommunityConfig:
                 raise ConfigError("founder_member must be a non-tracker peer")
             if self.k_authorized < 1:
                 raise ConfigError("timeline_enabled requires k_authorized >= 1")
+            if self.n_meta > MAX_TIMELINE_META:
+                raise ConfigError(
+                    f"timeline grants pack 4 permission bits per meta into "
+                    f"a u32, so timeline_enabled caps n_meta at "
+                    f"{MAX_TIMELINE_META} (got {self.n_meta})")
         if self.malicious_enabled and self.k_malicious < 1:
             raise ConfigError("malicious_enabled requires k_malicious >= 1")
+        if self.malicious_gossip and not self.malicious_enabled:
+            raise ConfigError("malicious_gossip requires malicious_enabled "
+                              "(gossip spreads convictions the local "
+                              "detector produces)")
         if not (0.0 <= self.p_symmetric <= 1.0):
             raise ConfigError("p_symmetric must be in [0, 1]")
         if self.delay_inbox < 0:
@@ -595,6 +684,17 @@ class CommunityConfig:
             if self.proof_inbox < 1 or self.proof_budget < 1:
                 raise ConfigError("proof_requests requires proof_inbox >= 1 "
                                  "and proof_budget >= 1")
+        if self.seq_requests:
+            if not self.seq_meta_mask:
+                raise ConfigError("seq_requests needs a seq_meta_mask "
+                                  "(no sequenced metas, no gaps to fill)")
+            if not self.delay_enabled:
+                raise ConfigError("seq_requests requires delay_inbox > 0 "
+                                  "(gapped records park in the pen; note "
+                                  "the pen itself needs timeline_enabled)")
+            if self.proof_inbox < 1 or self.proof_budget < 1:
+                raise ConfigError("seq_requests shares the proof channel: "
+                                  "proof_inbox/proof_budget must be >= 1")
 
     def replace(self, **kw) -> "CommunityConfig":
         return dataclasses.replace(self, **kw)

@@ -52,17 +52,20 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dispersy_tpu.config import (CONTROL_PRIORITY, DELEGATE_BIT, EMPTY_U32,
+from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_U32,
                                  IDENTITY_PRIORITY,
                                  INTRO_REQUEST_BASE_BYTES,
                                  INTRO_RESPONSE_BYTES, META_AUTHORIZE,
                                  META_DESTROY, META_DYNAMIC, META_IDENTITY,
+                                 META_MALICIOUS,
                                  META_REVOKE, META_UNDO_OTHER, META_UNDO_OWN,
-                                 MISSING_PROOF_BYTES,
-                                 NO_PEER, PUNCTURE_BYTES,
+                                 MISSING_PROOF_BYTES, MISSING_SEQ_BYTES,
+                                 NO_PEER, PERM_AUTHORIZE, PERM_REVOKE,
+                                 PERM_UNDO, PUNCTURE_BYTES,
                                  PUNCTURE_REQUEST_BYTES, RECORD_BYTES,
                                  SIGNATURE_REQUEST_BYTES,
-                                 SIGNATURE_RESPONSE_BYTES, CommunityConfig)
+                                 SIGNATURE_RESPONSE_BYTES, CommunityConfig,
+                                 user_perm_mask)
 from dispersy_tpu.ops import bloom, candidates as cand, inbox, rng, store as st
 from dispersy_tpu.ops import intake as ik
 from dispersy_tpu.ops import timeline as tl
@@ -82,6 +85,8 @@ _LOSS_SIGREQ = 6 << 16
 _LOSS_SIGRESP = 7 << 16
 _LOSS_PROOF_REQ = 8 << 16
 _LOSS_PROOF_RESP = 9 << 16
+_LOSS_SEQ_REQ = 10 << 16
+_LOSS_SEQ_RESP = 11 << 16
 _TRACKER_SALT = 1 << 15
 _TRACKER_INTRO_SALT = 1 << 20
 
@@ -110,7 +115,7 @@ def _store(state: PeerState) -> st.StoreCols:
 
 def _auth(state: PeerState) -> tl.AuthTable:
     return tl.AuthTable(member=state.auth_member, mask=state.auth_mask,
-                        gt=state.auth_gt)
+                        gt=state.auth_gt, rev=state.auth_rev)
 
 
 def _layout_cols(cfg: CommunityConfig, idx: jnp.ndarray):
@@ -293,7 +298,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         auth = tl.AuthTable(
             member=jnp.where(r1, jnp.uint32(EMPTY_U32), state.auth_member),
             mask=jnp.where(r1, jnp.uint32(0), state.auth_mask),
-            gt=jnp.where(r1, jnp.uint32(0), state.auth_gt))
+            gt=jnp.where(r1, jnp.uint32(0), state.auth_gt),
+            rev=jnp.where(r1, False, state.auth_rev))
         # The signature request cache dies with the process (reference:
         # RequestCache is in-memory only).
         sig = (jnp.where(reborn, NO_PEER, state.sig_target),
@@ -972,6 +978,104 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         pr_ok = jnp.zeros((n, 0), bool)
         pr_src = jnp.zeros((n, 0), jnp.int32)
 
+    # ---- phase 4s: active missing-sequence round trip ------------------
+    # (reference: community.py on_missing_sequence serving
+    # dispersy-missing-sequence(member, message, missing_low,
+    # missing_high); message.py DelayMessageBySequence parks the gapped
+    # record.)  Each SEQ-parked pen entry asks its original deliverer for
+    # the missing range [requester's stored max+1, gap-1]; the server's
+    # stored in-range records ride back by receipt ASCENDING (chains
+    # accept bottom-up within one batch) and join this round's intake —
+    # the parked record itself re-chains next round against the advanced
+    # stored max.  Shares the proof channel's bounds
+    # (config.proof_inbox/proof_budget); config.seq_requests.
+    # LOCKSTEP NOTE: this block deliberately mirrors phase 4p's
+    # request/serve/receipt scaffolding (and both have oracle
+    # mirrors in oracle/sim.py) — a change to either channel's
+    # delivery, gating, loss, or accounting must be made in all
+    # four places or the trace-equality tests will flag it.
+    if cfg.delay_enabled and cfg.seq_requests:
+        dd_, qb = cfg.delay_inbox, cfg.proof_budget
+        shq = jnp.minimum(dl_meta, jnp.uint32(31))
+        dl_is_seq = ((((jnp.uint32(cfg.seq_meta_mask) >> shq) & 1) == 1)
+                     & (dl_meta < cfg.n_meta))
+        sq_low = ik.seq_stored_max(stc, dl_member, dl_meta) + jnp.uint32(1)
+        sq_high = dl_aux - jnp.uint32(1)
+        want = (dl_ok & (dl_src != NO_PEER) & dl_is_seq
+                & (sq_low <= sq_high))                      # [N, D]
+        mrq_lost = _lost(seed, rnd, idx[:, None], _LOSS_SEQ_REQ,
+                         jnp.arange(dd_)[None, :], cfg.packet_loss)
+        bup = bup + jnp.sum(want, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(MISSING_SEQ_BYTES)
+        qreq = inbox.deliver(
+            dst=dl_src.reshape(-1),
+            cols=[dl_member.reshape(-1), dl_meta.reshape(-1),
+                  sq_low.reshape(-1), sq_high.reshape(-1)],
+            valid=(want & ~mrq_lost).reshape(-1), n_peers=n,
+            inbox_size=cfg.proof_inbox)
+        qq_member, qq_meta, qq_low, qq_high = qreq.inbox    # [N, Qi]
+        qq_ok = qreq.inbox_valid & alive[:, None]
+        if cfg.timeline_enabled:
+            qq_ok = qq_ok & ~killed[:, None]
+        stats = stats.replace(
+            seq_requests=stats.seq_requests
+            + jnp.sum(qq_ok, axis=1).astype(jnp.uint32),
+            requests_dropped=stats.requests_dropped
+            + qreq.n_dropped.astype(jnp.uint32))
+        bdown = bdown + jnp.sum(qq_ok, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(MISSING_SEQ_BYTES)
+        # Serve: per request, the proof_budget LOWEST-sequence stored rows
+        # in [low, high] for (member, meta) — the store sorts ascending
+        # and one member's sequence numbers rise with global_time, so
+        # rank-from-start IS ascending-sequence order, which lets a full
+        # reply chain accept in one batch.
+        live_rows = stc.gt != jnp.uint32(EMPTY_U32)
+        qouts = []
+        for s in range(cfg.proof_inbox):
+            m_s = (live_rows & qq_ok[:, s:s + 1]
+                   & (stc.member == qq_member[:, s:s + 1])
+                   & (stc.meta == qq_meta[:, s:s + 1])
+                   & (stc.aux >= qq_low[:, s:s + 1])
+                   & (stc.aux <= qq_high[:, s:s + 1]))      # [N, M]
+            from_start = jnp.cumsum(m_s.astype(jnp.int32), axis=1) - 1
+            qslot = jnp.where(m_s & (from_start < qb), from_start, qb)
+            qouts.append(tuple(st.rank_compact(col, qslot, qb, fill)
+                               for col, fill in
+                               ((stc.gt, EMPTY_U32), (stc.member, EMPTY_U32),
+                                (stc.meta, EMPTY_U32),
+                                (stc.payload, EMPTY_U32), (stc.aux, 0),
+                                (m_s, False))))
+        qbox = [jnp.stack([o[i] for o in qouts], axis=1)
+                for i in range(6)]                          # [N, Qi, qb]
+        bup = bup + jnp.sum(qbox[5], axis=(1, 2)).astype(jnp.uint32) \
+            * jnp.uint32(RECORD_BYTES)
+        # Pickup by receipt at the requester (same shape as phase 4p).
+        qsrc_flat = jnp.maximum(dl_src.reshape(-1), 0)      # [N*D]
+        qeslot = jnp.maximum(qreq.edge_slot, 0)
+        qgot = ((qreq.edge_slot >= 0)
+                & qq_ok[qsrc_flat, qeslot]).reshape(n, dd_)  # [N, D]
+
+        def qpick(col):
+            return col[qsrc_flat, qeslot].reshape(n, dd_ * qb)
+        mq_gt, mq_member, mq_meta, mq_payload, mq_aux = (
+            qpick(c) for c in qbox[:5])
+        mqs_lost = _lost(seed, rnd, idx[:, None], _LOSS_SEQ_RESP,
+                         jnp.arange(dd_ * qb)[None, :], cfg.packet_loss)
+        mq_ok = (qpick(qbox[5])
+                 & jnp.repeat(qgot, qb, axis=1)
+                 & alive[:, None] & ~mqs_lost)
+        mq_src = jnp.repeat(dl_src, qb, axis=1)
+        stats = stats.replace(
+            seq_records=stats.seq_records
+            + jnp.sum(mq_ok, axis=1).astype(jnp.uint32))
+        bdown = bdown + jnp.sum(mq_ok, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(RECORD_BYTES)
+    else:
+        m0 = jnp.zeros((n, 0), jnp.uint32)
+        mq_gt = mq_member = mq_meta = mq_payload = mq_aux = m0
+        mq_ok = jnp.zeros((n, 0), bool)
+        mq_src = jnp.zeros((n, 0), jnp.int32)
+
     # ---- phase 5: combined intake (delayed pen + sync pull + push +
     # completed double-signed + returned proofs) -> store.  One batch per
     # round: the pen's waiting records first (they were delivered in an
@@ -980,17 +1084,18 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     # records, then this round's countersigned completion, then the
     # missing-proof replies, in delivery order — mirroring the reference's
     # _on_batch_cache handling one grouped batch per meta per window.
-    in_gt = jnp.concatenate([dl_gt, sy_gt, ph_gt, db_gt, pr_gt],
+    in_gt = jnp.concatenate([dl_gt, sy_gt, ph_gt, db_gt, pr_gt, mq_gt],
                             axis=1)                            # [N, B]
     in_member = jnp.concatenate([dl_member, sy_member, ph_member, db_member,
-                                 pr_member], axis=1)
-    in_meta = jnp.concatenate([dl_meta, sy_meta, ph_meta, db_meta, pr_meta],
-                              axis=1)
+                                 pr_member, mq_member], axis=1)
+    in_meta = jnp.concatenate([dl_meta, sy_meta, ph_meta, db_meta, pr_meta,
+                               mq_meta], axis=1)
     in_payload = jnp.concatenate([dl_payload, sy_payload, ph_payload,
-                                  db_payload, pr_payload], axis=1)
-    in_aux = jnp.concatenate([dl_aux, sy_aux, ph_aux, db_aux, pr_aux],
-                             axis=1)
-    in_ok = jnp.concatenate([dl_ok, sy_ok, ph_ok, db_ok, pr_ok], axis=1)
+                                  db_payload, pr_payload, mq_payload], axis=1)
+    in_aux = jnp.concatenate([dl_aux, sy_aux, ph_aux, db_aux, pr_aux,
+                              mq_aux], axis=1)
+    in_ok = jnp.concatenate([dl_ok, sy_ok, ph_ok, db_ok, pr_ok, mq_ok],
+                            axis=1)
     bb = in_gt.shape[1]
     if cfg.delay_enabled:
         # Round each batch entry was (first) delivered: pen entries keep
@@ -1010,7 +1115,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                   if db_ok.shape[1] else
                   jnp.zeros((n, 0), jnp.int32))
         in_src = jnp.concatenate(
-            [dl_src, sy_src, ph_src, db_src, pr_src], axis=1)
+            [dl_src, sy_src, ph_src, db_src, pr_src, mq_src], axis=1)
     if bb > 0:
         # Clock-jump defense before the store accepts anything.
         in_ok = in_ok & (in_gt <= global_time[:, None] + jnp.uint32(
@@ -1043,6 +1148,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             # differing in content proves its author signed two messages
             # at one time.  Convict locally, then reject this batch's (and
             # every future) record by any convicted member.
+            pre_mal = mal
             conflict = in_ok & ik.conflict(
                 stc, in_member, in_gt, in_meta, in_payload, in_aux)  # [N, B]
             mf = tl.fold_set(mal, in_member, valid=conflict)
@@ -1051,6 +1157,36 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                 conflicts=stats.conflicts + mf.n_inserted.astype(jnp.uint32),
                 msgs_dropped=stats.msgs_dropped
                 + mf.n_dropped.astype(jnp.uint32))
+            if cfg.malicious_gossip:
+                # Gossiped convictions (reference: dispersy.py spreads the
+                # conflicting packet pair as dispersy-malicious-proof so
+                # non-eyewitnesses convict too).  An arriving claim record
+                # convicts its named member here — unless the CLAIMANT is
+                # itself already blacklisted (post-eyewitness-fold): a
+                # convicted member's traffic, claims included, is dead.
+                black0 = jnp.any(mal[:, None, :] == in_member[:, :, None],
+                                 axis=-1)
+                claims = (in_ok & ~black0
+                          & (in_meta == jnp.uint32(META_MALICIOUS)))
+                cf = tl.fold_set(mal, in_payload, valid=claims)
+                mal = cf.table
+                stats = stats.replace(
+                    convictions_rx=stats.convictions_rx
+                    + cf.n_inserted.astype(jnp.uint32),
+                    msgs_dropped=stats.msgs_dropped
+                    + cf.n_dropped.astype(jnp.uint32))
+                # Eyewitness gossip pick: the batch's first conflict naming
+                # a member not blacklisted before this batch; the proof
+                # record itself is authored post-insert (below), claiming
+                # the NEXT global_time like any create.
+                was_black = jnp.any(
+                    pre_mal[:, None, :] == in_member[:, :, None], axis=-1)
+                gospick = conflict & ~was_black                   # [N, B]
+                gossip_now = jnp.any(gospick, axis=1)             # [N]
+                gj = jnp.argmax(gospick, axis=1)
+                g_member = jnp.take_along_axis(
+                    in_member, gj[:, None], 1)[:, 0]              # [N]
+                g_gt = jnp.take_along_axis(in_gt, gj[:, None], 1)[:, 0]
             is_black = jnp.any(mal[:, None, :] == in_member[:, :, None],
                                axis=-1)
             stats = stats.replace(
@@ -1078,11 +1214,15 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             is_flip = in_meta == jnp.uint32(META_DYNAMIC)
             is_destroy = in_meta == jnp.uint32(META_DESTROY)
             is_ctrl = is_auth | is_rev | is_undo | is_flip | is_destroy
-            # undo-other/dynamic-settings/destroy: founder-only.
-            # undo-own: the author undoes itself.  authorize/revoke:
-            # founder, or a member holding the delegated authorize
-            # permission for every meta in the grant (chains — pass B
-            # below; reference: Timeline.check's recursive proof walk).
+            # destroy: founder-only (the reference's master member signs
+            # dispersy-destroy-community).  undo-own: the author undoes
+            # itself.  authorize/revoke: founder, or a member holding the
+            # AUTHORIZE/REVOKE authority bit for every meta in the grant
+            # (chains — pass B below; reference: Timeline.check's
+            # recursive proof walk).  undo-other: founder, or the UNDO
+            # authority on the *target record's* meta; dynamic-settings:
+            # founder, or the AUTHORIZE authority on the flipped meta —
+            # both checked against the post-fold table below.
             ctrl_ok0 = jnp.where(is_undo_own, in_member == in_payload,
                                  in_member == founder)
 
@@ -1094,23 +1234,44 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             # updated table and folds those — so a chain link folds one
             # level per round at worst, with Bloom re-offers carrying
             # deeper links across rounds (ops/timeline.check_grant doc).
-            # Table rows keep DELEGATE_BIT so folded grants prove chains.
+            # Table rows keep their full nibble masks so folded grants
+            # prove chains (the AUTHORIZE/REVOKE bits travel with them).
             fresh0 = in_ok & ~in_store & ~dup_in_batch
-            user_bits = jnp.uint32((1 << cfg.n_meta) - 1)
-            grant_mask = in_aux & (user_bits | jnp.uint32(DELEGATE_BIT))
+            user_bits = jnp.uint32(user_perm_mask(cfg.n_meta))
+            grant_mask = in_aux & user_bits
             fr = tl.fold(auth, target=in_payload, mask=grant_mask,
                          gt=in_gt, is_revoke=is_rev,
                          valid=fresh0 & (is_auth | is_rev) & ctrl_ok0)
             auth = fr.table
             deleg_ok = ((is_auth | is_rev) & ~ctrl_ok0
-                        & tl.check_grant(auth, in_member,
-                                         in_aux & user_bits, in_gt,
-                                         cfg.n_meta))
+                        & jnp.where(
+                            is_rev,
+                            tl.check_grant(auth, in_member, grant_mask,
+                                           in_gt, cfg.n_meta,
+                                           perm=PERM_REVOKE),
+                            tl.check_grant(auth, in_member, grant_mask,
+                                           in_gt, cfg.n_meta,
+                                           perm=PERM_AUTHORIZE)))
             fr2 = tl.fold(auth, target=in_payload, mask=grant_mask,
                           gt=in_gt, is_revoke=is_rev,
                           valid=fresh0 & deleg_ok)
             auth = fr2.table
-            ctrl_ok = ctrl_ok0 | deleg_ok
+            # Granted undo-other: the undoer holds the UNDO permission on
+            # the target record's meta (resolved from the receiver's own
+            # store; an absent target refuses this round and the Bloom
+            # re-offer retries — reference: timeline.py checks u"undo"
+            # against the target message's meta).  Granted flips: the
+            # AUTHORIZE permission on the flipped meta stands in for the
+            # reference's permit on the LinearResolution dynamic-settings
+            # meta (authority over a meta's grants extends to its policy).
+            undo_tmeta = ik.stored_meta_of(stc, in_payload, in_aux)
+            undo_ok = (is_undo_other
+                       & tl.check(auth, in_member, undo_tmeta, in_gt,
+                                  founder, perm=PERM_UNDO))
+            flip_grant_ok = (is_flip
+                             & tl.check(auth, in_member, in_payload, in_gt,
+                                        founder, perm=PERM_AUTHORIZE))
+            ctrl_ok = ctrl_ok0 | deleg_ok | undo_ok | flip_grant_ok
 
             # LinearResolution check against the updated table.
             prot = jnp.uint32(cfg.protected_meta_mask)
@@ -1127,7 +1288,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                 is_dyn = ((((dynm >> shift) & 1) == 1)
                           & (in_meta < cfg.n_meta))
                 best = _flip_best(stc, in_meta, in_gt)            # [N, B]
-                flip_ok = fresh0 & is_flip & ctrl_ok0             # [N, B]
+                flip_ok = (fresh0 & is_flip
+                           & (ctrl_ok0 | flip_grant_ok))          # [N, B]
                 best = jnp.maximum(best, ik.flip_best_batch(
                     flip_ok, in_payload, in_gt, in_aux, in_meta, in_gt))
                 linear_now = jnp.where(best > 0, (best & 1) == 1, protected)
@@ -1150,27 +1312,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                           & ik.undo_marked(stc, in_member, in_gt))
             in_flags = jnp.where(pre_undone, jnp.uint32(FLAG_UNDONE),
                                  jnp.uint32(0))
-            if cfg.delay_enabled:
-                # DelayMessageByProof: a non-control record that failed
-                # ONLY the permission check (for a control record ~accept
-                # means a forged authority — never delayable), is not
-                # already covered (stored, or a dup of an earlier batch
-                # entry), and has not exceeded its waiting time, parks in
-                # the pen instead of being rejected.  First-fit into the
-                # bounded pen; overflow rejects like the reference's
-                # delay-queue cap.
-                waiting = (in_ok & ~is_ctrl & ~accept & ~in_store
-                           & ~dup_in_batch
-                           & (rnd - in_since
-                              < jnp.uint32(cfg.delay_timeout_rounds)))
-                drank = jnp.cumsum(waiting.astype(jnp.int32), axis=1) - 1
-                parked = waiting & (drank < cfg.delay_inbox)
-            else:
-                parked = jnp.zeros_like(accept)
             stats = stats.replace(
-                msgs_rejected=stats.msgs_rejected
-                + jnp.sum(in_ok & ~accept & ~parked,
-                          axis=1).astype(jnp.uint32),
                 msgs_dropped=stats.msgs_dropped
                 + fr.n_dropped.astype(jnp.uint32)
                 + fr2.n_dropped.astype(jnp.uint32))
@@ -1213,10 +1355,35 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
 
             _, seq_ok = lax.fori_loop(
                 0, bb, seq_body, (stored_max, jnp.ones_like(accept)))
+        else:
+            seq_ok = jnp.ones_like(accept)
+
+        if cfg.delay_enabled:
+            # DelayMessageByProof — and, with config.seq_requests,
+            # DelayMessageBySequence: a non-control record that failed
+            # ONLY the permission check (for a control record ~accept
+            # means a forged authority — never delayable), or only the
+            # sequence-chain check, is not already covered (stored, or a
+            # dup of an earlier batch entry), and has not exceeded its
+            # waiting time, parks in the pen instead of being rejected.
+            # First-fit into the bounded pen; overflow rejects like the
+            # reference's delay-queue cap.
+            gap_wait = ((accept & ~seq_ok) if cfg.seq_requests
+                        else jnp.zeros_like(accept))
+            waiting = (in_ok & ~is_ctrl & (~accept | gap_wait) & ~in_store
+                       & ~dup_in_batch
+                       & (rnd - in_since
+                          < jnp.uint32(cfg.delay_timeout_rounds)))
+            drank = jnp.cumsum(waiting.astype(jnp.int32), axis=1) - 1
+            parked = waiting & (drank < cfg.delay_inbox)
+        else:
+            parked = jnp.zeros_like(accept)
+        accept = accept & seq_ok
+        if cfg.timeline_enabled or cfg.seq_meta_mask:
             stats = stats.replace(
                 msgs_rejected=stats.msgs_rejected
-                + jnp.sum(accept & ~seq_ok, axis=1).astype(jnp.uint32))
-            accept = accept & seq_ok
+                + jnp.sum(in_ok & ~accept & ~parked,
+                          axis=1).astype(jnp.uint32))
 
         if cfg.direct_meta_mask:
             # DirectDistribution receipt: counted, never stored, never
@@ -1272,6 +1439,32 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             stc = stc._replace(flags=jnp.where(
                 hit, stc.flags | jnp.uint32(FLAG_UNDONE), stc.flags))
 
+        if cfg.malicious_enabled and cfg.malicious_gossip:
+            # The eyewitness authors its dispersy-malicious-proof record
+            # now — after the batch landed and the clock folded, exactly
+            # like an application create in the same round (reference:
+            # dispersy.py authors the proof message on conviction).  One
+            # record per round: the first fresh conviction (gospick).
+            g_gt_new = global_time + jnp.uint32(1)
+            gins = st.store_insert(
+                stc,
+                st.StoreCols(
+                    gt=g_gt_new[:, None],
+                    member=idx.astype(jnp.uint32)[:, None],
+                    meta=jnp.full((n, 1), META_MALICIOUS, jnp.uint32),
+                    payload=g_member[:, None], aux=g_gt[:, None],
+                    flags=jnp.zeros((n, 1), jnp.uint32)),
+                new_mask=gossip_now[:, None], history=cfg.history)
+            stc = gins.store
+            global_time = jnp.where(gossip_now, g_gt_new, global_time)
+            stats = stats.replace(
+                msgs_stored=stats.msgs_stored
+                + gins.n_inserted.astype(jnp.uint32),
+                msgs_dropped=stats.msgs_dropped
+                + (gins.n_dropped + gins.n_evicted).astype(jnp.uint32),
+                accepted_by_meta=stats.accepted_by_meta
+                .at[:, cfg.n_meta].add(gossip_now.astype(jnp.uint32)))
+
         # Next round's forward batch = F fresh records of this batch.
         # With a timeline or mixed priorities, the F slots go to the
         # HIGHEST-priority fresh records (ties by delivery order) so a
@@ -1295,6 +1488,22 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         fwd = tuple(st.rank_compact(col, fslot, fb, EMPTY_U32)
                     for col in (in_gt, in_member, in_meta, in_payload,
                                 in_aux))
+        if cfg.malicious_enabled and cfg.malicious_gossip and fb > 0:
+            # The authored proof record claims a forward slot the way
+            # create_messages does: first free, displacing the newest
+            # relayed entry when full (the conviction must not lose its
+            # only push to relay traffic).
+            gput = jnp.minimum(st.count_valid(fwd[0]), fb - 1)
+            rowsg = jnp.arange(n)
+
+            def gbuf(cur, val):
+                return cur.at[rowsg, gput].set(
+                    jnp.where(gossip_now, val, cur[rowsg, gput]))
+            fwd = (gbuf(fwd[0], g_gt_new),
+                   gbuf(fwd[1], idx.astype(jnp.uint32)),
+                   gbuf(fwd[2], jnp.full((n,), META_MALICIOUS, jnp.uint32)),
+                   gbuf(fwd[3], g_member),
+                   gbuf(fwd[4], g_gt))
 
         if cfg.delay_enabled:
             # Rebuild the pen from this batch's parked records (waiting
@@ -1342,7 +1551,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         fwd_aux=fwd[4],
         dly_gt=dly[0], dly_member=dly[1], dly_meta=dly[2], dly_payload=dly[3],
         dly_aux=dly[4], dly_since=dly[5], dly_src=dly[6],
-        auth_member=auth.member, auth_mask=auth.mask, auth_gt=auth.gt,
+        auth_member=auth.member, auth_mask=auth.mask,
+        auth_gt=auth.gt, auth_rev=auth.rev,
         sig_target=sig[0], sig_meta=sig[1], sig_payload=sig[2],
         sig_gt=sig[3], sig_since=sig[4],
         stats=stats.replace(bytes_up=stats.bytes_up + bup,
@@ -1432,15 +1642,35 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
         _, _, mem_base, _ = _layout_cols(cfg, jnp.arange(n, dtype=jnp.int32))
         founder_row = _founder_col(cfg, mem_base)
         if meta in (META_AUTHORIZE, META_REVOKE):
-            # Founder, or a member holding the delegated authorize
-            # permission for every meta in the grant (Timeline.check's
-            # author-side gate on create — chains, see ops/timeline).
+            # Founder, or a member holding the matching authority bit
+            # (AUTHORIZE for grants, REVOKE for revokes — separable) for
+            # every meta in the mask (Timeline.check's author-side gate
+            # on create — chains, see ops/timeline).
             deleg = tl.check_grant(
                 auth, idx[:, None],
-                (aux & jnp.uint32((1 << cfg.n_meta) - 1))[:, None],
-                gt_new[:, None], cfg.n_meta)[:, 0]
+                (aux & jnp.uint32(user_perm_mask(cfg.n_meta)))[:, None],
+                gt_new[:, None], cfg.n_meta,
+                perm=(PERM_REVOKE if meta == META_REVOKE
+                      else PERM_AUTHORIZE))[:, 0]
             allowed = (idx == founder_row) | deleg
-        elif meta in (META_UNDO_OTHER, META_DYNAMIC, META_DESTROY):
+        elif meta == META_UNDO_OTHER:
+            # Founder, or the UNDO permission on the target record's meta
+            # — resolved from the author's OWN store (the reference undoes
+            # a message it holds; an unknown target refuses the create).
+            tmeta = ik.stored_meta_of(_store(state), payload[:, None],
+                                      aux[:, None])               # [N, 1]
+            granted = tl.check(auth, idx[:, None], tmeta,
+                               gt_new[:, None], founder_row[:, None],
+                               perm=PERM_UNDO)[:, 0]
+            allowed = (idx == founder_row) | granted
+        elif meta == META_DYNAMIC:
+            # Founder, or the AUTHORIZE permission on the flipped meta
+            # (mirrors the intake's flip_grant_ok rule).
+            granted = tl.check(auth, idx[:, None], payload[:, None],
+                               gt_new[:, None], founder_row[:, None],
+                               perm=PERM_AUTHORIZE)[:, 0]
+            allowed = (idx == founder_row) | granted
+        elif meta == META_DESTROY:
             allowed = idx == founder_row
         elif meta == META_UNDO_OWN:
             allowed = payload == idx
@@ -1478,8 +1708,8 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
     if cfg.timeline_enabled and meta in (META_AUTHORIZE, META_REVOKE):
         # The author's own table learns its own grant/revoke at create time.
         fr = tl.fold(auth, target=payload[:, None],
-                     mask=(aux & jnp.uint32((1 << cfg.n_meta) - 1
-                                            | DELEGATE_BIT))[:, None],
+                     mask=(aux
+                           & jnp.uint32(user_perm_mask(cfg.n_meta)))[:, None],
                      gt=gt_new[:, None],
                      is_revoke=jnp.full((n, 1), meta == META_REVOKE),
                      valid=author_mask[:, None])
@@ -1514,7 +1744,8 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
         fwd_meta=buf(state.fwd_meta, new.meta[:, 0]),
         fwd_payload=buf(state.fwd_payload, new.payload[:, 0]),
         fwd_aux=buf(state.fwd_aux, new.aux[:, 0]),
-        auth_member=auth.member, auth_mask=auth.mask, auth_gt=auth.gt,
+        auth_member=auth.member, auth_mask=auth.mask,
+        auth_gt=auth.gt, auth_rev=auth.rev,
         global_time=jnp.where(author_mask, gt_new, state.global_time),
         stats=state.stats.replace(
             msgs_stored=state.stats.msgs_stored

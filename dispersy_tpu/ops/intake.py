@@ -232,6 +232,36 @@ def undo_hits_store(stc, target_member: jnp.ndarray,
     return lax.fori_loop(0, b, body, jnp.zeros((n, m), bool))
 
 
+def stored_meta_of(stc, member: jnp.ndarray, gt: jnp.ndarray,
+                   impl: str | None = None) -> jnp.ndarray:
+    """u32[N, B]: meta id of the stored USER row at (member, gt), else
+    0xFFFF.  (The undo-other permission check resolves the target
+    record's meta — reference: timeline.py checks the u"undo" permission
+    against the *target message's* meta; payload.py UndoPayload names the
+    target by (member, global_time).)  A target not yet stored returns
+    the sentinel: the undo is refused this round and Bloom re-offers it,
+    the module-standard missing-proof fixed point."""
+    n, b = member.shape
+    m = stc.gt.shape[-1]
+    user = stc.meta < jnp.uint32(32)                      # [N, M]
+    sentinel = jnp.uint32(0xFFFF)
+    if _auto_impl(impl, n * b * m) == "broadcast":
+        match = (user[:, None, :]
+                 & (stc.member[:, None, :] == member[:, :, None])
+                 & (stc.gt[:, None, :] == gt[:, :, None]))
+        return jnp.min(jnp.where(match, stc.meta[:, None, :], sentinel),
+                       axis=-1)
+
+    def body(j, out):
+        mb = lax.dynamic_index_in_dim(member, j, 1)       # [N, 1]
+        g = lax.dynamic_index_in_dim(gt, j, 1)
+        match = user & (stc.member == mb) & (stc.gt == g)
+        mt = jnp.min(jnp.where(match, stc.meta, sentinel), axis=-1)
+        return lax.dynamic_update_index_in_dim(out, mt, j, 1)
+
+    return lax.fori_loop(0, b, body, jnp.full((n, b), sentinel))
+
+
 def seq_stored_max(stc, member: jnp.ndarray, meta: jnp.ndarray,
                    impl: str | None = None) -> jnp.ndarray:
     """u32[N, B]: per batch entry, the highest stored sequence number

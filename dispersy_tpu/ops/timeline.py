@@ -13,26 +13,32 @@ is accepted on a later round once the authorize record has spread.  Same
 fixed point, no delay queue.
 
 TPU recast: each peer holds a bounded ``[A]`` table of grant/revoke rows
-(member, meta-bitmask + revoke flag in bit 31, global_time of the
-authorizing record).  ``check`` is a broadcast-compare over the table;
-``fold`` inserts freshly synced authorize/revoke records.  Rows are never
-merged: the latest-at-or-before-gt row decides, with revoke beating a grant
-at the same global_time (the reference orders equal-time proofs by packet
-and rejects on conflict; a deterministic revoke-wins rule is the simulation
-equivalent).
+(member, per-meta permission-nibble mask, global_time, revoke flag).  The
+mask packs the reference's FOUR permission types per user meta — bit
+(4*meta + p) with p in {permit, authorize, revoke, undo}
+(config.PERM_* ids), mirroring ``Timeline.check``'s (member, message,
+permission) triple resolution.  ``check`` is a broadcast-compare over the
+table; ``fold`` inserts freshly synced authorize/revoke records.  Rows are
+never merged: the latest-at-or-before-gt row carrying the queried bit
+decides, with a revoke row beating a grant at the same global_time (the
+reference orders equal-time proofs by packet and rejects on conflict; a
+deterministic revoke-wins rule is the simulation equivalent).
 
 The founder (``CommunityConfig.founder``) holds every permission implicitly
-and is the root of authority.  Grants carrying ``DELEGATE_BIT`` convey the
-*authorize permission itself*, so chains (founder → A(authorize) →
-B(permit) → …) fold to arbitrary depth across rounds —
+and is the root of authority.  Grants carrying a meta's AUTHORIZE bit
+convey the *authorize permission itself* for that meta, so chains (founder
+→ A(authorize) → B(permit) → …) fold to arbitrary depth across rounds —
 :func:`check_grant` is the chain-link validity test, the bounded-table
-recast of ``Timeline.check``'s recursive proof walk.  One documented
-divergence from the reference's proof-chain walk: a link's validity is
-judged against the receiving peer's table *when the link folds*, not
-re-walked on every later check — a revoke that syncs after a grant it
-should have pre-dated does not retroactively unwind grants already folded
-from that granter (each peer's view converges to its own arrival order's
-fixed point; the reference re-validates chains lazily and can retro-reject).
+recast of ``Timeline.check``'s recursive proof walk; the REVOKE bit gates
+issuing revoke records separably, and the UNDO bit (checked via
+:func:`check` with ``perm=PERM_UNDO``) gates dispersy-undo-other.  One
+documented divergence from the reference's proof-chain walk: a link's
+validity is judged against the receiving peer's table *when the link
+folds*, not re-walked on every later check — a revoke that syncs after a
+grant it should have pre-dated does not retroactively unwind grants
+already folded from that granter (each peer's view converges to its own
+arrival order's fixed point; the reference re-validates chains lazily and
+can retro-reject).
 """
 
 from __future__ import annotations
@@ -42,69 +48,77 @@ from typing import NamedTuple
 import jax.numpy as jnp
 from jax import lax
 
-from dispersy_tpu.config import DELEGATE_BIT, EMPTY_U32
-
-# Bit 31 of a table row's mask marks a revoke row.  (Plain int, not a jnp
-# scalar: module import must not touch a JAX backend.)
-REVOKE_BIT = 1 << 31
+from dispersy_tpu.config import (EMPTY_U32, MAX_TIMELINE_META, PERM_AUTHORIZE,
+                                 PERM_PERMIT)
 
 
 class AuthTable(NamedTuple):
     """[N, A] grant/revoke rows; ``member == EMPTY_U32`` marks a free slot."""
     member: jnp.ndarray  # u32[N, A] member the row applies to
-    mask: jnp.ndarray    # u32[N, A] user-meta bitmask; bit 31 = revoke row
+    mask: jnp.ndarray    # u32[N, A] per-meta permission nibbles (perm_bit)
     gt: jnp.ndarray      # u32[N, A] global_time the row takes effect
+    rev: jnp.ndarray     # bool[N, A] True = revoke row (removes the bits)
+
+
+def _latest_row_verdict(match, row_gt_masked, is_rev):
+    """Shared latest-wins rule: the highest-gt matching row decides;
+    a revoke row beats a grant row at the same global_time."""
+    best = jnp.max(row_gt_masked, axis=-1)
+    at_best = match & (row_gt_masked == best[..., None])
+    return (jnp.any(at_best & ~is_rev, axis=-1)
+            & ~jnp.any(at_best & is_rev, axis=-1)
+            & jnp.any(match, axis=-1))
 
 
 def check(tab: AuthTable, member: jnp.ndarray, meta: jnp.ndarray,
-          gt: jnp.ndarray, founder) -> jnp.ndarray:
-    """Is ``member`` permitted to emit ``meta`` at ``gt``?  [N, B] verdicts.
+          gt: jnp.ndarray, founder, perm: int = PERM_PERMIT) -> jnp.ndarray:
+    """Does ``member`` hold permission ``perm`` for ``meta`` at ``gt``?
+    [N, B] verdicts.
 
-    Mirrors ``Timeline.check`` for the permit permission: the latest
-    grant/revoke row for (member, meta) at global_time <= gt decides;
-    revoke wins a tie at equal global_time; no row at all means not
-    permitted.  The founder is always permitted.
+    Mirrors ``Timeline.check`` for one permission type: the latest
+    grant/revoke row carrying bit (4*meta + perm) for ``member`` at
+    global_time <= gt decides; revoke wins a tie at equal global_time; no
+    row at all means not held.  The founder always holds everything.
 
     ``member``/``meta``/``gt`` are [N, B] record fields checked against each
     receiving peer's own table.  ``founder`` is an int (one community) or a
     per-row array broadcastable against [N, B] (multi-community layouts,
     where each block answers to its own founder).
     """
-    # Clamped shift: control metas (>= 32) never match a mask bit, and a
-    # shift >= the bit width would be undefined in XLA.
-    sh = jnp.minimum(meta, jnp.uint32(31))
+    # Clamped shift: metas outside the nibble range (control ids, or the
+    # caller's not-found sentinel) never match a bit, and a shift >= the
+    # bit width would be undefined in XLA.
+    in_range = meta < MAX_TIMELINE_META
+    sh = jnp.minimum(jnp.uint32(4) * meta + jnp.uint32(perm), jnp.uint32(31))
     bit = ((tab.mask[:, None, :] >> sh[:, :, None]) & jnp.uint32(1)
-           & (meta < 32)[:, :, None].astype(jnp.uint32))             # [N,B,A]
+           & in_range[:, :, None].astype(jnp.uint32))               # [N,B,A]
     match = ((tab.member[:, None, :] == member[:, :, None])
              & (tab.member[:, None, :] != jnp.uint32(EMPTY_U32))
              & (bit == 1)
              & (tab.gt[:, None, :] <= gt[:, :, None]))
     row_gt = jnp.where(match, tab.gt[:, None, :], 0)
-    best = jnp.max(row_gt, axis=-1)                                   # [N, B]
-    at_best = match & (row_gt == best[:, :, None])
-    is_revoke = (tab.mask[:, None, :] & jnp.uint32(REVOKE_BIT)) != 0
-    granted = (jnp.any(at_best & ~is_revoke, axis=-1)
-               & ~jnp.any(at_best & is_revoke, axis=-1)
-               & jnp.any(match, axis=-1))
+    granted = _latest_row_verdict(match, row_gt, tab.rev[:, None, :])
     return granted | (member == jnp.asarray(founder, jnp.uint32))
 
 
 def check_grant(tab: AuthTable, member: jnp.ndarray, mask: jnp.ndarray,
                 gt: jnp.ndarray, n_meta: int,
+                perm: int = PERM_AUTHORIZE,
                 impl: str | None = None) -> jnp.ndarray:
-    """May ``member`` issue an authorize/revoke covering ``mask`` at ``gt``?
+    """May ``member`` issue a grant/revoke covering ``mask`` at ``gt``?
 
     The delegation chain check (reference: timeline.py ``Timeline.check``
     walking authorize proofs — a member granted the *authorize* permission
-    for a meta can itself authorize others for it).  A grant row conveys
-    that permission only when it carries :data:`~dispersy_tpu.config.
-    DELEGATE_BIT`; per meta, the latest delegate-row at global_time <= gt
-    decides, revoke winning ties — the same latest-wins rule as
-    :func:`check`, evaluated on the delegate bit instead of the permit
-    bit.  The verdict requires EVERY meta bit set in ``mask`` (and a
-    non-empty mask: an empty grant proves nothing).  The founder shortcut
-    is the CALLER's (``founder-or-delegated``), keeping this function a
-    pure chain check.
+    for a meta can itself authorize others for it; one granted the
+    *revoke* permission can issue revokes, separably).  Per meta whose
+    NIBBLE in ``mask`` is non-empty, the latest row carrying that meta's
+    ``perm`` authority bit at global_time <= gt decides, revoke winning
+    ties — the same latest-wins rule as :func:`check`, evaluated on the
+    authority bit (``perm`` = PERM_AUTHORIZE for authorize records,
+    PERM_REVOKE for revoke records).  The verdict requires EVERY meta
+    named in ``mask`` (and a non-empty mask: an empty grant proves
+    nothing).  The founder shortcut is the CALLER's
+    (``founder-or-delegated``), keeping this function a pure chain check.
 
     Chains deepen one table-fold per round: a full chain arriving in one
     batch folds its first link this round and the rest on re-offer —
@@ -118,24 +132,20 @@ def check_grant(tab: AuthTable, member: jnp.ndarray, mask: jnp.ndarray,
 
     n, b = member.shape
     a = tab.member.shape[-1]
-    deleg_rows = ((tab.mask & jnp.uint32(DELEGATE_BIT)) != 0)        # [N, A]
     live = tab.member != jnp.uint32(EMPTY_U32)
-    is_rev = (tab.mask & jnp.uint32(REVOKE_BIT)) != 0
 
     if _auto_impl(impl, n * b * a * n_meta) == "broadcast":
         ok = mask != 0
         for k in range(n_meta):
-            need = ((mask >> k) & jnp.uint32(1)) == 1                # [N, B]
-            rows_k = ((((tab.mask >> k) & jnp.uint32(1)) == 1)
-                      & deleg_rows & live)
+            need = ((mask >> (4 * k)) & jnp.uint32(0xF)) != 0        # [N, B]
+            rows_k = (((tab.mask >> (4 * k + perm)) & jnp.uint32(1)) == 1) \
+                & live
             match = (rows_k[:, None, :]
                      & (tab.member[:, None, :] == member[:, :, None])
                      & (tab.gt[:, None, :] <= gt[:, :, None]))       # [N,B,A]
             row_gt = jnp.where(match, tab.gt[:, None, :], 0)
-            best = jnp.max(row_gt, axis=-1)
-            at_best = match & (row_gt == best[:, :, None])
-            granted_k = (jnp.any(at_best & ~is_rev[:, None, :], axis=-1)
-                         & ~jnp.any(at_best & is_rev[:, None, :], axis=-1))
+            granted_k = _latest_row_verdict(match, row_gt,
+                                            tab.rev[:, None, :])
             ok = ok & (~need | granted_k)
         return ok
 
@@ -147,15 +157,12 @@ def check_grant(tab: AuthTable, member: jnp.ndarray, mask: jnp.ndarray,
         g = lax.dynamic_index_in_dim(gt, j, 1)
         ok_j = (mk != 0)[:, 0]
         for k in range(n_meta):
-            need = (((mk >> k) & jnp.uint32(1)) == 1)[:, 0]          # [N]
-            rows_k = ((((tab.mask >> k) & jnp.uint32(1)) == 1)
-                      & deleg_rows & live)
+            need = (((mk >> (4 * k)) & jnp.uint32(0xF)) != 0)[:, 0]  # [N]
+            rows_k = (((tab.mask >> (4 * k + perm)) & jnp.uint32(1)) == 1) \
+                & live
             match = rows_k & (tab.member == mb) & (tab.gt <= g)      # [N, A]
             row_gt = jnp.where(match, tab.gt, 0)
-            best = jnp.max(row_gt, axis=-1)
-            at_best = match & (row_gt == best[:, None])
-            granted_k = (jnp.any(at_best & ~is_rev, axis=-1)
-                         & ~jnp.any(at_best & is_rev, axis=-1))
+            granted_k = _latest_row_verdict(match, row_gt, tab.rev)
             ok_j = ok_j & (~need | granted_k)
         return lax.dynamic_update_index_in_dim(out, ok_j, j, 1)
 
@@ -173,22 +180,23 @@ def fold(tab: AuthTable, target: jnp.ndarray, mask: jnp.ndarray,
     """Insert [N, B] accepted authorize/revoke records into each table.
 
     Mirrors ``Timeline.authorize``/``.revoke`` folding stored proof into the
-    permission state.  Idempotent per (member, mask, gt) row — an evicted
-    record that re-syncs after store overflow must not eat a second slot.
-    Overflow drops the new row, counted (bounded state, as everywhere).
+    permission state.  Idempotent per (member, mask, gt, revoke) row — an
+    evicted record that re-syncs after store overflow must not eat a second
+    slot.  Overflow drops the new row, counted (bounded state, as
+    everywhere).
     """
     n, b = target.shape
-    row_mask = jnp.where(is_revoke, mask | jnp.uint32(REVOKE_BIT),
-                         mask).astype(jnp.uint32)
+    is_revoke = jnp.broadcast_to(jnp.asarray(is_revoke, bool), (n, b))
 
     def body(i, carry):
         t, dropped = carry
         tg = lax.dynamic_index_in_dim(target, i, axis=1)     # [N, 1]
-        mk = lax.dynamic_index_in_dim(row_mask, i, axis=1)
+        mk = lax.dynamic_index_in_dim(mask, i, axis=1)
         g = lax.dynamic_index_in_dim(gt, i, axis=1)
+        rv = lax.dynamic_index_in_dim(is_revoke, i, axis=1)
         ok = lax.dynamic_index_in_dim(valid, i, axis=1)      # [N, 1]
-        dup = jnp.any((t.member == tg) & (t.mask == mk) & (t.gt == g),
-                      axis=1, keepdims=True)
+        dup = jnp.any((t.member == tg) & (t.mask == mk) & (t.gt == g)
+                      & (t.rev == rv), axis=1, keepdims=True)
         want = ok & ~dup
         free = t.member == jnp.uint32(EMPTY_U32)             # [N, A]
         slot = jnp.argmax(free, axis=1)                      # first free
@@ -197,7 +205,8 @@ def fold(tab: AuthTable, target: jnp.ndarray, mask: jnp.ndarray,
         return (AuthTable(
             member=jnp.where(hit, tg, t.member),
             mask=jnp.where(hit, mk, t.mask),
-            gt=jnp.where(hit, g, t.gt)),
+            gt=jnp.where(hit, g, t.gt),
+            rev=jnp.where(hit, rv, t.rev)),
             dropped + (want & ~can)[:, 0].astype(jnp.int32))
 
     init = (tab, jnp.zeros((n,), jnp.int32))

@@ -25,20 +25,24 @@ from __future__ import annotations
 
 import numpy as np
 
-from dispersy_tpu.config import (CONTROL_PRIORITY, DELEGATE_BIT, EMPTY_U32,
+from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_U32,
                                  INTRO_REQUEST_BASE_BYTES,
-                                 INTRO_RESPONSE_BYTES, META_AUTHORIZE,
-                                 META_DESTROY, META_DYNAMIC, META_REVOKE,
+                                 INTRO_RESPONSE_BYTES, MAX_TIMELINE_META,
+                                 META_AUTHORIZE,
+                                 META_DESTROY, META_DYNAMIC, META_MALICIOUS,
+                                 META_REVOKE,
                                  META_UNDO_OTHER, META_UNDO_OWN,
-                                 MISSING_PROOF_BYTES, NO_PEER,
+                                 MISSING_PROOF_BYTES, MISSING_SEQ_BYTES,
+                                 NO_PEER,
+                                 PERM_AUTHORIZE, PERM_PERMIT, PERM_REVOKE,
+                                 PERM_UNDO,
                                  PUNCTURE_BYTES, PUNCTURE_REQUEST_BYTES,
                                  RECORD_BYTES, SIGNATURE_REQUEST_BYTES,
                                  SIGNATURE_RESPONSE_BYTES, CommunityConfig,
-                                 priority_of)
+                                 priority_of, user_perm_mask)
 from dispersy_tpu.oracle.bloom import OracleBloom, record_hash
 from dispersy_tpu.ops import rng as _jrng
 
-REVOKE_BIT = 1 << 31
 FLAG_UNDONE = 1
 
 M32 = 0xFFFFFFFF
@@ -56,6 +60,8 @@ _LOSS_SIGREQ = 6 << 16
 _LOSS_SIGRESP = 7 << 16
 _LOSS_PROOF_REQ = 8 << 16
 _LOSS_PROOF_RESP = 9 << 16
+_LOSS_SEQ_REQ = 10 << 16
+_LOSS_SEQ_RESP = 11 << 16
 _TRACKER_SALT = 1 << 15
 _TRACKER_INTRO_SALT = 1 << 20
 
@@ -125,12 +131,14 @@ class Record:
 
 
 class AuthRow:
-    """One grant/revoke row (ops/timeline.py AuthTable mirror)."""
+    """One grant/revoke row (ops/timeline.py AuthTable mirror): ``mask``
+    holds per-meta permission nibbles, ``rev`` flags a revoke row."""
 
-    __slots__ = ("member", "mask", "gt")
+    __slots__ = ("member", "mask", "gt", "rev")
 
-    def __init__(self, member, mask, gt):
+    def __init__(self, member, mask, gt, rev=False):
         self.member, self.mask, self.gt = int(member), int(mask), int(gt)
+        self.rev = bool(rev)
 
 
 class Slot:
@@ -170,8 +178,10 @@ class OraclePeer:
         self.msgs_direct = 0
         self.msgs_delayed = 0
         self.proof_requests = self.proof_records = 0
+        self.seq_requests = self.seq_records = 0
         self.sig_signed = self.sig_done = self.sig_expired = 0
         self.conflicts = 0
+        self.convictions_rx = 0
         self.bytes_up = self.bytes_down = 0          # wrap mod 2^32
         self.accepted_by_meta = [0] * (cfg.n_meta + 1)
 
@@ -424,58 +434,69 @@ class OracleSim:
 
     # ---- timeline (ops/timeline.py mirror) ----------------------------------
 
-    def _auth_check(self, owner: int, member: int, meta: int, gt: int) -> bool:
-        """tl.check for one record vs one peer's table."""
-        if member == self._founder(owner):
-            return True
-        if meta >= 32:
+    def _auth_bit(self, owner: int, member: int, meta: int, gt: int,
+                  perm: int) -> bool:
+        """Latest-wins table test on bit (4*meta + perm) — tl.check /
+        tl.check_grant's shared per-meta rule, WITHOUT the founder
+        shortcut (callers compose founder-or-granted)."""
+        if not 0 <= meta < MAX_TIMELINE_META:
             return False
+        bit = 4 * meta + perm
         matches = [r for r in self.peers[owner].auth
-                   if r.member == member and ((r.mask >> meta) & 1)
+                   if r.member == member and ((r.mask >> bit) & 1)
                    and r.gt <= gt]
         if not matches:
             return False
         best = max(r.gt for r in matches)
         at_best = [r for r in matches if r.gt == best]
-        grant = any(not (r.mask & REVOKE_BIT) for r in at_best)
-        revoke = any(r.mask & REVOKE_BIT for r in at_best)
+        grant = any(not r.rev for r in at_best)
+        revoke = any(r.rev for r in at_best)
         return grant and not revoke
 
-    def _auth_check_delegate(self, owner: int, member: int, meta: int,
-                             gt: int) -> bool:
-        """tl.check_grant's per-meta link test: latest DELEGATE row for
-        (member, meta) at or below gt decides, revoke winning ties.  No
-        founder shortcut — the caller composes founder-or-delegated."""
-        matches = [r for r in self.peers[owner].auth
-                   if r.member == member and (r.mask & DELEGATE_BIT)
-                   and ((r.mask >> meta) & 1) and r.gt <= gt]
-        if not matches:
-            return False
-        best = max(r.gt for r in matches)
-        at_best = [r for r in matches if r.gt == best]
-        grant = any(not (r.mask & REVOKE_BIT) for r in at_best)
-        revoke = any(r.mask & REVOKE_BIT for r in at_best)
-        return grant and not revoke
+    def _auth_check(self, owner: int, member: int, meta: int, gt: int,
+                    perm: int = PERM_PERMIT) -> bool:
+        """tl.check for one record vs one peer's table (founder included)."""
+        if member == self._founder(owner):
+            return True
+        return self._auth_bit(owner, member, meta, gt, perm)
 
-    def _grant_ok(self, owner: int, member: int, mask: int, gt: int) -> bool:
-        """tl.check_grant mirror: may ``member`` issue an authorize/revoke
-        covering ``mask`` at ``gt``?  Every masked meta must be delegated;
-        an empty mask proves nothing."""
+    def _grant_ok(self, owner: int, member: int, mask: int, gt: int,
+                  perm: int = PERM_AUTHORIZE) -> bool:
+        """tl.check_grant mirror: may ``member`` issue a grant/revoke
+        covering nibble-``mask`` at ``gt``?  Every meta with a non-empty
+        nibble needs the ``perm`` authority bit (PERM_AUTHORIZE for
+        authorize records, PERM_REVOKE for revokes); an empty mask proves
+        nothing."""
         if mask == 0:
             return False
-        return all(self._auth_check_delegate(owner, member, k, gt)
-                   for k in range(self.cfg.n_meta) if (mask >> k) & 1)
+        return all(self._auth_bit(owner, member, k, gt, perm)
+                   for k in range(self.cfg.n_meta)
+                   if (mask >> (4 * k)) & 0xF)
+
+    def _undo_other_ok(self, owner: int, member: int, target: int,
+                       target_gt: int, gt: int) -> bool:
+        """Engine's undo_ok: founder, or the UNDO permission on the
+        target record's meta, resolved from the owner's own store
+        (ik.stored_meta_of; absent target -> refused this round)."""
+        if member == self._founder(owner):
+            return True
+        tmeta = next((r.meta for r in self.peers[owner].store
+                      if r.member == target and r.gt == target_gt
+                      and r.meta < 32), None)
+        if tmeta is None:
+            return False
+        return self._auth_bit(owner, member, tmeta, gt, PERM_UNDO)
 
     def _auth_fold(self, owner: int, target: int, mask: int, gt: int,
                    is_revoke: bool) -> None:
         """tl.fold for one accepted authorize/revoke record."""
         p = self.peers[owner]
-        row_mask = (mask | REVOKE_BIT) if is_revoke else mask
         for r in p.auth:
-            if r.member == target and r.mask == row_mask and r.gt == gt:
+            if (r.member == target and r.mask == mask and r.gt == gt
+                    and r.rev == is_revoke):
                 return  # idempotent: row already folded
         if len(p.auth) < self.cfg.k_authorized:
-            p.auth.append(AuthRow(target, row_mask, gt))
+            p.auth.append(AuthRow(target, mask, gt, is_revoke))
         else:
             p.msgs_dropped += 1
 
@@ -525,7 +546,15 @@ class OracleSim:
         m = rec.meta
         if m in (META_AUTHORIZE, META_REVOKE):
             return rec.member == self._founder(owner) or deleg_ok
-        if m in (META_UNDO_OTHER, META_DYNAMIC, META_DESTROY):
+        if m == META_UNDO_OTHER:
+            return self._undo_other_ok(owner, rec.member, rec.payload,
+                                       rec.aux, rec.gt)
+        if m == META_DYNAMIC:
+            # Engine's flip_grant_ok: founder, or the AUTHORIZE authority
+            # on the flipped meta.
+            return self._auth_check(owner, rec.member, rec.payload,
+                                    rec.gt, PERM_AUTHORIZE)
+        if m == META_DESTROY:
             return rec.member == self._founder(owner)
         if m == META_UNDO_OWN:
             return rec.member == rec.payload
@@ -558,9 +587,17 @@ class OracleSim:
                 if meta in (META_AUTHORIZE, META_REVOKE):
                     if (i != self._founder(i)
                             and not self._grant_ok(
-                                i, i, av & ((1 << cfg.n_meta) - 1), gt)):
+                                i, i, av & user_perm_mask(cfg.n_meta), gt,
+                                PERM_REVOKE if meta == META_REVOKE
+                                else PERM_AUTHORIZE)):
                         continue
-                elif meta in (META_UNDO_OTHER, META_DYNAMIC, META_DESTROY):
+                elif meta == META_UNDO_OTHER:
+                    if not self._undo_other_ok(i, i, pv, av, gt):
+                        continue
+                elif meta == META_DYNAMIC:
+                    if not self._auth_check(i, i, pv, gt, PERM_AUTHORIZE):
+                        continue
+                elif meta == META_DESTROY:
                     if i != self._founder(i):
                         continue
                 elif meta == META_UNDO_OWN:
@@ -581,9 +618,8 @@ class OracleSim:
             if not (meta < cfg.n_meta and (cfg.direct_meta_mask >> meta) & 1):
                 self._store_insert(i, [rec], count_drops=False)
             if cfg.timeline_enabled and meta in (META_AUTHORIZE, META_REVOKE):
-                self._auth_fold(
-                    i, pv, av & (((1 << cfg.n_meta) - 1) | DELEGATE_BIT),
-                    gt, meta == META_REVOKE)
+                self._auth_fold(i, pv, av & user_perm_mask(cfg.n_meta),
+                                gt, meta == META_REVOKE)
             if cfg.timeline_enabled and meta in (META_UNDO_OWN,
                                                  META_UNDO_OTHER):
                 for r in p.store:
@@ -1104,6 +1140,62 @@ class OracleSim:
                         p.proof_records += 1
                         p.bytes_down += RECORD_BYTES
 
+        # phase 4s: active missing-sequence round trip (engine phase 4s) —
+        # every SEQ-parked pen entry asks its deliverer for the missing
+        # range; replies served ASCENDING from the sorted store.
+        mq_batch: list[list[tuple[Record, int]]] = [[] for _ in range(n)]
+        if delay_on and cfg.seq_requests:
+            seq_inbox: list[list[tuple[int, int, int, int, int, int]]] = \
+                [[] for _ in range(n)]
+            for i in range(n):
+                p = self.peers[i]
+                for d, (rec, since, src) in enumerate(p.delay):
+                    is_seq = (rec.meta < cfg.n_meta
+                              and (cfg.seq_meta_mask >> rec.meta) & 1)
+                    if not p.alive or src == NO_PEER or not is_seq:
+                        continue
+                    low = max((r.aux for r in p.store
+                               if r.member == rec.member
+                               and r.meta == rec.meta), default=0) + 1
+                    high = rec.aux - 1
+                    if low > high:
+                        continue
+                    p.bytes_up += MISSING_SEQ_BYTES     # sendto, pre-loss
+                    if self._lost(i, _LOSS_SEQ_REQ, d):
+                        continue
+                    if 0 <= src < n:
+                        if len(seq_inbox[src]) < cfg.proof_inbox:
+                            seq_inbox[src].append(
+                                (i, d, rec.member, rec.meta, low, high))
+                        else:
+                            self.peers[src].requests_dropped += 1
+            sreplies: dict[tuple[int, int], list[Record]] = {}
+            for sv in range(n):
+                psv = self.peers[sv]
+                if not psv.alive or (cfg.timeline_enabled and killed[sv]):
+                    continue
+                for (ri, d_slot, member, meta, low, high) in seq_inbox[sv]:
+                    psv.seq_requests += 1
+                    psv.bytes_down += MISSING_SEQ_BYTES
+                    served = [r for r in psv.store
+                              if r.member == member and r.meta == meta
+                              and low <= r.aux <= high][:cfg.proof_budget]
+                    psv.bytes_up += len(served) * RECORD_BYTES
+                    sreplies[(ri, d_slot)] = served
+            for i in range(n):
+                p = self.peers[i]
+                for d, entry in enumerate(p.delay):
+                    for b_ix, r in enumerate(sreplies.get((i, d), [])):
+                        if not p.alive or self._lost(
+                                i, _LOSS_SEQ_RESP,
+                                d * cfg.proof_budget + b_ix):
+                            continue
+                        mq_batch[i].append(
+                            (Record(r.gt, r.member, r.meta, r.payload,
+                                    r.aux), entry[2]))
+                        p.seq_records += 1
+                        p.bytes_down += RECORD_BYTES
+
         # phase 5: combined intake (delayed pen + sync pull + push) ->
         # store + fwd batch + rebuilt pen
         for i in range(n):
@@ -1134,6 +1226,7 @@ class OracleSim:
                 # the record's aux IS the countersigner it came back from
                 batch.append((sig_completed[i], rnd, sig_completed[i].aux))
             batch.extend((rec, rnd, src) for rec, src in pr_batch[i])
+            batch.extend((rec, rnd, src) for rec, src in mq_batch[i])
             # clock-jump defense (engine: post-walk-fold clock), plus the
             # structural countersigner check for double-signed metas
             ok_pairs = [(rec, s, sc) for rec, s, sc in batch
@@ -1145,22 +1238,43 @@ class OracleSim:
                 # a hard-killed peer convicts nobody and counts nothing
                 # (delivery bytes were already counted at recvfrom above)
                 ok_pairs = []
+            gossip_pick = None
             if cfg.malicious_enabled:
                 # engine: conviction + blacklist run AFTER the killed gate
                 # (a killed peer's emptied batch convicts nobody), in
                 # batch order (fold_set semantics)
+                pre_mal = set(p.mal)      # pre-batch blacklist snapshot
                 for rec, *_ in ok_pairs:
                     conflict = any(
                         r.member == rec.member and r.gt == rec.gt
                         and (r.meta != rec.meta or r.payload != rec.payload
                              or r.aux != rec.aux)
                         for r in p.store)
+                    if conflict and rec.member not in pre_mal \
+                            and gossip_pick is None:
+                        # engine gospick: first conflict naming a member
+                        # not blacklisted before this batch
+                        gossip_pick = (rec.member, rec.gt)
                     if conflict and rec.member not in p.mal:
                         if len(p.mal) < cfg.k_malicious:
                             p.mal.append(rec.member)
                             p.conflicts += 1
                         else:
                             p.msgs_dropped += 1
+                if cfg.malicious_gossip:
+                    # Gossiped conviction claims fold next — unless the
+                    # claimant is already blacklisted post-eyewitness-fold
+                    # (engine black0).
+                    black0 = set(p.mal)
+                    for rec, *_ in ok_pairs:
+                        if (rec.meta == META_MALICIOUS
+                                and rec.member not in black0
+                                and rec.payload not in p.mal):
+                            if len(p.mal) < cfg.k_malicious:
+                                p.mal.append(rec.payload)
+                                p.convictions_rx += 1
+                            else:
+                                p.msgs_dropped += 1
                 n_black = sum(1 for rec, *_ in ok_pairs
                               if rec.member in p.mal)
                 p.msgs_rejected += n_black
@@ -1185,7 +1299,7 @@ class OracleSim:
                 # Pass A: root (founder) grants; pass B: delegated grants,
                 # ALL judged against the post-pass-A table snapshot, then
                 # folded in batch order (engine's fr/fr2 two-pass).
-                gmask = ((1 << cfg.n_meta) - 1) | DELEGATE_BIT
+                gmask = user_perm_mask(cfg.n_meta)
                 for rec, f0 in zip(ok_batch, fresh0):
                     if (rec.meta in (META_AUTHORIZE, META_REVOKE) and f0
                             and rec.member == self._founder(i)):
@@ -1194,9 +1308,10 @@ class OracleSim:
                 deleg_flags = [
                     rec.meta in (META_AUTHORIZE, META_REVOKE)
                     and rec.member != self._founder(i)
-                    and self._grant_ok(i, rec.member,
-                                       rec.aux & ((1 << cfg.n_meta) - 1),
-                                       rec.gt)
+                    and self._grant_ok(i, rec.member, rec.aux & gmask,
+                                       rec.gt,
+                                       PERM_REVOKE if rec.meta == META_REVOKE
+                                       else PERM_AUTHORIZE)
                     for rec in ok_batch]
                 for rec, f0, dg in zip(ok_batch, fresh0, deleg_flags):
                     if dg and f0:
@@ -1204,45 +1319,23 @@ class OracleSim:
                                         rec.gt, rec.meta == META_REVOKE)
                 if cfg.dynamic_meta_mask:
                     # this batch's fresh accepted dynamic-settings flips
-                    # (engine: flip_ok = fresh0 & is_flip & ctrl_ok0)
+                    # (engine: flip_ok = fresh0 & is_flip
+                    #  & (ctrl_ok0 | flip_grant_ok) — founder or the
+                    #  AUTHORIZE authority on the flipped meta, judged
+                    #  against the post-fold table)
                     for rec, f0 in zip(ok_batch, fresh0):
                         if (rec.meta == META_DYNAMIC and f0
-                                and rec.member == self._founder(i)):
+                                and self._auth_check(i, rec.member,
+                                                     rec.payload, rec.gt,
+                                                     PERM_AUTHORIZE)):
                             batch_flips.append((rec.gt, rec.payload,
                                                 rec.aux))
             accept = [self._intake_accept(i, rec, batch_flips, dg)
                       for rec, dg in zip(ok_batch, deleg_flags)]
-            if delay_on:
-                # DelayMessageByProof pen (engine: waiting/parked masks).
-                # A non-control record failing only the permission check,
-                # not already covered (fresh0), and still inside its
-                # waiting window parks; first-fit into the bounded pen.
-                ctrl = (META_AUTHORIZE, META_REVOKE, META_UNDO_OWN,
-                        META_UNDO_OTHER, META_DYNAMIC, META_DESTROY)
-                new_delay: list[tuple[Record, int, int]] = []
-                parked_flags: list[bool] = []
-                for rec, s, sc, a, f0 in zip(ok_batch, ok_since, ok_src,
-                                             accept, fresh0):
-                    waiting = (not a and rec.meta not in ctrl and f0
-                               and rnd - s < cfg.delay_timeout_rounds)
-                    parked = waiting and len(new_delay) < cfg.delay_inbox
-                    if parked:
-                        new_delay.append(
-                            (Record(rec.gt, rec.member, rec.meta,
-                                    rec.payload, rec.aux), s, sc))
-                        if s == rnd:
-                            p.msgs_delayed += 1
-                    parked_flags.append(parked)
-                p.delay = new_delay
-            else:
-                parked_flags = [False] * len(ok_batch)
-            p.msgs_rejected += sum(1 for a, pk in zip(accept, parked_flags)
-                                   if not a and not pk)
-
             if cfg.seq_meta_mask:
                 # Sequence-chain intake (engine's fori scan, in batch order).
                 acc_state: dict[tuple[int, int], int] = {}
-                accept2 = []
+                seq_ok_l = []
                 for rec, a in zip(ok_batch, accept):
                     is_seq = (rec.meta < cfg.n_meta
                               and (cfg.seq_meta_mask >> rec.meta) & 1)
@@ -1259,10 +1352,41 @@ class OracleSim:
                             acc_state[gkey] = max(cur, rec.aux)
                     else:
                         ok_i = True
-                    if a and not ok_i:
-                        p.msgs_rejected += 1
-                    accept2.append(a and ok_i)
-                accept = accept2
+                    seq_ok_l.append(ok_i)
+            else:
+                seq_ok_l = [True] * len(ok_batch)
+
+            if delay_on:
+                # DelayMessageByProof pen — plus, with seq_requests,
+                # DelayMessageBySequence (engine: waiting/parked masks).
+                # A non-control record failing only the permission check
+                # (or only the sequence chain), not already covered
+                # (fresh0), and still inside its waiting window parks;
+                # first-fit into the bounded pen.
+                ctrl = (META_AUTHORIZE, META_REVOKE, META_UNDO_OWN,
+                        META_UNDO_OTHER, META_DYNAMIC, META_DESTROY)
+                new_delay: list[tuple[Record, int, int]] = []
+                parked_flags: list[bool] = []
+                for rec, s, sc, a, sok, f0 in zip(ok_batch, ok_since, ok_src,
+                                                  accept, seq_ok_l, fresh0):
+                    gap = cfg.seq_requests and a and not sok
+                    waiting = ((not a or gap) and rec.meta not in ctrl
+                               and f0
+                               and rnd - s < cfg.delay_timeout_rounds)
+                    parked = waiting and len(new_delay) < cfg.delay_inbox
+                    if parked:
+                        new_delay.append(
+                            (Record(rec.gt, rec.member, rec.meta,
+                                    rec.payload, rec.aux), s, sc))
+                        if s == rnd:
+                            p.msgs_delayed += 1
+                    parked_flags.append(parked)
+                p.delay = new_delay
+            else:
+                parked_flags = [False] * len(ok_batch)
+            accept = [a and sok for a, sok in zip(accept, seq_ok_l)]
+            p.msgs_rejected += sum(1 for a, pk in zip(accept, parked_flags)
+                                   if not a and not pk)
 
             if cfg.direct_meta_mask:
                 accept_store = []
@@ -1311,6 +1435,16 @@ class OracleSim:
                             if (r.member == rec.payload and r.gt == rec.aux
                                     and r.meta < 32):
                                 r.flags |= FLAG_UNDONE
+            grec = None
+            if (cfg.malicious_enabled and cfg.malicious_gossip
+                    and gossip_pick is not None):
+                # Eyewitness authors dispersy-malicious-proof post-insert
+                # (engine: after the batch landed and the clock folded).
+                gm, gg = gossip_pick
+                p.global_time += 1
+                grec = Record(p.global_time, i, META_MALICIOUS, gm, gg)
+                self._store_insert(i, [grec])
+                p.accepted_by_meta[min(META_MALICIOUS, cfg.n_meta)] += 1
             fresh_ix = [(j, rec) for j, (rec, a, f0) in
                         enumerate(zip(ok_batch, accept_store, fresh0))
                         if a and f0]
@@ -1324,6 +1458,13 @@ class OracleSim:
                 fresh_ix.sort(key=fkey)
             p.fwd = [rec.copy()
                      for _, rec in fresh_ix[:cfg.forward_buffer]]
+            if grec is not None and cfg.forward_buffer > 0:
+                # The proof record claims a forward slot like a create
+                # (engine: first free, displacing the newest relay entry).
+                if len(p.fwd) < cfg.forward_buffer:
+                    p.fwd.append(grec.copy())
+                else:
+                    p.fwd[cfg.forward_buffer - 1] = grec.copy()
 
         # wrap up: eject convicted members from candidate tables (engine)
         if cfg.malicious_enabled:
@@ -1370,6 +1511,7 @@ class OracleSim:
             "auth_member": np.full((n, a), EMPTY_U32, np.uint32),
             "auth_mask": np.zeros((n, a), np.uint32),
             "auth_gt": np.zeros((n, a), np.uint32),
+            "auth_rev": np.zeros((n, a), bool),
             "dly_gt": np.full((n, cfg.delay_inbox), EMPTY_U32, np.uint32),
             "dly_member": np.full((n, cfg.delay_inbox), EMPTY_U32,
                                   np.uint32),
@@ -1383,11 +1525,17 @@ class OracleSim:
                 [p.proof_requests for p in self.peers], np.uint32),
             "proof_records": np.array(
                 [p.proof_records for p in self.peers], np.uint32),
+            "seq_requests": np.array(
+                [p.seq_requests for p in self.peers], np.uint32),
+            "seq_records": np.array(
+                [p.seq_records for p in self.peers], np.uint32),
             "msgs_delayed": np.array([p.msgs_delayed for p in self.peers],
                                      np.uint32),
             "mal_member": np.full((n, cfg.k_malicious), EMPTY_U32, np.uint32),
             "conflicts": np.array([p.conflicts for p in self.peers],
                                   np.uint32),
+            "convictions_rx": np.array([p.convictions_rx
+                                        for p in self.peers], np.uint32),
             "sig_target": np.array([p.sig_target for p in self.peers],
                                    np.int32),
             "sig_meta": np.array([p.sig_meta for p in self.peers], np.uint32),
@@ -1447,6 +1595,7 @@ class OracleSim:
                 out["auth_member"][i, j] = row.member
                 out["auth_mask"][i, j] = row.mask
                 out["auth_gt"][i, j] = row.gt
+                out["auth_rev"][i, j] = row.rev
             for j, (rec, since, src) in enumerate(p.delay):
                 out["dly_gt"][i, j] = rec.gt
                 out["dly_member"][i, j] = rec.member

@@ -41,7 +41,7 @@ from dispersy_tpu import checkpoint as ckpt
 from dispersy_tpu import engine
 from dispersy_tpu.config import (META_AUTHORIZE, META_DESTROY, META_DYNAMIC,
                                  META_REVOKE, META_UNDO_OTHER, META_UNDO_OWN,
-                                 CommunityConfig)
+                                 CommunityConfig, perm_mask)
 from dispersy_tpu.metrics import MetricsLog
 from dispersy_tpu.state import PeerState, init_state
 
@@ -83,30 +83,39 @@ class SignatureRequest:
 
 @dataclasses.dataclass
 class Authorize:
-    """Grant `metas` (bitmask; may include config.DELEGATE_BIT to convey
-    the authorize permission itself — chains) to `members`.  ``by`` picks
-    the granting member (default: the founder); a non-founder granter
-    must hold the delegated authorize permission or the engine's author
-    gate refuses the create, exactly like a live overlay."""
+    """Grant permissions for the metas in the ``metas`` bitmask to
+    `members`.  ``perms`` names which of the reference's four permission
+    types each meta bit conveys ("permit" / "authorize" / "revoke" /
+    "undo" — timeline.py's quadruple; "authorize" lets the target extend
+    the chain).  ``by`` picks the granting member (default: the
+    founder); a non-founder granter must hold the authorize authority
+    for every named meta or the engine's author gate refuses the create,
+    exactly like a live overlay."""
     members: Sequence[int]
     metas: int
+    perms: Sequence[str] = ("permit",)
     by: int | None = None
 
 
 @dataclasses.dataclass
 class Revoke:
+    """Remove the named permissions; a non-founder ``by`` must hold the
+    REVOKE authority (separable from authorize) on every named meta."""
     members: Sequence[int]
     metas: int
+    perms: Sequence[str] = ("permit",)
     by: int | None = None
 
 
 @dataclasses.dataclass
 class Undo:
     """Mark (member, gt) undone; own=True means the author undoes itself,
-    else the founder undoes it."""
+    else ``by`` (default: the founder; a non-founder needs the UNDO
+    permission on the target's meta) undoes it."""
     member: int
     gt: int
     own: bool = True
+    by: int | None = None
 
 
 @dataclasses.dataclass
@@ -186,13 +195,16 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
     elif isinstance(ev, (Authorize, Revoke)):
         meta = META_AUTHORIZE if isinstance(ev, Authorize) else META_REVOKE
         granter = founder if ev.by is None else ev.by
+        nibbles = perm_mask([(k, p) for k in range(32)
+                             if (ev.metas >> k) & 1 for p in ev.perms])
         for member in ev.members:   # one record per target member
             state = engine.create_messages(
                 state, cfg, _mask(cfg, granter), meta,
-                _full(cfg, member), _full(cfg, ev.metas))
+                _full(cfg, member), _full(cfg, nibbles))
     elif isinstance(ev, Undo):
         meta = META_UNDO_OWN if ev.own else META_UNDO_OTHER
-        author = ev.member if ev.own else founder
+        author = ev.member if ev.own else (
+            founder if ev.by is None else ev.by)
         state = engine.create_messages(
             state, cfg, _mask(cfg, author), meta,
             _full(cfg, ev.member), _full(cfg, ev.gt))

@@ -58,6 +58,10 @@ class Stats:
     # config.proof_requests):
     proof_requests: jnp.ndarray   # u32[N] missing-proof requests served
     proof_records: jnp.ndarray    # u32[N] proof records received back
+    # Active missing-sequence round trips (reference: community.py
+    # on_missing_sequence; config.seq_requests):
+    seq_requests: jnp.ndarray     # u32[N] missing-sequence requests served
+    seq_records: jnp.ndarray      # u32[N] gap-fill records received back
     # Double-signed flow counters (reference: statistics.py counts
     # signature-request/-response traffic; SURVEY §3.5):
     sig_signed: jnp.ndarray       # u32[N] countersignatures granted (B side)
@@ -65,6 +69,8 @@ class Stats:
     sig_expired: jnp.ndarray      # u32[N] signature requests timed out (A)
     conflicts: jnp.ndarray        # u32[N] double-sign conflicts observed
     #   (malicious-member convictions at this peer; malicious_enabled)
+    convictions_rx: jnp.ndarray   # u32[N] convictions adopted from gossiped
+    #   dispersy-malicious-proof claims (config.malicious_gossip)
     # Byte-equivalent traffic totals (reference: endpoint.py total_up /
     # total_down).  Sent bytes count at the sender pre-loss (the reference
     # counts at sendto()); received bytes count per accepted inbox slot
@@ -113,8 +119,9 @@ class PeerState:
     # ---- timeline (ops/timeline.py AuthTable; folded from stored
     #      authorize/revoke records, wiped with the store on churn) ----
     auth_member: jnp.ndarray     # u32[N, A], EMPTY_U32 = empty slot
-    auth_mask: jnp.ndarray       # u32[N, A] meta bitmask; bit 31 = revoke row
+    auth_mask: jnp.ndarray       # u32[N, A] per-meta permission nibbles
     auth_gt: jnp.ndarray         # u32[N, A] global_time the row takes effect
+    auth_rev: jnp.ndarray        # bool[N, A] True = revoke row
 
     # ---- malicious-member blacklist (reference: dispersy.py malicious-
     #      member bookkeeping; config.malicious_enabled) ----
@@ -163,8 +170,9 @@ def init_stats(n: int, n_meta: int = 8) -> Stats:
                  msgs_dropped=z(), requests_dropped=z(), punctures=z(),
                  msgs_forwarded=z(), msgs_rejected=z(), msgs_direct=z(),
                  msgs_delayed=z(), proof_requests=z(), proof_records=z(),
+                 seq_requests=z(), seq_records=z(),
                  sig_signed=z(), sig_done=z(), sig_expired=z(),
-                 conflicts=z(),
+                 conflicts=z(), convictions_rx=z(),
                  bytes_up=z(), bytes_down=z(),
                  accepted_by_meta=jnp.zeros((n, n_meta + 1), jnp.uint32))
 
@@ -212,6 +220,7 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         auth_member=jnp.full((n, a), EMPTY_U32, jnp.uint32),
         auth_mask=jnp.zeros((n, a), jnp.uint32),
         auth_gt=jnp.zeros((n, a), jnp.uint32),
+        auth_rev=jnp.zeros((n, a), bool),
         mal_member=jnp.full((n, config.k_malicious), EMPTY_U32, jnp.uint32),
         sig_target=jnp.full((n,), NO_PEER, jnp.int32),
         sig_meta=jnp.zeros((n,), jnp.uint32),

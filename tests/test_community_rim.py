@@ -85,10 +85,8 @@ def test_rim_end_to_end_policy_behaviors():
     full = jnp.full(n, 7, jnp.uint32)
 
     # founder grants peer 9 the protected meta, then 9 publishes
-    st = c.create(st, "dispersy-authorize", m(cfg.founder),
-                  jnp.full(n, 9, jnp.uint32),
-                  jnp.full(n, 1 << c.meta_id("protected-full-sync-text"),
-                           jnp.uint32))
+    st = c.create_authorize(st, m(cfg.founder),
+                            [(9, "protected-full-sync-text")])
     for _ in range(6):
         st = c.step(st)
     st = c.create(st, "protected-full-sync-text", m(9), full)
@@ -195,12 +193,13 @@ def test_control_constructors_end_to_end():
     state = c.initialize(seed_degree=6)
 
     # founder delegates to A; A grants B; B authors a protected record
-    state = c.create_authorize(state, fm, A, "protected-full-sync-text",
-                               delegate=True)
+    state = c.create_authorize(state, fm, [
+        (A, "protected-full-sync-text", "permit"),
+        (A, "protected-full-sync-text", "authorize")])
     for _ in range(5):
         state = c.step(state)
-    state = c.create_authorize(state, np.arange(64) == A, B,
-                               "protected-full-sync-text")
+    state = c.create_authorize(state, np.arange(64) == A,
+                               [(B, "protected-full-sync-text")])
     for _ in range(5):
         state = c.step(state)
     state = c.create(state, "protected-full-sync-text", np.arange(64) == B,
@@ -223,8 +222,9 @@ def test_control_constructors_end_to_end():
     # founder flips the dynamic meta's policy, then revokes A's chain
     state = c.create_dynamic_settings(state, fm,
                                       "protected-full-sync-text", "public")
-    state = c.create_revoke(state, fm, A, "protected-full-sync-text",
-                            delegate=True)
+    state = c.create_revoke(state, fm, [
+        (A, "protected-full-sync-text", "permit"),
+        (A, "protected-full-sync-text", "authorize")])
     for _ in range(4):
         state = c.step(state)
 
@@ -244,6 +244,8 @@ def test_control_constructor_validation():
         c.create_dynamic_settings(c.initialize(), np.arange(16) == 2,
                                   "full-sync-text", "linear")  # not dynamic
     with pytest.raises(ConfigError):
-        c._permission_mask("dispersy-authorize", False)  # control meta
+        c._grant_masks([(5, "dispersy-authorize", "permit")])  # control meta
     with pytest.raises(ConfigError):
-        c._permission_mask([], delegate=True)            # empty grant
+        c._grant_masks([])                               # empty grant
+    with pytest.raises(ConfigError):
+        c._grant_masks([(5, "full-sync-text", "ownership")])  # bad perm

@@ -17,7 +17,8 @@ import numpy as np
 
 from dispersy_tpu import engine as E
 from dispersy_tpu import state as S
-from dispersy_tpu.config import EMPTY_U32, META_AUTHORIZE, CommunityConfig
+from dispersy_tpu.config import (EMPTY_U32, META_AUTHORIZE,
+                                 CommunityConfig, perm_bit)
 
 from test_timeline import run_both_script
 
@@ -60,7 +61,8 @@ def _grant(state, peer, member, meta, gt=1):
     am = np.array(state.auth_member)
     ak = np.array(state.auth_mask)
     ag = np.array(state.auth_gt)
-    am[peer, 0], ak[peer, 0], ag[peer, 0] = member, 1 << meta, gt
+    am[peer, 0], ak[peer, 0], ag[peer, 0] = \
+        member, perm_bit(meta, 'permit'), gt
     return state.replace(auth_member=jnp.asarray(am),
                          auth_mask=jnp.asarray(ak),
                          auth_gt=jnp.asarray(ag))
@@ -117,7 +119,7 @@ def test_trace_delay_pen_with_loss():
     then authors a protected record — peers receiving the record before
     the grant park it and accept later."""
     cfg = CFG.replace(packet_loss=0.35)
-    script = {0: [(FOUNDER, META_AUTHORIZE, 5, 1 << PROT)],
+    script = {0: [(FOUNDER, META_AUTHORIZE, 5, perm_bit(PROT, 'permit'))],
               2: [(5, PROT, 100, 0)], 3: [(5, PROT, 101, 0)],
               4: [(5, PROT, 102, 0)]}
     state, oracle = run_both_script(cfg, script, rounds=14, seed=2)
@@ -134,7 +136,7 @@ def test_trace_delay_pen_with_loss():
 def test_trace_delay_pen_with_churn():
     """Pen state dies with the process on churn, bit-identically."""
     cfg = CFG.replace(packet_loss=0.1, churn_rate=0.08)
-    script = {0: [(FOUNDER, META_AUTHORIZE, 5, 1 << PROT)],
+    script = {0: [(FOUNDER, META_AUTHORIZE, 5, perm_bit(PROT, 'permit'))],
               4: [(5, PROT, 9, 0)]}
     run_both_script(cfg, script, rounds=12)
 
@@ -175,7 +177,8 @@ def _store_grant(state, peer, granter, target, meta, gt=1):
     sp = np.array(state.store_payload)
     sa = np.array(state.store_aux)
     sg[peer, 0], sm[peer, 0] = gt, granter
-    st_[peer, 0], sp[peer, 0], sa[peer, 0] = META_AUTHORIZE, target, 1 << meta
+    st_[peer, 0], sp[peer, 0], sa[peer, 0] = \
+        META_AUTHORIZE, target, perm_bit(meta, 'permit')
     return state.replace(
         store_gt=jnp.asarray(sg), store_member=jnp.asarray(sm),
         store_meta=jnp.asarray(st_), store_payload=jnp.asarray(sp),
@@ -220,7 +223,7 @@ def test_trace_proof_requests_with_loss():
     under packet loss (request, reply, and record losses all mirrored)."""
     cfg = CFG.replace(packet_loss=0.35, proof_requests=True,
                       proof_inbox=2, proof_budget=2)
-    script = {0: [(FOUNDER, META_AUTHORIZE, 5, 1 << PROT)],
+    script = {0: [(FOUNDER, META_AUTHORIZE, 5, perm_bit(PROT, 'permit'))],
               2: [(5, PROT, 100, 0)], 3: [(5, PROT, 101, 0)],
               4: [(5, PROT, 102, 0)]}
     state, oracle = run_both_script(cfg, script, rounds=14, seed=2)

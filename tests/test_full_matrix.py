@@ -18,7 +18,7 @@ import numpy as np
 
 from dispersy_tpu import engine as E
 from dispersy_tpu import state as S
-from dispersy_tpu.config import (META_AUTHORIZE, META_DESTROY, META_DYNAMIC,
+from dispersy_tpu.config import (perm_bit, META_AUTHORIZE, META_DESTROY, META_DYNAMIC,
                                  CommunityConfig)
 from dispersy_tpu.oracle import sim as O
 
@@ -39,9 +39,9 @@ CFG = CommunityConfig(
     direct_meta_mask=0b0010000,
     desc_meta_mask=0b0100000,
     meta_priority=(128, 128, 128, 128, 128, 200, 128, 128),
-    seq_meta_mask=0b1000000,
+    seq_meta_mask=0b1000000, seq_requests=True,
     delay_inbox=2, delay_timeout=26.0,
-    malicious_enabled=True, k_malicious=4,
+    malicious_enabled=True, k_malicious=4, malicious_gossip=True,
     churn_rate=0.04, packet_loss=0.12)
 
 F0, F1 = 2, 15        # per-community founders (first member rows)
@@ -78,8 +78,8 @@ def test_everything_on_trace_equality():
 
     events = {
         # founders authorize one member each for the protected meta 1
-        0: [("create", F0, META_AUTHORIZE, 5, 0b10),
-            ("create", F1, META_AUTHORIZE, 18, 0b10)],
+        0: [("create", F0, META_AUTHORIZE, 5, perm_bit(1, "permit")),
+            ("create", F1, META_AUTHORIZE, 18, perm_bit(1, "permit"))],
         # bulk public traffic in both blocks
         1: [("create", 6, 0, 1001, 0), ("create", 19, 0, 2001, 0)],
         # sequence chain (meta 6): three in-order records by peer 7

@@ -134,3 +134,47 @@ def test_bounded_blacklist_overflow_counted():
     assert mal.shape[1] == 1
     assert ((mal == 9) | (mal == 10) | (mal == 0xFFFFFFFF)).all()
     assert int(np.asarray(state.stats.conflicts).sum()) > 0
+
+
+def test_gossip_convicts_network_wide():
+    """With malicious_gossip on, an eyewitness authors a
+    dispersy-malicious-proof record and the conviction converges
+    NETWORK-wide — every member blacklists the double-signer, not just
+    the few that saw both versions (reference: dispersy.py spreads the
+    conflicting pair as dispersy-malicious-proof).  Engine==oracle
+    bit-for-bit throughout."""
+    cfg = CFG.replace(malicious_gossip=True)
+    state, oracle = both(cfg)
+    state = inject_fwd(state, oracle, 5, (7, EVIL, 1, 100, 0))
+    state = inject_fwd(state, oracle, 6, (7, EVIL, 1, 200, 0))
+    state = run(state, oracle, cfg, 20, "gossip-")
+    mal = np.asarray(state.mal_member)
+    convicted = (mal == EVIL).any(axis=1)
+    members = ~np.asarray(state.is_tracker)
+    members[EVIL] = False        # the double-signer's own view is moot
+    frac = convicted[members].mean()
+    assert frac >= 0.99, f"only {frac:.0%} of members convicted"
+    # the spreading was done by gossip, not by everyone witnessing the
+    # conflict themselves
+    n_rx = int(np.asarray(state.stats.convictions_rx).sum())
+    n_eye = int(np.asarray(state.stats.conflicts).sum())
+    assert n_rx > 0
+    assert n_eye < convicted[members].sum()
+    # the proof record itself replicated (it is a stored, synced record)
+    from dispersy_tpu.config import META_MALICIOUS
+    holders = ((np.asarray(state.store_meta) == META_MALICIOUS)
+               & (np.asarray(state.store_payload) == EVIL)).any(axis=1)
+    assert holders[members].sum() > 3
+
+
+def test_gossip_off_stays_per_observer():
+    """Without the flag the old local-only semantics hold: no
+    convictions_rx, and conviction stays limited to eyewitnesses."""
+    cfg = CFG
+    state, oracle = both(cfg)
+    state = inject_fwd(state, oracle, 5, (7, EVIL, 1, 100, 0))
+    state = inject_fwd(state, oracle, 6, (7, EVIL, 1, 200, 0))
+    state = run(state, oracle, cfg, 12, "local-")
+    assert int(np.asarray(state.stats.convictions_rx).sum()) == 0
+    convicted = (np.asarray(state.mal_member) == EVIL).any(axis=1)
+    assert convicted.sum() == int(np.asarray(state.stats.conflicts).sum())

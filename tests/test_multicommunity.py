@@ -15,7 +15,8 @@ import numpy as np
 
 from dispersy_tpu import engine as E
 from dispersy_tpu import state as S
-from dispersy_tpu.config import EMPTY_U32, META_AUTHORIZE, CommunityConfig
+from dispersy_tpu.config import (EMPTY_U32, META_AUTHORIZE,
+                                 CommunityConfig, perm_bit)
 from dispersy_tpu.oracle import sim as O
 
 from test_oracle import assert_match
@@ -130,7 +131,7 @@ def test_timeline_per_community_founders():
         4: [(6, 1, 777)],                # provable in block 0
         5: [(f1, 1, 888)],               # block 1 founder, implicit permit
     }
-    # aux for authorize = mask bit for meta 1
+    # aux for authorize = permit nibble for meta 1
     state = S.init_state(cfg, jax.random.PRNGKey(3))
     oracle = O.OracleSim(cfg, np.asarray(state.key))
     state = E.seed_overlay(state, cfg, degree=4)
@@ -139,7 +140,7 @@ def test_timeline_per_community_founders():
         for author, meta, payload in script.get(rnd, []):
             mask = np.arange(cfg.n_peers) == author
             pl = np.full(cfg.n_peers, payload, np.uint32)
-            ax = np.full(cfg.n_peers, 0b10, np.uint32)
+            ax = np.full(cfg.n_peers, perm_bit(1, 'permit'), np.uint32)
             state = E.create_messages(state, cfg, jnp.asarray(mask), meta,
                                       jnp.asarray(pl), jnp.asarray(ax))
             oracle.create_messages(mask, meta, pl, aux=ax)

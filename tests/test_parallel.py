@@ -70,7 +70,8 @@ def test_sharded_step_matches_single_device_full_features():
         timeline_enabled=True, protected_meta_mask=0b10,
         dynamic_meta_mask=0b10, n_meta=8, k_authorized=8, delay_inbox=2,
         proof_requests=True, double_meta_mask=0b100,
-        malicious_enabled=True, seq_meta_mask=0b1000, p_symmetric=0.3,
+        malicious_enabled=True, malicious_gossip=True,
+        seq_meta_mask=0b1000, seq_requests=True, p_symmetric=0.3,
         identity_enabled=True)
     single = _prepared(fcfg)
     mesh = make_mesh(8)

@@ -160,9 +160,9 @@ def test_authorize_by_delegated_member():
     """Authorize(by=...): a delegated member extends the chain through
     the scenario driver; a non-delegated `by` is refused at the author
     gate (its grant validates nothing)."""
-    from dispersy_tpu.config import DELEGATE_BIT
     sc = S.Scenario(rounds=26, events=[
-        (0, S.Authorize(members=[5], metas=0b10 | DELEGATE_BIT)),
+        (0, S.Authorize(members=[5], metas=0b10,
+                        perms=("permit", "authorize"))),
         (8, S.Authorize(members=[9], metas=0b10, by=5)),
         (14, S.Create(meta=1, authors=[9], payload=21, track="chained")),
         # member 11 holds nothing: its grant is refused at create, so 12

@@ -24,7 +24,7 @@ import pytest
 
 from dispersy_tpu import engine as E
 from dispersy_tpu import state as S
-from dispersy_tpu.config import CommunityConfig
+from dispersy_tpu.config import CommunityConfig, perm_bit
 from dispersy_tpu.oracle import sim as O
 
 from test_oracle import assert_match
@@ -138,7 +138,7 @@ def test_protected_double_needs_both_permits():
     def authorize(state, member):
         mask = np.arange(cfg.n_peers) == founder
         pl = np.full(cfg.n_peers, member, np.uint32)
-        ax = np.full(cfg.n_peers, 1 << DBL, np.uint32)
+        ax = np.full(cfg.n_peers, perm_bit(DBL, 'permit'), np.uint32)
         state = E.create_messages(state, cfg, jnp.asarray(mask),
                                   meta=O.META_AUTHORIZE,
                                   payload=jnp.asarray(pl),

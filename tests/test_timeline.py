@@ -5,7 +5,10 @@ metas (reference: tests/test_timeline.py, test_undo.py,
 test_dynamicsettings.py — a "protected-full-sync-text" message is rejected
 until the authorize arrives, undo marks rows undone).  Here the same
 scenarios run through the jitted engine and the CPU oracle side by side,
-bit-for-bit.
+bit-for-bit.  Grants carry the reference's full permission quadruple
+(permit/authorize/revoke/undo per meta — timeline.py Timeline.check's
+(member, message, permission) triples), packed as per-meta nibbles
+(config.perm_bit).
 """
 
 import jax
@@ -16,7 +19,8 @@ from dispersy_tpu import engine as E
 from dispersy_tpu import state as S
 from dispersy_tpu.config import (EMPTY_U32, META_AUTHORIZE, META_REVOKE,
                                  META_UNDO_OTHER, META_UNDO_OWN,
-                                 CommunityConfig)
+                                 PERM_AUTHORIZE, PERM_PERMIT, PERM_REVOKE,
+                                 PERM_UNDO, CommunityConfig, perm_bit)
 from dispersy_tpu.ops import timeline as tl
 from dispersy_tpu.oracle import sim as O
 
@@ -29,28 +33,35 @@ CFG = CommunityConfig(
     k_authorized=8)
 FOUNDER = CFG.founder  # == n_trackers == 2
 PROT = 1               # protected user meta (bit 1 of the mask)
+P_PERMIT = perm_bit(PROT, PERM_PERMIT)
+P_AUTH = perm_bit(PROT, PERM_AUTHORIZE)
+P_REVOKE = perm_bit(PROT, PERM_REVOKE)
+P_UNDO = perm_bit(PROT, PERM_UNDO)
 
 
 def mk_table(rows, n=1, a=4):
-    """rows: list of (member, mask, gt) -> AuthTable [n, a] (row 0 filled)."""
+    """rows: (member, mask, gt) or (member, mask, gt, rev) -> AuthTable
+    [n, a] (row 0 filled)."""
     member = np.full((n, a), EMPTY_U32, np.uint32)
     mask = np.zeros((n, a), np.uint32)
     gt = np.zeros((n, a), np.uint32)
-    for j, (m, mk, g) in enumerate(rows):
-        member[0, j], mask[0, j], gt[0, j] = m, mk, g
+    rev = np.zeros((n, a), bool)
+    for j, row in enumerate(rows):
+        member[0, j], mask[0, j], gt[0, j] = row[:3]
+        rev[0, j] = bool(row[3]) if len(row) > 3 else False
     return tl.AuthTable(member=jnp.asarray(member), mask=jnp.asarray(mask),
-                        gt=jnp.asarray(gt))
+                        gt=jnp.asarray(gt), rev=jnp.asarray(rev))
 
 
-def ck(tab, member, meta, gt, founder=99):
+def ck(tab, member, meta, gt, founder=99, perm=PERM_PERMIT):
     out = tl.check(tab, jnp.asarray([[member]], jnp.uint32),
                    jnp.asarray([[meta]], jnp.uint32),
-                   jnp.asarray([[gt]], jnp.uint32), founder)
+                   jnp.asarray([[gt]], jnp.uint32), founder, perm=perm)
     return bool(out[0, 0])
 
 
 def test_check_grant_and_gt_bounds():
-    tab = mk_table([(7, 1 << PROT, 5)])
+    tab = mk_table([(7, P_PERMIT, 5)])
     assert not ck(tab, 7, PROT, 4)     # before the grant takes effect
     assert ck(tab, 7, PROT, 5)         # at the grant
     assert ck(tab, 7, PROT, 100)       # after
@@ -60,17 +71,32 @@ def test_check_grant_and_gt_bounds():
 
 
 def test_check_revoke_and_tie():
-    rev = (1 << PROT) | tl.REVOKE_BIT
-    tab = mk_table([(7, 1 << PROT, 5), (7, rev, 9)])
+    tab = mk_table([(7, P_PERMIT, 5), (7, P_PERMIT, 9, True)])
     assert ck(tab, 7, PROT, 8)         # granted window
     assert not ck(tab, 7, PROT, 9)     # revoked from gt 9 on
     assert not ck(tab, 7, PROT, 50)
     # re-grant after revoke
-    tab2 = mk_table([(7, 1 << PROT, 5), (7, rev, 9), (7, 1 << PROT, 12)])
+    tab2 = mk_table([(7, P_PERMIT, 5), (7, P_PERMIT, 9, True),
+                     (7, P_PERMIT, 12)])
     assert ck(tab2, 7, PROT, 12)
     # tie at identical gt: revoke wins
-    tab3 = mk_table([(7, 1 << PROT, 5), (7, rev, 5)])
+    tab3 = mk_table([(7, P_PERMIT, 5), (7, P_PERMIT, 5, True)])
     assert not ck(tab3, 7, PROT, 7)
+
+
+def test_permission_types_are_separable():
+    """One permission type never implies another (reference: timeline.py
+    resolves (member, message, permission) — u"permit" != u"authorize" !=
+    u"revoke" != u"undo")."""
+    tab = mk_table([(7, P_AUTH, 5)])          # authorize-only grant
+    assert not ck(tab, 7, PROT, 50)                        # no permit
+    assert not ck(tab, 7, PROT, 50, perm=PERM_UNDO)        # no undo
+    assert ck(tab, 7, PROT, 50, perm=PERM_AUTHORIZE)
+    tab2 = mk_table([(7, P_REVOKE | P_UNDO, 5)])
+    assert not ck(tab2, 7, PROT, 50)
+    assert not ck(tab2, 7, PROT, 50, perm=PERM_AUTHORIZE)
+    assert ck(tab2, 7, PROT, 50, perm=PERM_REVOKE)
+    assert ck(tab2, 7, PROT, 50, perm=PERM_UNDO)
 
 
 def test_fold_dedup_and_capacity():
@@ -84,15 +110,22 @@ def test_fold_dedup_and_capacity():
     # identical rows: second is a dup, only one slot used
     assert int(jnp.sum(r1.table.member != jnp.uint32(EMPTY_U32))) == 1
     assert int(r1.n_dropped[0]) == 0
+    # a revoke row with the same (member, mask, gt) is NOT a dup
+    r1b = tl.fold(r1.table,
+                  target=jnp.asarray([[7, 7]], jnp.uint32),
+                  mask=jnp.asarray([[2, 2]], jnp.uint32),
+                  gt=jnp.asarray([[3, 3]], jnp.uint32),
+                  is_revoke=jnp.ones((1, 2), bool),
+                  valid=jnp.ones((1, 2), bool))
+    assert int(jnp.sum(r1b.table.member != jnp.uint32(EMPTY_U32))) == 2
     # fill the table, then overflow drops and counts
-    r2 = tl.fold(r1.table,
+    r2 = tl.fold(r1b.table,
                  target=jnp.asarray([[8, 9]], jnp.uint32),
                  mask=jnp.asarray([[2, 2]], jnp.uint32),
                  gt=jnp.asarray([[4, 5]], jnp.uint32),
                  is_revoke=jnp.zeros((1, 2), bool),
                  valid=jnp.ones((1, 2), bool))
-    assert int(jnp.sum(r2.table.member != jnp.uint32(EMPTY_U32))) == 2
-    assert int(r2.n_dropped[0]) == 1
+    assert int(r2.n_dropped[0]) == 2
 
 
 def run_both_script(cfg, script, rounds, seed=0, warm=4):
@@ -154,9 +187,9 @@ def test_trace_authorize_then_protected_sync():
     # out-of-band grant at gt 1, known only to peer 9 itself
     state = state.replace(
         auth_member=state.auth_member.at[9, 0].set(9),
-        auth_mask=state.auth_mask.at[9, 0].set(1 << PROT),
+        auth_mask=state.auth_mask.at[9, 0].set(P_PERMIT),
         auth_gt=state.auth_gt.at[9, 0].set(1))
-    oracle.peers[9].auth.append(O.AuthRow(9, 1 << PROT, 1))
+    oracle.peers[9].auth.append(O.AuthRow(9, P_PERMIT, 1))
 
     def create(author, meta, payload, aux):
         nonlocal state
@@ -183,7 +216,7 @@ def test_trace_authorize_then_protected_sync():
         (state.store_payload == 777) & (state.store_member == 9), axis=1)))
     assert holders_777 == 1           # never accepted anywhere else
 
-    create(FOUNDER, META_AUTHORIZE, 9, 1 << PROT)
+    create(FOUNDER, META_AUTHORIZE, 9, P_PERMIT)
     run(6, "authorized")
     create(9, PROT, 888, 0)           # now provable via the synced grant
     run(8, "spread")
@@ -201,9 +234,9 @@ def test_trace_revoke_blocks_new_records():
     global_time are rejected everywhere, while the pre-revoke record keeps
     spreading (historical validity — Timeline.check at the record's gt)."""
     script = {
-        0: [(FOUNDER, META_AUTHORIZE, 9, 1 << PROT)],
+        0: [(FOUNDER, META_AUTHORIZE, 9, P_PERMIT)],
         3: [(9, PROT, 111, 0)],
-        6: [(FOUNDER, META_REVOKE, 9, 1 << PROT)],
+        6: [(FOUNDER, META_REVOKE, 9, P_PERMIT)],
         10: [(9, PROT, 222, 0)],
     }
     state, oracle = run_both_script(CFG, script, rounds=16)
@@ -223,7 +256,7 @@ def test_trace_undo_own_marks_everywhere():
     """An undo-own record spreads and flips FLAG_UNDONE on every replica of
     its target, including replicas that arrive after the undo."""
     script = {
-        0: [(FOUNDER, META_AUTHORIZE, 9, 1 << PROT)],
+        0: [(FOUNDER, META_AUTHORIZE, 9, P_PERMIT)],
         4: [(9, PROT, 333, 0)],
     }
     # find the gt that record will get: author 9 creates at its own clock+1;
@@ -261,43 +294,187 @@ def test_trace_undo_own_marks_everywhere():
     assert (sf[target] & S.FLAG_UNDONE).all()    # every replica marked
 
 
-def test_check_grant_unit():
-    """check_grant: delegate rows only, every masked meta required,
-    revoke-latest-wins per meta, empty mask never proves."""
-    from dispersy_tpu.config import DELEGATE_BIT
-    dele = (1 << PROT) | DELEGATE_BIT
+def test_trace_granted_undoer():
+    """A non-founder holding the UNDO permission on the target's meta
+    undoes ANOTHER member's record, and the mark spreads network-wide
+    (reference: timeline.py resolves u"undo" against the target message's
+    meta for dispersy-undo-other; previously founder-only here).
+    Engine==oracle bit-for-bit, including the undoer's author gate."""
+    A, U = 9, 12     # A authors the record; U is the granted undoer
+    script = {
+        0: [(FOUNDER, META_AUTHORIZE, A, P_PERMIT)],
+        3: [(A, PROT, 333, 0)],
+        6: [(FOUNDER, META_AUTHORIZE, U, P_UNDO)],
+    }
+    state, oracle = run_both_script(CFG, script, rounds=14)
+    cfg = CFG
+    # the record must have reached U's store (the author gate resolves
+    # the target meta from the undoer's OWN store)
+    su = np.asarray(state.store_member[U]) == A
+    metas_u = np.asarray(state.store_meta[U])
+    assert (su & (metas_u == PROT)).any(), "record never reached the undoer"
+    target_gt = int(np.asarray(state.store_gt[U])[su & (metas_u == PROT)][0])
 
-    def cg(tab, member, mask, gt):
+    mask = np.arange(cfg.n_peers) == U
+    pl = np.full(cfg.n_peers, A, np.uint32)
+    ax = np.full(cfg.n_peers, target_gt, np.uint32)
+    state = E.create_messages(state, cfg, jnp.asarray(mask),
+                              META_UNDO_OTHER, jnp.asarray(pl),
+                              jnp.asarray(ax))
+    oracle.create_messages(mask, META_UNDO_OTHER, pl, aux=ax)
+    assert_match(jax.block_until_ready(state), oracle, "granted-undo-create")
+    # the undoer's own replica of the target is marked immediately
+    tu = ((np.asarray(state.store_member[U]) == A)
+          & (np.asarray(state.store_gt[U]) == target_gt)
+          & (np.asarray(state.store_meta[U]) == PROT))
+    assert (np.asarray(state.store_flags[U])[tu] & S.FLAG_UNDONE).all()
+
+    for rnd in range(12):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle,
+                     f"granted-undo+{rnd}")
+    sm = np.asarray(state.store_member)
+    sg = np.asarray(state.store_gt)
+    sme = np.asarray(state.store_meta)
+    sf = np.asarray(state.store_flags)
+    target = (sm == A) & (sg == target_gt) & (sme == PROT)
+    assert target.any(axis=1).sum() > 1
+    assert (sf[target] & S.FLAG_UNDONE).all(), \
+        "granted undo-other must mark every replica"
+
+
+def test_ungranted_undo_other_refused():
+    """Without the UNDO grant the same undo-other create is a no-op (and a
+    permit grant does NOT convey undo — separability at the author gate)."""
+    A, U = 9, 12
+    script = {
+        0: [(FOUNDER, META_AUTHORIZE, A, P_PERMIT),
+            (FOUNDER, META_AUTHORIZE, U, P_PERMIT)],   # permit, not undo
+        3: [(A, PROT, 333, 0)],
+    }
+    state, oracle = run_both_script(CFG, script, rounds=12)
+    cfg = CFG
+    su = ((np.asarray(state.store_member[U]) == A)
+          & (np.asarray(state.store_meta[U]) == PROT))
+    assert su.any()
+    target_gt = int(np.asarray(state.store_gt[U])[su][0])
+    before = int(jnp.sum(state.store_gt[U] != jnp.uint32(EMPTY_U32)))
+    mask = np.arange(cfg.n_peers) == U
+    pl = np.full(cfg.n_peers, A, np.uint32)
+    ax = np.full(cfg.n_peers, target_gt, np.uint32)
+    state = E.create_messages(state, cfg, jnp.asarray(mask),
+                              META_UNDO_OTHER, jnp.asarray(pl),
+                              jnp.asarray(ax))
+    oracle.create_messages(mask, META_UNDO_OTHER, pl, aux=ax)
+    assert_match(jax.block_until_ready(state), oracle, "refused-undo")
+    after = int(jnp.sum(state.store_gt[U] != jnp.uint32(EMPTY_U32)))
+    assert after == before, "ungranted undo-other must not author a record"
+    tu = ((np.asarray(state.store_member[U]) == A)
+          & (np.asarray(state.store_gt[U]) == target_gt))
+    assert not (np.asarray(state.store_flags[U])[tu] & S.FLAG_UNDONE).any()
+
+
+def test_trace_granted_revoker_separable():
+    """Revoke authority WITHOUT authorize authority (the reference's
+    separable u"revoke" permission type): R can strip A's permit
+    network-wide, but R's attempt to GRANT is refused at its author gate.
+    Engine==oracle bit-for-bit."""
+    A, R, X = 9, 12, 13
+    script = {
+        0: [(FOUNDER, META_AUTHORIZE, A, P_PERMIT)],
+        3: [(A, PROT, 111, 0)],
+        # R gets revoke authority only — no authorize, no permit
+        6: [(FOUNDER, META_AUTHORIZE, R, P_REVOKE)],
+        # R's grant attempt must be refused (no authorize authority) ...
+        12: [(R, META_AUTHORIZE, X, P_PERMIT)],
+        # ... but R's revoke of A is valid and spreads
+        13: [(R, META_REVOKE, A, P_PERMIT)],
+        16: [(A, PROT, 222, 0)],
+    }
+    state, oracle = run_both_script(CFG, script, rounds=22)
+    # R's authorize attempt authored nothing: X never gained the permit,
+    # so an X record would be refused at X's own gate — and no grant
+    # record for X exists anywhere.
+    grant_rows = int(jnp.sum((state.store_meta == META_AUTHORIZE)
+                             & (state.store_member == R)))
+    assert grant_rows == 0, "revoke-only member must not issue grants"
+    # A's post-revoke record is refused/rejected (<= its own store)
+    late = int(jnp.sum(jnp.any(
+        (state.store_payload == 222) & (state.store_member == A), axis=1)))
+    assert late <= 1, "granted revoker's revoke must bind network-wide"
+    # the pre-revoke record keeps spreading (historical validity)
+    early = int(jnp.sum(jnp.any(
+        (state.store_payload == 111) & (state.store_member == A), axis=1)))
+    assert early > 1
+
+
+def test_trace_revoked_revoker():
+    """The founder strips R's revoke authority; R's later revoke is
+    refused at create and A's permit survives."""
+    A, R = 9, 12
+    script = {
+        0: [(FOUNDER, META_AUTHORIZE, A, P_PERMIT),
+            (FOUNDER, META_AUTHORIZE, R, P_REVOKE)],
+        # founder revokes R's revoke authority itself
+        6: [(FOUNDER, META_REVOKE, R, P_REVOKE)],
+        # R tries to revoke A's permit — refused at R's author gate
+        12: [(R, META_REVOKE, A, P_PERMIT)],
+        14: [(A, PROT, 444, 0)],
+    }
+    state, oracle = run_both_script(CFG, script, rounds=20)
+    revoke_rows = int(jnp.sum((state.store_meta == META_REVOKE)
+                              & (state.store_member == R)))
+    assert revoke_rows == 0, "revoked revoker must not issue revokes"
+    holders = int(jnp.sum(jnp.any(
+        (state.store_payload == 444) & (state.store_member == A), axis=1)))
+    assert holders > 1, "A's permit should have survived"
+
+
+def test_check_grant_unit():
+    """check_grant: authority rows only, every masked meta required,
+    revoke-latest-wins per meta, empty mask never proves; the REVOKE
+    authority is checked separably from AUTHORIZE."""
+
+    def cg(tab, member, mask, gt, perm=PERM_AUTHORIZE):
         out = tl.check_grant(tab, jnp.asarray([[member]], jnp.uint32),
                              jnp.asarray([[mask]], jnp.uint32),
-                             jnp.asarray([[gt]], jnp.uint32), n_meta=8)
+                             jnp.asarray([[gt]], jnp.uint32), n_meta=8,
+                             perm=perm)
         return bool(out[0, 0])
 
-    tab = mk_table([(7, dele, 5)])
-    assert cg(tab, 7, 1 << PROT, 5)
-    assert not cg(tab, 7, 1 << PROT, 4)      # before the delegation
+    tab = mk_table([(7, P_PERMIT | P_AUTH, 5)])
+    assert cg(tab, 7, P_PERMIT, 5)
+    assert not cg(tab, 7, P_PERMIT, 4)       # before the delegation
     assert not cg(tab, 7, 0, 50)             # empty mask proves nothing
-    assert not cg(tab, 7, (1 << PROT) | 1, 50)   # meta 0 not delegated
-    assert not cg(tab, 8, 1 << PROT, 50)     # other member
-    # a permit-only grant (no DELEGATE_BIT) conveys no authorize right
-    tab2 = mk_table([(7, 1 << PROT, 5)])
-    assert not cg(tab2, 7, 1 << PROT, 50)
+    # meta 0's nibble named but meta 0 has no authority row
+    assert not cg(tab, 7, P_PERMIT | perm_bit(0, PERM_PERMIT), 50)
+    assert not cg(tab, 8, P_PERMIT, 50)      # other member
+    # authorize authority does NOT convey revoke authority
+    assert not cg(tab, 7, P_PERMIT, 50, perm=PERM_REVOKE)
+    # a permit-only grant conveys no authorize right
+    tab2 = mk_table([(7, P_PERMIT, 5)])
+    assert not cg(tab2, 7, P_PERMIT, 50)
+    # revoke-only authority: revoke yes, authorize no
+    tab2r = mk_table([(7, P_REVOKE, 5)])
+    assert cg(tab2r, 7, P_PERMIT, 50, perm=PERM_REVOKE)
+    assert not cg(tab2r, 7, P_PERMIT, 50)
     # delegation revoked from gt 9 on; tie goes to the revoke
-    tab3 = mk_table([(7, dele, 5), (7, dele | tl.REVOKE_BIT, 9)])
-    assert cg(tab3, 7, 1 << PROT, 8)
-    assert not cg(tab3, 7, 1 << PROT, 9)
+    tab3 = mk_table([(7, P_PERMIT | P_AUTH, 5),
+                     (7, P_PERMIT | P_AUTH, 9, True)])
+    assert cg(tab3, 7, P_PERMIT, 8)
+    assert not cg(tab3, 7, P_PERMIT, 9)
 
 
 def test_trace_delegation_chain():
-    """founder -> A (authorize w/ DELEGATE) -> A grants B (permit) -> B's
+    """founder -> A (permit+authorize) -> A grants B (permit) -> B's
     protected record spreads — the chain the reference walks as recursive
     authorize proofs (timeline.py Timeline.check), engine==oracle at every
     round."""
-    from dispersy_tpu.config import DELEGATE_BIT
     A, B = 9, 12
     script = {
-        0: [(FOUNDER, META_AUTHORIZE, A, (1 << PROT) | DELEGATE_BIT)],
-        5: [(A, META_AUTHORIZE, B, 1 << PROT)],
+        0: [(FOUNDER, META_AUTHORIZE, A, P_PERMIT | P_AUTH)],
+        5: [(A, META_AUTHORIZE, B, P_PERMIT)],
         10: [(B, PROT, 444, 0)],
     }
     state, oracle = run_both_script(CFG, script, rounds=20)
@@ -312,15 +489,14 @@ def test_trace_revoke_mid_chain():
     documented divergence), while A's post-revoke grants are refused at
     create and rejected at intake, so the would-be grantee's record never
     spreads.  Engine==oracle bit-for-bit throughout."""
-    from dispersy_tpu.config import DELEGATE_BIT
     A, B, C = 9, 12, 13
-    dele = (1 << PROT) | DELEGATE_BIT
+    dele = P_PERMIT | P_AUTH
     script = {
         0: [(FOUNDER, META_AUTHORIZE, A, dele)],
-        5: [(A, META_AUTHORIZE, B, 1 << PROT)],
+        5: [(A, META_AUTHORIZE, B, P_PERMIT)],
         9: [(B, PROT, 555, 0)],
         12: [(FOUNDER, META_REVOKE, A, dele)],
-        16: [(A, META_AUTHORIZE, C, 1 << PROT)],
+        16: [(A, META_AUTHORIZE, C, P_PERMIT)],
         18: [(C, PROT, 666, 0)],
     }
     state, oracle = run_both_script(CFG, script, rounds=24)
@@ -334,24 +510,30 @@ def test_trace_revoke_mid_chain():
 
 def test_check_grant_cross_form_equal():
     """check_grant's broadcast and chunked forms are bit-identical on
-    random tables with delegate/revoke rows and EMPTY holes."""
-    from dispersy_tpu.config import DELEGATE_BIT
+    random tables with mixed-permission nibble rows, revoke rows, and
+    EMPTY holes, for every authority type."""
     rng = np.random.default_rng(31)
     n, a, b, n_meta = 9, 6, 7, 8
     for trial in range(5):
         member = rng.integers(0, 8, (n, a)).astype(np.uint32)
         member[rng.random((n, a)) < 0.3] = EMPTY_U32
-        mask = rng.integers(0, 1 << n_meta, (n, a)).astype(np.uint32)
-        mask |= np.where(rng.random((n, a)) < 0.5, DELEGATE_BIT, 0).astype(np.uint32)
-        mask |= np.where(rng.random((n, a)) < 0.3, tl.REVOKE_BIT, 0).astype(np.uint32)
-        tab = tl.AuthTable(member=jnp.asarray(member), mask=jnp.asarray(mask),
-                           gt=jnp.asarray(rng.integers(1, 20, (n, a)), jnp.uint32))
+        mask = rng.integers(0, 1 << 32, (n, a), dtype=np.uint64) \
+            .astype(np.uint32)
+        rev = rng.random((n, a)) < 0.3
+        tab = tl.AuthTable(
+            member=jnp.asarray(member), mask=jnp.asarray(mask),
+            gt=jnp.asarray(rng.integers(1, 20, (n, a)), jnp.uint32),
+            rev=jnp.asarray(rev))
         q_member = jnp.asarray(rng.integers(0, 8, (n, b)), jnp.uint32)
-        q_mask = jnp.asarray(rng.integers(0, 1 << n_meta, (n, b)), jnp.uint32)
+        q_mask = jnp.asarray(
+            rng.integers(0, 1 << 32, (n, b), dtype=np.uint64)
+            .astype(np.uint32))
         q_gt = jnp.asarray(rng.integers(1, 20, (n, b)), jnp.uint32)
-        got_b = tl.check_grant(tab, q_member, q_mask, q_gt, n_meta,
-                               impl="broadcast")
-        got_c = tl.check_grant(tab, q_member, q_mask, q_gt, n_meta,
-                               impl="chunked")
-        np.testing.assert_array_equal(np.asarray(got_b), np.asarray(got_c),
-                                      err_msg=f"trial {trial}")
+        for perm in (PERM_AUTHORIZE, PERM_REVOKE):
+            got_b = tl.check_grant(tab, q_member, q_mask, q_gt, n_meta,
+                                   perm=perm, impl="broadcast")
+            got_c = tl.check_grant(tab, q_member, q_mask, q_gt, n_meta,
+                                   perm=perm, impl="chunked")
+            np.testing.assert_array_equal(
+                np.asarray(got_b), np.asarray(got_c),
+                err_msg=f"trial {trial} perm {perm}")

@@ -36,7 +36,7 @@ import numpy as np
 from dispersy_tpu import engine
 from dispersy_tpu.logutil import (configure as _configure_logging,
                                   get_logger, log_round)
-from dispersy_tpu.config import META_AUTHORIZE, CommunityConfig
+from dispersy_tpu.config import META_AUTHORIZE, CommunityConfig, perm_bit
 from dispersy_tpu.state import init_state
 
 
@@ -264,7 +264,7 @@ def communities_timeline_curve(n_peers: int = 1_000_000,
     state = engine.create_messages(
         state, cfg, jnp.asarray(f_mask), meta=META_AUTHORIZE,
         payload=jnp.asarray(payload),
-        aux=jnp.full(n, 0b10, jnp.uint32))
+        aux=jnp.full(n, perm_bit(1, 'permit'), jnp.uint32))
 
     authors_d = jnp.asarray(authors)
 

@@ -1,20 +1,26 @@
-"""Pen-residence measurement: passive Bloom-luck vs active missing-proof.
+"""Missing-X latency measurements: passive Bloom-luck vs active round trips.
 
-VERDICT r2 #7's acceptance metric: the active dispersy-missing-proof
-round trip (config.proof_requests) must DROP the median time a
-DelayMessageByProof-parked record spends in the pen.  This tool runs the
-same seeded scenario twice — proof requests off, then on — and tracks
-every pen entry's lifetime by scanning the (small) dly_* arrays each
-round on the host: an entry identified by (peer, member, gt) enters at
-its ``since`` round and leaves when it disappears from the pen
-(accepted or expired).
+Two measurements, one artifact each:
 
-Scenario: a timeline community under packet loss where the founder's
-grant and the granted author's records race each other, so receivers
-keep parking records whose proof is still in flight.
+- **proof** (VERDICT r2 #7's metric): the active dispersy-missing-proof
+  round trip (config.proof_requests) must DROP the median time a
+  DelayMessageByProof-parked record spends in the pen.  Tracks every pen
+  entry's lifetime by scanning the (small) dly_* arrays each round on
+  the host: an entry identified by (peer, member, gt) enters at its
+  ``since`` round and leaves when it disappears (accepted or expired).
+  Scenario: a timeline community under packet loss where the founder's
+  grant and the granted author's records race each other.
+
+- **seq** (VERDICT r3 #5's metric): the active dispersy-missing-sequence
+  round trip (config.seq_requests) must reach full-chain coverage FASTER
+  than Bloom re-offer luck.  Scenario: one author emits a sequence chain
+  under heavy loss, so pushes race ahead of their predecessors and
+  receivers gap; measured as the per-round fraction of members holding
+  the COMPLETE chain, plus the gap-parked pen residence.
 
 Usage:
     python tools/proof_latency.py --out artifacts/proof_latency.json
+    python tools/proof_latency.py --mode seq --out artifacts/seq_latency.json
 """
 
 from __future__ import annotations
@@ -39,7 +45,8 @@ def run_once(proof_requests: bool, n_peers: int = 1024, rounds: int = 50,
     import jax.numpy as jnp
 
     from dispersy_tpu import engine
-    from dispersy_tpu.config import META_AUTHORIZE, EMPTY_U32, CommunityConfig
+    from dispersy_tpu.config import (META_AUTHORIZE, EMPTY_U32,
+                                     CommunityConfig, perm_bit)
     from dispersy_tpu.state import init_state
 
     _configure_logging()
@@ -62,7 +69,8 @@ def run_once(proof_requests: bool, n_peers: int = 1024, rounds: int = 50,
     for a in authors:
         state = engine.create_messages(
             state, cfg, jnp.arange(n) == F, META_AUTHORIZE,
-            jnp.full(n, a, jnp.uint32), jnp.full(n, 0b10, jnp.uint32))
+            jnp.full(n, a, jnp.uint32),
+            jnp.full(n, perm_bit(1, 'permit'), jnp.uint32))
     live: dict[tuple, int] = {}    # (peer, member, gt) -> since round
     durations: list[int] = []
 
@@ -109,26 +117,93 @@ def run_once(proof_requests: bool, n_peers: int = 1024, rounds: int = 50,
     }
 
 
+def run_seq_once(seq_requests: bool, n_peers: int = 1024, rounds: int = 40,
+                 seed: int = 3, chain: int = 10) -> dict:
+    """One seeded chain-under-loss run; returns the full-chain coverage
+    curve (fraction of members holding EVERY link 1..chain)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dispersy_tpu import engine
+    from dispersy_tpu.config import CommunityConfig
+    from dispersy_tpu.state import init_state
+
+    _configure_logging()
+    seq_meta = 3
+    cfg = CommunityConfig(
+        n_peers=n_peers, n_trackers=2, k_candidates=8, msg_capacity=64,
+        bloom_capacity=32, request_inbox=4,
+        tracker_inbox=max(32, n_peers // 16), response_budget=4,
+        timeline_enabled=True, n_meta=8, k_authorized=8, delay_inbox=3,
+        seq_meta_mask=1 << seq_meta, seq_requests=seq_requests,
+        packet_loss=0.35)
+    state = init_state(cfg, jax.random.PRNGKey(seed))
+    state = engine.seed_overlay(state, cfg, degree=6)
+    n = cfg.n_peers
+    author = cfg.founder + 1
+    amask = jnp.arange(n) == author
+    members = ~np.asarray(state.is_tracker)
+    curve = []
+    rounds_to_99 = None
+    for rnd in range(1, rounds + 1):
+        if rnd <= chain:
+            state = engine.step(engine.create_messages(
+                state, cfg, amask, seq_meta,
+                jnp.full(n, 900 + rnd, jnp.uint32)), cfg)
+        else:
+            state = engine.step(state, cfg)
+        links = (((np.asarray(state.store_member) == author)
+                  & (np.asarray(state.store_meta) == seq_meta)
+                  & (np.asarray(state.store_aux) >= 1)
+                  & (np.asarray(state.store_aux) <= chain))
+                 .sum(axis=1))
+        cov = float((links[members] == chain).mean())
+        curve.append(round(cov, 6))
+        if rounds_to_99 is None and cov >= 0.99:
+            rounds_to_99 = rnd
+    return {
+        "seq_requests": seq_requests,
+        "chain_len": chain,
+        "rounds_to_99pct_full_chain": rounds_to_99,
+        "curve": curve,
+        "parks": int(np.asarray(state.stats.msgs_delayed).sum()),
+        "seq_requests_served": int(
+            np.asarray(state.stats.seq_requests).sum()),
+        "seq_records_returned": int(
+            np.asarray(state.stats.seq_records).sum()),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("proof", "seq"), default="proof")
     ap.add_argument("--peers", type=int, default=1024)
     ap.add_argument("--rounds", type=int, default=50)
     ap.add_argument("--seed", type=int, default=3)
-    ap.add_argument("--out", default="artifacts/proof_latency.json")
+    ap.add_argument("--out", default=None)
     args = ap.parse_args()
+    out_path = args.out or (f"artifacts/{args.mode}_latency.json")
     _configure_logging()
+    runner = run_once if args.mode == "proof" else run_seq_once
     results = []
     for flag in (False, True):
-        r = run_once(flag, args.peers, args.rounds, args.seed)
-        _LOG.info("proof_requests=%s: %s parks, median %s rounds in pen",
-                  flag, r["parks"], r["median_park_rounds"])
+        r = runner(flag, args.peers, args.rounds, args.seed)
+        _LOG.info("%s active=%s: %s", args.mode, flag,
+                  {k: v for k, v in r.items() if k != "curve"})
         results.append(r)
-    out = {"n_peers": args.peers, "rounds": args.rounds, "seed": args.seed,
-           "passive": results[0], "active": results[1]}
-    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-    with open(args.out, "w") as f:
+    out = {"mode": args.mode, "n_peers": args.peers, "rounds": args.rounds,
+           "seed": args.seed, "passive": results[0], "active": results[1]}
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
-    print(json.dumps(out))
+    print(json.dumps({k: v for k, v in out.items()
+                      if k not in ("passive", "active")}
+                     | {"passive_rounds": results[0].get(
+                         "rounds_to_99pct_full_chain",
+                         results[0].get("median_park_rounds")),
+                        "active_rounds": results[1].get(
+                         "rounds_to_99pct_full_chain",
+                         results[1].get("median_park_rounds"))}))
 
 
 if __name__ == "__main__":

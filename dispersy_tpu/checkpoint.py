@@ -45,7 +45,7 @@ from dispersy_tpu.state import NEVER, PeerState, init_state
 # v4: + the delayed-message pen (dly_*) and msgs_delayed counter.
 # v5: + the pen's deliverer column (dly_src) and the proof_requests /
 #     proof_records counters (active missing-proof round trips).
-FORMAT_VERSION = 5
+FORMAT_VERSION = 6   # v6: PeerState gained the `loaded` leaf
 
 
 def _fingerprint(cfg: CommunityConfig) -> str:
@@ -121,6 +121,9 @@ def _wipe_ephemeral(state: PeerState, cfg: CommunityConfig) -> PeerState:
     f = cfg.forward_buffer
     never = np.full((n, k), NEVER, np.float32)
     return state.replace(
+        # An app restart re-loads its stored communities (reference:
+        # Dispersy.start + auto_load), whatever their pre-crash state.
+        loaded=np.ones((n,), bool),
         cand_peer=np.full((n, k), NO_PEER, np.int32),
         cand_last_walk=never,
         cand_last_stumble=never.copy(),

@@ -36,6 +36,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from dispersy_tpu import engine
 from dispersy_tpu.exceptions import ConfigError, MetaNotFoundError
@@ -372,6 +373,30 @@ class Community:
         n = self.config.n_peers
         return self.create(state, "dispersy-destroy-community", author_mask,
                            payload=jnp.zeros(n, jnp.uint32))
+
+    def unload_community(self, state: PeerState, mask) -> PeerState:
+        """Unload the community instance on the masked peers (reference:
+        community.py Community.unload_community): they stop walking,
+        serving, and taking records in; candidate tables, delay pens, and
+        signature caches — instance memory — are freed; the store (the
+        database) persists.  With ``auto_load`` (config) any later
+        community packet re-loads them (reference: dispersy.py
+        define_auto_load)."""
+        from dispersy_tpu.scenario import Unload, _apply
+        members = np.flatnonzero(np.asarray(mask))
+        state, _ = _apply(state, self.config, Unload(members=members),
+                          {}, {})
+        return state
+
+    def load_community(self, state: PeerState, mask) -> PeerState:
+        """Explicitly (re-)load the community instance on the masked
+        peers (reference: dispersy.py get_community(load=True) /
+        Community.load_community); they re-walk from the trackers, since
+        candidates are never persisted."""
+        from dispersy_tpu.scenario import Load, _apply
+        members = np.flatnonzero(np.asarray(mask))
+        state, _ = _apply(state, self.config, Load(members=members), {}, {})
+        return state
 
     def create_signature_request(self, state: PeerState, name: str,
                                  author_mask, counterparty,

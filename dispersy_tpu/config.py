@@ -416,6 +416,14 @@ class CommunityConfig:
     k_malicious: int = 8                # blacklist slots per peer
     malicious_gossip: bool = False      # spread convictions as records
 
+    # ---- community load/unload (reference: dispersy.py define_auto_load
+    #      / get_community(load=True) + Community.load_community /
+    #      unload_community, tests/test_classification.py) ----
+    # True (the reference's default): a community packet arriving at a
+    # peer whose instance is unloaded loads it for the next round.  False:
+    # only an explicit load (scenario Load event / Community.load) does.
+    auto_load: bool = True
+
     # ---- permissions (reference: timeline.py; bounded table of authorized
     #      members — real overlays authorize a handful of members) ----
     timeline_enabled: bool = False

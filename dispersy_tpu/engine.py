@@ -325,6 +325,23 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         global_time, session = state.global_time, state.session
 
     alive = state.alive
+    # Community load state (reference: dispersy.py define_auto_load /
+    # get_community(load=True); Community.load_community /
+    # unload_community): an UNLOADED peer's community instance is absent
+    # — it neither walks, serves, nor takes records in, though its
+    # process stays up and its database (the store) persists.  With
+    # cfg.auto_load, any community packet arriving at an unloaded peer
+    # loads the instance for the NEXT round (one-round spin-up — the
+    # reference loads synchronously and dispatches the same packet; a
+    # documented round-resolution divergence, like every timer here).
+    # A churn rebirth restarts the whole app, which re-loads communities
+    # found in its database (reference: Dispersy.start + auto_load).
+    if cfg.churn_rate > 0.0:
+        loaded = jnp.where(reborn, True, state.loaded)
+    else:
+        loaded = state.loaded
+    act = alive & loaded        # participating this round
+    arrivals = jnp.zeros((n,), bool)   # community packets seen (auto-load)
 
     if cfg.p_symmetric > 0.0:
         # Connection types (reference: candidate.py ``connection_type``):
@@ -365,7 +382,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     if cfg.walker_enabled:
         target = cand.sample_walk_target(tab, now, cfg, seed, rnd, idx,
                                          boot_base, boot_count)
-        target = jnp.where(alive & ~state.is_tracker & ~killed, target,
+        target = jnp.where(act & ~state.is_tracker & ~killed, target,
                            NO_PEER)
     else:
         target = jnp.full((n,), NO_PEER, jnp.int32)
@@ -411,12 +428,12 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             # HardKilledCommunity actively spreads the kill (the creator
             # itself is killed the instant its own destroy stores, so
             # without this the record would never leave the founder).
-            send_rec_ok = (alive[:, None]
+            send_rec_ok = (act[:, None]
                            & (~killed[:, None]
                               | (fwd_meta == jnp.uint32(META_DESTROY))
                               ))[:, :, None]                  # [N, F, 1]
         else:
-            send_rec_ok = alive[:, None, None]
+            send_rec_ok = act[:, None, None]
         push_valid = send_rec_ok & have_rec & tgt_ok & ~push_lost
         push_dst = jnp.broadcast_to(fwd_targets[:, None, :], (n, f, c))
 
@@ -434,7 +451,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             valid=push_valid.reshape(-1), n_peers=n,
             inbox_size=cfg.push_inbox)
         ph_gt, ph_member, ph_meta, ph_payload, ph_aux = push.inbox[:5]
-        ph_ok = push.inbox_valid & alive[:, None]
+        arrivals = arrivals | jnp.any(push.inbox_valid, axis=1)
+        ph_ok = push.inbox_valid & act[:, None]
         if cfg.delay_enabled:
             ph_src = jnp.where(ph_ok, push.inbox[5].astype(jnp.int32),
                                NO_PEER)
@@ -456,8 +474,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
 
     req_lost = _lost(seed, rnd, idx, _LOSS_REQUEST, 0, cfg.packet_loss)
     # target is already NO_PEER for dead/tracker/killed peers (phase 1).
-    bup = bup + (alive & (target != NO_PEER)).astype(jnp.uint32) * req_bytes
-    send_ok = alive & (target != NO_PEER) & ~req_lost
+    bup = bup + (act & (target != NO_PEER)).astype(jnp.uint32) * req_bytes
+    send_ok = act & (target != NO_PEER) & ~req_lost
     to_tracker = (target >= 0) & (target < t)
     # Every request packet carries the sender's clock *as of round start*:
     # the tracker delivery below must not read a clock already raised by
@@ -471,7 +489,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
               sl.offset, gt_at_send, my_bloom],
         valid=send_ok & ~to_tracker, n_peers=n, inbox_size=cfg.request_inbox)
     (rq_src, rq_tlow, rq_thigh, rq_mod, rq_off, rq_gt, rq_bloom) = req.inbox
-    rq_ok = req.inbox_valid & alive[:, None]                 # [N, R]
+    arrivals = arrivals | jnp.any(req.inbox_valid, axis=1)
+    rq_ok = req.inbox_valid & act[:, None]                   # [N, R]
     rq_src_i = jnp.where(rq_ok, rq_src.astype(jnp.int32), NO_PEER)
     stats = stats.replace(
         requests_dropped=stats.requests_dropped
@@ -501,7 +520,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             dst=target, cols=[idx.astype(jnp.uint32), gt_at_send],
             valid=send_ok & to_tracker, n_peers=t, inbox_size=rt)
         tq_src, tq_gt = treq.inbox                           # [T, Rt]
-        tq_ok = treq.inbox_valid & alive[:t][:, None]
+        tq_ok = treq.inbox_valid & act[:t][:, None]
         tq_src_i = jnp.where(tq_ok, tq_src.astype(jnp.int32), NO_PEER)
 
         # Recent-contact ring in the tracker's candidate rows: up to K
@@ -622,7 +641,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         valid=jnp.concatenate(pr_valid), n_peers=n,
         inbox_size=cfg.request_inbox)
     (pq_target,) = punc_req.inbox                             # [N, P]
-    pq_ok = punc_req.inbox_valid & alive[:, None]
+    arrivals = arrivals | jnp.any(punc_req.inbox_valid, axis=1)
+    pq_ok = punc_req.inbox_valid & act[:, None]
     stats = stats.replace(
         punctures=stats.punctures
         + jnp.sum(pq_ok, axis=1).astype(jnp.uint32),
@@ -652,7 +672,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                                (n, p)).reshape(-1)],
         valid=pu_valid, n_peers=n, inbox_size=cfg.request_inbox)
     (pu_from,) = punc.inbox
-    pu_ok = punc.inbox_valid & alive[:, None]
+    arrivals = arrivals | jnp.any(punc.inbox_valid, axis=1)
+    pu_ok = punc.inbox_valid & act[:, None]
     stats = stats.replace(
         requests_dropped=stats.requests_dropped
         + punc.n_dropped.astype(jnp.uint32))
@@ -679,7 +700,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     else:
         got_raw, intro_pick = got_n, intro_n
     resp_lost = _lost(seed, rnd, idx, _LOSS_RESPONSE, 0, cfg.packet_loss)
-    got_resp = got_raw & ~resp_lost & alive
+    got_resp = got_raw & ~resp_lost & act
     bdown = bdown + got_resp.astype(jnp.uint32) \
         * jnp.uint32(INTRO_RESPONSE_BYTES)
     walked = jnp.where(got_resp, target, NO_PEER)
@@ -699,7 +720,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     global_time = _fold_gt(global_time, rs_gt, rs_ok,
                            cfg.acceptable_global_time_range)
 
-    walked_ok = alive & (target != NO_PEER)
+    walked_ok = act & (target != NO_PEER)
     failed = walked_ok & ~got_resp
     tab = cand.remove(tab, target, failed)
     stats = stats.replace(
@@ -722,7 +743,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     sg_target, sg_meta, sg_payload, sg_gt, sg_since = sig
     if cfg.double_meta_mask:
         s_sz = cfg.sig_inbox
-        sending = alive & ~killed & (sg_target != NO_PEER) & (sg_since == rnd)
+        sending = act & ~killed & (sg_target != NO_PEER) & (sg_since == rnd)
         srq_lost = _lost(seed, rnd, idx, _LOSS_SIGREQ, 0, cfg.packet_loss)
         bup = bup + sending.astype(jnp.uint32) \
             * jnp.uint32(SIGNATURE_REQUEST_BYTES)
@@ -731,9 +752,10 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             cols=[idx.astype(jnp.uint32), sg_meta, sg_payload, sg_gt],
             valid=sending & ~srq_lost, n_peers=n, inbox_size=s_sz)
         sq_src, sq_meta, sq_payload, sq_gt = sreq.inbox          # [N, S]
+        arrivals = arrivals | jnp.any(sreq.inbox_valid, axis=1)
         # Trackers never countersign (infrastructure, not members); neither
         # do hard-killed peers (their community instance is unloaded).
-        sq_ok = (sreq.inbox_valid & alive[:, None]
+        sq_ok = (sreq.inbox_valid & act[:, None]
                  & ~state.is_tracker[:, None] & ~killed[:, None])
         if cfg.countersign_rate >= 1.0:
             agree = jnp.ones((n, s_sz), bool)
@@ -875,7 +897,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         sync_lost = _lost(seed, rnd, idx[:, None], _LOSS_SYNC,
                           jnp.arange(b)[None, :], cfg.packet_loss)
         sy_ok = (obox_ok[tgt, slot_n] & (req.edge_slot >= 0)[:, None]
-                 & alive[:, None] & ~sync_lost)
+                 & act[:, None] & ~sync_lost)
         bup = bup + jnp.sum(obox_ok, axis=(1, 2)).astype(jnp.uint32) \
             * jnp.uint32(RECORD_BYTES)
         bdown = bdown + jnp.sum(sy_ok, axis=1).astype(jnp.uint32) \
@@ -887,7 +909,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
 
     if cfg.delay_enabled:
         dl_gt, dl_member, dl_meta, dl_payload, dl_aux, dl_since, dl_src = dly
-        dl_ok = (dl_gt != jnp.uint32(EMPTY_U32)) & alive[:, None]
+        dl_ok = (dl_gt != jnp.uint32(EMPTY_U32)) & act[:, None]
     else:
         z0 = jnp.zeros((n, 0), jnp.uint32)
         dl_gt = dl_member = dl_meta = dl_payload = dl_aux = dl_since = z0
@@ -917,7 +939,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             valid=(have_pen & ~prq_lost).reshape(-1), n_peers=n,
             inbox_size=cfg.proof_inbox)
         (pq_author,) = preq.inbox                               # [N, Pi]
-        pq_pok = preq.inbox_valid & alive[:, None]
+        arrivals = arrivals | jnp.any(preq.inbox_valid, axis=1)
+        pq_pok = preq.inbox_valid & act[:, None]
         if cfg.timeline_enabled:
             pq_pok = pq_pok & ~killed[:, None]
         stats = stats.replace(
@@ -965,7 +988,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                          jnp.arange(dd_ * pb)[None, :], cfg.packet_loss)
         pr_ok = (pick(pbox[5])
                  & jnp.repeat(got, pb, axis=1)
-                 & alive[:, None] & ~prs_lost)
+                 & act[:, None] & ~prs_lost)
         pr_src = jnp.repeat(dl_src, pb, axis=1)
         stats = stats.replace(
             proof_records=stats.proof_records
@@ -1014,7 +1037,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             valid=(want & ~mrq_lost).reshape(-1), n_peers=n,
             inbox_size=cfg.proof_inbox)
         qq_member, qq_meta, qq_low, qq_high = qreq.inbox    # [N, Qi]
-        qq_ok = qreq.inbox_valid & alive[:, None]
+        arrivals = arrivals | jnp.any(qreq.inbox_valid, axis=1)
+        qq_ok = qreq.inbox_valid & act[:, None]
         if cfg.timeline_enabled:
             qq_ok = qq_ok & ~killed[:, None]
         stats = stats.replace(
@@ -1063,7 +1087,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                          jnp.arange(dd_ * qb)[None, :], cfg.packet_loss)
         mq_ok = (qpick(qbox[5])
                  & jnp.repeat(qgot, qb, axis=1)
-                 & alive[:, None] & ~mqs_lost)
+                 & act[:, None] & ~mqs_lost)
         mq_src = jnp.repeat(dl_src, qb, axis=1)
         stats = stats.replace(
             seq_records=stats.seq_records
@@ -1540,8 +1564,13 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             last_walk=jnp.where(bad, NEVER, tab.last_walk),
             last_stumble=jnp.where(bad, NEVER, tab.last_stumble),
             last_intro=jnp.where(bad, NEVER, tab.last_intro))
+    if cfg.auto_load:
+        # Any community packet that reached an unloaded peer loads its
+        # instance for the next round (define_auto_load semantics).
+        loaded = loaded | (arrivals & alive)
     return state.replace(
-        alive=alive, session=session, global_time=global_time,
+        alive=alive, loaded=loaded, session=session,
+        global_time=global_time,
         mal_member=mal,
         cand_peer=tab.peer, cand_last_walk=tab.last_walk,
         cand_last_stumble=tab.last_stumble, cand_last_intro=tab.last_intro,
@@ -1624,6 +1653,9 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
         aux = jnp.zeros((n,), jnp.uint32)
     aux = jnp.asarray(aux, jnp.uint32).reshape(n)
     payload = jnp.asarray(payload, jnp.uint32).reshape(n)
+    # No community instance, nothing to create on (reference: a
+    # create_<msg> call needs the loaded Community object).
+    author_mask = jnp.asarray(author_mask) & state.loaded
     auth = _auth(state)
     gt_new = state.global_time + jnp.uint32(1)
 
@@ -1786,7 +1818,8 @@ def create_signature_request(state: PeerState, cfg: CommunityConfig,
     payload = jnp.asarray(payload, jnp.uint32).reshape(n)
     _, _, mem_base, mem_count = _layout_cols(cfg, idx)
     gt_new = state.global_time + jnp.uint32(1)
-    ok = (jnp.asarray(author_mask, bool) & state.alive & ~state.is_tracker
+    ok = (jnp.asarray(author_mask, bool) & state.alive & state.loaded
+          & ~state.is_tracker
           & (state.sig_target == NO_PEER)
           & (counterparty != idx)
           & (counterparty >= mem_base)

@@ -154,6 +154,7 @@ class Slot:
 class OraclePeer:
     def __init__(self, cfg: CommunityConfig):
         self.alive = True
+        self.loaded = True
         self.session = 0
         self.global_time = 1
         self.slots = [Slot() for _ in range(cfg.k_candidates)]
@@ -576,8 +577,8 @@ class OracleSim:
         assert not (meta < cfg.n_meta and (cfg.double_meta_mask >> meta) & 1), \
             "double-signed metas go through create_signature_request"
         for i, p in enumerate(self.peers):
-            if not author_mask[i]:
-                continue
+            if not author_mask[i] or not p.loaded:
+                continue          # engine: author_mask &= state.loaded
             gt = p.global_time + 1
             av = int(aux[i]) if aux is not None else 0
             pv = int(payload[i])
@@ -646,7 +647,7 @@ class OracleSim:
             base = int(self.mem_base[i])
             cnt = int(self.mem_count[i])
             gt_new = p.global_time + 1
-            if not (p.alive and i >= cfg.n_trackers
+            if not (p.alive and p.loaded and i >= cfg.n_trackers
                     and p.sig_target == NO_PEER and cp != i
                     and base <= cp < base + cnt):
                 continue
@@ -692,6 +693,9 @@ class OracleSim:
         r = cfg.request_inbox
         rt = cfg.tracker_inbox
         seed, rnd = self.seed, self.rnd
+        # community packets seen by each peer this round (auto-load
+        # trigger — engine `arrivals`)
+        arrivals = [False] * n
 
         # phase 0: churn
         if cfg.churn_rate > 0.0:
@@ -709,6 +713,7 @@ class OracleSim:
                     p.mal = []
                     p.global_time = 1
                     p.session += 1
+                    p.loaded = True   # app restart re-loads (engine)
 
         # hard-kill state (engine mirror: derived from the post-churn store)
         if cfg.timeline_enabled:
@@ -721,7 +726,7 @@ class OracleSim:
         targets = [NO_PEER] * n
         if cfg.walker_enabled:
             for i, p in enumerate(self.peers):
-                if p.alive and i >= t and not killed[i]:
+                if p.alive and p.loaded and i >= t and not killed[i]:
                     targets[i] = self._sample_walk_target(i)
 
         slices, blooms = [None] * n, [None] * n
@@ -772,16 +777,18 @@ class OracleSim:
                     # send_rec_ok)
                     rec_ok = not killed[i] or rec.meta == META_DESTROY
                     for ci, tc in enumerate(tgts):
-                        if p.alive and rec_ok and tc != NO_PEER:
+                        if p.alive and p.loaded and rec_ok \
+                                and tc != NO_PEER:
                             p.bytes_up += RECORD_BYTES       # pre-loss
                             if not self._lost(i, _LOSS_FORWARD,
                                               fi * cc + ci):
                                 sent += 1
                                 if len(push_inbox[tc]) < cfg.push_inbox:
                                     push_inbox[tc].append((rec, i))
-                                    if self.peers[tc].alive:
-                                        self.peers[tc].bytes_down += \
-                                            RECORD_BYTES
+                                    arrivals[tc] = True
+                                    qc = self.peers[tc]
+                                    if qc.alive and qc.loaded:
+                                        qc.bytes_down += RECORD_BYTES
                                 else:
                                     self.peers[tc].msgs_dropped += 1
                 p.msgs_forwarded += sent
@@ -797,8 +804,12 @@ class OracleSim:
                     req_inbox[d].append(i)
                 else:
                     self.peers[d].requests_dropped += 1
-        # rq_ok also requires the *receiver* alive
-        rq_ok = [[self.peers[d].alive for _ in box]
+        # rq_ok also requires the *receiver* participating (act)
+        for d, box in enumerate(req_inbox):
+            if box:
+                arrivals[d] = True
+        rq_ok = [[self.peers[d].alive and self.peers[d].loaded
+                  for _ in box]
                  for d, box in enumerate(req_inbox)]
         for d in range(n):
             n_rq = sum(rq_ok[d])
@@ -830,7 +841,8 @@ class OracleSim:
                         tq_inbox[d].append(i)
                     else:
                         self.peers[d].requests_dropped += 1
-            tq_ok = [[self.peers[d].alive for _ in box]
+            tq_ok = [[self.peers[d].alive and self.peers[d].loaded
+                      for _ in box]
                      for d, box in enumerate(tq_inbox)]
             k = cfg.k_candidates
             kr = min(rt, k)
@@ -920,7 +932,11 @@ class OracleSim:
                     punc_req_inbox[c].append(a)
                 else:
                     self.peers[c].requests_dropped += 1
-        pq_ok = [[self.peers[c].alive for _ in box]
+        for c, box in enumerate(punc_req_inbox):
+            if box:
+                arrivals[c] = True
+        pq_ok = [[self.peers[c].alive and self.peers[c].loaded
+                  for _ in box]
                  for c, box in enumerate(punc_req_inbox)]
         for c in range(n):
             n_pq = sum(pq_ok[c])
@@ -944,7 +960,11 @@ class OracleSim:
                     punc_inbox[a].append(c)
                 else:
                     self.peers[a].requests_dropped += 1
-        pu_ok = [[self.peers[a].alive for _ in box]
+        for a, box in enumerate(punc_inbox):
+            if box:
+                arrivals[a] = True
+        pu_ok = [[self.peers[a].alive and self.peers[a].loaded
+                  for _ in box]
                  for a, box in enumerate(punc_inbox)]
         for a in range(n):
             self.peers[a].bytes_down += sum(pu_ok[a]) * PUNCTURE_BYTES
@@ -964,7 +984,7 @@ class OracleSim:
                 got = sl >= 0 and rq_ok[d][sl] if d >= 0 else False
                 pick = intro[d][sl] if got else NO_PEER
             got = (got and not self._lost(i, _LOSS_RESPONSE, 0)
-                   and self.peers[i].alive)
+                   and self.peers[i].alive and self.peers[i].loaded)
             got_resp[i] = got
             if got:
                 self.peers[i].bytes_down += INTRO_RESPONSE_BYTES
@@ -981,7 +1001,8 @@ class OracleSim:
                     self._upsert(i, c, KIND_STUMBLE)
             if got_resp[i]:
                 self._fold_gt(i, [resp_gt[i]])
-            walked_ok = self.peers[i].alive and targets[i] != NO_PEER
+            walked_ok = (self.peers[i].alive and self.peers[i].loaded
+                         and targets[i] != NO_PEER)
             if walked_ok and got_resp[i]:
                 self.peers[i].walk_success += 1
             elif walked_ok:
@@ -996,7 +1017,7 @@ class OracleSim:
             sig_slot = [-1] * n
             sending = [False] * n
             for i, p in enumerate(self.peers):
-                sending[i] = (p.alive and not killed[i]
+                sending[i] = (p.alive and p.loaded and not killed[i]
                               and p.sig_target != NO_PEER
                               and p.sig_since == rnd)
                 if sending[i]:
@@ -1006,13 +1027,14 @@ class OracleSim:
                         if len(sig_inbox_[d]) < s_sz:
                             sig_slot[i] = len(sig_inbox_[d])
                             sig_inbox_[d].append(i)
+                            arrivals[d] = True
                         else:
                             self.peers[d].requests_dropped += 1
             countersign: list[list[bool]] = [[] for _ in range(n)]
             for d in range(n):
                 pd = self.peers[d]
                 # trackers and hard-killed peers never countersign
-                ok_d = pd.alive and d >= t and not killed[d]
+                ok_d = pd.alive and pd.loaded and d >= t and not killed[d]
                 n_sq = n_cs = 0
                 for s_ix, src in enumerate(sig_inbox_[d]):
                     if ok_d:
@@ -1102,7 +1124,7 @@ class OracleSim:
             for i in range(n):
                 p = self.peers[i]
                 for d, (rec, since, src) in enumerate(p.delay):
-                    if not p.alive or src == NO_PEER:
+                    if not (p.alive and p.loaded) or src == NO_PEER:
                         continue
                     p.bytes_up += MISSING_PROOF_BYTES       # sendto, pre-loss
                     if self._lost(i, _LOSS_PROOF_REQ, d):
@@ -1110,12 +1132,14 @@ class OracleSim:
                     if 0 <= src < n:
                         if len(proof_inbox[src]) < cfg.proof_inbox:
                             proof_inbox[src].append((i, d))
+                            arrivals[src] = True
                         else:
                             self.peers[src].requests_dropped += 1
             replies: dict[tuple[int, int], list[Record]] = {}
             for sv in range(n):
                 psv = self.peers[sv]
-                if not psv.alive or (cfg.timeline_enabled and killed[sv]):
+                if not (psv.alive and psv.loaded) \
+                        or (cfg.timeline_enabled and killed[sv]):
                     continue
                 for (ri, d_slot) in proof_inbox[sv]:
                     psv.proof_requests += 1
@@ -1130,7 +1154,7 @@ class OracleSim:
                 p = self.peers[i]
                 for d, entry in enumerate(p.delay):
                     for b_ix, r in enumerate(replies.get((i, d), [])):
-                        if not p.alive or self._lost(
+                        if not (p.alive and p.loaded) or self._lost(
                                 i, _LOSS_PROOF_RESP,
                                 d * cfg.proof_budget + b_ix):
                             continue
@@ -1152,7 +1176,8 @@ class OracleSim:
                 for d, (rec, since, src) in enumerate(p.delay):
                     is_seq = (rec.meta < cfg.n_meta
                               and (cfg.seq_meta_mask >> rec.meta) & 1)
-                    if not p.alive or src == NO_PEER or not is_seq:
+                    if not (p.alive and p.loaded) or src == NO_PEER \
+                            or not is_seq:
                         continue
                     low = max((r.aux for r in p.store
                                if r.member == rec.member
@@ -1167,12 +1192,14 @@ class OracleSim:
                         if len(seq_inbox[src]) < cfg.proof_inbox:
                             seq_inbox[src].append(
                                 (i, d, rec.member, rec.meta, low, high))
+                            arrivals[src] = True
                         else:
                             self.peers[src].requests_dropped += 1
             sreplies: dict[tuple[int, int], list[Record]] = {}
             for sv in range(n):
                 psv = self.peers[sv]
-                if not psv.alive or (cfg.timeline_enabled and killed[sv]):
+                if not (psv.alive and psv.loaded) \
+                        or (cfg.timeline_enabled and killed[sv]):
                     continue
                 for (ri, d_slot, member, meta, low, high) in seq_inbox[sv]:
                     psv.seq_requests += 1
@@ -1186,7 +1213,7 @@ class OracleSim:
                 p = self.peers[i]
                 for d, entry in enumerate(p.delay):
                     for b_ix, r in enumerate(sreplies.get((i, d), [])):
-                        if not p.alive or self._lost(
+                        if not (p.alive and p.loaded) or self._lost(
                                 i, _LOSS_SEQ_RESP,
                                 d * cfg.proof_budget + b_ix):
                             continue
@@ -1207,10 +1234,11 @@ class OracleSim:
             # in_since), and its deliverer (engine in_src; the future
             # missing-proof target should it park).
             batch: list[tuple[Record, int, int]] = []
-            if delay_on and p.alive:
+            if delay_on and p.alive and p.loaded:
                 # pen first (engine: dl segment leads the concat)
                 batch.extend(p.delay)
-            if cfg.sync_enabled and p.alive and req_slot[i] >= 0:
+            if cfg.sync_enabled and p.alive and p.loaded \
+                    and req_slot[i] >= 0:
                 recs = outbox.get((targets[i], req_slot[i]), [])
                 for j, r in enumerate(recs):
                     if not self._lost(i, _LOSS_SYNC, j):
@@ -1218,7 +1246,7 @@ class OracleSim:
                                              r.payload, r.aux), rnd,
                                       targets[i]))
                         p.bytes_down += RECORD_BYTES
-            if p.alive:
+            if p.alive and p.loaded:
                 batch.extend((Record(r.gt, r.member, r.meta, r.payload,
                                      r.aux), rnd, src)
                              for r, src in push_inbox[i])
@@ -1476,6 +1504,12 @@ class OracleSim:
                         s.peer = NO_PEER
                         s.walk = s.stumble = s.intro = NEVER
 
+        if cfg.auto_load:
+            # engine wrap-up: any arrival loads the instance next round
+            for i, p in enumerate(self.peers):
+                if arrivals[i] and p.alive:
+                    p.loaded = True
+
         self.now = _f32(self.now + np.float32(cfg.walk_interval))
         self.rnd += 1
 
@@ -1488,6 +1522,7 @@ class OracleSim:
         a = cfg.k_authorized
         out = {
             "alive": np.array([p.alive for p in self.peers]),
+            "loaded": np.array([p.loaded for p in self.peers]),
             "session": np.array([p.session for p in self.peers], np.uint32),
             "global_time": np.array([p.global_time for p in self.peers],
                                     np.uint32),

@@ -39,11 +39,12 @@ import numpy as np
 
 from dispersy_tpu import checkpoint as ckpt
 from dispersy_tpu import engine
-from dispersy_tpu.config import (META_AUTHORIZE, META_DESTROY, META_DYNAMIC,
+from dispersy_tpu.config import (EMPTY_U32, META_AUTHORIZE, META_DESTROY,
+                                 META_DYNAMIC,
                                  META_REVOKE, META_UNDO_OTHER, META_UNDO_OWN,
-                                 CommunityConfig, perm_mask)
+                                 NO_PEER, CommunityConfig, perm_mask)
 from dispersy_tpu.metrics import MetricsLog
-from dispersy_tpu.state import PeerState, init_state
+from dispersy_tpu.state import NEVER, PeerState, init_state
 
 
 def _mask(cfg: CommunityConfig, peers) -> jnp.ndarray:
@@ -148,6 +149,29 @@ class SetFault:
 
 
 @dataclasses.dataclass
+class Unload:
+    """Unload `members`' community instances (reference:
+    Community.unload_community): they stop walking, serving, and taking
+    records in; their candidate tables, delay pens, and signature caches
+    — community-instance memory — are freed, while the store (the
+    database) persists.  Tracker rows are silently excluded: the
+    reference's TrackerCommunity auto-joins any community generically
+    and has no unload path (tool/tracker.py).  With cfg.auto_load (the reference's
+    define_auto_load default) any later community packet re-loads them;
+    otherwise only an explicit Load event does."""
+    members: Sequence[int]
+
+
+@dataclasses.dataclass
+class Load:
+    """Explicitly re-load `members`' community instances (reference:
+    Dispersy.get_community(load=True) / Community.load_community).  A
+    re-loaded peer re-walks from the trackers — candidates were not
+    persisted, exactly the reference's restart rule."""
+    members: Sequence[int]
+
+
+@dataclasses.dataclass
 class Checkpoint:
     path: str
 
@@ -225,6 +249,37 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
         state = engine.create_messages(
             state, cfg, _mask(cfg, founder), META_DESTROY,
             _full(cfg, 0))
+    elif isinstance(ev, Unload):
+        m = np.isin(np.arange(cfg.n_peers), list(ev.members))
+        # Trackers are infrastructure, not community members: the
+        # reference's TrackerCommunity generically auto-joins EVERY
+        # community id it hears (tool/tracker.py) — it has no unload.
+        m &= np.arange(cfg.n_peers) >= cfg.n_trackers
+        mj = jnp.asarray(m)
+        m2 = mj[:, None]
+        state = state.replace(
+            loaded=jnp.where(mj, False, state.loaded),
+            # community-instance memory dies with the unload
+            cand_peer=jnp.where(m2, NO_PEER, state.cand_peer),
+            cand_last_walk=jnp.where(m2, NEVER, state.cand_last_walk),
+            cand_last_stumble=jnp.where(m2, NEVER,
+                                        state.cand_last_stumble),
+            cand_last_intro=jnp.where(m2, NEVER, state.cand_last_intro),
+            dly_gt=jnp.where(m2, jnp.uint32(EMPTY_U32), state.dly_gt),
+            dly_member=jnp.where(m2, jnp.uint32(EMPTY_U32), state.dly_member),
+            dly_meta=jnp.where(m2, jnp.uint32(EMPTY_U32), state.dly_meta),
+            dly_payload=jnp.where(m2, jnp.uint32(EMPTY_U32), state.dly_payload),
+            dly_aux=jnp.where(m2, jnp.uint32(0), state.dly_aux),
+            dly_since=jnp.where(m2, jnp.uint32(0), state.dly_since),
+            dly_src=jnp.where(m2, NO_PEER, state.dly_src),
+            sig_target=jnp.where(mj, NO_PEER, state.sig_target),
+            sig_meta=jnp.where(mj, jnp.uint32(0), state.sig_meta),
+            sig_payload=jnp.where(mj, jnp.uint32(0), state.sig_payload),
+            sig_gt=jnp.where(mj, jnp.uint32(0), state.sig_gt),
+            sig_since=jnp.where(mj, jnp.uint32(0), state.sig_since))
+    elif isinstance(ev, Load):
+        m = np.isin(np.arange(cfg.n_peers), list(ev.members))
+        state = state.replace(loaded=jnp.asarray(m) | state.loaded)
     elif isinstance(ev, SetFault):
         kw = {}
         if ev.churn_rate is not None:

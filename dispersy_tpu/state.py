@@ -88,6 +88,11 @@ class Stats:
 class PeerState:
     # ---- liveness / identity ----
     alive: jnp.ndarray        # bool[N]
+    loaded: jnp.ndarray       # bool[N]  community instance loaded (reference:
+    #   dispersy.py get_community(load=True) / define_auto_load;
+    #   Community.load_community/unload_community — an unloaded peer's
+    #   process is up and its store persists, but it neither walks,
+    #   serves, nor takes records in until (re)loaded)
     is_tracker: jnp.ndarray   # bool[N]  bootstrap peers (tool/tracker.py role)
     session: jnp.ndarray      # u32[N]   bumped on churn rejoin
     global_time: jnp.ndarray  # u32[N]   Lamport clock (community.py claim_global_time)
@@ -192,6 +197,7 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         return jnp.full((n, k), NEVER, jnp.float32)
     return PeerState(
         alive=jnp.ones((n,), bool),
+        loaded=jnp.ones((n,), bool),
         is_tracker=jnp.arange(n) < config.n_trackers,
         session=jnp.zeros((n,), jnp.uint32),
         global_time=jnp.ones((n,), jnp.uint32),

@@ -1,0 +1,173 @@
+"""Community load/unload + auto-load (reference: dispersy.py
+define_auto_load / get_community(load=True), Community.load_community /
+unload_community, tests/test_classification.py).
+
+Behaviors pinned:
+
+- an unloaded peer stops walking, serving, and taking records in; its
+  store (the database) persists, its instance memory (candidates, pen,
+  signature cache) is freed;
+- with auto_load (the reference's default), a community packet arriving
+  at the unloaded peer re-loads it the next round — well-connected peers
+  re-load almost immediately because walks and pushes keep arriving;
+- with auto_load=False the peer stays dark until an explicit Load;
+- creating on an unloaded author is a refused no-op;
+- the whole path replays bit-for-bit in the CPU oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispersy_tpu import engine as E
+from dispersy_tpu import state as S
+from dispersy_tpu import scenario as SC
+from dispersy_tpu.config import CommunityConfig
+from dispersy_tpu.oracle import sim as O
+
+from test_oracle import assert_match
+
+CFG = CommunityConfig(n_peers=24, n_trackers=2, msg_capacity=32,
+                      bloom_capacity=16, k_candidates=8, request_inbox=4,
+                      tracker_inbox=8, response_budget=4)
+U = 9
+
+
+def both(cfg, seed=0, warm=4):
+    state = S.init_state(cfg, jax.random.PRNGKey(seed))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=warm)
+    oracle.seed_overlay(degree=warm)
+    return state, oracle
+
+
+def unload_both(state, oracle, cfg, members):
+    """Apply the Unload op to the engine state AND its oracle mirror."""
+    state, _ = SC._apply(state, cfg, SC.Unload(members=members), {}, {})
+    for i in members:
+        p = oracle.peers[i]
+        p.loaded = False
+        p.slots = [O.Slot() for _ in range(cfg.k_candidates)]
+        p.delay = []
+        p.sig_target = O.NO_PEER
+        p.sig_meta = p.sig_payload = p.sig_gt = p.sig_since = 0
+    return state
+
+
+def run(state, oracle, cfg, rounds, tag=""):
+    for rnd in range(rounds):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, f"{tag}{rnd}")
+    return state
+
+
+def test_trace_autoload_reloads_on_contact():
+    """Default auto_load: the unloaded peer is re-loaded by the very
+    traffic that keeps arriving for it (walk requests / pushes), the
+    reference's load-on-packet semantics.  Engine==oracle throughout."""
+    cfg = CFG
+    state, oracle = both(cfg)
+    state = run(state, oracle, cfg, 6, "warm-")
+    state = unload_both(state, oracle, cfg, [U])
+    assert not bool(state.loaded[U])
+    assert_match(state, oracle, "post-unload")
+    state = run(state, oracle, cfg, 6, "reload-")
+    assert bool(state.loaded[U]), \
+        "a connected peer must auto-load from arriving community packets"
+
+
+def test_trace_unloaded_stays_dark_without_autoload():
+    """auto_load=False: the unloaded peer neither takes records in nor
+    serves, its store freezes while everyone else converges; an explicit
+    Load brings it back and it catches up."""
+    cfg = CFG.replace(auto_load=False)
+    state, oracle = both(cfg)
+    state = run(state, oracle, cfg, 4, "warm-")
+    state = unload_both(state, oracle, cfg, [U])
+    assert_match(state, oracle, "post-unload")
+
+    # a record authored while U is dark
+    mask = np.arange(cfg.n_peers) == 5
+    pl = np.full(cfg.n_peers, 77, np.uint32)
+    state = E.create_messages(state, cfg, jnp.asarray(mask), meta=1,
+                              payload=jnp.asarray(pl))
+    oracle.create_messages(mask, meta=1, payload=pl)
+    store_before = int(jnp.sum(state.store_gt[U] != jnp.uint32(0xFFFFFFFF)))
+    state = run(state, oracle, cfg, 10, "dark-")
+    assert not bool(state.loaded[U])
+    # U's database froze; everyone else holds the record
+    store_after = int(jnp.sum(state.store_gt[U] != jnp.uint32(0xFFFFFFFF)))
+    assert store_after == store_before, "unloaded peer must not take records"
+    holds = ((np.asarray(state.store_member) == 5)
+             & (np.asarray(state.store_payload) == 77)).any(axis=1)
+    members = ~np.asarray(state.is_tracker)
+    assert not holds[U]
+    assert holds[members & (np.arange(cfg.n_peers) != U)].all()
+
+    # explicit re-load (reference: get_community(load=True)); U re-walks
+    # from nothing (candidates were freed) and catches up via sync
+    state, _ = SC._apply(state, cfg, SC.Load(members=[U]), {}, {})
+    oracle.peers[U].loaded = True
+    assert_match(state, oracle, "post-load")
+    state = run(state, oracle, cfg, 14, "reload-")
+    holds_u = ((np.asarray(state.store_member[U]) == 5)
+               & (np.asarray(state.store_payload[U]) == 77)).any()
+    assert holds_u, "re-loaded peer must catch up via sync"
+
+
+def test_unloaded_author_create_is_noop():
+    cfg = CFG.replace(auto_load=False)
+    state, oracle = both(cfg)
+    state = unload_both(state, oracle, cfg, [U])
+    mask = np.arange(cfg.n_peers) == U
+    before = int(state.global_time[U])
+    state = E.create_messages(state, cfg, jnp.asarray(mask), meta=1,
+                              payload=jnp.zeros(cfg.n_peers, jnp.uint32))
+    oracle.create_messages(mask, meta=1,
+                           payload=np.zeros(cfg.n_peers, np.uint32))
+    assert int(state.global_time[U]) == before
+    assert_match(state, oracle, "refused-create")
+
+
+def test_rim_load_unload_roundtrip():
+    from test_community_rim import mk
+    c = mk(32)
+    st = c.initialize(seed_degree=4)
+    m = np.arange(32) == c.config.founder + 3
+    st = c.unload_community(st, m)
+    assert not bool(st.loaded[c.config.founder + 3])
+    st = c.load_community(st, m)
+    assert bool(st.loaded[c.config.founder + 3])
+
+
+def test_unload_never_touches_trackers():
+    """Tracker rows are infrastructure (reference: TrackerCommunity
+    auto-joins every community generically; tool/tracker.py has no
+    unload) — an Unload naming one is silently ignored."""
+    cfg = CFG
+    state, oracle = both(cfg)
+    state, _ = SC._apply(state, cfg, SC.Unload(members=[0, U]), {}, {})
+    assert bool(state.loaded[0]), "tracker must stay loaded"
+    assert not bool(state.loaded[U])
+
+
+def test_sig_request_triggers_autoload():
+    """A dispersy-signature-request arriving at an unloaded counterparty
+    re-loads it (the reference loads on ANY community packet)."""
+    cfg = CFG.replace(double_meta_mask=0b100, sig_inbox=2,
+                      walker_enabled=False, sync_enabled=False,
+                      forward_fanout=0)
+    state, oracle = both(cfg)
+    state = unload_both(state, oracle, cfg, [U])
+    mask = np.arange(cfg.n_peers) == 5
+    state = E.create_signature_request(
+        state, cfg, jnp.asarray(mask), meta=2,
+        counterparty=jnp.full(cfg.n_peers, U, jnp.int32),
+        payload=jnp.full(cfg.n_peers, 9, jnp.uint32))
+    oracle.create_signature_request(
+        mask, meta=2, counterparty=np.full(cfg.n_peers, U),
+        payload=np.full(cfg.n_peers, 9, np.uint32))
+    state = run(state, oracle, cfg, 2, "sigload-")
+    assert bool(state.loaded[U]), \
+        "the signature request must auto-load its counterparty"

@@ -22,7 +22,7 @@ BASE = CommunityConfig(n_peers=32, n_trackers=2, msg_capacity=32,
                        bloom_capacity=16, k_candidates=8, request_inbox=4,
                        tracker_inbox=8, response_budget=4)
 
-FIELDS = ["alive", "session", "global_time",
+FIELDS = ["alive", "loaded", "session", "global_time",
           "cand_peer", "cand_last_walk", "cand_last_stumble", "cand_last_intro",
           "store_gt", "store_member", "store_meta", "store_payload",
           "store_aux", "store_flags",

@@ -47,6 +47,8 @@ EVENT_TYPES = {
     "identity": S.Identity,
     "destroy": S.Destroy,
     "set_fault": S.SetFault,
+    "unload": S.Unload,
+    "load": S.Load,
     "checkpoint": S.Checkpoint,
 }
 

@@ -62,7 +62,15 @@ def _probe_platform(env: dict) -> str:
     return out[-1] if proc.returncode == 0 and out else "hang"
 
 
-def _worker() -> None:
+def _hb(msg: str) -> None:
+    """Worker heartbeat on stderr (flushed): a timed-out worker's captured
+    tail must show HOW FAR it got — the r4 manual sweep lost a 900 s TPU
+    attempt to silence and could not tell tunnel-wedge from slow-compile."""
+    print(f"[bench:worker +{time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def _worker(n_peers_override: int | None = None) -> None:
     # Durable in-repo compile cache on TPU only (entries target the chip,
     # so they survive across attempts and rounds).  On CPU this is a
     # no-op: the CPU fallback compiles cold, trading ~1 min of compile
@@ -78,11 +86,13 @@ def _worker() -> None:
     from dispersy_tpu.config import CommunityConfig
     from dispersy_tpu.state import init_state
 
+    _hb("importing jax / resolving backend")
     platform = jax.devices()[0].platform
+    _hb(f"backend ready: {platform}")
     if platform == "tpu":
         # Config #3-shaped load (Bloom-sync with a real backlog) at the
         # largest population one chip holds comfortably.
-        n = 1 << 20  # 1,048,576 peers
+        n = n_peers_override or (1 << 20)  # 1,048,576 peers
         cfg = CommunityConfig(
             n_peers=n, n_trackers=8, k_candidates=16, msg_capacity=48,
             bloom_capacity=48, request_inbox=4, tracker_inbox=1024,
@@ -94,24 +104,31 @@ def _worker() -> None:
             bloom_capacity=64, request_inbox=4, tracker_inbox=256,
             response_budget=8, churn_rate=0.0)
 
+    _hb(f"init_state at n_peers={cfg.n_peers}")
     state = init_state(cfg, jax.random.PRNGKey(0))
     state = engine.seed_overlay(state, cfg, degree=8)
     authors = jnp.arange(cfg.n_peers) % 64 == 63
     state = engine.create_messages(
         state, cfg, author_mask=authors, meta=1,
         payload=jnp.arange(cfg.n_peers, dtype=jnp.uint32))
+    jax.block_until_ready(state)
+    _hb("state ready; warmup (first step compiles)")
 
     # Warmup: compile + populate stores so the timed rounds do real sync work.
-    for _ in range(3):
+    t_c = time.perf_counter()
+    for i in range(3):
         state = engine.step(state, cfg)
-    jax.block_until_ready(state)
+        jax.block_until_ready(state)
+        _hb(f"warmup step {i} done (+{time.perf_counter() - t_c:.1f}s)")
 
     n_rounds = 30 if platform == "tpu" else 10
+    _hb(f"timing {n_rounds} rounds")
     t0 = time.perf_counter()
     for _ in range(n_rounds):
         state = engine.step(state, cfg)
     jax.block_until_ready(state)
     dt = time.perf_counter() - t0
+    _hb(f"timed {n_rounds} rounds in {dt:.3f}s")
 
     rounds_per_sec = n_rounds / dt
     scale = min(1.0, cfg.n_peers / NORTH_STAR_PEERS)
@@ -124,10 +141,17 @@ def _worker() -> None:
         "platform": platform,
     }
 
+    # Headline line FIRST: if the best-effort secondary below hangs the
+    # worker into its timeout, the parent salvages this line from the
+    # captured stdout; on success the parser takes the LAST line (the
+    # combined one printed at the end).
+    print(json.dumps(out), flush=True)
+
     if platform == "tpu":
         # Config #5's shape as a secondary datapoint: the same population
         # split into 8 communities with Timeline permission checks on.
         # Best-effort — the headline metric above is already secured.
+        _hb("secondary: 8-community timeline config")
         try:
             n_c = cfg.n_peers // 8
             cfg5 = cfg.replace(
@@ -154,20 +178,50 @@ def _worker() -> None:
     print(json.dumps(out))
 
 
-def _try_worker(env: dict, timeout_s: int) -> dict | None:
-    """Run one worker; return its parsed JSON result or None."""
+def _try_worker(env: dict, timeout_s: int,
+                n_peers: int | None = None) -> tuple[dict | None, bool]:
+    """Run one worker; returns (parsed JSON result or None, progressed).
+
+    ``progressed`` = the worker's heartbeats show backend init SUCCEEDED,
+    so a failure is attributable to the workload (size/compile) rather
+    than a wedged tunnel — the signal the population ladder keys on."""
+    argv = [sys.executable, os.path.abspath(__file__), "--worker"]
+    if n_peers is not None:
+        argv += ["--n-peers", str(n_peers)]
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--worker"],
-            cwd=_REPO_ROOT, env=env, timeout=timeout_s,
+            argv, cwd=_REPO_ROOT, env=env, timeout=timeout_s,
             capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        print("bench worker timed out", file=sys.stderr)
-        return None
+    except subprocess.TimeoutExpired as e:
+        # The captured tail says how far the worker got (heartbeat lines):
+        # backend init hang = wedged tunnel; post-"state ready" silence =
+        # compile overrun — different fixes, same rc before this existed.
+        err = e.stderr or ""
+        if isinstance(err, bytes):
+            err = err.decode("utf-8", "replace")
+        print(f"bench worker timed out after {timeout_s}s; stderr tail:\n"
+              f"{err[-2000:]}", file=sys.stderr)
+        # The headline JSON may already be on stdout (timeout inside the
+        # best-effort secondary metric) — salvage it rather than retry.
+        # Scan the FULL stderr for the init marker: XLA can emit >2KB of
+        # compile chatter after it, and the tail alone would misread a
+        # compile overrun as an init hang (and never advance the ladder).
+        # ": tpu" matters — a worker that silently resolved to CPU must
+        # not count as TPU progress and shrink an unrun 1M config.
+        return _parse_result(e.stdout), "backend ready: tpu" in err
     sys.stderr.write(proc.stderr[-4000:])
+    progressed = "backend ready: tpu" in (proc.stderr or "")
     if proc.returncode != 0:
+        return None, progressed
+    return _parse_result(proc.stdout), progressed
+
+
+def _parse_result(stdout) -> dict | None:
+    if stdout is None:
         return None
-    for line in reversed(proc.stdout.strip().splitlines()):
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode("utf-8", "replace")
+    for line in reversed(stdout.strip().splitlines()):
         try:
             out = json.loads(line)
         except json.JSONDecodeError:
@@ -186,6 +240,13 @@ def main() -> None:
     # CPU fallback.
     deadline = time.monotonic() + TOTAL_BUDGET_S
     result = None
+    # Population ladder: a timed-out 1M attempt retries smaller — an
+    # honest TPU number at 256k (vs_baseline scales by population) beats
+    # a CPU fallback at 8k.  The r4 manual sweep saw the 1M worker hit
+    # its 900 s ceiling while smaller TPU runs fit comfortably.
+    ladder = [None, 1 << 18, 1 << 16]
+    rung = 0   # advances only when a WORKER ran and failed — wedged-tunnel
+    #            probe retries must not shrink a 1M run never attempted
     if os.environ.get("JAX_PLATFORMS", "") != "cpu":
         for attempt in range(TPU_ATTEMPTS):
             if attempt:
@@ -210,13 +271,16 @@ def main() -> None:
             slack = deadline - time.monotonic() - CPU_TIMEOUT_S
             if slack < 60:
                 break
-            result = _try_worker(dict(os.environ),
-                                 min(TPU_TIMEOUT_S, int(slack)))
+            result, progressed = _try_worker(
+                dict(os.environ), min(TPU_TIMEOUT_S, int(slack)),
+                n_peers=ladder[min(rung, len(ladder) - 1)])
             if result is not None and result.get("platform") == "tpu":
                 break
             result = None
+            if progressed:   # init OK -> the workload was the problem;
+                rung += 1    # an init hang must not shrink an unrun 1M
     if result is None:
-        result = _try_worker(cpu_env(), CPU_TIMEOUT_S)
+        result, _ = _try_worker(cpu_env(), CPU_TIMEOUT_S)
     if result is not None and result.get("platform") != "tpu":
         # Make a CPU-fallback line self-explanatory to whoever reads the
         # recorded artifact: the TPU attempt failed (tunnel down/wedged),
@@ -238,6 +302,9 @@ def main() -> None:
 
 if __name__ == "__main__":
     if "--worker" in sys.argv:
-        _worker()
+        n_over = None
+        if "--n-peers" in sys.argv:
+            n_over = int(sys.argv[sys.argv.index("--n-peers") + 1])
+        _worker(n_over)
     else:
         main()

@@ -35,9 +35,9 @@ import os
 import jax
 import numpy as np
 
-from dispersy_tpu.config import EMPTY_U32, CommunityConfig, NO_PEER
+from dispersy_tpu.config import CommunityConfig
 from dispersy_tpu.exceptions import CheckpointError
-from dispersy_tpu.state import NEVER, PeerState, init_state
+from dispersy_tpu.state import PeerState, init_state, wipe_instance_memory
 
 # v2: PeerState gained the signature request cache (sig_*) and Stats the
 # sig_signed/sig_done/sig_expired counters — v1 archives lack those leaves.
@@ -115,37 +115,20 @@ def _wipe_ephemeral(state: PeerState, cfg: CommunityConfig) -> PeerState:
     memory (not the database) is ephemeral — candidates (the walker
     re-bootstraps from trackers, SURVEY §5.4), the signature
     RequestCache, the delayed-message pen, and malicious-member
-    convictions all die with the process, exactly as the engine's
-    churn rebirth models."""
-    n, k, d = cfg.n_peers, cfg.k_candidates, cfg.delay_inbox
-    f = cfg.forward_buffer
-    never = np.full((n, k), NEVER, np.float32)
+    convictions all die with the process, like the engine's churn
+    rebirth — EXCEPT ``loaded``: rebirth is a wiped-disk NEW participant
+    whose join is an explicit load, while this is the SAME app restarting
+    on its database, so with ``auto_load`` off an explicit pre-crash
+    unload survives (the full boundary: engine.unload_members)."""
+    n = cfg.n_peers
+    state = wipe_instance_memory(state, np.ones((n,), bool))
     return state.replace(
         # An app restart re-loads its stored communities (reference:
-        # Dispersy.start + auto_load), whatever their pre-crash state.
-        loaded=np.ones((n,), bool),
-        cand_peer=np.full((n, k), NO_PEER, np.int32),
-        cand_last_walk=never,
-        cand_last_stumble=never.copy(),
-        cand_last_intro=never.copy(),
-        fwd_gt=np.full((n, f), EMPTY_U32, np.uint32),
-        fwd_member=np.full((n, f), EMPTY_U32, np.uint32),
-        fwd_meta=np.full((n, f), EMPTY_U32, np.uint32),
-        fwd_payload=np.full((n, f), EMPTY_U32, np.uint32),
-        fwd_aux=np.full((n, f), EMPTY_U32, np.uint32),
-        sig_target=np.full((n,), NO_PEER, np.int32),
-        sig_meta=np.zeros((n,), np.uint32),
-        sig_payload=np.zeros((n,), np.uint32),
-        sig_gt=np.zeros((n,), np.uint32),
-        sig_since=np.zeros((n,), np.uint32),
-        mal_member=np.full((n, cfg.k_malicious), EMPTY_U32, np.uint32),
-        dly_gt=np.full((n, d), EMPTY_U32, np.uint32),
-        dly_member=np.full((n, d), EMPTY_U32, np.uint32),
-        dly_meta=np.full((n, d), EMPTY_U32, np.uint32),
-        dly_payload=np.full((n, d), EMPTY_U32, np.uint32),
-        dly_aux=np.zeros((n, d), np.uint32),
-        dly_since=np.zeros((n, d), np.uint32),
-        dly_src=np.full((n, d), NO_PEER, np.int32))
+        # Dispersy.start + auto_load), whatever their pre-crash state —
+        # but with auto_load OFF only an explicit Load does (config.py
+        # contract), so an explicit pre-crash Unload survives restart.
+        loaded=(np.ones((n,), bool) if cfg.auto_load
+                else np.asarray(state.loaded, bool)))
 
 
 def _atomic_npz(path: str, arrays: dict) -> None:

@@ -382,21 +382,15 @@ class Community:
         database) persists.  With ``auto_load`` (config) any later
         community packet re-loads them (reference: dispersy.py
         define_auto_load)."""
-        from dispersy_tpu.scenario import Unload, _apply
-        members = np.flatnonzero(np.asarray(mask))
-        state, _ = _apply(state, self.config, Unload(members=members),
-                          {}, {})
-        return state
+        return engine.unload_members(state, self.config,
+                                     np.asarray(mask, bool))
 
     def load_community(self, state: PeerState, mask) -> PeerState:
         """Explicitly (re-)load the community instance on the masked
         peers (reference: dispersy.py get_community(load=True) /
         Community.load_community); they re-walk from the trackers, since
         candidates are never persisted."""
-        from dispersy_tpu.scenario import Load, _apply
-        members = np.flatnonzero(np.asarray(mask))
-        state, _ = _apply(state, self.config, Load(members=members), {}, {})
-        return state
+        return engine.load_members(state, np.asarray(mask, bool))
 
     def create_signature_request(self, state: PeerState, name: str,
                                  author_mask, counterparty,

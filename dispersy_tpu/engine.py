@@ -70,7 +70,8 @@ from dispersy_tpu.ops import bloom, candidates as cand, inbox, rng, store as st
 from dispersy_tpu.ops import intake as ik
 from dispersy_tpu.ops import timeline as tl
 from dispersy_tpu.ops.hashing import record_hash
-from dispersy_tpu.state import FLAG_UNDONE, NEVER, PeerState
+from dispersy_tpu.state import (FLAG_UNDONE, NEVER, PeerState,
+                                wipe_instance_memory)
 
 # Loss-draw salt blocks: one disjoint block per packet kind so every logical
 # packet flips an independent Bernoulli coin.  Within a block, the normal
@@ -334,8 +335,14 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     # loads the instance for the NEXT round (one-round spin-up — the
     # reference loads synchronously and dispatches the same packet; a
     # documented round-resolution divergence, like every timer here).
-    # A churn rebirth restarts the whole app, which re-loads communities
-    # found in its database (reference: Dispersy.start + auto_load).
+    # A churn rebirth re-loads UNCONDITIONALLY (even with auto_load
+    # off): the reborn row is a wiped-disk NEW participant whose join IS
+    # an explicit load — unlike checkpoint restart, where the same app
+    # resumes its database and an explicit unload can survive (the full
+    # re-load boundary is spelled out at engine.unload_members).  The
+    # rebirth wipe below covers a SUPERSET of
+    # state.INSTANCE_MEMORY_FIELDS (plus store/clock/auth — the disk);
+    # keep the two inventories in sync when adding ephemeral leaves.
     if cfg.churn_rate > 0.0:
         loaded = jnp.where(reborn, True, state.loaded)
     else:
@@ -1606,6 +1613,42 @@ def multi_step(state: PeerState, cfg: CommunityConfig, k: int) -> PeerState:
     ticks without returning to the caller in between.
     """
     return lax.fori_loop(0, k, lambda i, s: step.__wrapped__(s, cfg), state)
+
+
+def unload_members(state: PeerState, cfg: CommunityConfig,
+                   mask) -> PeerState:
+    """Unload the community instance on the masked peers (reference:
+    community.py ``Community.unload_community``): ``loaded`` off, the
+    community-instance memory — candidate table, delay pen, signature
+    cache, forward batch, malicious convictions — freed, while the
+    store (the database) persists.  Tracker
+    rows are silently excluded: the reference's TrackerCommunity
+    auto-joins any community generically and has no unload path
+    (tool/tracker.py).  Called by both the scenario-event interpreter
+    (scenario.Unload) and the rim (Community.unload_community).
+
+    Re-load paths, in one place (the auto_load boundary):
+    - any arriving community packet, when ``cfg.auto_load`` (step
+      phase intake; reference define_auto_load);
+    - an explicit ``load_members`` (reference get_community(load=True));
+    - churn rebirth (step phase 0) ALWAYS re-loads — a reborn row is a
+      wiped-disk NEW participant whose join IS an explicit load, not the
+      old instance resuming;
+    - checkpoint restart (`checkpoint.restore(fresh_candidates=True)`)
+      re-loads only under ``auto_load`` — the same app restarting on the
+      same database honors an explicit pre-crash unload otherwise.
+    """
+    mj = jnp.asarray(mask) & (jnp.arange(cfg.n_peers) >= cfg.n_trackers)
+    state = wipe_instance_memory(state, mj)
+    return state.replace(loaded=jnp.where(mj, False, state.loaded))
+
+
+def load_members(state: PeerState, mask) -> PeerState:
+    """Explicitly (re-)load the community instance on the masked peers
+    (reference: dispersy.py ``get_community(load=True)`` /
+    ``Community.load_community``); they re-walk from the trackers since
+    candidates are never persisted."""
+    return state.replace(loaded=jnp.asarray(mask) | state.loaded)
 
 
 def create_messages(state: PeerState, cfg: CommunityConfig,

@@ -685,6 +685,30 @@ class OracleSim:
                 s.walk = eligible_at
                 s.stumble = s.intro = NEVER
 
+    def unload(self, members) -> None:
+        """engine.unload_members mirror (Community.unload_community):
+        loaded off, community-instance memory (candidate slots, delay
+        pen, sig cache, forward batch, convictions) freed, store kept;
+        tracker rows excluded — TrackerCommunity has no unload path
+        (tool/tracker.py)."""
+        cfg = self.cfg
+        for i in members:
+            if i < cfg.n_trackers:
+                continue
+            p = self.peers[i]
+            p.loaded = False
+            p.slots = [Slot() for _ in range(cfg.k_candidates)]
+            p.delay = []
+            p.fwd = []
+            p.mal = []
+            p.sig_target = NO_PEER
+            p.sig_meta = p.sig_payload = p.sig_gt = p.sig_since = 0
+
+    def load(self, members) -> None:
+        """scenario.Load mirror (Community.load_community)."""
+        for i in members:
+            self.peers[i].loaded = True
+
     # ---- the round ----------------------------------------------------------
 
     def step(self) -> None:
@@ -713,7 +737,9 @@ class OracleSim:
                     p.mal = []
                     p.global_time = 1
                     p.session += 1
-                    p.loaded = True   # app restart re-loads (engine)
+                    # rebirth = new participant; its join IS an explicit
+                    # load, auto_load notwithstanding (engine.unload_members)
+                    p.loaded = True
 
         # hard-kill state (engine mirror: derived from the post-churn store)
         if cfg.timeline_enabled:

@@ -39,12 +39,12 @@ import numpy as np
 
 from dispersy_tpu import checkpoint as ckpt
 from dispersy_tpu import engine
-from dispersy_tpu.config import (EMPTY_U32, META_AUTHORIZE, META_DESTROY,
+from dispersy_tpu.config import (META_AUTHORIZE, META_DESTROY,
                                  META_DYNAMIC,
                                  META_REVOKE, META_UNDO_OTHER, META_UNDO_OWN,
-                                 NO_PEER, CommunityConfig, perm_mask)
+                                 CommunityConfig, perm_mask)
 from dispersy_tpu.metrics import MetricsLog
-from dispersy_tpu.state import NEVER, PeerState, init_state
+from dispersy_tpu.state import PeerState, init_state
 
 
 def _mask(cfg: CommunityConfig, peers) -> jnp.ndarray:
@@ -251,35 +251,10 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
             _full(cfg, 0))
     elif isinstance(ev, Unload):
         m = np.isin(np.arange(cfg.n_peers), list(ev.members))
-        # Trackers are infrastructure, not community members: the
-        # reference's TrackerCommunity generically auto-joins EVERY
-        # community id it hears (tool/tracker.py) — it has no unload.
-        m &= np.arange(cfg.n_peers) >= cfg.n_trackers
-        mj = jnp.asarray(m)
-        m2 = mj[:, None]
-        state = state.replace(
-            loaded=jnp.where(mj, False, state.loaded),
-            # community-instance memory dies with the unload
-            cand_peer=jnp.where(m2, NO_PEER, state.cand_peer),
-            cand_last_walk=jnp.where(m2, NEVER, state.cand_last_walk),
-            cand_last_stumble=jnp.where(m2, NEVER,
-                                        state.cand_last_stumble),
-            cand_last_intro=jnp.where(m2, NEVER, state.cand_last_intro),
-            dly_gt=jnp.where(m2, jnp.uint32(EMPTY_U32), state.dly_gt),
-            dly_member=jnp.where(m2, jnp.uint32(EMPTY_U32), state.dly_member),
-            dly_meta=jnp.where(m2, jnp.uint32(EMPTY_U32), state.dly_meta),
-            dly_payload=jnp.where(m2, jnp.uint32(EMPTY_U32), state.dly_payload),
-            dly_aux=jnp.where(m2, jnp.uint32(0), state.dly_aux),
-            dly_since=jnp.where(m2, jnp.uint32(0), state.dly_since),
-            dly_src=jnp.where(m2, NO_PEER, state.dly_src),
-            sig_target=jnp.where(mj, NO_PEER, state.sig_target),
-            sig_meta=jnp.where(mj, jnp.uint32(0), state.sig_meta),
-            sig_payload=jnp.where(mj, jnp.uint32(0), state.sig_payload),
-            sig_gt=jnp.where(mj, jnp.uint32(0), state.sig_gt),
-            sig_since=jnp.where(mj, jnp.uint32(0), state.sig_since))
+        state = engine.unload_members(state, cfg, jnp.asarray(m))
     elif isinstance(ev, Load):
         m = np.isin(np.arange(cfg.n_peers), list(ev.members))
-        state = state.replace(loaded=jnp.asarray(m) | state.loaded)
+        state = engine.load_members(state, jnp.asarray(m))
     elif isinstance(ev, SetFault):
         kw = {}
         if ev.churn_rate is not None:

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from dispersy_tpu.config import EMPTY_U32, NO_PEER, CommunityConfig
@@ -180,6 +181,50 @@ def init_stats(n: int, n_meta: int = 8) -> Stats:
                  conflicts=z(), convictions_rx=z(),
                  bytes_up=z(), bytes_down=z(),
                  accepted_by_meta=jnp.zeros((n, n_meta + 1), jnp.uint32))
+
+
+# Community-INSTANCE memory: the one inventory of fields that die when
+# the community instance goes away while the database (store) persists.
+# Consumed by engine.unload_members (Community.unload_community) and
+# checkpoint._wipe_ephemeral (app-restart restore); the churn-rebirth
+# block in engine.step phase 0 wipes a SUPERSET of this (plus the store,
+# clocks, auth table, and loaded — a wiped-disk rebirth) and cross-refs
+# this list.  Fill kinds resolve per field dtype in wipe_instance_memory.
+INSTANCE_MEMORY_FIELDS: tuple = (
+    ("cand_peer", "no_peer"),
+    ("cand_last_walk", "never"),
+    ("cand_last_stumble", "never"),
+    ("cand_last_intro", "never"),
+    ("fwd_gt", "empty"), ("fwd_member", "empty"), ("fwd_meta", "empty"),
+    ("fwd_payload", "empty"), ("fwd_aux", "empty"),
+    ("sig_target", "no_peer"), ("sig_meta", "zero"),
+    ("sig_payload", "zero"), ("sig_gt", "zero"), ("sig_since", "zero"),
+    ("mal_member", "empty"),
+    ("dly_gt", "empty"), ("dly_member", "empty"), ("dly_meta", "empty"),
+    ("dly_payload", "empty"), ("dly_aux", "zero"), ("dly_since", "zero"),
+    ("dly_src", "no_peer"),
+)
+
+
+def wipe_instance_memory(state: PeerState, mask) -> PeerState:
+    """Fill every INSTANCE_MEMORY_FIELDS leaf with its empty value on the
+    masked rows (bool[n]); other rows untouched.
+
+    Array-library-preserving: numpy leaves stay numpy (checkpoint restore
+    promises host arrays so a mesh restore can shard before anything
+    lands on a device), jax leaves stay jax (engine.unload_members runs
+    on live device state)."""
+    n = np.shape(mask)[0]
+    fills = {"no_peer": NO_PEER, "never": NEVER, "empty": EMPTY_U32,
+             "zero": 0}
+    updates = {}
+    for name, kind in INSTANCE_MEMORY_FIELDS:
+        arr = getattr(state, name)
+        xp = np if isinstance(arr, np.ndarray) else jnp
+        m = xp.reshape(xp.asarray(mask), (n,) + (1,) * (arr.ndim - 1))
+        updates[name] = xp.where(m, xp.asarray(fills[kind], dtype=arr.dtype),
+                                 arr)
+    return state.replace(**updates)
 
 
 def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:

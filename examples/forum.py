@@ -1,0 +1,145 @@
+"""A worked rim-API example: a moderated forum overlay, end to end.
+
+The shape a reference user knows (community.py ``Community`` subclass +
+``initiate_meta_messages``), driven through this framework's batched
+runtime: declaration -> config compile -> init -> grants -> posts ->
+moderation (undo-other) -> a policy flip -> unload/reload -> checkpoint
+-> coverage and stats.  Small-N so it runs anywhere:
+
+    JAX_PLATFORMS=cpu python examples/forum.py
+
+Every call here is the migration-guide (MIGRATION.md) mapping of a
+reference API; comments name the reference symbol being exercised.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from dispersy_tpu import checkpoint
+from dispersy_tpu.community import (Community, CommunityDestination,
+                                    DynamicResolution, FullSyncDistribution,
+                                    LastSyncDistribution, LinearResolution,
+                                    MemberAuthentication, Message,
+                                    PublicResolution)
+from dispersy_tpu.metrics import snapshot
+
+N = 256          # peers (2 trackers + 254 members)
+FOUNDER = 2      # first member row (config.founder defaults to n_trackers)
+
+
+class ForumCommunity(Community):
+    """Three metas covering three policy corners (DebugCommunity style —
+    the reference's tests declare one meta per policy combination)."""
+
+    def initiate_meta_messages(self):
+        return [
+            # anyone may post; epidemic full-sync (community.py full-sync-text)
+            Message("post", MemberAuthentication(), PublicResolution(),
+                    FullSyncDistribution(synchronization_direction="ASC"),
+                    CommunityDestination(node_count=3)),
+            # only granted members may pin; founder can flip it public later
+            # (resolution.py DynamicResolution + dispersy-dynamic-settings)
+            Message("pin", MemberAuthentication(),
+                    DynamicResolution(LinearResolution(), PublicResolution()),
+                    FullSyncDistribution(),
+                    CommunityDestination(node_count=3)),
+            # mutable profile: keep only the newest per member
+            # (distribution.py LastSyncDistribution history_size=1)
+            Message("profile", MemberAuthentication(), PublicResolution(),
+                    LastSyncDistribution(history_size=1),
+                    CommunityDestination(node_count=3)),
+        ]
+
+
+def row_mask(i):
+    return jnp.asarray(np.arange(N) == i)
+
+
+def main():
+    comm = ForumCommunity(n_peers=N, n_trackers=2, k_candidates=8,
+                          msg_capacity=64, bloom_capacity=32,
+                          response_budget=8, k_authorized=8,
+                          founder_member=FOUNDER)
+    print(f"compiled config: n_meta={comm.config.n_meta} "
+          f"protected={comm.config.protected_meta_mask:#x} "
+          f"dynamic={comm.config.dynamic_meta_mask:#x} "
+          f"last_sync={comm.config.last_sync_history}")
+
+    state = comm.initialize(key=jax.random.PRNGKey(7), seed_degree=4)
+
+    # --- founder grants moderator powers (Community.create_authorize
+    # with (member, message, permission) triples; timeline.py quadruple)
+    MOD = 10
+    state = comm.create_authorize(
+        state, row_mask(FOUNDER),
+        [(MOD, "pin", "permit"),       # may pin
+         (MOD, "pin", "undo"),         # may undo others' pins
+         (MOD, "pin", "authorize")])   # may grant pin onward (delegation)
+    # the new moderator delegates pin-permit to member 11
+    # (the reference's recursive proof chain)
+    state = comm.create_authorize(state, row_mask(MOD),
+                                  [(11, "pin", "permit")])
+
+    # --- content (Community.create_<message>)
+    state = comm.create(state, "post", row_mask(20),
+                        payload=jnp.full(N, 1001, jnp.uint32))
+    post_gt = int(state.global_time[20])      # the record's Lamport time
+    state = comm.create(state, "pin", row_mask(MOD),
+                        payload=jnp.full(N, 9, jnp.uint32))
+    pin_gt = int(state.global_time[MOD])      # for the undo below
+
+    for _ in range(12):                        # let the overlay converge
+        state = comm.step(state)
+
+    post_cov = comm.coverage(state, 20, post_gt, "post", 1001)
+    print(f"after 12 rounds: post coverage {float(post_cov):.2%}")
+
+    # --- moderation: the moderator undoes its own pin, then the founder
+    # flips "pin" to PublicResolution (dispersy-dynamic-settings)
+    state = comm.create_undo_own(state, row_mask(MOD), target_gt=pin_gt)
+    state = comm.create_dynamic_settings(state, row_mask(FOUNDER),
+                                         "pin", "public")
+    for _ in range(6):      # the flip record must REACH a peer before
+        state = comm.step(state)   # that peer's own timeline allows it to pin
+    # now ANY member may pin (no grant needed)
+    state = comm.create(state, "pin", row_mask(42),
+                        payload=jnp.full(N, 77, jnp.uint32))
+    pin42_gt = int(state.global_time[42])
+
+    # --- lifecycle: peer 30 unloads the community instance
+    # (Community.unload_community), its database freezes, then traffic
+    # re-loads it (define_auto_load semantics)
+    state = comm.unload_community(state, row_mask(30))
+    state = comm.step(state)
+    state = comm.step(state)
+    print(f"peer 30 unloaded -> auto-reloaded: {bool(state.loaded[30])}")
+
+    # --- persistence (SQLite analogue: checkpoint.py)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "forum.npz")
+        checkpoint.save(path, state, comm.config)
+        state = checkpoint.restore(path, comm.config, fresh_candidates=True)
+    for _ in range(10):                        # re-walk from the trackers
+        state = comm.step(state)
+
+    snap = snapshot(state, comm.config)
+    print(f"after restart+10 rounds: walk_success={snap['walk_success']} "
+          f"stored={snap['msgs_stored']} "
+          f"candidate_fill={snap['candidate_fill']:.2f}")
+    pin_cov = comm.coverage(state, 42, pin42_gt, "pin", 77)
+    print(f"public-era pin coverage {float(pin_cov):.2%} "
+          f"(flip spread + post-restart catch-up)")
+    assert float(post_cov) > 0.9, "posts must reach the overlay"
+    assert float(pin_cov) > 0.9, "the flip must open pinning to everyone"
+    print("forum example: OK")
+
+
+if __name__ == "__main__":
+    main()

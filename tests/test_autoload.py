@@ -44,13 +44,7 @@ def both(cfg, seed=0, warm=4):
 def unload_both(state, oracle, cfg, members):
     """Apply the Unload op to the engine state AND its oracle mirror."""
     state, _ = SC._apply(state, cfg, SC.Unload(members=members), {}, {})
-    for i in members:
-        p = oracle.peers[i]
-        p.loaded = False
-        p.slots = [O.Slot() for _ in range(cfg.k_candidates)]
-        p.delay = []
-        p.sig_target = O.NO_PEER
-        p.sig_meta = p.sig_payload = p.sig_gt = p.sig_since = 0
+    oracle.unload(members)
     return state
 
 
@@ -150,6 +144,27 @@ def test_unload_never_touches_trackers():
     state, _ = SC._apply(state, cfg, SC.Unload(members=[0, U]), {}, {})
     assert bool(state.loaded[0]), "tracker must stay loaded"
     assert not bool(state.loaded[U])
+
+
+def test_restart_respects_explicit_unload(tmp_path):
+    """Restart semantics x auto_load: with auto_load ON a restart
+    re-loads every stored community (reference: Dispersy.start +
+    define_auto_load); with it OFF an explicit pre-crash Unload
+    survives the restart — only an explicit Load brings it back
+    (config.py contract)."""
+    from dispersy_tpu import checkpoint as CK
+    for auto, expect_loaded in ((True, True), (False, False)):
+        cfg = CFG.replace(auto_load=auto)
+        state, oracle = both(cfg)
+        state = run(state, oracle, cfg, 2, f"warm{auto}-")
+        state = unload_both(state, oracle, cfg, [U])
+        path = str(tmp_path / f"ckpt_{auto}.npz")
+        CK.save(path, state, cfg)
+        restored = CK.restore(path, cfg, fresh_candidates=True)
+        assert bool(restored.loaded[U]) == expect_loaded, \
+            f"auto_load={auto}: restart loaded[U] must be {expect_loaded}"
+        # everyone not explicitly unloaded is loaded either way
+        assert bool(restored.loaded[U + 1])
 
 
 def test_sig_request_triggers_autoload():

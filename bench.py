@@ -153,6 +153,10 @@ def _worker(n_peers_override: int | None = None) -> None:
         # Best-effort — the headline metric above is already secured.
         _hb("secondary: 8-community timeline config")
         try:
+            # The headline state is near the chip's comfortable limit at
+            # 1M peers; free it before allocating the second population
+            # or the secondary becomes the worker's likeliest OOM.
+            del state
             n_c = cfg.n_peers // 8
             cfg5 = cfg.replace(
                 n_trackers=8, communities=((n_c - 1, 1),) * 8,
@@ -211,8 +215,10 @@ def _try_worker(env: dict, timeout_s: int,
         return _parse_result(e.stdout), "backend ready: tpu" in err
     sys.stderr.write(proc.stderr[-4000:])
     progressed = "backend ready: tpu" in (proc.stderr or "")
-    if proc.returncode != 0:
-        return None, progressed
+    # rc != 0 still parses stdout: the headline JSON may already be there
+    # (a crash — e.g. OOM-kill — inside the best-effort secondary metric);
+    # salvage it exactly like the timeout branch rather than discard a
+    # completed measurement.
     return _parse_result(proc.stdout), progressed
 
 

@@ -19,6 +19,13 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # The env var alone is not enough where a TPU-tunnel sitecustomize
+    # prepends its backend to jax_platforms — pin the live config too
+    # (same workaround as tests/conftest.py).
+    jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import jax.numpy as jnp
 

@@ -1,0 +1,141 @@
+"""Seeded config-space fuzz: random knobs, random traffic, trace-equal.
+
+The reference pins behavior with a hand-picked policy matrix
+(tests/debugcommunity/community.py: one message per policy combination);
+test_full_matrix.py ports that.  This file widens it mechanically: a
+seeded RNG draws whole CommunityConfigs (population, capacities, fault
+rates, NAT mix, claim strategy, policy masks) and a random create/unload
+schedule, and every drawn overlay must stay bit-exact against the CPU
+oracle every round.  Interaction bugs that only appear at odd capacity
+ratios or fault combinations land here instead of in a driver run.
+
+Deterministic (fixed seeds) so failures reproduce; each draw prints its
+config repr on failure via the assert message.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispersy_tpu import engine as E
+from dispersy_tpu import state as S
+from dispersy_tpu.config import CommunityConfig, perm_bit
+from dispersy_tpu.oracle import sim as O
+from dispersy_tpu.scenario import Unload, Load, _apply
+
+from test_oracle import assert_match
+
+N_DRAWS = 5
+ROUNDS = 12
+
+
+def draw_config(rng: np.random.Generator) -> CommunityConfig:
+    n_trackers = int(rng.integers(1, 3))
+    n_peers = n_trackers + int(rng.integers(10, 36))
+    timeline = bool(rng.integers(0, 2))
+    kw = dict(
+        n_peers=n_peers, n_trackers=n_trackers,
+        k_candidates=int(rng.choice([4, 8])),
+        msg_capacity=int(rng.choice([16, 32])),
+        bloom_capacity=int(rng.choice([8, 16])),
+        request_inbox=int(rng.choice([2, 4])),
+        tracker_inbox=int(rng.choice([4, 8])),
+        response_budget=int(rng.choice([2, 6])),
+        forward_fanout=int(rng.choice([0, 2, 3])),
+        sync_strategy=str(rng.choice(["largest", "modulo"])),
+        churn_rate=float(rng.choice([0.0, 0.05])),
+        packet_loss=float(rng.choice([0.0, 0.15, 0.3])),
+        p_symmetric=float(rng.choice([0.0, 0.3])),
+        auto_load=bool(rng.integers(0, 2)),
+        n_meta=4,
+        desc_meta_mask=int(rng.choice([0, 0b1000])),
+        meta_priority=(128, 128, int(rng.choice([64, 200])), 128),
+        last_sync_history=(0, 0, 0, int(rng.choice([0, 2]))),
+    )
+    if kw["last_sync_history"][3]:
+        kw["desc_meta_mask"] = 0      # a meta is LastSync OR DESC FullSync
+    if timeline:
+        kw.update(timeline_enabled=True, k_authorized=4,
+                  protected_meta_mask=0b10, founder_member=-1,
+                  delay_inbox=int(rng.choice([0, 2])))
+    if bool(rng.integers(0, 2)):
+        kw["seq_meta_mask"] = 0b100 if not kw["desc_meta_mask"] else 0
+        # the active round trip needs the pen, which needs the timeline
+        if (kw["seq_meta_mask"] and timeline and kw.get("delay_inbox")
+                and bool(rng.integers(0, 2))):
+            kw["seq_requests"] = True
+    if kw["churn_rate"] == 0.0 and bool(rng.integers(0, 2)):
+        kw.update(malicious_enabled=True, k_malicious=4)
+    return CommunityConfig(**kw)
+
+
+def run_draw(seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    cfg = draw_config(rng)
+    n = cfg.n_peers
+    state = S.init_state(cfg, jax.random.PRNGKey(seed))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=4)
+    oracle.seed_overlay(degree=4)
+
+    founder = cfg.n_trackers
+    if cfg.timeline_enabled:
+        # the founder grants meta-1 permit to two random members so the
+        # protected meta sees both accepted and rejected records
+        targets = rng.integers(cfg.n_trackers, n, size=2)
+        for t in sorted(set(int(x) for x in targets)):
+            mask = np.arange(n) == founder
+            pl = np.full(n, t, np.uint32)
+            ax = np.full(n, perm_bit(1, "permit"), np.uint32)
+            state = E.create_messages(state, cfg, jnp.asarray(mask),
+                                      E_META_AUTHORIZE, jnp.asarray(pl),
+                                      jnp.asarray(ax))
+            oracle.create_messages(mask, E_META_AUTHORIZE, pl, aux=ax)
+
+    for rnd in range(ROUNDS):
+        # random traffic: ~2 authors, random meta among the declared 4
+        for _ in range(2):
+            author = int(rng.integers(cfg.n_trackers, n))
+            meta = int(rng.integers(0, cfg.n_meta))
+            payload = int(rng.integers(1, 1 << 16))
+            mask = np.arange(n) == author
+            pl = np.full(n, payload, np.uint32)
+            state = E.create_messages(state, cfg, jnp.asarray(mask), meta,
+                                      jnp.asarray(pl))
+            oracle.create_messages(mask, meta, pl)
+        if rnd == 4:     # mid-run lifecycle event
+            victim = [int(rng.integers(cfg.n_trackers, n))]
+            state, _ = _apply(state, cfg, Unload(members=victim), {}, {})
+            oracle.unload(victim)
+        if rnd == 8 and not cfg.auto_load:
+            everyone = list(range(cfg.n_trackers, n))
+            state, _ = _apply(state, cfg, Load(members=everyone), {}, {})
+            oracle.load(everyone)
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle,
+                     f"seed{seed}-round{rnd} cfg={cfg!r}")
+
+
+# resolved at import so draw bodies stay readable
+from dispersy_tpu.config import META_AUTHORIZE as E_META_AUTHORIZE  # noqa: E402
+
+
+def test_fuzz_draw_0():
+    run_draw(1000)
+
+
+def test_fuzz_draw_1():
+    run_draw(1001)
+
+
+def test_fuzz_draw_2():
+    run_draw(1002)
+
+
+def test_fuzz_draw_3():
+    run_draw(1003)
+
+
+def test_fuzz_draw_4():
+    run_draw(1004)

@@ -1,0 +1,73 @@
+"""The three wipe paths share one inventory — pin it mechanically.
+
+``state.INSTANCE_MEMORY_FIELDS`` is consumed by ``engine.unload_members``
+and ``checkpoint._wipe_ephemeral`` by construction; the churn-rebirth
+block inside ``engine.step`` phase 0 is hand-fused for speed and only
+*promises* (engine.py comment) to wipe a superset.  These tests make the
+promise mechanical: pollute every inventory leaf, force a rebirth of the
+whole membership, and require every leaf back at its fresh-init value —
+so adding an ephemeral leaf to the inventory without teaching the rebirth
+block (or vice versa) fails a test instead of silently splitting the
+restart semantics (reference: candidates/request-cache/pen die with the
+process, SURVEY §5.4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispersy_tpu import engine as E
+from dispersy_tpu import state as S
+from dispersy_tpu.config import CommunityConfig
+
+CFG = CommunityConfig(
+    n_peers=16, n_trackers=2, msg_capacity=8, bloom_capacity=8,
+    k_candidates=4, request_inbox=2, tracker_inbox=4, response_budget=2,
+    delay_inbox=2, malicious_enabled=True, timeline_enabled=True,
+    k_authorized=4, founder_member=-1,
+    # a quiet round: nothing may repopulate instance memory post-wipe
+    walker_enabled=False, sync_enabled=False, forward_fanout=0)
+
+
+def _pollute(state):
+    """Garbage in every inventory leaf (valid dtypes, non-init values)."""
+    updates = {}
+    for name, _ in S.INSTANCE_MEMORY_FIELDS:
+        arr = np.asarray(getattr(state, name))
+        updates[name] = jnp.asarray(np.full_like(arr, 1))
+    return state.replace(**updates)
+
+
+def test_rebirth_wipes_every_instance_memory_leaf():
+    cfg = CFG.replace(churn_rate=1.0)   # every member reborn this round
+    fresh = S.init_state(cfg, jax.random.PRNGKey(0))
+    out = E.step(_pollute(fresh), cfg)
+    members = np.arange(cfg.n_peers) >= cfg.n_trackers
+    assert np.asarray(out.session)[members].min() >= 1, \
+        "churn_rate=1.0 must rebirth every member"
+    for name, _ in S.INSTANCE_MEMORY_FIELDS:
+        got = np.asarray(getattr(out, name))[members]
+        want = np.asarray(getattr(fresh, name))[members]
+        assert (got == want).all(), \
+            f"rebirth left instance-memory leaf {name!r} unwiped"
+
+
+def test_unload_wipes_every_instance_memory_leaf():
+    fresh = S.init_state(CFG, jax.random.PRNGKey(0))
+    out = E.unload_members(_pollute(fresh), CFG,
+                           np.arange(CFG.n_peers) >= CFG.n_trackers)
+    members = np.arange(CFG.n_peers) >= CFG.n_trackers
+    for name, _ in S.INSTANCE_MEMORY_FIELDS:
+        got = np.asarray(getattr(out, name))[members]
+        want = np.asarray(getattr(fresh, name))[members]
+        assert (got == want).all(), name
+    # trackers excluded: their (polluted) rows stay untouched
+    t = ~members
+    assert (np.asarray(out.cand_peer)[t] == 1).all()
+
+
+def test_inventory_names_are_real_state_leaves():
+    fresh = S.init_state(CFG, jax.random.PRNGKey(0))
+    for name, kind in S.INSTANCE_MEMORY_FIELDS:
+        assert hasattr(fresh, name), name
+        assert kind in ("no_peer", "never", "empty", "zero"), (name, kind)

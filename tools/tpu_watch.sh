@@ -1,0 +1,93 @@
+#!/bin/bash
+# Tunnel watcher: poll the axon TPU tunnel and, the moment it answers,
+# capture TPU artifacts in an escalating ladder — smallest first, so a
+# flaky window still yields SOMETHING dated and real:
+#
+#   1. bench worker @ 65,536 peers   (also measures step-compile time)
+#   2. full bench (1M with bench.py's own retry/population ladder)
+#   3. convergence config #2 @ 1M    (rounds-to-99% at the north-star N)
+#   4. config #4 (1M walker churn) -> #5 (1M x 8 communities) -> #3 (100k
+#      x 1k backlog — the heavy merge-insert shape, most compile risk)
+#
+# Serialized by design (one process may hold the tunnel grant; a killed
+# holder wedges it until a server-side timeout), each stage bounded, and
+# a stage failure backs off and re-probes rather than hammering a dying
+# tunnel.  artifacts/tpu_watch.running marks a capture in flight so an
+# interactive operator knows not to touch the tunnel.
+#
+# Usage:  WATCH_HOURS=8 bash tools/tpu_watch.sh   (logs: artifacts/tpu_watch.log)
+
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts
+LOG=artifacts/tpu_watch.log
+MARK=artifacts/tpu_watch.running
+DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-8} * 3600 ))
+trap 'rm -f "$MARK"' EXIT
+
+say() { echo "[tpu_watch $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+probe() {
+  timeout 120 python -c \
+    "import jax,sys; sys.exit(0 if jax.devices()[0].platform=='tpu' else 1)" \
+    >/dev/null 2>&1
+}
+
+stage() {  # stage <name> <timeout_s> <outfile|-> cmd...
+  local name=$1 tmo=$2 out=$3; shift 3
+  say "stage $name: $*"
+  local t0=$(date +%s)
+  if [ "$out" = "-" ]; then
+    timeout "$tmo" "$@" >>"$LOG" 2>&1
+  else
+    timeout "$tmo" "$@" >"$out" 2>>"$LOG"
+  fi
+  local rc=$?
+  say "stage $name: rc=$rc after $(( $(date +%s) - t0 ))s"
+  return $rc
+}
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if ! probe; then
+    say "tunnel down; sleeping 300"
+    sleep 300
+    continue
+  fi
+  say "tunnel UP — starting capture ladder"
+  touch "$MARK"
+
+  if ! stage bench64k 1200 artifacts/bench_tpu_64k.json \
+       python bench.py --worker --n-peers 65536; then
+    rm -f "$MARK"; say "small bench failed; backing off 600s"; sleep 600
+    continue
+  fi
+  # the direct --worker call bypasses bench.py's platform guard: a worker
+  # whose jax silently fell back to CPU exits 0 with platform "cpu" —
+  # that is NOT a TPU capture, and the 1M stages would hammer a dead tunnel
+  if ! grep -q '"platform": "tpu"' artifacts/bench_tpu_64k.json; then
+    mv artifacts/bench_tpu_64k.json artifacts/bench_64k_cpu_fallback.json
+    rm -f "$MARK"; say "worker resolved CPU, not TPU; backing off 600s"
+    sleep 600
+    continue
+  fi
+  say "bench64k: $(tail -c 300 artifacts/bench_tpu_64k.json)"
+
+  BENCH_TPU_TIMEOUT=1800 BENCH_TOTAL_BUDGET=4500 \
+    stage bench1M 4600 artifacts/bench_tpu_manual.json python bench.py \
+    && say "bench1M: $(tail -c 300 artifacts/bench_tpu_manual.json)"
+
+  stage cfg2_1M 2400 - python tools/convergence.py --config 2 --scale 100 \
+       --out artifacts/convergence_1M_broadcast_tpu.json
+  stage cfg4 2400 - python tools/convergence.py --config 4 \
+       --out artifacts/walker_churn_cfg4_tpu.json
+  stage cfg5 3000 - python tools/convergence.py --config 5 \
+       --out artifacts/communities_timeline_cfg5_tpu.json
+  stage cfg3 3000 - python tools/convergence.py --config 3 \
+       --out artifacts/convergence_cfg3_tpu.json
+
+  rm -f "$MARK"
+  say "capture ladder complete"
+  exit 0
+done
+say "deadline reached without a completed ladder"
+exit 1

@@ -25,16 +25,22 @@ from dispersy_tpu.scenario import Unload, Load, _apply
 
 from test_oracle import assert_match
 
-N_DRAWS = 5
 ROUNDS = 12
 
 
 def draw_config(rng: np.random.Generator) -> CommunityConfig:
-    n_trackers = int(rng.integers(1, 3))
-    n_peers = n_trackers + int(rng.integers(10, 36))
+    multi = bool(rng.integers(0, 2))     # two row blocks vs one community
+    if multi:
+        m1, m2 = (int(x) for x in rng.integers(6, 15, size=2))
+        blocks = dict(communities=((m1, 1), (m2, 1)))
+        n_trackers, n_peers = 2, m1 + m2 + 2
+    else:
+        blocks = {}
+        n_trackers = int(rng.integers(1, 3))
+        n_peers = n_trackers + int(rng.integers(10, 36))
     timeline = bool(rng.integers(0, 2))
     kw = dict(
-        n_peers=n_peers, n_trackers=n_trackers,
+        n_peers=n_peers, n_trackers=n_trackers, **blocks,
         k_candidates=int(rng.choice([4, 8])),
         msg_capacity=int(rng.choice([16, 32])),
         bloom_capacity=int(rng.choice([8, 16])),
@@ -78,19 +84,24 @@ def run_draw(seed: int) -> None:
     state = E.seed_overlay(state, cfg, degree=4)
     oracle.seed_overlay(degree=4)
 
-    founder = cfg.n_trackers
     if cfg.timeline_enabled:
-        # the founder grants meta-1 permit to two random members so the
-        # protected meta sees both accepted and rejected records
-        targets = rng.integers(cfg.n_trackers, n, size=2)
-        for t in sorted(set(int(x) for x in targets)):
-            mask = np.arange(n) == founder
-            pl = np.full(n, t, np.uint32)
-            ax = np.full(n, perm_bit(1, "permit"), np.uint32)
-            state = E.create_messages(state, cfg, jnp.asarray(mask),
-                                      E_META_AUTHORIZE, jnp.asarray(pl),
-                                      jnp.asarray(ax))
-            oracle.create_messages(mask, E_META_AUTHORIZE, pl, aux=ax)
+        # each block's founder grants meta-1 permit to two random members
+        # of its own block, so the protected meta sees both accepted and
+        # rejected records (multi-community draws: one founder per block)
+        mem_base = np.asarray(cfg.layout()[3])
+        for f in sorted({int(b) for b in mem_base[cfg.n_trackers:]}):
+            rows = np.flatnonzero(mem_base == f)
+            rows = rows[rows >= cfg.n_trackers]
+            targets = rng.choice(rows, size=min(2, len(rows)),
+                                 replace=False)
+            for t in sorted(set(int(x) for x in targets)):
+                mask = np.arange(n) == f
+                pl = np.full(n, t, np.uint32)
+                ax = np.full(n, perm_bit(1, "permit"), np.uint32)
+                state = E.create_messages(state, cfg, jnp.asarray(mask),
+                                          E_META_AUTHORIZE, jnp.asarray(pl),
+                                          jnp.asarray(ax))
+                oracle.create_messages(mask, E_META_AUTHORIZE, pl, aux=ax)
 
     for rnd in range(ROUNDS):
         # random traffic: ~2 authors, random meta among the declared 4
@@ -139,3 +150,15 @@ def test_fuzz_draw_3():
 
 def test_fuzz_draw_4():
     run_draw(1004)
+
+
+def test_fuzz_draw_5():
+    run_draw(1005)
+
+
+def test_fuzz_draw_6():
+    run_draw(1006)
+
+
+def test_fuzz_draw_7():
+    run_draw(1007)

@@ -1,0 +1,238 @@
+"""Real multi-PROCESS execution of the sharded step (jax.distributed).
+
+Every multi-chip artifact so far runs SPMD inside ONE process over virtual
+devices; the reference's deployment crosses process/host boundaries
+(reference: one Dispersy process per peer over UDP; tool/scenarioscript.py
+DAS4 runs).  This tool closes that gap at the runtime level: it launches
+``--num-processes`` worker processes, each owning 4 virtual CPU devices,
+joins them into one ``jax.distributed`` cluster (the same TCP coordination
+service a multi-host TPU pod uses), builds ONE global 1-D peer mesh across
+all processes, and runs the FULL everything-on step on globally sharded
+state — so the delivery kernel's sort-by-receiver lowers to cross-process
+collectives, the exact mechanism a v5e multi-host deployment rides over
+DCN (parallel/mesh.py docstring; SURVEY §5.8).
+
+Verification is bit-exact: each worker also advances its own full local
+single-device copy of the same state and compares EVERY leaf of the
+allgathered sharded result against it after every round.  Passing means
+the cross-process execution is indistinguishable from the single-device
+one — the property the per-round sharded==single tests pin in-process,
+now pinned across processes.
+
+Usage:
+    python tools/multihost.py --out artifacts/multihost_cpu.json
+    python tools/multihost.py --num-processes 2 --peers 256 --rounds 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dispersy_tpu.cpuenv import cpu_env  # jax-free import
+
+WORKER_TIMEOUT_S = int(os.environ.get("MULTIHOST_TIMEOUT", "1500"))
+DEVICES_PER_PROCESS = 4
+
+
+def _everything_on_config(n_peers: int):
+    """The dryrun's everything-on shape (a SUPERSET of
+    ``__graft_entry__``'s fcfg: identity records on, plus a two-block
+    multi-community layout on top): all four policy axes, pens, faults,
+    NAT, identity, gossiped convictions, two communities."""
+    from dispersy_tpu.config import CommunityConfig
+    half = n_peers // 2
+    return CommunityConfig(
+        n_peers=n_peers, n_trackers=2,
+        communities=((half - 1, 1), (n_peers - half - 1, 1)),
+        k_candidates=8, msg_capacity=32,
+        bloom_capacity=16, request_inbox=4, tracker_inbox=16,
+        response_budget=4, n_meta=8, timeline_enabled=True, k_authorized=8,
+        protected_meta_mask=0b10, dynamic_meta_mask=0b100,
+        double_meta_mask=0b100, sig_inbox=2,
+        last_sync_history=(0, 0, 0, 2, 0, 0, 0, 0),
+        seq_meta_mask=0b1000000, seq_requests=True,
+        delay_inbox=2, proof_requests=True, identity_enabled=True,
+        malicious_enabled=True, k_malicious=4, malicious_gossip=True,
+        churn_rate=0.03, packet_loss=0.1, p_symmetric=0.2)
+
+
+def _worker(args) -> None:
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{args.port}",
+        num_processes=args.num_processes,
+        process_id=args.process_id)
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from dispersy_tpu import engine
+    from dispersy_tpu.parallel.mesh import make_mesh, state_sharding
+    from dispersy_tpu.state import init_state
+
+    def hb(msg):
+        print(f"[worker {args.process_id} +{time.strftime('%H:%M:%S')}] "
+              f"{msg}", flush=True)
+
+    n_local = len(jax.local_devices())
+    n_global = len(jax.devices())
+    hb(f"cluster up: {n_local} local / {n_global} global devices")
+    assert n_global == args.num_processes * DEVICES_PER_PROCESS
+
+    cfg = _everything_on_config(args.peers)
+    # Deterministic full state, identically computed by every process on
+    # its own devices (single-device local arrays).
+    local = init_state(cfg, jax.random.PRNGKey(3))
+    local = engine.seed_overlay(local, cfg, degree=4)
+    authors = jnp.arange(cfg.n_peers) % 16 == 5
+    local = engine.create_messages(
+        local, cfg, author_mask=authors, meta=0,
+        payload=jnp.arange(cfg.n_peers, dtype=jnp.uint32))
+    local = jax.block_until_ready(local)
+    hb("local reference state ready")
+
+    # Lift the same values into GLOBAL arrays sharded across the whole
+    # cluster: every process donates the shards it owns.
+    mesh = make_mesh()                      # all global devices
+    shardings = state_sharding(local, mesh, cfg.n_peers)
+
+    def to_global(leaf, sh):
+        arr = np.asarray(leaf)
+        return jax.make_array_from_callback(arr.shape, sh,
+                                            lambda idx: arr[idx])
+    gstate = jax.tree.map(to_global, local, shardings)
+    hb("global sharded state assembled")
+
+    step_sharded = jax.jit(engine.step, static_argnums=1,
+                           in_shardings=(shardings,),
+                           out_shardings=shardings)
+    t0 = time.time()
+    for rnd in range(args.rounds):
+        gstate = jax.block_until_ready(step_sharded(gstate, cfg))
+        if args.process_id == 0:
+            # Only rank 0 pays for the full single-device replay — the
+            # replicas would be bit-identical on every rank anyway
+            # (same PRNGKey), and the parent requires rank 0's rc.
+            local = jax.block_until_ready(engine.step(local, cfg))
+        if rnd == 0:
+            hb(f"round 0 done (+{time.time() - t0:.1f}s incl. compiles)")
+        # Bit-exact cross-check.  process_allgather is a COLLECTIVE —
+        # every rank participates; only the numpy compare is rank-0-only.
+        gathered = jax.tree.map(
+            lambda g: multihost_utils.process_allgather(g, tiled=True),
+            gstate)
+        if args.process_id == 0:
+            mism = [
+                path for (path, a), b in zip(
+                    jax.tree_util.tree_flatten_with_path(gathered)[0],
+                    jax.tree_util.tree_leaves(local))
+                if not np.array_equal(np.asarray(a), np.asarray(b))]
+            assert not mism, f"round {rnd}: sharded != local at {mism}"
+            hb(f"round {rnd}: {len(jax.tree_util.tree_leaves(local))} "
+               f"leaves bit-equal across {args.num_processes} processes")
+    print(f"[worker {args.process_id}] OK", flush=True)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-processes", type=int, default=2)
+    ap.add_argument("--peers", type=int, default=256)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--out", default="artifacts/multihost_cpu.json")
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--process-id", type=int, default=0)
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+    if args.worker:
+        _worker(args)
+        return
+
+    env = cpu_env(n_devices=DEVICES_PER_PROCESS)
+    t0 = time.time()
+    for attempt in range(2):   # one retry for the port-grab race below
+        port = _free_port()
+        # Workers write to FILES, not pipes: a pipe nobody drains fills at
+        # ~64KB of heartbeats and blocks the writer mid-collective,
+        # hanging the whole cluster.  Each worker is its own process
+        # group so a timeout can kill the full tree (the virtual-CPU
+        # communicator can deadlock — parallel/mesh.py caveat).
+        logs = [f"/tmp/multihost_w{i}_{port}.log"
+                for i in range(args.num_processes)]
+        procs = []
+        for i in range(args.num_processes):
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker",
+                 "--process-id", str(i), "--port", str(port),
+                 "--num-processes", str(args.num_processes),
+                 "--peers", str(args.peers), "--rounds", str(args.rounds)],
+                env=env, stdout=open(logs[i], "w"),
+                stderr=subprocess.STDOUT, start_new_session=True))
+        deadline = time.time() + WORKER_TIMEOUT_S
+        ok = True
+        for p in procs:
+            try:
+                p.wait(timeout=max(1.0, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                ok = False
+        if not ok:
+            import signal
+            for p in procs:
+                if p.poll() is None:
+                    try:
+                        os.killpg(p.pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+                p.wait()
+        ok = ok and all(p.returncode == 0 for p in procs)
+        outs = []
+        for lg in logs:
+            with open(lg) as f:
+                outs.append(f.read())
+        # _free_port closes its probe socket before the coordinator
+        # rebinds (TOCTOU): if the coordinator lost the port to another
+        # process, retry once on a fresh one.
+        bind_race = any("address already in use" in o.lower() for o in outs)
+        if ok or not bind_race:
+            break
+        sys.stderr.write("coordinator port was taken; retrying on a "
+                         "fresh port\n")
+    wall = time.time() - t0
+    for i, out in enumerate(outs):
+        sys.stderr.write(f"--- worker {i} ---\n{out[-3000:]}\n")
+    doc = {
+        "tool": "multihost",
+        "num_processes": args.num_processes,
+        "devices_per_process": DEVICES_PER_PROCESS,
+        "n_peers": args.peers,
+        "rounds": args.rounds,
+        "bit_equal_vs_single_device": ok,
+        "wall_seconds": round(wall, 1),
+        "config": "everything-on (all policy axes, pens, faults, NAT, "
+                  "identity, 2 communities)",
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -76,6 +76,8 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     stage bench1M 4600 artifacts/bench_tpu_manual.json python bench.py \
     && say "bench1M: $(tail -c 300 artifacts/bench_tpu_manual.json)"
 
+  stage profile_trace 2400 - python tools/profile.py --tpu --mode trace \
+       --out artifacts/profile_tpu_trace.json
   stage cfg2_1M 2400 - python tools/convergence.py --config 2 --scale 100 \
        --out artifacts/convergence_1M_broadcast_tpu.json
   stage cfg4 2400 - python tools/convergence.py --config 4 \

@@ -64,6 +64,20 @@ def _everything_on_config(n_peers: int):
         churn_rate=0.03, packet_loss=0.1, p_symmetric=0.2)
 
 
+def _broadcast_config(n_peers: int):
+    """Config #2's knob shape (the same CommunityConfig literal as
+    tools/convergence.broadcast_curve — keep in sync).  The run here is
+    an INDEPENDENT instance of the experiment (different seed, meta, and
+    author row than artifacts/convergence_cfg2.json), so a matching
+    rounds-to-99% count demonstrates the metric's robustness across
+    instances, not a bit replay of that artifact."""
+    from dispersy_tpu.config import CommunityConfig
+    return CommunityConfig(
+        n_peers=n_peers, n_trackers=2, k_candidates=16, msg_capacity=16,
+        bloom_capacity=16, request_inbox=8,
+        tracker_inbox=max(64, n_peers // 64), response_budget=8)
+
+
 def _worker(args) -> None:
     import jax
 
@@ -88,15 +102,24 @@ def _worker(args) -> None:
     hb(f"cluster up: {n_local} local / {n_global} global devices")
     assert n_global == args.num_processes * DEVICES_PER_PROCESS
 
-    cfg = _everything_on_config(args.peers)
+    if args.mode == "broadcast":
+        cfg = _broadcast_config(args.peers)
+        author = cfg.n_trackers + 1
+        authors = jnp.arange(cfg.n_peers) == author
+    else:
+        cfg = _everything_on_config(args.peers)
+        authors = jnp.arange(cfg.n_peers) % 16 == 5
     # Deterministic full state, identically computed by every process on
     # its own devices (single-device local arrays).
     local = init_state(cfg, jax.random.PRNGKey(3))
-    local = engine.seed_overlay(local, cfg, degree=4)
-    authors = jnp.arange(cfg.n_peers) % 16 == 5
+    local = engine.seed_overlay(local, cfg, degree=4 if args.mode != "broadcast" else 8)
     local = engine.create_messages(
         local, cfg, author_mask=authors, meta=0,
-        payload=jnp.arange(cfg.n_peers, dtype=jnp.uint32))
+        payload=jnp.full(cfg.n_peers, 42, jnp.uint32)
+        if args.mode == "broadcast"
+        else jnp.arange(cfg.n_peers, dtype=jnp.uint32))
+    gt0 = int(local.global_time[cfg.n_trackers + 1]) \
+        if args.mode == "broadcast" else 0
     local = jax.block_until_ready(local)
     hb("local reference state ready")
 
@@ -116,6 +139,7 @@ def _worker(args) -> None:
                            in_shardings=(shardings,),
                            out_shardings=shardings)
     t0 = time.time()
+    curve = []
     for rnd in range(args.rounds):
         gstate = jax.block_until_ready(step_sharded(gstate, cfg))
         if args.process_id == 0:
@@ -139,6 +163,21 @@ def _worker(args) -> None:
             assert not mism, f"round {rnd}: sharded != local at {mism}"
             hb(f"round {rnd}: {len(jax.tree_util.tree_leaves(local))} "
                f"leaves bit-equal across {args.num_processes} processes")
+        if args.mode == "broadcast":
+            # Every rank computes coverage from the GATHERED (full)
+            # state so the early-exit decision is identical everywhere —
+            # a rank-0-only break would leave the others blocked in the
+            # next collective.
+            cov = float(engine.coverage(
+                gathered, member=cfg.n_trackers + 1, gt=gt0, meta=0,
+                payload=42))
+            curve.append(round(cov, 6))
+            if args.process_id == 0:
+                hb(f"round {rnd}: coverage {cov:.4f}")
+            if cov >= 0.99:
+                break
+    if args.process_id == 0 and args.mode == "broadcast":
+        print("CURVE " + json.dumps(curve), flush=True)
     print(f"[worker {args.process_id}] OK", flush=True)
 
 
@@ -155,6 +194,10 @@ def main() -> None:
     ap.add_argument("--num-processes", type=int, default=2)
     ap.add_argument("--peers", type=int, default=256)
     ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--mode", choices=["everything-on", "broadcast"],
+                    default="everything-on",
+                    help="broadcast = config #2's rounds-to-99% metric, "
+                         "measured ON the cluster")
     ap.add_argument("--out", default="artifacts/multihost_cpu.json")
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--process-id", type=int, default=0)
@@ -181,7 +224,8 @@ def main() -> None:
                 [sys.executable, os.path.abspath(__file__), "--worker",
                  "--process-id", str(i), "--port", str(port),
                  "--num-processes", str(args.num_processes),
-                 "--peers", str(args.peers), "--rounds", str(args.rounds)],
+                 "--peers", str(args.peers), "--rounds", str(args.rounds),
+                 "--mode", args.mode],
                 env=env, stdout=open(logs[i], "w"),
                 stderr=subprocess.STDOUT, start_new_session=True))
         deadline = time.time() + WORKER_TIMEOUT_S
@@ -218,15 +262,26 @@ def main() -> None:
         sys.stderr.write(f"--- worker {i} ---\n{out[-3000:]}\n")
     doc = {
         "tool": "multihost",
+        "mode": args.mode,
         "num_processes": args.num_processes,
         "devices_per_process": DEVICES_PER_PROCESS,
         "n_peers": args.peers,
-        "rounds": args.rounds,
+        "rounds_requested": args.rounds,
         "bit_equal_vs_single_device": ok,
         "wall_seconds": round(wall, 1),
-        "config": "everything-on (all policy axes, pens, faults, NAT, "
-                  "identity, 2 communities)",
+        "config": ("config #2 broadcast (rounds-to-99% measured on the "
+                   "cluster)" if args.mode == "broadcast" else
+                   "everything-on (all policy axes, pens, faults, NAT, "
+                   "identity, 2 communities)"),
     }
+    for line in outs[0].splitlines() if outs else []:
+        if line.startswith("CURVE "):
+            curve = json.loads(line[6:])
+            doc["curve"] = curve
+            doc["rounds_run"] = len(curve)   # early-exit at 99%
+            doc["rounds_to_99pct"] = (
+                next((i + 1 for i, c in enumerate(curve) if c >= 0.99),
+                     None))
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=1)

@@ -1,0 +1,74 @@
+"""Bulk config-space fuzz: N random overlays, each bit-exact vs oracle.
+
+Reuses tests/test_fuzz_configs.py's draw/run machinery at sweep scale:
+where CI pins 8 deterministic draws, this runs an arbitrary seed range
+(default 50 draws) and writes a pass/skip/fail tally — bulk evidence
+that the engine==oracle bit-equality holds across the config space, not
+just at hand-picked points.  Invalid knob combinations (ConfigError)
+count as skips: the validator rejecting them is correct behavior.
+
+Usage:
+    python tools/fuzz_sweep.py --start 2000 --count 50 \
+        --out artifacts/fuzz_sweep.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+from dispersy_tpu.exceptions import ConfigError  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--start", type=int, default=2000)
+    ap.add_argument("--count", type=int, default=50)
+    ap.add_argument("--out", default="artifacts/fuzz_sweep.json")
+    args = ap.parse_args()
+
+    from test_fuzz_configs import run_draw   # pulls in jax (CPU-pinned)
+
+    passed, skipped, failed = [], [], []
+    t0 = time.time()
+    for seed in range(args.start, args.start + args.count):
+        t1 = time.time()
+        try:
+            run_draw(seed)
+            passed.append(seed)
+            verdict = "pass"
+        except ConfigError as e:
+            skipped.append(seed)
+            verdict = f"skip ({e})"
+        except Exception:
+            failed.append(seed)
+            verdict = "FAIL"
+            traceback.print_exc()
+        print(f"[fuzz_sweep] seed {seed}: {verdict} "
+              f"({time.time() - t1:.1f}s)", flush=True)
+        # incremental artifact: a killed sweep still reports its tally
+        doc = {
+            "tool": "fuzz_sweep", "seed_start": args.start,
+            "seeds_run": seed - args.start + 1,
+            "passed": len(passed), "skipped_invalid_config": len(skipped),
+            "failed": len(failed), "failed_seeds": failed,
+            "wall_seconds": round(time.time() - t0, 1),
+        }
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, args.out)
+    print(json.dumps(doc))
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -36,10 +36,18 @@ def main() -> None:
     args = ap.parse_args()
 
     from test_fuzz_configs import run_draw   # pulls in jax (CPU-pinned)
+    import jax
 
     passed, skipped, failed = [], [], []
     t0 = time.time()
-    for seed in range(args.start, args.start + args.count):
+    for i, seed in enumerate(range(args.start, args.start + args.count)):
+        if i and i % 10 == 0:
+            # Every drawn config compiles a full fresh step program;
+            # 100+ of them in one process exhaust LLVM's code memory
+            # (observed: "LLVM compilation error: Cannot allocate
+            # memory" at draw ~52 of a 100-draw run).  Dropping the
+            # in-process caches bounds the growth.
+            jax.clear_caches()
         t1 = time.time()
         try:
             run_draw(seed)

@@ -134,14 +134,19 @@ def _wipe_ephemeral(state: PeerState, cfg: CommunityConfig) -> PeerState:
 def _atomic_npz(path: str, arrays: dict) -> None:
     buf = io.BytesIO()
     np.savez_compressed(buf, **arrays)
-    tmp = f"{path}.tmp"
+    # pid-unique tmp: concurrent multi-process savers (save_sharded with
+    # clean_stale=False) all write meta.npz with identical content — a
+    # SHARED tmp path would let one rank's os.replace yank another's
+    # file mid-write (FileNotFoundError / torn publish); unique tmps
+    # make the last replace win harmlessly.
+    tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:       # atomic-ish: no torn checkpoint files
         f.write(buf.getvalue())
     os.replace(tmp, path)
 
 
 def save_sharded(dirpath: str, state: PeerState,
-                 cfg: CommunityConfig) -> None:
+                 cfg: CommunityConfig, clean_stale: bool = True) -> None:
     """Multi-host sharded layout: one file per device holding only that
     device's addressable shards of the peer-axis leaves.
 
@@ -152,18 +157,25 @@ def save_sharded(dirpath: str, state: PeerState,
     calls this against a shared directory and writes only its own
     addressable shards — the union of the per-host files is the
     checkpoint, orbax-style; replicated leaves (clock scalars, the RNG
-    key) land in ``meta.npz``, written once.  (Single-process virtual
-    meshes write every shard file themselves, which is the tested path
-    in this environment.)
+    key) land in ``meta.npz``, which every process writes with identical
+    content (pid-unique tmp files make the concurrent replaces safe;
+    last writer wins).  Multi-process callers must pass
+    ``clean_stale=False`` and clean the directory from exactly one
+    process behind a barrier (tools/multihost.py).
     """
     import glob as _glob
 
     os.makedirs(dirpath, exist_ok=True)
     # A reused directory may hold MORE shard files than this mesh writes
     # (e.g. an older 8-way save overwritten by a 4-way one); stale files
-    # would silently win over fresh rows at restore.  Clear them first.
-    for old in _glob.glob(os.path.join(dirpath, "shard_*.npz")):
-        os.remove(old)
+    # would silently win over fresh rows at restore.  Clear them first —
+    # UNLESS this is one process of a multi-process save (clean_stale=
+    # False): concurrent savers would delete each other's fresh shards,
+    # so exactly one process must clean BEFORE a barrier and all save
+    # after it (tools/multihost.py does exactly this).
+    if clean_stale:
+        for old in _glob.glob(os.path.join(dirpath, "shard_*.npz")):
+            os.remove(old)
     names, leaves, _ = _leaves_with_paths(state)
     n = cfg.n_peers
     meta = {"meta:version": np.asarray(FORMAT_VERSION),

@@ -35,3 +35,7 @@ def test_two_process_cluster_is_bit_exact(tmp_path):
     doc = json.load(open(out))
     assert doc["bit_equal_vs_single_device"] is True
     assert doc["num_processes"] == 2
+    # the checkpoint assembled from both processes' shard files must
+    # restore bit-exact on one device (save_sharded's multi-process
+    # contract, executed for real)
+    assert doc["cluster_checkpoint_roundtrip_ok"] is True

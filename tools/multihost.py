@@ -97,6 +97,14 @@ def _worker(args) -> None:
         print(f"[worker {args.process_id} +{time.strftime('%H:%M:%S')}] "
               f"{msg}", flush=True)
 
+    def diff_leaves(tree, ref):
+        """Paths of leaves that differ between two same-structure trees."""
+        return [
+            path for (path, a), b in zip(
+                jax.tree_util.tree_flatten_with_path(tree)[0],
+                jax.tree_util.tree_leaves(ref))
+            if not np.array_equal(np.asarray(a), np.asarray(b))]
+
     n_local = len(jax.local_devices())
     n_global = len(jax.devices())
     hb(f"cluster up: {n_local} local / {n_global} global devices")
@@ -155,11 +163,7 @@ def _worker(args) -> None:
             lambda g: multihost_utils.process_allgather(g, tiled=True),
             gstate)
         if args.process_id == 0:
-            mism = [
-                path for (path, a), b in zip(
-                    jax.tree_util.tree_flatten_with_path(gathered)[0],
-                    jax.tree_util.tree_leaves(local))
-                if not np.array_equal(np.asarray(a), np.asarray(b))]
+            mism = diff_leaves(gathered, local)
             assert not mism, f"round {rnd}: sharded != local at {mism}"
             hb(f"round {rnd}: {len(jax.tree_util.tree_leaves(local))} "
                f"leaves bit-equal across {args.num_processes} processes")
@@ -176,6 +180,32 @@ def _worker(args) -> None:
                 hb(f"round {rnd}: coverage {cov:.4f}")
             if cov >= 0.99:
                 break
+    if args.mode != "broadcast":
+        # Cross-process sharded checkpoint round-trip (the reference's
+        # restart story across hosts, checkpoint.py save_sharded's
+        # documented-but-never-executed multi-process contract): every
+        # process writes ONLY its addressable shards into one shared
+        # directory; the union must restore bit-exact on one device.
+        import shutil
+        from dispersy_tpu import checkpoint as ckpt
+        ckpt_dir = f"/tmp/multihost_ckpt_{args.port}"
+        if args.process_id == 0:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+            os.makedirs(ckpt_dir)
+        # exactly one cleaner, BEFORE anyone writes
+        multihost_utils.sync_global_devices("ckpt-dir-ready")
+        ckpt.save_sharded(ckpt_dir, gstate, cfg, clean_stale=False)
+        multihost_utils.sync_global_devices("ckpt-saved")
+        if args.process_id == 0:
+            restored = ckpt.restore_sharded(ckpt_dir, cfg)
+            bad = diff_leaves(restored, local)
+            assert not bad, f"cluster checkpoint roundtrip differs: {bad}"
+            hb(f"cluster-written checkpoint ({args.num_processes} "
+               f"processes' shard files) restored bit-exact on one device")
+            print("CKPT_ROUNDTRIP ok", flush=True)
+        multihost_utils.sync_global_devices("ckpt-verified")
+        if args.process_id == 0:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
     if args.process_id == 0 and args.mode == "broadcast":
         print("CURVE " + json.dumps(curve), flush=True)
     print(f"[worker {args.process_id}] OK", flush=True)
@@ -275,6 +305,8 @@ def main() -> None:
                    "identity, 2 communities)"),
     }
     for line in outs[0].splitlines() if outs else []:
+        if line.startswith("CKPT_ROUNDTRIP "):
+            doc["cluster_checkpoint_roundtrip_ok"] = line.split()[1] == "ok"
         if line.startswith("CURVE "):
             curve = json.loads(line[6:])
             doc["curve"] = curve

@@ -25,7 +25,7 @@ See ``SURVEY.md`` for the reference's layer map and the provenance caveat
 symbol-level).
 """
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 from dispersy_tpu.config import CommunityConfig  # noqa: F401
 from dispersy_tpu.community import Community  # noqa: F401

@@ -238,6 +238,13 @@ def main() -> None:
         return
 
     env = cpu_env(n_devices=DEVICES_PER_PROCESS)
+    # No persistent compile cache for cluster workers: ASYMMETRIC cache
+    # hits (one rank warm from an earlier same-host run, the other cold)
+    # skew the ranks minutes apart and XLA:CPU's Gloo rendezvous has a
+    # fixed 30 s deadline — observed as "Connect timeout" /
+    # DEADLINE_EXCEEDED when the suite's warmed /tmp/jax_cache leaked in.
+    # Cold-compiling BOTH ranks keeps them in lockstep.
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
     t0 = time.time()
     for attempt in range(2):   # one retry for the port-grab race below
         port = _free_port()

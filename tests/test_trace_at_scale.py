@@ -1,0 +1,79 @@
+"""Engine == oracle at ~30x the usual trace scale: 768 peers, all on.
+
+The per-round trace-equality tests pin tiny overlays (24-32 peers);
+this one runs the everything-on policy matrix (timeline, pens, proofs,
+sequences, double-signing, malicious gossip, LastSync, NAT mix, two
+communities, churn + loss) at 768 peers for 8 rounds, every PeerState
+field and stats counter bit-equal each round — population-scaling bugs
+(rank overflows, block-boundary arithmetic, inbox contention paths that
+tiny overlays never fill) have to show up here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispersy_tpu import engine as E
+from dispersy_tpu import state as S
+from dispersy_tpu.config import META_AUTHORIZE, CommunityConfig, perm_bit
+from dispersy_tpu.oracle import sim as O
+
+from test_oracle import assert_match
+
+CFG = CommunityConfig(
+    n_peers=768, n_trackers=2, communities=((500, 1), (266, 1)),
+    msg_capacity=48, bloom_capacity=16, k_candidates=8, request_inbox=4,
+    tracker_inbox=16, response_budget=6, n_meta=8,
+    timeline_enabled=True, k_authorized=8,
+    protected_meta_mask=0b10, dynamic_meta_mask=0b100,
+    double_meta_mask=0b100, sig_inbox=2,
+    last_sync_history=(0, 0, 0, 2, 0, 0, 0, 0),
+    seq_meta_mask=0b1000000, seq_requests=True, delay_inbox=2,
+    proof_requests=True, malicious_enabled=True, k_malicious=4,
+    malicious_gossip=True, churn_rate=0.02, packet_loss=0.1,
+    p_symmetric=0.25)
+
+
+def test_everything_on_768_peers_trace_equality():
+    cfg = CFG
+    n = cfg.n_peers
+    state = S.init_state(cfg, jax.random.PRNGKey(11))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=6)
+    oracle.seed_overlay(degree=6)
+
+    def create(author, meta, payload, aux=0):
+        nonlocal state
+        m = np.arange(n) == author
+        pl = np.full(n, payload, np.uint32)
+        ax = np.full(n, aux, np.uint32)
+        state = E.create_messages(state, cfg, jnp.asarray(m), meta,
+                                  jnp.asarray(pl), jnp.asarray(ax))
+        oracle.create_messages(m, meta, pl, aux=ax)
+
+    f1, f2 = sorted({int(b)
+                     for b in np.asarray(cfg.layout()[3])[cfg.n_trackers:]})
+    create(f1, META_AUTHORIZE, 10, perm_bit(1, "permit"))
+    create(f2, META_AUTHORIZE, 600, perm_bit(1, "permit"))
+    create(10, 1, 777)     # granted, community 1
+    create(600, 1, 888)    # granted, community 2
+    create(20, 0, 1)       # public
+    create(700, 6, 1)      # sequenced
+    # double-signed drafts in both communities (meta 2 is
+    # DoubleMemberAuthentication) — the sig-request/response flow must
+    # actually fire, not just sit configured on empty inboxes
+    for author, counterparty in ((30, 31), (610, 611)):
+        m = np.arange(n) == author
+        state = E.create_signature_request(
+            state, cfg, jnp.asarray(m), 2,
+            jnp.full(n, counterparty, jnp.int32),
+            jnp.full(n, 99, jnp.uint32))
+        oracle.create_signature_request(
+            m, 2, np.full(n, counterparty, np.int32),
+            np.full(n, 99, np.uint32))
+    for rnd in range(8):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, f"big-{rnd}")
+    # the double-signed flow completed somewhere in the population
+    assert int(np.asarray(state.stats.sig_done).sum()) >= 1

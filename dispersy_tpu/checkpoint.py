@@ -45,7 +45,9 @@ from dispersy_tpu.state import PeerState, init_state, wipe_instance_memory
 # v4: + the delayed-message pen (dly_*) and msgs_delayed counter.
 # v5: + the pen's deliverer column (dly_src) and the proof_requests /
 #     proof_records counters (active missing-proof round trips).
-FORMAT_VERSION = 6   # v6: PeerState gained the `loaded` leaf
+# v6: PeerState gained the `loaded` leaf.
+FORMAT_VERSION = 7   # v7: + auth_issuer (retro re-walk handle) and the
+#     auth_unwound/msgs_retro + mm_*/id_* counter leaves
 
 
 def _fingerprint(cfg: CommunityConfig) -> str:

@@ -173,6 +173,13 @@ MISSING_PROOF_BYTES = HEADER_BYTES + 2 + 8
 # (missing_low, missing_high) (reference: payload.py
 # MissingSequencePayload (member, message, missing_low, missing_high)).
 MISSING_SEQ_BYTES = HEADER_BYTES + 2 + 4 + 1 + 8
+# missing-message request: header + 2 B identifier + (member, global_time)
+# (reference: payload.py MissingMessagePayload — member + one global_time
+# in the round-synchronous recast).
+MISSING_MSG_BYTES = HEADER_BYTES + 2 + 8
+# missing-identity request: header + 2 B identifier + the 20-byte member
+# id (reference: payload.py MissingIdentityPayload carries the mid).
+MISSING_IDENTITY_BYTES = HEADER_BYTES + 2 + 20
 # signature-request: header + 2 B identifier + the draft record's columns
 # (reference: conversion.py packs the half-signed message inside
 # dispersy-signature-request; the response carries it back countersigned).
@@ -366,6 +373,33 @@ class CommunityConfig:
     # instead of Bloom re-offer luck.  Shares the pen and the
     # proof_inbox/proof_budget channel bounds.
     seq_requests: bool = False
+    # Active missing-message round trips (reference: community.py
+    # on_missing_message / payload.py MissingMessagePayload, via
+    # message.py DelayPacketByMissingMessage): a dispersy-undo-other
+    # whose check fails (target record not yet stored, or undoer's grant
+    # chain unseen) PARKS in the pen instead of being rejected, and each
+    # round its deliverer is asked for the exact (member, global_time)
+    # record it names; the stored record rides back by receipt and joins
+    # the same round's intake — the undo re-checks against it next round.
+    # Shares the pen and the proof_inbox channel bound (budget 1: the
+    # UNIQUE(member, global_time) store key makes the reply a single
+    # record).
+    msg_requests: bool = False
+    # Unknown-member gate (reference: member.py — a packet whose author's
+    # public key is unknown cannot be verified; conversion.py raises
+    # DelayPacketByMissingMember): a USER record from an author whose
+    # dispersy-identity record is not stored parks in the pen (or, with
+    # the pen disabled/full, is rejected and re-learned by Bloom
+    # re-offer).  Control records stay exempt — their authority is
+    # structural in the simulation (SURVEY §7 stage 9).
+    identity_required: bool = False
+    # Active missing-identity round trips (reference: community.py
+    # on_missing_identity / payload.py MissingIdentityPayload): each
+    # round an identity-parked record's deliverer is asked for the
+    # author's stored dispersy-identity record, returned by receipt in
+    # the same round.  Shares the pen and proof_inbox bound (budget 1:
+    # one identity record per member).
+    identity_requests: bool = False
 
     # ---- clock (reference: community.py claim_global_time /
     #      dispersy_acceptable_global_time_range) ----
@@ -703,6 +737,33 @@ class CommunityConfig:
             if self.proof_inbox < 1 or self.proof_budget < 1:
                 raise ConfigError("seq_requests shares the proof channel: "
                                   "proof_inbox/proof_budget must be >= 1")
+        if self.msg_requests:
+            if not self.timeline_enabled:
+                raise ConfigError("msg_requests serves undo-other targets, "
+                                  "which need timeline_enabled")
+            if not self.delay_enabled:
+                raise ConfigError("msg_requests requires delay_inbox > 0 "
+                                  "(target-less undos park in the pen)")
+            if self.proof_inbox < 1:
+                raise ConfigError("msg_requests shares the proof channel: "
+                                  "proof_inbox must be >= 1")
+        if self.identity_required and not self.identity_enabled:
+            raise ConfigError("identity_required gates on stored "
+                              "dispersy-identity records — set "
+                              "identity_enabled and create_identities first")
+        if self.identity_requests:
+            if not self.identity_required:
+                raise ConfigError("identity_requests without "
+                                  "identity_required has nothing to ask "
+                                  "for (no record ever parks on identity)")
+            if not self.delay_enabled:
+                raise ConfigError("identity_requests requires delay_inbox "
+                                  "> 0 (identity-less records park in the "
+                                  "pen; note the pen needs "
+                                  "timeline_enabled)")
+            if self.proof_inbox < 1:
+                raise ConfigError("identity_requests shares the proof "
+                                  "channel: proof_inbox must be >= 1")
 
     def replace(self, **kw) -> "CommunityConfig":
         return dataclasses.replace(self, **kw)

@@ -111,6 +111,65 @@ def decode_record(data: bytes, registry: MemberRegistry,
                   valid_signature=ok)
 
 
+def encode_malicious_proof(packet_a: bytes, packet_b: bytes) -> bytes:
+    """Pack two conflicting signed packets into one dispersy-malicious-
+    proof blob (reference: dispersy.py spreads the packet PAIR so
+    receivers re-verify the double-signing independently instead of
+    trusting the claim).  Layout: version byte + 2 B length + packet A +
+    2 B length + packet B."""
+    for p in (packet_a, packet_b):
+        if len(p) > 0xFFFF:
+            raise ValueError("packet too long for a 2-byte length prefix")
+    return (bytes([DISPERSY_VERSION])
+            + len(packet_a).to_bytes(2, "big") + packet_a
+            + len(packet_b).to_bytes(2, "big") + packet_b)
+
+
+def verify_malicious_proof(blob: bytes, registry: MemberRegistry,
+                           crypto: ECCrypto) -> bytes | None:
+    """Verify a malicious-proof blob; the convicted author's mid, or
+    ``None`` if the proof does not hold.
+
+    The receiver-side re-verification the reference performs before
+    convicting (reference: dispersy.py's malicious-proof handling): BOTH
+    packets must carry valid signatures from the SAME resolvable member
+    of the SAME community at the SAME global_time while differing in
+    content — a forged signature, a mismatched pair, or two copies of
+    one packet convict nobody.  The simulation's META_MALICIOUS record
+    (engine gossip path) carries (member, global_time) structurally; this
+    is the tiny-N conformance bridge proving the byte-level pair check
+    (PARITY.md "Malicious-proof trust is structural" boundary)."""
+    if len(blob) < 3 or blob[0] != DISPERSY_VERSION:
+        return None
+    off = 1
+    packets = []
+    for _ in range(2):
+        if off + 2 > len(blob):
+            return None
+        ln = int.from_bytes(blob[off:off + 2], "big")
+        off += 2
+        if off + ln > len(blob):
+            return None
+        packets.append(blob[off:off + ln])
+        off += ln
+    if off != len(blob):
+        return None
+    try:
+        a = decode_record(packets[0], registry, crypto)
+        b = decode_record(packets[1], registry, crypto)
+    except ValueError:
+        return None
+    if not (a.valid_signature and b.valid_signature):
+        return None
+    if a.author_mid != b.author_mid or a.global_time != b.global_time:
+        return None
+    if a.community_mid != b.community_mid:
+        return None
+    if packets[0] == packets[1]:
+        return None       # one packet twice proves nothing
+    return a.author_mid
+
+
 def encode_store(state, cfg, registry: MemberRegistry, crypto: ECCrypto,
                  peer: int, community_mid: bytes | None = None,
                  community_version: int = 1) -> list[bytes]:

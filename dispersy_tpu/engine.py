@@ -59,6 +59,7 @@ from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_U32,
                                  META_DESTROY, META_DYNAMIC, META_IDENTITY,
                                  META_MALICIOUS,
                                  META_REVOKE, META_UNDO_OTHER, META_UNDO_OWN,
+                                 MISSING_IDENTITY_BYTES, MISSING_MSG_BYTES,
                                  MISSING_PROOF_BYTES, MISSING_SEQ_BYTES,
                                  NO_PEER, PERM_AUTHORIZE, PERM_REVOKE,
                                  PERM_UNDO, PUNCTURE_BYTES,
@@ -88,6 +89,10 @@ _LOSS_PROOF_REQ = 8 << 16
 _LOSS_PROOF_RESP = 9 << 16
 _LOSS_SEQ_REQ = 10 << 16
 _LOSS_SEQ_RESP = 11 << 16
+_LOSS_MSG_REQ = 12 << 16
+_LOSS_MSG_RESP = 13 << 16
+_LOSS_ID_REQ = 14 << 16
+_LOSS_ID_RESP = 15 << 16
 _TRACKER_SALT = 1 << 15
 _TRACKER_INTRO_SALT = 1 << 20
 
@@ -116,7 +121,8 @@ def _store(state: PeerState) -> st.StoreCols:
 
 def _auth(state: PeerState) -> tl.AuthTable:
     return tl.AuthTable(member=state.auth_member, mask=state.auth_mask,
-                        gt=state.auth_gt, rev=state.auth_rev)
+                        gt=state.auth_gt, rev=state.auth_rev,
+                        issuer=state.auth_issuer)
 
 
 def _layout_cols(cfg: CommunityConfig, idx: jnp.ndarray):
@@ -228,6 +234,112 @@ def _author_linear(state: PeerState, cfg: CommunityConfig, meta: int,
     return jnp.where(best > 0, (best & 1) == 1, static)
 
 
+def _retro_pass(auth: tl.AuthTable, stc: st.StoreCols, cfg: CommunityConfig,
+                founder_col: jnp.ndarray):
+    """Retroactive permission re-walk after a revoke folds.
+
+    The order-independence half of the Timeline (reference: timeline.py
+    ``Timeline.check`` re-validates proof chains lazily, so verdicts never
+    depend on arrival order): a revoke that syncs AFTER a grant it
+    pre-dates must unwind that grant — and everything downstream of it —
+    exactly as if the revoke had arrived first.
+
+    1. ``tl.revalidate`` re-judges every auth-table row by its issuer's
+       authority over surviving rows (transitive, fixed-point); failed
+       rows are wiped.
+    2. Stored control records are re-checked against the cleaned table
+       (authorize/revoke via the chain rule, dynamic-settings flips via
+       the AUTHORIZE bit) and removed when their authority is gone — so a
+       peer that folded grant-then-revoke ends with the same store as one
+       that received revoke-then-grant (which never stored the grant).
+    3. Stored protected user records are re-checked under the cleaned
+       table and the surviving flip set; no-longer-permitted records are
+       removed.  Peers still offering removed records get re-refused at
+       this peer's intake (the revoke is folded now), so the network
+       converges to the full-knowledge fixed point.
+
+    Clocks never rewind (the reference's global_time is likewise
+    monotone), and undo marks on surviving records stay — only record
+    EXISTENCE is re-decided here.  Returns (auth', store', rows_unwound
+    i32[N], records_removed i32[N]).
+    """
+    keep = tl.revalidate(auth, founder_col, cfg.n_meta)
+    live = auth.member != jnp.uint32(EMPTY_U32)
+    n_unwound = jnp.sum((live & ~keep).astype(jnp.int32), axis=-1)
+    # Compact survivors left (order preserved) so later folds fill from
+    # the end again — the same dense-slots invariant fold maintains.
+    a_slots = auth.member.shape[-1]
+    rank = jnp.cumsum(keep.astype(jnp.int32), axis=-1) - 1
+    slot = jnp.where(keep, rank, a_slots)
+    auth = tl.AuthTable(
+        member=st.rank_compact(auth.member, slot, a_slots, EMPTY_U32),
+        mask=st.rank_compact(auth.mask, slot, a_slots, 0),
+        gt=st.rank_compact(auth.gt, slot, a_slots, 0),
+        rev=st.rank_compact(auth.rev, slot, a_slots, False),
+        issuer=st.rank_compact(auth.issuer, slot, a_slots, EMPTY_U32))
+
+    fcol = founder_col[:, None]
+    user_bits = jnp.uint32(user_perm_mask(cfg.n_meta))
+    is_sauth = stc.meta == jnp.uint32(META_AUTHORIZE)
+    is_srev = stc.meta == jnp.uint32(META_REVOKE)
+    ok_auth = ((stc.member == fcol)
+               | tl.check_grant(auth, stc.member, stc.aux & user_bits,
+                                stc.gt, cfg.n_meta, perm=PERM_AUTHORIZE))
+    ok_rev = ((stc.member == fcol)
+              | tl.check_grant(auth, stc.member, stc.aux & user_bits,
+                               stc.gt, cfg.n_meta, perm=PERM_REVOKE))
+    kill = (is_sauth & ~ok_auth) | (is_srev & ~ok_rev)
+    if cfg.dynamic_meta_mask:
+        is_sflip = stc.meta == jnp.uint32(META_DYNAMIC)
+        ok_flip = tl.check(auth, stc.member, stc.payload, stc.gt, fcol,
+                           perm=PERM_AUTHORIZE)
+        kill = kill | (is_sflip & ~ok_flip)
+    r1 = st.store_remove(stc, kill)
+    stc = r1.store
+
+    # User records re-checked under the cleaned table + surviving flips
+    # (mirrors the intake's protected/permitted computation exactly).
+    prot = jnp.uint32(cfg.protected_meta_mask)
+    shift = jnp.minimum(stc.meta, jnp.uint32(31))
+    protected = (((prot >> shift) & 1) == 1) & (stc.meta < 32)
+    if cfg.dynamic_meta_mask:
+        dynm = jnp.uint32(cfg.dynamic_meta_mask)
+        is_dyn = ((((dynm >> shift) & 1) == 1) & (stc.meta < cfg.n_meta))
+        best = _flip_best(stc, stc.meta, stc.gt)
+        linear_now = jnp.where(best > 0, (best & 1) == 1, protected)
+        protected = jnp.where(is_dyn, linear_now, protected)
+    permitted = tl.check(auth, stc.member, stc.meta, stc.gt, fcol)
+    if cfg.double_meta_mask & (cfg.protected_meta_mask
+                               | cfg.dynamic_meta_mask):
+        is_dbl = ((((jnp.uint32(cfg.double_meta_mask) >> shift) & 1) == 1)
+                  & (stc.meta < cfg.n_meta))
+        permitted = permitted & jnp.where(
+            is_dbl, tl.check(auth, stc.aux, stc.meta, stc.gt, fcol), True)
+    r2 = st.store_remove(stc, protected & ~permitted)
+    stc = r2.store
+
+    # Stored undo-other records re-checked LAST: the undoer's UNDO grant
+    # may have been unwound above, and the TARGET may have been
+    # retro-removed (a stage-2 casualty) — resolving the target's meta
+    # against the post-stage-2 store makes both failure modes converge
+    # to the revoke-first peer's view, which never accepted the undo.
+    is_sundo = stc.meta == jnp.uint32(META_UNDO_OTHER)
+    undo_tmeta = ik.stored_meta_of(stc, stc.payload, stc.aux)
+    ok_undo = tl.check(auth, stc.member, undo_tmeta, stc.gt, fcol,
+                       perm=PERM_UNDO)
+    r3 = st.store_remove(stc, is_sundo & ~ok_undo)
+    stc = r3.store
+    # Undone marks are DERIVED from stored undo records; removed undos
+    # must take their marks with them (revoke-first peers never marked).
+    um = ik.undo_marked(stc, stc.member, stc.gt)
+    stc = stc._replace(flags=jnp.where(
+        (stc.meta < 32) & um,
+        stc.flags | jnp.uint32(FLAG_UNDONE),
+        stc.flags & ~jnp.uint32(FLAG_UNDONE)))
+    return (auth, stc, n_unwound,
+            r1.n_removed + r2.n_removed + r3.n_removed)
+
+
 def _fold_gt(own_gt: jnp.ndarray, seen_gt: jnp.ndarray, seen_valid: jnp.ndarray,
              rng_range: int) -> jnp.ndarray:
     """Lamport fold: max over acceptable observed global times.
@@ -300,7 +412,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             member=jnp.where(r1, jnp.uint32(EMPTY_U32), state.auth_member),
             mask=jnp.where(r1, jnp.uint32(0), state.auth_mask),
             gt=jnp.where(r1, jnp.uint32(0), state.auth_gt),
-            rev=jnp.where(r1, False, state.auth_rev))
+            rev=jnp.where(r1, False, state.auth_rev),
+            issuer=jnp.where(r1, jnp.uint32(EMPTY_U32), state.auth_issuer))
         # The signature request cache dies with the process (reference:
         # RequestCache is in-memory only).
         sig = (jnp.where(reborn, NO_PEER, state.sig_target),
@@ -1107,6 +1220,162 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         mq_ok = jnp.zeros((n, 0), bool)
         mq_src = jnp.zeros((n, 0), jnp.int32)
 
+    # ---- phase 4m: active missing-message round trip -------------------
+    # (reference: community.py on_missing_message serving
+    # dispersy-missing-message(member, global_times); message.py
+    # DelayPacketByMissingMessage parks the dependent packet.)  Each
+    # UNDO-OTHER pen entry — parked because its named target record (or
+    # the undoer's grant) had not arrived — asks its original deliverer
+    # for the exact (member, global_time) record it names; the stored
+    # record rides back by receipt into this round's intake, and the
+    # parked undo re-checks against it next round.  Budget 1: the store's
+    # UNIQUE(member, global_time) key makes the reply a single record.
+    # LOCKSTEP NOTE: mirrors phase 4p's request/serve/receipt scaffolding
+    # (oracle: sm_batch) — change all four places together.
+    if cfg.delay_enabled and cfg.msg_requests:
+        dd_ = cfg.delay_inbox
+        want_mm = (dl_ok & (dl_src != NO_PEER)
+                   & (dl_meta == jnp.uint32(META_UNDO_OTHER)))   # [N, D]
+        mmq_lost = _lost(seed, rnd, idx[:, None], _LOSS_MSG_REQ,
+                         jnp.arange(dd_)[None, :], cfg.packet_loss)
+        bup = bup + jnp.sum(want_mm, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(MISSING_MSG_BYTES)
+        mreq = inbox.deliver(
+            dst=dl_src.reshape(-1),
+            cols=[dl_payload.reshape(-1), dl_aux.reshape(-1)],
+            valid=(want_mm & ~mmq_lost).reshape(-1), n_peers=n,
+            inbox_size=cfg.proof_inbox)
+        mr_member, mr_gt = mreq.inbox                            # [N, Mi]
+        arrivals = arrivals | jnp.any(mreq.inbox_valid, axis=1)
+        mr_ok = mreq.inbox_valid & act[:, None]
+        if cfg.timeline_enabled:
+            mr_ok = mr_ok & ~killed[:, None]
+        stats = stats.replace(
+            mm_requests=stats.mm_requests
+            + jnp.sum(mr_ok, axis=1).astype(jnp.uint32),
+            requests_dropped=stats.requests_dropped
+            + mreq.n_dropped.astype(jnp.uint32))
+        bdown = bdown + jnp.sum(mr_ok, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(MISSING_MSG_BYTES)
+        # Serve: the (unique) stored USER row at (member, global_time) —
+        # control rows are never undo targets (pre_undone's meta < 32).
+        live_rows = (stc.gt != jnp.uint32(EMPTY_U32)) & (stc.meta < 32)
+        mouts = []
+        for s in range(cfg.proof_inbox):
+            m_s = (live_rows & mr_ok[:, s:s + 1]
+                   & (stc.member == mr_member[:, s:s + 1])
+                   & (stc.gt == mr_gt[:, s:s + 1]))              # [N, M]
+            first = jnp.cumsum(m_s.astype(jnp.int32), axis=1) - 1
+            mslot = jnp.where(m_s & (first < 1), first, 1)
+            mouts.append(tuple(st.rank_compact(col, mslot, 1, fill)
+                               for col, fill in
+                               ((stc.gt, EMPTY_U32), (stc.member, EMPTY_U32),
+                                (stc.meta, EMPTY_U32),
+                                (stc.payload, EMPTY_U32), (stc.aux, 0),
+                                (m_s, False))))
+        mbox = [jnp.stack([o[i] for o in mouts], axis=1)
+                for i in range(6)]                               # [N, Mi, 1]
+        bup = bup + jnp.sum(mbox[5], axis=(1, 2)).astype(jnp.uint32) \
+            * jnp.uint32(RECORD_BYTES)
+        msrc_flat = jnp.maximum(dl_src.reshape(-1), 0)           # [N*D]
+        meslot = jnp.maximum(mreq.edge_slot, 0)
+        mgot = ((mreq.edge_slot >= 0)
+                & mr_ok[msrc_flat, meslot]).reshape(n, dd_)      # [N, D]
+
+        def mpick(col):
+            return col[msrc_flat, meslot].reshape(n, dd_)
+        mm_gt, mm_member, mm_meta, mm_payload, mm_aux = (
+            mpick(c[:, :, 0]) for c in mbox[:5])
+        mms_lost = _lost(seed, rnd, idx[:, None], _LOSS_MSG_RESP,
+                         jnp.arange(dd_)[None, :], cfg.packet_loss)
+        mm_ok = (mpick(mbox[5][:, :, 0]) & mgot & act[:, None] & ~mms_lost)
+        mm_src = dl_src
+        stats = stats.replace(
+            mm_records=stats.mm_records
+            + jnp.sum(mm_ok, axis=1).astype(jnp.uint32))
+        bdown = bdown + jnp.sum(mm_ok, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(RECORD_BYTES)
+    else:
+        mm0 = jnp.zeros((n, 0), jnp.uint32)
+        mm_gt = mm_member = mm_meta = mm_payload = mm_aux = mm0
+        mm_ok = jnp.zeros((n, 0), bool)
+        mm_src = jnp.zeros((n, 0), jnp.int32)
+
+    # ---- phase 4i: active missing-identity round trip ------------------
+    # (reference: community.py on_missing_identity serving
+    # dispersy-missing-identity(mid); conversion.py raises
+    # DelayPacketByMissingMember for packets from unknown members.)  Each
+    # pen entry still lacking its author's dispersy-identity record asks
+    # its deliverer for it; the identity rides back by receipt into this
+    # round's intake, and the parked record re-checks next round.
+    # Budget 1: one identity record per member.  LOCKSTEP NOTE: same
+    # scaffolding as 4p/4s/4m (oracle: si_batch).
+    if cfg.delay_enabled and cfg.identity_requests:
+        dd_ = cfg.delay_inbox
+        want_id = (dl_ok & (dl_src != NO_PEER)
+                   & (dl_meta < cfg.n_meta)
+                   & ~ik.identity_stored(stc, dl_member))        # [N, D]
+        idq_lost = _lost(seed, rnd, idx[:, None], _LOSS_ID_REQ,
+                         jnp.arange(dd_)[None, :], cfg.packet_loss)
+        bup = bup + jnp.sum(want_id, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(MISSING_IDENTITY_BYTES)
+        ireq = inbox.deliver(
+            dst=dl_src.reshape(-1), cols=[dl_member.reshape(-1)],
+            valid=(want_id & ~idq_lost).reshape(-1), n_peers=n,
+            inbox_size=cfg.proof_inbox)
+        (iq_member,) = ireq.inbox                                # [N, Ii]
+        arrivals = arrivals | jnp.any(ireq.inbox_valid, axis=1)
+        iq_ok = ireq.inbox_valid & act[:, None]
+        if cfg.timeline_enabled:
+            iq_ok = iq_ok & ~killed[:, None]
+        stats = stats.replace(
+            id_requests=stats.id_requests
+            + jnp.sum(iq_ok, axis=1).astype(jnp.uint32),
+            requests_dropped=stats.requests_dropped
+            + ireq.n_dropped.astype(jnp.uint32))
+        bdown = bdown + jnp.sum(iq_ok, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(MISSING_IDENTITY_BYTES)
+        id_rows = stc.meta == jnp.uint32(META_IDENTITY)          # [N, M]
+        iouts = []
+        for s in range(cfg.proof_inbox):
+            m_s = (id_rows & iq_ok[:, s:s + 1]
+                   & (stc.member == iq_member[:, s:s + 1]))      # [N, M]
+            first = jnp.cumsum(m_s.astype(jnp.int32), axis=1) - 1
+            islot = jnp.where(m_s & (first < 1), first, 1)
+            iouts.append(tuple(st.rank_compact(col, islot, 1, fill)
+                               for col, fill in
+                               ((stc.gt, EMPTY_U32), (stc.member, EMPTY_U32),
+                                (stc.meta, EMPTY_U32),
+                                (stc.payload, EMPTY_U32), (stc.aux, 0),
+                                (m_s, False))))
+        ibox = [jnp.stack([o[i] for o in iouts], axis=1)
+                for i in range(6)]                               # [N, Ii, 1]
+        bup = bup + jnp.sum(ibox[5], axis=(1, 2)).astype(jnp.uint32) \
+            * jnp.uint32(RECORD_BYTES)
+        isrc_flat = jnp.maximum(dl_src.reshape(-1), 0)           # [N*D]
+        ieslot = jnp.maximum(ireq.edge_slot, 0)
+        igot = ((ireq.edge_slot >= 0)
+                & iq_ok[isrc_flat, ieslot]).reshape(n, dd_)      # [N, D]
+
+        def ipick(col):
+            return col[isrc_flat, ieslot].reshape(n, dd_)
+        ii_gt, ii_member, ii_meta, ii_payload, ii_aux = (
+            ipick(c[:, :, 0]) for c in ibox[:5])
+        iis_lost = _lost(seed, rnd, idx[:, None], _LOSS_ID_RESP,
+                         jnp.arange(dd_)[None, :], cfg.packet_loss)
+        ii_ok = (ipick(ibox[5][:, :, 0]) & igot & act[:, None] & ~iis_lost)
+        ii_src = dl_src
+        stats = stats.replace(
+            id_records=stats.id_records
+            + jnp.sum(ii_ok, axis=1).astype(jnp.uint32))
+        bdown = bdown + jnp.sum(ii_ok, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(RECORD_BYTES)
+    else:
+        ii0 = jnp.zeros((n, 0), jnp.uint32)
+        ii_gt = ii_member = ii_meta = ii_payload = ii_aux = ii0
+        ii_ok = jnp.zeros((n, 0), bool)
+        ii_src = jnp.zeros((n, 0), jnp.int32)
+
     # ---- phase 5: combined intake (delayed pen + sync pull + push +
     # completed double-signed + returned proofs) -> store.  One batch per
     # round: the pen's waiting records first (they were delivered in an
@@ -1115,18 +1384,20 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     # records, then this round's countersigned completion, then the
     # missing-proof replies, in delivery order — mirroring the reference's
     # _on_batch_cache handling one grouped batch per meta per window.
-    in_gt = jnp.concatenate([dl_gt, sy_gt, ph_gt, db_gt, pr_gt, mq_gt],
-                            axis=1)                            # [N, B]
+    in_gt = jnp.concatenate([dl_gt, sy_gt, ph_gt, db_gt, pr_gt, mq_gt,
+                             mm_gt, ii_gt], axis=1)            # [N, B]
     in_member = jnp.concatenate([dl_member, sy_member, ph_member, db_member,
-                                 pr_member, mq_member], axis=1)
+                                 pr_member, mq_member, mm_member, ii_member],
+                                axis=1)
     in_meta = jnp.concatenate([dl_meta, sy_meta, ph_meta, db_meta, pr_meta,
-                               mq_meta], axis=1)
+                               mq_meta, mm_meta, ii_meta], axis=1)
     in_payload = jnp.concatenate([dl_payload, sy_payload, ph_payload,
-                                  db_payload, pr_payload, mq_payload], axis=1)
+                                  db_payload, pr_payload, mq_payload,
+                                  mm_payload, ii_payload], axis=1)
     in_aux = jnp.concatenate([dl_aux, sy_aux, ph_aux, db_aux, pr_aux,
-                              mq_aux], axis=1)
-    in_ok = jnp.concatenate([dl_ok, sy_ok, ph_ok, db_ok, pr_ok, mq_ok],
-                            axis=1)
+                              mq_aux, mm_aux, ii_aux], axis=1)
+    in_ok = jnp.concatenate([dl_ok, sy_ok, ph_ok, db_ok, pr_ok, mq_ok,
+                             mm_ok, ii_ok], axis=1)
     bb = in_gt.shape[1]
     if cfg.delay_enabled:
         # Round each batch entry was (first) delivered: pen entries keep
@@ -1146,7 +1417,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                   if db_ok.shape[1] else
                   jnp.zeros((n, 0), jnp.int32))
         in_src = jnp.concatenate(
-            [dl_src, sy_src, ph_src, db_src, pr_src, mq_src], axis=1)
+            [dl_src, sy_src, ph_src, db_src, pr_src, mq_src, mm_src,
+             ii_src], axis=1)
     if bb > 0:
         # Clock-jump defense before the store accepts anything.
         in_ok = in_ok & (in_gt <= global_time[:, None] + jnp.uint32(
@@ -1272,7 +1544,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             grant_mask = in_aux & user_bits
             fr = tl.fold(auth, target=in_payload, mask=grant_mask,
                          gt=in_gt, is_revoke=is_rev,
-                         valid=fresh0 & (is_auth | is_rev) & ctrl_ok0)
+                         valid=fresh0 & (is_auth | is_rev) & ctrl_ok0,
+                         issuer=in_member)
             auth = fr.table
             deleg_ok = ((is_auth | is_rev) & ~ctrl_ok0
                         & jnp.where(
@@ -1285,7 +1558,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                                            perm=PERM_AUTHORIZE)))
             fr2 = tl.fold(auth, target=in_payload, mask=grant_mask,
                           gt=in_gt, is_revoke=is_rev,
-                          valid=fresh0 & deleg_ok)
+                          valid=fresh0 & deleg_ok, issuer=in_member)
             auth = fr2.table
             # Granted undo-other: the undoer holds the UNDO permission on
             # the target record's meta (resolved from the receiver's own
@@ -1336,6 +1609,14 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                     True)
             accept = in_ok & jnp.where(
                 is_ctrl, ctrl_ok, jnp.where(protected, permitted, True))
+            if cfg.msg_requests:
+                # DelayPacketByMissingMessage recast: a failing undo-other
+                # parks (its named target — or the undoer's grant — may
+                # still be in flight; phase 4m asks for the target by
+                # name) instead of rejecting outright.
+                undo_park = is_undo_other & in_ok & ~accept
+            else:
+                undo_park = jnp.zeros_like(accept)
 
             # Arriving records whose undo is already stored come in
             # pre-undone (the reference re-marks on re-insert attempts).
@@ -1345,10 +1626,29 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                                  jnp.uint32(0))
             stats = stats.replace(
                 msgs_dropped=stats.msgs_dropped
-                + fr.n_dropped.astype(jnp.uint32)
-                + fr2.n_dropped.astype(jnp.uint32))
+                + (fr.n_dropped + fr2.n_dropped
+                   + fr.n_evicted + fr2.n_evicted).astype(jnp.uint32))
         else:
             accept = in_ok
+            undo_park = jnp.zeros_like(accept)
+
+        if cfg.identity_required:
+            # Unknown-member gate (reference: member.py — no public key,
+            # no verification; conversion.py DelayPacketByMissingMember):
+            # USER records need the author's dispersy-identity record in
+            # the receiver's store.  Control records stay exempt (their
+            # authority is structural — SURVEY §7 stage 9).  Gated
+            # records park via the pen's ~accept path (phase 4i actively
+            # fetches the identity) or reject without one.
+            have_id = ik.identity_stored(stc, in_member)
+            needs_id = in_meta < cfg.n_meta
+            id_ok = ~needs_id | have_id
+            if cfg.double_meta_mask:
+                # both signers must be known (Timeline.check iterates
+                # every authentication member; same for identity)
+                id_ok = id_ok & jnp.where(
+                    is_dbl, ik.identity_stored(stc, in_aux), True)
+            accept = accept & id_ok
 
         if cfg.seq_meta_mask:
             # enable_sequence_number intake: a sequenced record is accepted
@@ -1401,7 +1701,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             # reference's delay-queue cap.
             gap_wait = ((accept & ~seq_ok) if cfg.seq_requests
                         else jnp.zeros_like(accept))
-            waiting = (in_ok & ~is_ctrl & (~accept | gap_wait) & ~in_store
+            waiting = (in_ok & (~is_ctrl | undo_park)
+                       & (~accept | gap_wait) & ~in_store
                        & ~dup_in_batch
                        & (rnd - in_since
                           < jnp.uint32(cfg.delay_timeout_rounds)))
@@ -1410,7 +1711,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         else:
             parked = jnp.zeros_like(accept)
         accept = accept & seq_ok
-        if cfg.timeline_enabled or cfg.seq_meta_mask:
+        if cfg.timeline_enabled or cfg.seq_meta_mask or cfg.identity_required:
             stats = stats.replace(
                 msgs_rejected=stats.msgs_rejected
                 + jnp.sum(in_ok & ~accept & ~parked,
@@ -1553,6 +1854,27 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                 msgs_delayed=stats.msgs_delayed
                 + jnp.sum(parked & (in_since == rnd),
                           axis=1).astype(jnp.uint32))
+
+        if cfg.timeline_enabled:
+            # Retroactive re-walk whenever a fresh revoke folded — or a
+            # table EVICTION displaced a row — ANYWHERE this round (a
+            # scalar trigger; lax.cond skips the pass entirely on quiet
+            # rounds, which is nearly all of them).  Revokes and
+            # evictions are the two folds that can invalidate
+            # already-accepted state; grant inserts only ever add
+            # authority, so tables stay chain-consistent in between.
+            # See _retro_pass (reference: timeline.py lazy re-validation).
+            rev_folded = (jnp.any(fresh0 & is_rev & (ctrl_ok0 | deleg_ok))
+                          | jnp.any((fr.n_evicted + fr2.n_evicted) > 0))
+            auth, stc, n_unw, n_ret = lax.cond(
+                rev_folded,
+                lambda a, s: _retro_pass(a, s, cfg, founder[:, 0]),
+                lambda a, s: (a, s, jnp.zeros((n,), jnp.int32),
+                              jnp.zeros((n,), jnp.int32)),
+                auth, stc)
+            stats = stats.replace(
+                auth_unwound=stats.auth_unwound + n_unw.astype(jnp.uint32),
+                msgs_retro=stats.msgs_retro + n_ret.astype(jnp.uint32))
     else:
         e0 = jnp.full((n, cfg.forward_buffer), EMPTY_U32, jnp.uint32)
         fwd = (e0, e0, e0, e0, e0)
@@ -1588,7 +1910,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         dly_gt=dly[0], dly_member=dly[1], dly_meta=dly[2], dly_payload=dly[3],
         dly_aux=dly[4], dly_since=dly[5], dly_src=dly[6],
         auth_member=auth.member, auth_mask=auth.mask,
-        auth_gt=auth.gt, auth_rev=auth.rev,
+        auth_gt=auth.gt, auth_rev=auth.rev, auth_issuer=auth.issuer,
         sig_target=sig[0], sig_meta=sig[1], sig_payload=sig[2],
         sig_gt=sig[3], sig_since=sig[4],
         stats=stats.replace(bytes_up=stats.bytes_up + bup,
@@ -1780,6 +2102,8 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
                           history=cfg.history)
     stc = ins.store
 
+    retro_unw = retro_rm = None
+    fold_dropped = None
     if cfg.timeline_enabled and meta in (META_AUTHORIZE, META_REVOKE):
         # The author's own table learns its own grant/revoke at create time.
         fr = tl.fold(auth, target=payload[:, None],
@@ -1787,8 +2111,26 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
                            & jnp.uint32(user_perm_mask(cfg.n_meta)))[:, None],
                      gt=gt_new[:, None],
                      is_revoke=jnp.full((n, 1), meta == META_REVOKE),
-                     valid=author_mask[:, None])
+                     valid=author_mask[:, None],
+                     issuer=idx[:, None])
         auth = fr.table
+        fold_dropped = fr.n_dropped + fr.n_evicted   # own-table overflow,
+        #   counted like every bounded-state loss (oracle _auth_fold)
+        # A self-created revoke claims clock+1, but the author's table can
+        # hold rows at HIGHER global_times (records from faster peers
+        # arrive up to acceptable_global_time_range ahead) — the same
+        # late-revoke hazard as the intake; an EVICTION can likewise
+        # orphan rows the displaced grant proved.  Same re-walk either
+        # way (see _retro_pass).
+        trigger = jnp.any(fr.n_evicted > 0)
+        if meta == META_REVOKE:
+            trigger = trigger | jnp.any(author_mask)
+        auth, stc, retro_unw, retro_rm = lax.cond(
+            trigger,
+            lambda a, s: _retro_pass(a, s, cfg, founder_row),
+            lambda a, s: (a, s, jnp.zeros((n,), jnp.int32),
+                          jnp.zeros((n,), jnp.int32)),
+            auth, stc)
     if cfg.timeline_enabled and meta in (META_UNDO_OWN, META_UNDO_OTHER):
         # Mark the target row in the author's own store immediately.
         hit = (author_mask[:, None] & (stc.member == payload[:, None])
@@ -1820,14 +2162,22 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
         fwd_payload=buf(state.fwd_payload, new.payload[:, 0]),
         fwd_aux=buf(state.fwd_aux, new.aux[:, 0]),
         auth_member=auth.member, auth_mask=auth.mask,
-        auth_gt=auth.gt, auth_rev=auth.rev,
+        auth_gt=auth.gt, auth_rev=auth.rev, auth_issuer=auth.issuer,
         global_time=jnp.where(author_mask, gt_new, state.global_time),
         stats=state.stats.replace(
             msgs_stored=state.stats.msgs_stored
             + ins.n_inserted.astype(jnp.uint32),
             accepted_by_meta=state.stats.accepted_by_meta
             .at[:, min(meta, cfg.n_meta)]
-            .add(author_mask.astype(jnp.uint32))))
+            .add(author_mask.astype(jnp.uint32)),
+            **({} if fold_dropped is None else {
+                "msgs_dropped": state.stats.msgs_dropped
+                + fold_dropped.astype(jnp.uint32)}),
+            **({} if retro_unw is None else {
+                "auth_unwound": state.stats.auth_unwound
+                + retro_unw.astype(jnp.uint32),
+                "msgs_retro": state.stats.msgs_retro
+                + retro_rm.astype(jnp.uint32)})))
 
 
 def create_signature_request(state: PeerState, cfg: CommunityConfig,

@@ -36,8 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dispersy_tpu.config import (EMPTY_U32, META_DYNAMIC, META_UNDO_OTHER,
-                                 META_UNDO_OWN)
+from dispersy_tpu.config import (EMPTY_U32, META_DYNAMIC, META_IDENTITY,
+                                 META_UNDO_OTHER, META_UNDO_OWN)
 
 # Live-memory bound for the broadcast form's product tensor, in elements.
 # 2**28 bools = 256 MB — comfortably under this host's RAM even with
@@ -230,6 +230,29 @@ def undo_hits_store(stc, target_member: jnp.ndarray,
         return out | (ok & (stc.member == mb) & (stc.gt == g))
 
     return lax.fori_loop(0, b, body, jnp.zeros((n, m), bool))
+
+
+def identity_stored(stc, member: jnp.ndarray,
+                    impl: str | None = None) -> jnp.ndarray:
+    """bool[N, B]: does the receiver's store hold a dispersy-identity
+    record for ``member``?  (Reference: member.py ``has_identity`` — the
+    unknown-member gate before any signature can verify;
+    config.identity_required.)  Same two-form memory story as every
+    intake check."""
+    n, b = member.shape
+    m = stc.gt.shape[-1]
+    rows = stc.meta == jnp.uint32(META_IDENTITY)          # [N, M]
+    if _auto_impl(impl, n * b * m) == "broadcast":
+        return jnp.any(rows[:, None, :]
+                       & (stc.member[:, None, :] == member[:, :, None]),
+                       axis=-1)
+
+    def body(j, out):
+        mb = lax.dynamic_index_in_dim(member, j, 1)       # [N, 1]
+        got = jnp.any(rows & (stc.member == mb), axis=-1)
+        return lax.dynamic_update_index_in_dim(out, got, j, 1)
+
+    return lax.fori_loop(0, b, body, jnp.zeros((n, b), bool))
 
 
 def stored_meta_of(stc, member: jnp.ndarray, gt: jnp.ndarray,

@@ -274,6 +274,35 @@ def _merge_ordered(store: StoreCols, masked: StoreCols):
             interleave(store.flags, b_flags))
 
 
+class RemoveResult(NamedTuple):
+    store: StoreCols
+    n_removed: jnp.ndarray  # i32[N] records deleted
+
+
+def store_remove(store: StoreCols, kill: jnp.ndarray) -> RemoveResult:
+    """Delete masked records; survivors compact left, holes to the end.
+
+    The retro-reject half of the permission re-walk (reference: timeline.py
+    lazy re-validation — a message whose proof chain stops checking out is
+    dropped from the database; engine._retro_pass).  Survivors keep their
+    sorted order, so a rank-scatter compaction suffices — no re-sort.
+    ``kill``: bool[N, M] over the store slots; dead slots in ``kill`` are
+    ignored.
+    """
+    m = store.gt.shape[-1]
+    keep = store.valid & ~kill
+    rank = jnp.cumsum(keep.astype(jnp.int32), axis=-1) - 1
+    slot = jnp.where(keep, rank, m)
+    out = StoreCols(gt=rank_compact(store.gt, slot, m, _EMPTY),
+                    member=rank_compact(store.member, slot, m, _EMPTY),
+                    meta=rank_compact(store.meta, slot, m, _EMPTY),
+                    payload=rank_compact(store.payload, slot, m, _EMPTY),
+                    aux=rank_compact(store.aux, slot, m, 0),
+                    flags=rank_compact(store.flags, slot, m, 0))
+    n_removed = jnp.sum((store.valid & kill).astype(jnp.int32), axis=-1)
+    return RemoveResult(store=out, n_removed=n_removed)
+
+
 class SyncSlice(NamedTuple):
     """The sync range advertised in an introduction request.
 

@@ -31,14 +31,24 @@ convey the *authorize permission itself* for that meta, so chains (founder
 :func:`check_grant` is the chain-link validity test, the bounded-table
 recast of ``Timeline.check``'s recursive proof walk; the REVOKE bit gates
 issuing revoke records separably, and the UNDO bit (checked via
-:func:`check` with ``perm=PERM_UNDO``) gates dispersy-undo-other.  One
-documented divergence from the reference's proof-chain walk: a link's
-validity is judged against the receiving peer's table *when the link
-folds*, not re-walked on every later check — a revoke that syncs after a
-grant it should have pre-dated does not retroactively unwind grants
-already folded from that granter (each peer's view converges to its own
-arrival order's fixed point; the reference re-validates chains lazily and
-can retro-reject).
+:func:`check` with ``perm=PERM_UNDO``) gates dispersy-undo-other.
+
+Order independence (reference: timeline.py ``Timeline.check`` re-walks
+proof chains lazily, so every peer converges to the same verdict
+regardless of arrival order): a link's validity is still judged at fold
+time for *acceptance* (with Bloom re-offers supplying out-of-order
+grants), but each row also records its ISSUER, and whenever a revoke
+folds the engine re-validates the whole table with :func:`revalidate` —
+a bounded fixed-point re-walk that unwinds rows whose granting chain no
+longer checks out at their global_time, transitively.  Store records
+backed by unwound rows are retro-rejected in the same pass
+(engine._retro_pass), so two peers that received {grant-chain, revoke}
+in opposite orders converge to identical verdicts AND identical stores.
+Remaining documented divergence: mutually-granting same-global_time row
+cycles (A grants B authorize while B grants A authorize, both at one gt,
+their common root later revoked) survive ``revalidate``'s greatest-fixed-
+point iteration where the reference's visited-set walk would reject them
+— unreachable through gated intake without an adversarial equal-gt pair.
 """
 
 from __future__ import annotations
@@ -49,7 +59,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dispersy_tpu.config import (EMPTY_U32, MAX_TIMELINE_META, PERM_AUTHORIZE,
-                                 PERM_PERMIT)
+                                 PERM_PERMIT, PERM_REVOKE)
 
 
 class AuthTable(NamedTuple):
@@ -58,6 +68,10 @@ class AuthTable(NamedTuple):
     mask: jnp.ndarray    # u32[N, A] per-meta permission nibbles (perm_bit)
     gt: jnp.ndarray      # u32[N, A] global_time the row takes effect
     rev: jnp.ndarray     # bool[N, A] True = revoke row (removes the bits)
+    issuer: jnp.ndarray  # u32[N, A] member that signed the grant/revoke —
+    #   the re-walk handle: revalidate() re-judges each row by its
+    #   issuer's authority (reference: an authorize message's own
+    #   authentication member, walked by Timeline.check)
 
 
 def _latest_row_verdict(match, row_gt_masked, is_rev):
@@ -171,47 +185,145 @@ def check_grant(tab: AuthTable, member: jnp.ndarray, mask: jnp.ndarray,
 
 class FoldResult(NamedTuple):
     table: AuthTable
-    n_dropped: jnp.ndarray  # i32[N] rows lost (table full)
+    n_dropped: jnp.ndarray  # i32[N] new rows lost (keyed below the window)
+    n_evicted: jnp.ndarray  # i32[N] existing rows displaced by higher keys
+
+
+def _row_lt(ag, am, ak, ar, ai, bg, bm, bk, br, bi):
+    """Lexicographic (gt, member, mask, rev, issuer) strict less-than —
+    the ONE total order on table rows (fold eviction + oracle mirror)."""
+    return ((ag < bg)
+            | ((ag == bg) & ((am < bm)
+               | ((am == bm) & ((ak < bk)
+                  | ((ak == bk) & ((ar < br)
+                     | ((ar == br) & (ai < bi)))))))))
 
 
 def fold(tab: AuthTable, target: jnp.ndarray, mask: jnp.ndarray,
          gt: jnp.ndarray, is_revoke: jnp.ndarray,
-         valid: jnp.ndarray) -> FoldResult:
+         valid: jnp.ndarray, issuer: jnp.ndarray) -> FoldResult:
     """Insert [N, B] accepted authorize/revoke records into each table.
 
     Mirrors ``Timeline.authorize``/``.revoke`` folding stored proof into the
-    permission state.  Idempotent per (member, mask, gt, revoke) row — an
-    evicted record that re-syncs after store overflow must not eat a second
-    slot.  Overflow drops the new row, counted (bounded state, as
-    everywhere).
+    permission state.  Idempotent per (issuer, member, mask, gt, revoke)
+    row — an evicted record that re-syncs after store overflow must not eat
+    a second slot.
+
+    Overflow keeps the A rows with the HIGHEST (gt, member, mask, rev,
+    issuer) key: the arriving row replaces the table's minimum row in
+    place when it keys above it, else it is dropped; either loss is
+    counted.  A first-come-keeps-slot rule would make the table's content
+    depend on arrival order — two peers whose tables overflowed in
+    different orders would disagree on permissions FOREVER (the bounded
+    table's version of the order-dependence the retro re-walk fixes), so
+    the window must be a deterministic function of the row SET.  Keeping
+    the highest keys also matches ``check``'s latest-wins rule: the rows
+    that decide current verdicts are exactly the high-global_time ones.
+    The reference's Timeline dict is unbounded; this top-A window is the
+    bounded recast, and evictions trigger the engine's retro pass so
+    rows proved by an evicted grant unwind deterministically.
     """
     n, b = target.shape
+    a = tab.member.shape[-1]
     is_revoke = jnp.broadcast_to(jnp.asarray(is_revoke, bool), (n, b))
 
     def body(i, carry):
-        t, dropped = carry
+        t, dropped, evicted = carry
         tg = lax.dynamic_index_in_dim(target, i, axis=1)     # [N, 1]
         mk = lax.dynamic_index_in_dim(mask, i, axis=1)
         g = lax.dynamic_index_in_dim(gt, i, axis=1)
         rv = lax.dynamic_index_in_dim(is_revoke, i, axis=1)
+        isr = lax.dynamic_index_in_dim(issuer, i, axis=1)
         ok = lax.dynamic_index_in_dim(valid, i, axis=1)      # [N, 1]
         dup = jnp.any((t.member == tg) & (t.mask == mk) & (t.gt == g)
-                      & (t.rev == rv), axis=1, keepdims=True)
+                      & (t.rev == rv) & (t.issuer == isr),
+                      axis=1, keepdims=True)
         want = ok & ~dup
         free = t.member == jnp.uint32(EMPTY_U32)             # [N, A]
-        slot = jnp.argmax(free, axis=1)                      # first free
-        can = jnp.any(free, axis=1, keepdims=True) & want
-        hit = (jnp.arange(t.member.shape[1]) == slot[:, None]) & can
+        has_free = jnp.any(free, axis=1, keepdims=True)
+        # minimum live row per peer by the total order (full-table scan:
+        # row j is the min iff no other live row keys below it)
+        live = ~free
+        below = _row_lt(t.gt[:, :, None], t.member[:, :, None],
+                        t.mask[:, :, None], t.rev[:, :, None],
+                        t.issuer[:, :, None],
+                        t.gt[:, None, :], t.member[:, None, :],
+                        t.mask[:, None, :], t.rev[:, None, :],
+                        t.issuer[:, None, :])                # [N, A, A]
+        # below[n, x, y] = row_x < row_y; y is the min iff no live x != y
+        # keys below it (keys are unique: identical rows are dups)
+        is_min = live & ~jnp.any(below & live[:, :, None]
+                                 & (jnp.arange(a)[None, :, None]
+                                    != jnp.arange(a)[None, None, :]),
+                                 axis=1)                     # [N, A]
+        min_slot = jnp.argmax(is_min, axis=1)                # [N]
+        rows = jnp.arange(n)
+        new_above_min = _row_lt(
+            t.gt[rows, min_slot][:, None], t.member[rows, min_slot][:, None],
+            t.mask[rows, min_slot][:, None], t.rev[rows, min_slot][:, None],
+            t.issuer[rows, min_slot][:, None], g, tg, mk, rv, isr)  # [N, 1]
+        slot = jnp.where(has_free[:, 0], jnp.argmax(free, axis=1), min_slot)
+        can = want & (has_free | new_above_min)
+        hit = (jnp.arange(a) == slot[:, None]) & can
         return (AuthTable(
             member=jnp.where(hit, tg, t.member),
             mask=jnp.where(hit, mk, t.mask),
             gt=jnp.where(hit, g, t.gt),
-            rev=jnp.where(hit, rv, t.rev)),
-            dropped + (want & ~can)[:, 0].astype(jnp.int32))
+            rev=jnp.where(hit, rv, t.rev),
+            issuer=jnp.where(hit, isr, t.issuer)),
+            dropped + (want & ~can)[:, 0].astype(jnp.int32),
+            evicted + (can & ~has_free)[:, 0].astype(jnp.int32))
 
-    init = (tab, jnp.zeros((n,), jnp.int32))
-    t, dropped = lax.fori_loop(0, b, body, init) if b > 0 else init
-    return FoldResult(table=t, n_dropped=dropped)
+    init = (tab, jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
+    t, dropped, evicted = lax.fori_loop(0, b, body, init) if b > 0 else init
+    return FoldResult(table=t, n_dropped=dropped, n_evicted=evicted)
+
+
+def revalidate(tab: AuthTable, founder, n_meta: int) -> jnp.ndarray:
+    """Re-walk every row's granting chain; bool[N, A] rows that survive.
+
+    The bounded-table recast of ``Timeline.check``'s lazy re-validation
+    (reference: timeline.py — a revoke arriving after a grant it pre-dates
+    retro-rejects that grant on the next check).  Each row is re-judged by
+    whether its ISSUER held the required authority bit (AUTHORIZE for grant
+    rows, REVOKE for revoke rows) for every meta named in its mask at the
+    row's global_time — the authority computed from surviving rows only,
+    iterated A times so invalidation unwinds transitively (a removed grant
+    invalidates the rows its grantee issued, one chain level per
+    iteration; A rows bound the chain depth).  The verdict is a pure
+    function of the row SET, never of arrival order.
+
+    A row cannot witness its own validity (the diagonal is excluded), so a
+    direct self-grant dies with its external support.  ``founder`` is an
+    int or [N] per-row founder column; founder-issued rows are axiomatic.
+    """
+    n, a = tab.member.shape
+    live = tab.member != jnp.uint32(EMPTY_U32)
+    f = jnp.broadcast_to(jnp.asarray(founder, jnp.uint32), (n,))
+    by_founder = tab.issuer == f[:, None]                    # [N, A]
+    # Authority bit each row's issuer must hold, per row: grants need the
+    # AUTHORIZE bit, revokes the REVOKE bit (separable authorities).
+    permsel = jnp.where(tab.rev, jnp.uint32(PERM_REVOKE),
+                        jnp.uint32(PERM_AUTHORIZE))          # [N, A]
+    not_self = ~jnp.eye(a, dtype=bool)[None, :, :]           # [1, Ar, As]
+
+    def body(_, keep):
+        ok = tab.mask != 0          # an empty grant proves nothing
+        for k in range(n_meta):
+            need = ((tab.mask >> jnp.uint32(4 * k))
+                    & jnp.uint32(0xF)) != 0                  # [N, Ar]
+            sh = (jnp.uint32(4 * k) + permsel)[:, :, None]   # [N, Ar, 1]
+            bit = ((tab.mask[:, None, :] >> sh) & jnp.uint32(1)) == 1
+            match = (keep[:, None, :] & not_self & bit
+                     & (tab.member[:, None, :] == tab.issuer[:, :, None])
+                     & (tab.gt[:, None, :] <= tab.gt[:, :, None]))
+            row_gt = jnp.where(match, tab.gt[:, None, :], 0)
+            granted_k = _latest_row_verdict(match, row_gt,
+                                            tab.rev[:, None, :])
+            ok = ok & (~need | granted_k)
+        return live & (ok | by_founder)
+
+    return lax.fori_loop(0, a, body, live)
 
 
 class SetFoldResult(NamedTuple):

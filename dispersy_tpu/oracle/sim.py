@@ -32,6 +32,8 @@ from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_U32,
                                  META_DESTROY, META_DYNAMIC, META_MALICIOUS,
                                  META_REVOKE,
                                  META_UNDO_OTHER, META_UNDO_OWN,
+                                 META_IDENTITY, MISSING_IDENTITY_BYTES,
+                                 MISSING_MSG_BYTES,
                                  MISSING_PROOF_BYTES, MISSING_SEQ_BYTES,
                                  NO_PEER,
                                  PERM_AUTHORIZE, PERM_PERMIT, PERM_REVOKE,
@@ -62,6 +64,10 @@ _LOSS_PROOF_REQ = 8 << 16
 _LOSS_PROOF_RESP = 9 << 16
 _LOSS_SEQ_REQ = 10 << 16
 _LOSS_SEQ_RESP = 11 << 16
+_LOSS_MSG_REQ = 12 << 16
+_LOSS_MSG_RESP = 13 << 16
+_LOSS_ID_REQ = 14 << 16
+_LOSS_ID_RESP = 15 << 16
 _TRACKER_SALT = 1 << 15
 _TRACKER_INTRO_SALT = 1 << 20
 
@@ -132,13 +138,15 @@ class Record:
 
 class AuthRow:
     """One grant/revoke row (ops/timeline.py AuthTable mirror): ``mask``
-    holds per-meta permission nibbles, ``rev`` flags a revoke row."""
+    holds per-meta permission nibbles, ``rev`` flags a revoke row,
+    ``issuer`` the member that signed it (the retro re-walk handle)."""
 
-    __slots__ = ("member", "mask", "gt", "rev")
+    __slots__ = ("member", "mask", "gt", "rev", "issuer")
 
-    def __init__(self, member, mask, gt, rev=False):
+    def __init__(self, member, mask, gt, rev=False, issuer=0):
         self.member, self.mask, self.gt = int(member), int(mask), int(gt)
         self.rev = bool(rev)
+        self.issuer = int(issuer)
 
 
 class Slot:
@@ -180,9 +188,13 @@ class OraclePeer:
         self.msgs_delayed = 0
         self.proof_requests = self.proof_records = 0
         self.seq_requests = self.seq_records = 0
+        self.mm_requests = self.mm_records = 0
+        self.id_requests = self.id_records = 0
         self.sig_signed = self.sig_done = self.sig_expired = 0
         self.conflicts = 0
         self.convictions_rx = 0
+        self.auth_unwound = 0
+        self.msgs_retro = 0
         self.bytes_up = self.bytes_down = 0          # wrap mod 2^32
         self.accepted_by_meta = [0] * (cfg.n_meta + 1)
 
@@ -489,17 +501,166 @@ class OracleSim:
         return self._auth_bit(owner, member, tmeta, gt, PERM_UNDO)
 
     def _auth_fold(self, owner: int, target: int, mask: int, gt: int,
-                   is_revoke: bool) -> None:
-        """tl.fold for one accepted authorize/revoke record."""
+                   is_revoke: bool, issuer: int) -> bool:
+        """tl.fold for one accepted authorize/revoke record.  Returns True
+        when an existing row was EVICTED (the engine's retro trigger).
+
+        Overflow keeps the top-A rows by (gt, member, mask, rev, issuer)
+        — the deterministic window (tl.fold docstring): the arriving row
+        replaces the minimum row in place when it keys above it, else it
+        is dropped; either loss counts as msgs_dropped."""
         p = self.peers[owner]
         for r in p.auth:
             if (r.member == target and r.mask == mask and r.gt == gt
-                    and r.rev == is_revoke):
-                return  # idempotent: row already folded
+                    and r.rev == is_revoke and r.issuer == issuer):
+                return False  # idempotent: row already folded
         if len(p.auth) < self.cfg.k_authorized:
-            p.auth.append(AuthRow(target, mask, gt, is_revoke))
-        else:
-            p.msgs_dropped += 1
+            p.auth.append(AuthRow(target, mask, gt, is_revoke, issuer))
+            return False
+
+        def key(r):
+            return (r.gt, r.member, r.mask, int(r.rev), r.issuer)
+        mi = min(range(len(p.auth)), key=lambda j: key(p.auth[j]))
+        newk = (int(gt), int(target), int(mask), int(bool(is_revoke)),
+                int(issuer))
+        p.msgs_dropped += 1        # a row is lost either way
+        if key(p.auth[mi]) < newk:
+            p.auth[mi] = AuthRow(target, mask, gt, is_revoke, issuer)
+            return True
+        return False
+
+    def _retro_pass(self, owner: int) -> None:
+        """engine._retro_pass mirror: re-walk the table to its fixed point
+        (tl.revalidate — k_authorized iterations, greatest-fixed-point,
+        diagonal excluded), unwind failed rows, then retro-reject stored
+        records whose authority is gone (control rows first, then
+        protected user rows under the surviving flip set)."""
+        cfg, p = self.cfg, self.peers[owner]
+        f = self._founder(owner)
+        rows = p.auth
+        keep = [True] * len(rows)
+        for _ in range(cfg.k_authorized):
+            new_keep = []
+            for ri, r in enumerate(rows):
+                if r.issuer == f:
+                    new_keep.append(True)
+                    continue
+                if r.mask == 0:
+                    new_keep.append(False)
+                    continue
+                perm = PERM_REVOKE if r.rev else PERM_AUTHORIZE
+                ok = True
+                for k in range(cfg.n_meta):
+                    if not (r.mask >> (4 * k)) & 0xF:
+                        continue
+                    sup = [s for si, s in enumerate(rows)
+                           if keep[si] and si != ri
+                           and s.member == r.issuer
+                           and (s.mask >> (4 * k + perm)) & 1
+                           and s.gt <= r.gt]
+                    if not sup:
+                        ok = False
+                        break
+                    best = max(s.gt for s in sup)
+                    at_best = [s for s in sup if s.gt == best]
+                    if not (any(not s.rev for s in at_best)
+                            and not any(s.rev for s in at_best)):
+                        ok = False
+                        break
+                new_keep.append(ok)
+            keep = new_keep
+        p.auth_unwound += sum(1 for kk in keep if not kk)
+        p.auth = [r for r, kk in zip(rows, keep) if kk]
+
+        # stage 1: stored control records re-checked vs the cleaned table
+        gmask = user_perm_mask(cfg.n_meta)
+        survivors = []
+        for r in p.store:
+            if r.meta in (META_AUTHORIZE, META_REVOKE):
+                perm = (PERM_REVOKE if r.meta == META_REVOKE
+                        else PERM_AUTHORIZE)
+                ok = (r.member == f
+                      or self._grant_ok(owner, r.member, r.aux & gmask,
+                                        r.gt, perm))
+            elif cfg.dynamic_meta_mask and r.meta == META_DYNAMIC:
+                ok = self._auth_check(owner, r.member, r.payload, r.gt,
+                                      PERM_AUTHORIZE)
+            else:
+                ok = True
+            if ok:
+                survivors.append(r)
+            else:
+                p.msgs_retro += 1
+        p.store = survivors
+
+        # stage 2: protected user records under the surviving flip set
+        survivors = []
+        for r in p.store:
+            prot = (r.meta < 32
+                    and bool((cfg.protected_meta_mask >> min(r.meta, 31))
+                             & 1))
+            if (cfg.dynamic_meta_mask and r.meta < cfg.n_meta
+                    and (cfg.dynamic_meta_mask >> r.meta) & 1):
+                prot = self._linear_at(owner, r.meta, r.gt)
+            ok = True
+            if prot:
+                ok = self._auth_check(owner, r.member, r.meta, r.gt)
+                if ok and (cfg.double_meta_mask
+                           & (cfg.protected_meta_mask
+                              | cfg.dynamic_meta_mask)) \
+                        and r.meta < cfg.n_meta \
+                        and (cfg.double_meta_mask >> r.meta) & 1:
+                    ok = self._auth_check(owner, r.aux, r.meta, r.gt)
+            if ok:
+                survivors.append(r)
+            else:
+                p.msgs_retro += 1
+        p.store = survivors
+
+        # stage 3: stored undo-other records — the undoer's UNDO grant
+        # may be unwound, or the target retro-removed (resolved against
+        # the post-stage-2 store, mirroring engine._retro_pass)
+        survivors = []
+        for r in p.store:
+            if r.meta == META_UNDO_OTHER:
+                ok = self._undo_other_ok(owner, r.member, r.payload,
+                                         r.aux, r.gt)
+            else:
+                ok = True
+            if ok:
+                survivors.append(r)
+            else:
+                p.msgs_retro += 1
+        p.store = survivors
+        # undone marks are derived from SURVIVING undo records; removed
+        # undos take their marks with them (revoke-first peers never
+        # marked)
+        undos = {(r.payload, r.aux) for r in p.store
+                 if r.meta in (META_UNDO_OWN, META_UNDO_OTHER)}
+        for r in p.store:
+            if r.meta < 32:
+                if (r.member, r.gt) in undos:
+                    r.flags |= FLAG_UNDONE
+                else:
+                    r.flags &= ~FLAG_UNDONE
+
+    def _has_identity(self, owner: int, member: int) -> bool:
+        """ik.identity_stored for one member vs one peer's store."""
+        return any(r.meta == META_IDENTITY and r.member == member
+                   for r in self.peers[owner].store)
+
+    def _id_ok(self, owner: int, rec: Record) -> bool:
+        """Engine's identity_required gate: USER records need the
+        author's (and, double-signed, the countersigner's) stored
+        dispersy-identity record; control records are exempt."""
+        cfg = self.cfg
+        if not cfg.identity_required or not rec.meta < cfg.n_meta:
+            return True
+        ok = self._has_identity(owner, rec.member)
+        if ok and cfg.double_meta_mask \
+                and (cfg.double_meta_mask >> rec.meta) & 1:
+            ok = self._has_identity(owner, rec.aux)
+        return ok
 
     def _dbl_struct_ok(self, owner: int, rec: Record) -> bool:
         """Engine's structural countersigner check (phase 5): for a
@@ -576,6 +737,7 @@ class OracleSim:
         cfg = self.cfg
         assert not (meta < cfg.n_meta and (cfg.double_meta_mask >> meta) & 1), \
             "double-signed metas go through create_signature_request"
+        created_rev = False
         for i, p in enumerate(self.peers):
             if not author_mask[i] or not p.loaded:
                 continue          # engine: author_mask &= state.loaded
@@ -619,8 +781,9 @@ class OracleSim:
             if not (meta < cfg.n_meta and (cfg.direct_meta_mask >> meta) & 1):
                 self._store_insert(i, [rec], count_drops=False)
             if cfg.timeline_enabled and meta in (META_AUTHORIZE, META_REVOKE):
-                self._auth_fold(i, pv, av & user_perm_mask(cfg.n_meta),
-                                gt, meta == META_REVOKE)
+                ev = self._auth_fold(i, pv, av & user_perm_mask(cfg.n_meta),
+                                     gt, meta == META_REVOKE, issuer=i)
+                created_rev = created_rev or meta == META_REVOKE or ev
             if cfg.timeline_enabled and meta in (META_UNDO_OWN,
                                                  META_UNDO_OTHER):
                 for r in p.store:
@@ -634,6 +797,11 @@ class OracleSim:
                 p.fwd[cfg.forward_buffer - 1] = rec.copy()
             p.global_time = gt
             p.accepted_by_meta[min(meta, cfg.n_meta)] += 1
+        if created_rev:
+            # engine: a self-created revoke can pre-date table rows learned
+            # from faster peers — same global-trigger re-walk as the intake
+            for i in range(cfg.n_peers):
+                self._retro_pass(i)
 
     def create_signature_request(self, author_mask, meta: int, counterparty,
                                  payload) -> None:
@@ -1249,8 +1417,109 @@ class OracleSim:
                         p.seq_records += 1
                         p.bytes_down += RECORD_BYTES
 
+        # phase 4m: active missing-message round trip (engine phase 4m) —
+        # every UNDO-OTHER pen entry asks its deliverer for the exact
+        # (member, global_time) record it names; budget 1 (UNIQUE key).
+        sm_batch: list[list[tuple[Record, int]]] = [[] for _ in range(n)]
+        if delay_on and cfg.msg_requests:
+            mm_inbox: list[list[tuple[int, int]]] = [[] for _ in range(n)]
+            for i in range(n):
+                p = self.peers[i]
+                for d, (rec, since, src) in enumerate(p.delay):
+                    if not (p.alive and p.loaded) or src == NO_PEER \
+                            or rec.meta != META_UNDO_OTHER:
+                        continue
+                    p.bytes_up += MISSING_MSG_BYTES     # sendto, pre-loss
+                    if self._lost(i, _LOSS_MSG_REQ, d):
+                        continue
+                    if 0 <= src < n:
+                        if len(mm_inbox[src]) < cfg.proof_inbox:
+                            mm_inbox[src].append((i, d))
+                            arrivals[src] = True
+                        else:
+                            self.peers[src].requests_dropped += 1
+            mreplies: dict[tuple[int, int], list[Record]] = {}
+            for sv in range(n):
+                psv = self.peers[sv]
+                if not (psv.alive and psv.loaded) \
+                        or (cfg.timeline_enabled and killed[sv]):
+                    continue
+                for (ri, d_slot) in mm_inbox[sv]:
+                    psv.mm_requests += 1
+                    psv.bytes_down += MISSING_MSG_BYTES
+                    q = self.peers[ri].delay[d_slot][0]
+                    served = [r for r in psv.store
+                              if r.meta < 32 and r.member == q.payload
+                              and r.gt == q.aux][:1]
+                    psv.bytes_up += len(served) * RECORD_BYTES
+                    mreplies[(ri, d_slot)] = served
+            for i in range(n):
+                p = self.peers[i]
+                for d, entry in enumerate(p.delay):
+                    for r in mreplies.get((i, d), []):
+                        if not (p.alive and p.loaded) or self._lost(
+                                i, _LOSS_MSG_RESP, d):
+                            continue
+                        sm_batch[i].append(
+                            (Record(r.gt, r.member, r.meta, r.payload,
+                                    r.aux), entry[2]))
+                        p.mm_records += 1
+                        p.bytes_down += RECORD_BYTES
+
+        # phase 4i: active missing-identity round trip (engine phase 4i) —
+        # every pen entry still lacking its author's identity record asks
+        # its deliverer for it; budget 1 (one identity per member).
+        si_batch: list[list[tuple[Record, int]]] = [[] for _ in range(n)]
+        if delay_on and cfg.identity_requests:
+            id_inbox: list[list[tuple[int, int]]] = [[] for _ in range(n)]
+            for i in range(n):
+                p = self.peers[i]
+                for d, (rec, since, src) in enumerate(p.delay):
+                    if not (p.alive and p.loaded) or src == NO_PEER \
+                            or not rec.meta < cfg.n_meta \
+                            or self._has_identity(i, rec.member):
+                        continue
+                    p.bytes_up += MISSING_IDENTITY_BYTES
+                    if self._lost(i, _LOSS_ID_REQ, d):
+                        continue
+                    if 0 <= src < n:
+                        if len(id_inbox[src]) < cfg.proof_inbox:
+                            id_inbox[src].append((i, d))
+                            arrivals[src] = True
+                        else:
+                            self.peers[src].requests_dropped += 1
+            ireplies: dict[tuple[int, int], list[Record]] = {}
+            for sv in range(n):
+                psv = self.peers[sv]
+                if not (psv.alive and psv.loaded) \
+                        or (cfg.timeline_enabled and killed[sv]):
+                    continue
+                for (ri, d_slot) in id_inbox[sv]:
+                    psv.id_requests += 1
+                    psv.bytes_down += MISSING_IDENTITY_BYTES
+                    q = self.peers[ri].delay[d_slot][0]
+                    served = [r for r in psv.store
+                              if r.meta == META_IDENTITY
+                              and r.member == q.member][:1]
+                    psv.bytes_up += len(served) * RECORD_BYTES
+                    ireplies[(ri, d_slot)] = served
+            for i in range(n):
+                p = self.peers[i]
+                for d, entry in enumerate(p.delay):
+                    for r in ireplies.get((i, d), []):
+                        if not (p.alive and p.loaded) or self._lost(
+                                i, _LOSS_ID_RESP, d):
+                            continue
+                        si_batch[i].append(
+                            (Record(r.gt, r.member, r.meta, r.payload,
+                                    r.aux), entry[2]))
+                        p.id_records += 1
+                        p.bytes_down += RECORD_BYTES
+
         # phase 5: combined intake (delayed pen + sync pull + push) ->
         # store + fwd batch + rebuilt pen
+        retro_trigger = False   # any fresh revoke folded anywhere (engine:
+        #   the scalar lax.cond predicate over all peers)
         for i in range(n):
             p = self.peers[i]
             # On-the-wire records: (gt, member, meta, payload, aux) — flags
@@ -1281,6 +1550,8 @@ class OracleSim:
                 batch.append((sig_completed[i], rnd, sig_completed[i].aux))
             batch.extend((rec, rnd, src) for rec, src in pr_batch[i])
             batch.extend((rec, rnd, src) for rec, src in mq_batch[i])
+            batch.extend((rec, rnd, src) for rec, src in sm_batch[i])
+            batch.extend((rec, rnd, src) for rec, src in si_batch[i])
             # clock-jump defense (engine: post-walk-fold clock), plus the
             # structural countersigner check for double-signed metas
             ok_pairs = [(rec, s, sc) for rec, s, sc in batch
@@ -1357,8 +1628,11 @@ class OracleSim:
                 for rec, f0 in zip(ok_batch, fresh0):
                     if (rec.meta in (META_AUTHORIZE, META_REVOKE) and f0
                             and rec.member == self._founder(i)):
-                        self._auth_fold(i, rec.payload, rec.aux & gmask,
-                                        rec.gt, rec.meta == META_REVOKE)
+                        ev = self._auth_fold(i, rec.payload, rec.aux & gmask,
+                                             rec.gt, rec.meta == META_REVOKE,
+                                             issuer=rec.member)
+                        retro_trigger = (retro_trigger or ev
+                                         or rec.meta == META_REVOKE)
                 deleg_flags = [
                     rec.meta in (META_AUTHORIZE, META_REVOKE)
                     and rec.member != self._founder(i)
@@ -1369,8 +1643,11 @@ class OracleSim:
                     for rec in ok_batch]
                 for rec, f0, dg in zip(ok_batch, fresh0, deleg_flags):
                     if dg and f0:
-                        self._auth_fold(i, rec.payload, rec.aux & gmask,
-                                        rec.gt, rec.meta == META_REVOKE)
+                        ev = self._auth_fold(i, rec.payload, rec.aux & gmask,
+                                             rec.gt, rec.meta == META_REVOKE,
+                                             issuer=rec.member)
+                        retro_trigger = (retro_trigger or ev
+                                         or rec.meta == META_REVOKE)
                 if cfg.dynamic_meta_mask:
                     # this batch's fresh accepted dynamic-settings flips
                     # (engine: flip_ok = fresh0 & is_flip
@@ -1385,6 +1662,7 @@ class OracleSim:
                             batch_flips.append((rec.gt, rec.payload,
                                                 rec.aux))
             accept = [self._intake_accept(i, rec, batch_flips, dg)
+                      and self._id_ok(i, rec)
                       for rec, dg in zip(ok_batch, deleg_flags)]
             if cfg.seq_meta_mask:
                 # Sequence-chain intake (engine's fori scan, in batch order).
@@ -1424,7 +1702,12 @@ class OracleSim:
                 for rec, s, sc, a, sok, f0 in zip(ok_batch, ok_since, ok_src,
                                                   accept, seq_ok_l, fresh0):
                     gap = cfg.seq_requests and a and not sok
-                    waiting = ((not a or gap) and rec.meta not in ctrl
+                    # msg_requests: a failing undo-other parks (engine
+                    # undo_park) — phase 4m fetches its target by name
+                    parkable = (rec.meta not in ctrl
+                                or (cfg.msg_requests and not a
+                                    and rec.meta == META_UNDO_OTHER))
+                    waiting = ((not a or gap) and parkable
                                and f0
                                and rnd - s < cfg.delay_timeout_rounds)
                     parked = waiting and len(new_delay) < cfg.delay_inbox
@@ -1520,6 +1803,12 @@ class OracleSim:
                 else:
                     p.fwd[cfg.forward_buffer - 1] = grec.copy()
 
+        if cfg.timeline_enabled and retro_trigger:
+            # Retroactive re-walk — the engine's lax.cond branch taken
+            # whenever a fresh revoke folded anywhere this round.
+            for i in range(n):
+                self._retro_pass(i)
+
         # wrap up: eject convicted members from candidate tables (engine)
         if cfg.malicious_enabled:
             for i, p in enumerate(self.peers):
@@ -1573,6 +1862,11 @@ class OracleSim:
             "auth_mask": np.zeros((n, a), np.uint32),
             "auth_gt": np.zeros((n, a), np.uint32),
             "auth_rev": np.zeros((n, a), bool),
+            "auth_issuer": np.full((n, a), EMPTY_U32, np.uint32),
+            "auth_unwound": np.array([p.auth_unwound for p in self.peers],
+                                     np.uint32),
+            "msgs_retro": np.array([p.msgs_retro for p in self.peers],
+                                   np.uint32),
             "dly_gt": np.full((n, cfg.delay_inbox), EMPTY_U32, np.uint32),
             "dly_member": np.full((n, cfg.delay_inbox), EMPTY_U32,
                                   np.uint32),
@@ -1590,6 +1884,14 @@ class OracleSim:
                 [p.seq_requests for p in self.peers], np.uint32),
             "seq_records": np.array(
                 [p.seq_records for p in self.peers], np.uint32),
+            "mm_requests": np.array(
+                [p.mm_requests for p in self.peers], np.uint32),
+            "mm_records": np.array(
+                [p.mm_records for p in self.peers], np.uint32),
+            "id_requests": np.array(
+                [p.id_requests for p in self.peers], np.uint32),
+            "id_records": np.array(
+                [p.id_records for p in self.peers], np.uint32),
             "msgs_delayed": np.array([p.msgs_delayed for p in self.peers],
                                      np.uint32),
             "mal_member": np.full((n, cfg.k_malicious), EMPTY_U32, np.uint32),
@@ -1657,6 +1959,7 @@ class OracleSim:
                 out["auth_mask"][i, j] = row.mask
                 out["auth_gt"][i, j] = row.gt
                 out["auth_rev"][i, j] = row.rev
+                out["auth_issuer"][i, j] = row.issuer
             for j, (rec, since, src) in enumerate(p.delay):
                 out["dly_gt"][i, j] = rec.gt
                 out["dly_member"][i, j] = rec.member

@@ -158,7 +158,14 @@ class Unload:
     reference's TrackerCommunity auto-joins any community generically
     and has no unload path (tool/tracker.py).  With cfg.auto_load (the reference's
     define_auto_load default) any later community packet re-loads them;
-    otherwise only an explicit Load event does."""
+    otherwise only an explicit Load event does.
+
+    Behavior change (round 4): this event now routes through
+    engine.unload_members, which also clears pending forward queues
+    (fwd_*) and the mal_member conviction scratch — community-instance
+    memory the old scenario-local wipe preserved.  Replays of pre-round-4
+    timelines that unload a peer with forwards in flight can diverge
+    from their old traces."""
     members: Sequence[int]
 
 

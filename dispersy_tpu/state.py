@@ -63,6 +63,14 @@ class Stats:
     # on_missing_sequence; config.seq_requests):
     seq_requests: jnp.ndarray     # u32[N] missing-sequence requests served
     seq_records: jnp.ndarray      # u32[N] gap-fill records received back
+    # Active missing-message round trips (reference: community.py
+    # on_missing_message; config.msg_requests):
+    mm_requests: jnp.ndarray      # u32[N] missing-message requests served
+    mm_records: jnp.ndarray       # u32[N] named records received back
+    # Active missing-identity round trips (reference: community.py
+    # on_missing_identity; config.identity_requests):
+    id_requests: jnp.ndarray      # u32[N] missing-identity requests served
+    id_records: jnp.ndarray       # u32[N] identity records received back
     # Double-signed flow counters (reference: statistics.py counts
     # signature-request/-response traffic; SURVEY §3.5):
     sig_signed: jnp.ndarray       # u32[N] countersignatures granted (B side)
@@ -72,6 +80,12 @@ class Stats:
     #   (malicious-member convictions at this peer; malicious_enabled)
     convictions_rx: jnp.ndarray   # u32[N] convictions adopted from gossiped
     #   dispersy-malicious-proof claims (config.malicious_gossip)
+    # Retroactive permission re-walk (reference: timeline.py lazy chain
+    # re-validation — order-independent verdicts; engine._retro_pass):
+    auth_unwound: jnp.ndarray     # u32[N] auth-table rows unwound when a
+    #   late revoke invalidated their granting chain
+    msgs_retro: jnp.ndarray       # u32[N] stored records retro-rejected
+    #   after a revoke unwound the chain that had permitted them
     # Byte-equivalent traffic totals (reference: endpoint.py total_up /
     # total_down).  Sent bytes count at the sender pre-loss (the reference
     # counts at sendto()); received bytes count per accepted inbox slot
@@ -128,6 +142,8 @@ class PeerState:
     auth_mask: jnp.ndarray       # u32[N, A] per-meta permission nibbles
     auth_gt: jnp.ndarray         # u32[N, A] global_time the row takes effect
     auth_rev: jnp.ndarray        # bool[N, A] True = revoke row
+    auth_issuer: jnp.ndarray     # u32[N, A] member that signed the row —
+    #   the retro re-walk handle (ops/timeline.revalidate)
 
     # ---- malicious-member blacklist (reference: dispersy.py malicious-
     #      member bookkeeping; config.malicious_enabled) ----
@@ -177,8 +193,11 @@ def init_stats(n: int, n_meta: int = 8) -> Stats:
                  msgs_forwarded=z(), msgs_rejected=z(), msgs_direct=z(),
                  msgs_delayed=z(), proof_requests=z(), proof_records=z(),
                  seq_requests=z(), seq_records=z(),
+                 mm_requests=z(), mm_records=z(),
+                 id_requests=z(), id_records=z(),
                  sig_signed=z(), sig_done=z(), sig_expired=z(),
                  conflicts=z(), convictions_rx=z(),
+                 auth_unwound=z(), msgs_retro=z(),
                  bytes_up=z(), bytes_down=z(),
                  accepted_by_meta=jnp.zeros((n, n_meta + 1), jnp.uint32))
 
@@ -272,6 +291,7 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         auth_mask=jnp.zeros((n, a), jnp.uint32),
         auth_gt=jnp.zeros((n, a), jnp.uint32),
         auth_rev=jnp.zeros((n, a), bool),
+        auth_issuer=jnp.full((n, a), EMPTY_U32, jnp.uint32),
         mal_member=jnp.full((n, config.k_malicious), EMPTY_U32, jnp.uint32),
         sig_target=jnp.full((n,), NO_PEER, jnp.int32),
         sig_meta=jnp.zeros((n,), jnp.uint32),

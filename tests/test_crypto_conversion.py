@@ -142,3 +142,44 @@ def test_identity_sync_and_conformance():
     for pkt in packets:
         dec = decode_record(pkt, reg, crypto)
         assert dec.valid_signature
+
+
+def test_malicious_proof_verifies_pair_and_refuses_forgery():
+    """dispersy-malicious-proof carries BOTH conflicting signed packets;
+    receivers re-verify before convicting (reference: dispersy.py's
+    malicious-proof machinery).  A verified conflicting pair convicts;
+    a forged signature, a mismatched pair, or a duplicated packet does
+    not."""
+    from dispersy_tpu.conversion import (encode_malicious_proof,
+                                         verify_malicious_proof)
+    crypto = ECCrypto()
+    reg = MemberRegistry(seed=b"mal", security=u"low", crypto=crypto)
+    cm = hashlib.sha1(b"community").digest()
+    m = reg.member(7)
+    # the double-signing: two DIFFERENT records at one global_time
+    pa = encode_record(cm, 1, 1, m, 42, 111, 0, crypto)
+    pb = encode_record(cm, 1, 1, m, 42, 222, 0, crypto)
+    proof = encode_malicious_proof(pa, pb)
+    assert verify_malicious_proof(proof, reg, crypto) == m.mid
+
+    # a forged signature convicts nobody
+    forged = pb[:-1] + bytes([pb[-1] ^ 1])
+    assert verify_malicious_proof(
+        encode_malicious_proof(pa, forged), reg, crypto) is None
+    # two copies of one packet prove nothing
+    assert verify_malicious_proof(
+        encode_malicious_proof(pa, pa), reg, crypto) is None
+    # different global_times are two honest records, not a conflict
+    pc = encode_record(cm, 1, 1, m, 43, 222, 0, crypto)
+    assert verify_malicious_proof(
+        encode_malicious_proof(pa, pc), reg, crypto) is None
+    # different authors are not a conflict either
+    pd = encode_record(cm, 1, 1, reg.member(8), 42, 222, 0, crypto)
+    assert verify_malicious_proof(
+        encode_malicious_proof(pa, pd), reg, crypto) is None
+    # a claimed author outside the registry cannot be verified
+    ghost_reg = MemberRegistry(seed=b"other", security=u"low", crypto=crypto)
+    assert verify_malicious_proof(proof, ghost_reg, crypto) is None
+    # truncated / malformed blobs refuse instead of raising
+    assert verify_malicious_proof(proof[:-3], reg, crypto) is None
+    assert verify_malicious_proof(b"", reg, crypto) is None

@@ -130,6 +130,248 @@ def run_draw(seed: int) -> None:
 
 # resolved at import so draw bodies stay readable
 from dispersy_tpu.config import META_AUTHORIZE as E_META_AUTHORIZE  # noqa: E402
+from dispersy_tpu.config import (META_DYNAMIC, META_REVOKE,  # noqa: E402
+                                 META_UNDO_OTHER, META_UNDO_OWN, perm_mask)
+
+
+# ---- adversarial grant/revoke orderings (VERDICT r4 #6) ----------------
+#
+# The knob fuzz above randomizes configs and traffic but never the
+# ORDERING of control records.  These draws hammer exactly that: random
+# authorize/revoke/undo/flip interleavings with random permission-nibble
+# masks, and "dark" authors — a peer unloaded right after creating a
+# control record, so the record syncs out rounds later than its
+# global_time says (the network-delay generator that produces
+# grant-then-revoke and revoke-then-grant arrival orders at different
+# peers).  Two assertions per draw:
+#
+#   1. engine == oracle bit-exact every round (as everywhere), and
+#   2. CONVERGENCE: after the schedule, everyone re-loads and the
+#      overlay runs quiet rounds; all non-tracker members of each
+#      community must end with IDENTICAL store record sets.  The
+#      pre-round-5 fold-time-only Timeline fails (2) on late-revoke
+#      draws — peers that accepted records under a later-revoked chain
+#      kept them forever — while the retro re-walk (engine._retro_pass)
+#      unwinds them; bit-equality alone could never see that divergence
+#      because engine and oracle agreed on the broken behavior.
+
+ADV_ROUNDS = 18
+ADV_EVENT_ROUNDS = 14   # no new control records in the tail: a record
+#   authored on the last round of a fanout-0 draw cannot finish its
+#   pull-only spread inside any fixed settle window
+ADV_SETTLE = 24
+
+
+def draw_adversarial_config(rng: np.random.Generator) -> CommunityConfig:
+    n_trackers = int(rng.integers(1, 3))
+    n_peers = n_trackers + int(rng.integers(10, 24))
+    kw = dict(
+        n_peers=n_peers, n_trackers=n_trackers,
+        k_candidates=8, msg_capacity=64, bloom_capacity=16,
+        request_inbox=4, tracker_inbox=8, response_budget=4,
+        forward_fanout=int(rng.choice([0, 2])),
+        sync_strategy=str(rng.choice(["largest", "modulo"])),
+        auto_load=bool(rng.integers(0, 2)),
+        n_meta=4,
+        timeline_enabled=True, k_authorized=6,
+        protected_meta_mask=0b0110,      # metas 1 and 2 LinearResolution
+        founder_member=-1,
+        delay_inbox=int(rng.choice([0, 2])),
+    )
+    if bool(rng.integers(0, 2)):
+        kw["dynamic_meta_mask"] = 0b1000     # meta 3 DynamicResolution
+    return CommunityConfig(**kw)
+
+
+def run_adversarial_draw(seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    cfg = draw_adversarial_config(rng)
+    n = cfg.n_peers
+    state = S.init_state(cfg, jax.random.PRNGKey(seed))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=4)
+    oracle.seed_overlay(degree=4)
+    founder = cfg.founder
+    members = list(range(cfg.n_trackers, n))
+    perms = ("permit", "authorize", "revoke", "undo")
+    dark: dict[int, int] = {}            # member -> rounds left dark
+    authored: list[tuple[int, int]] = []  # (author, gt) of user records
+    granted: list[int] = []              # past authorize targets — the
+    #   members whose chains a late revoke can retroactively sever
+
+    def create(author, meta, payload, aux=0):
+        nonlocal state
+        mask = np.arange(n) == author
+        pl = np.full(n, payload, np.uint32)
+        ax = np.full(n, aux, np.uint32)
+        state = E.create_messages(state, cfg, jnp.asarray(mask), meta,
+                                  jnp.asarray(pl), jnp.asarray(ax))
+        oracle.create_messages(mask, meta, pl, aux=ax)
+
+    def grant_mask():
+        # every grant conveys permit+authorize (chains can deepen), plus
+        # random extras — guaranteed bit overlap with revoke_mask below
+        metas = [m for m in (1, 2) if rng.random() < 0.7] or [1]
+        pairs = [(m, p) for m in metas for p in ("permit", "authorize")]
+        pairs += [(m, p) for m in metas for p in ("revoke", "undo")
+                  if rng.random() < 0.3]
+        return perm_mask(pairs)
+
+    def revoke_mask():
+        # strip permit+authorize — severing both the member's records and
+        # every chain link it issued (the retro hazard) — and sometimes
+        # the undo authority, dooming delegated undo-others too
+        metas = [m for m in (1, 2) if rng.random() < 0.7] or [1]
+        perms2 = ["permit", "authorize"]
+        if rng.random() < 0.4:
+            perms2.append("undo")
+        return perm_mask([(m, p) for m in metas for p in perms2])
+
+    # Doom injection: a randomized instance of the late-revoke hazard is
+    # scheduled into every draw — purely random interleavings almost
+    # never complete the 4-event pattern (grant → revoke-then-dark →
+    # delegated grant → records under it), which would leave the retro
+    # re-walk untested.  Random rounds, members, and meta; the random
+    # traffic around it can still disrupt it (an unloaded dA fizzles the
+    # pattern — that is itself a valid ordering).
+    dA = int(rng.choice(members))
+    dB = int(rng.choice([m for m in members if m != dA]))
+    dmeta = int(rng.choice([1, 2]))
+    r_grant = int(rng.integers(0, 3))
+    r_revoke = r_grant + int(rng.integers(3, 5))
+    r_deleg = r_revoke + int(rng.integers(1, 3))
+    r_rec = r_deleg + int(rng.integers(2, 4))
+    dark_rounds = (r_rec - r_revoke) + int(rng.integers(2, 4))
+    doom_bits = perm_mask([(dmeta, "permit"), (dmeta, "authorize")])
+
+    for rnd in range(ADV_ROUNDS):
+        if rnd == r_grant:
+            create(founder, E_META_AUTHORIZE, dA, doom_bits)
+            granted.append(dA)
+        if rnd == r_revoke:
+            # the revoke claims its global_time NOW, then goes dark while
+            # the chain below keeps growing at higher global_times
+            create(founder, META_REVOKE, dA, doom_bits)
+            dark[founder] = dark_rounds
+            state, _ = _apply(state, cfg, Unload(members=[founder]), {}, {})
+            oracle.unload([founder])
+        if rnd == r_deleg:
+            create(dA, E_META_AUTHORIZE, dB, perm_mask([(dmeta, "permit")]))
+        if rnd == r_rec:
+            create(dB, dmeta, int(rng.integers(1, 1 << 16)))
+        for ev in range(int(rng.integers(1, 4))
+                        if rnd < ADV_EVENT_ROUNDS else 0):
+            roll = rng.random()
+            author = int(rng.choice(members))
+            # bias toward previously-granted members: their chains are
+            # what a late revoke retroactively severs
+            target = (int(rng.choice(granted))
+                      if granted and rng.random() < 0.6
+                      else int(rng.choice(members)))
+            went_dark = False
+            if roll < 0.33:                       # grant (maybe doomed)
+                src = (int(rng.choice(granted))
+                       if granted and rng.random() < 0.5 else founder)
+                create(src, E_META_AUTHORIZE, target, grant_mask())
+                granted.append(target)
+            elif roll < 0.55:                     # revoke — the hazard
+                src = founder if rng.random() < 0.6 else author
+                create(src, META_REVOKE, target, revoke_mask())
+                if rng.random() < 0.6:
+                    # the revoker goes dark BEFORE its revoke can sync:
+                    # the grant chain keeps spreading and deepening with
+                    # HIGHER global_times while the revoke's stays put —
+                    # the late-revoke arrival order at every other peer
+                    went_dark = True
+                    dark[src] = int(rng.integers(3, 8))
+                    state, _ = _apply(state, cfg, Unload(members=[src]),
+                                      {}, {})
+                    oracle.unload([src])
+            elif roll < 0.63 and cfg.dynamic_meta_mask:
+                create(founder, META_DYNAMIC, 3, int(rng.integers(0, 2)))
+            elif roll < 0.72 and authored:        # undo own / other
+                a2, g2 = authored[int(rng.integers(0, len(authored)))]
+                u = rng.random()
+                if u < 0.35:
+                    create(a2, META_UNDO_OWN, a2, g2)
+                elif u < 0.7 and granted:
+                    # DELEGATED undo-other: the one control class whose
+                    # authority can be retro-revoked (a founder-authored
+                    # undo is axiomatic and exercises nothing)
+                    create(int(rng.choice(granted)), META_UNDO_OTHER,
+                           a2, g2)
+                else:
+                    create(founder, META_UNDO_OTHER, a2, g2)
+            else:                                 # protected user traffic,
+                # preferentially under freshly granted (doomable) chains
+                if granted and rng.random() < 0.6:
+                    author = int(rng.choice(granted))
+                gt_new = int(np.asarray(state.global_time)[author]) + 1
+                create(author, int(rng.choice([1, 2])),
+                       int(rng.integers(1, 1 << 16)))
+                authored.append((author, gt_new))
+            if not went_dark and rng.random() < 0.25:
+                # record authors go dark too (delayed control records)
+                dark[author] = int(rng.integers(2, 6))
+                state, _ = _apply(state, cfg, Unload(members=[author]),
+                                  {}, {})
+                oracle.unload([author])
+        woke = [m for m, left in dark.items() if left <= 1]
+        dark = {m: left - 1 for m, left in dark.items() if left > 1}
+        if woke:
+            state, _ = _apply(state, cfg, Load(members=sorted(woke)), {}, {})
+            oracle.load(sorted(woke))
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle,
+                     f"adv-seed{seed}-round{rnd} cfg={cfg!r}")
+
+    # settle: everyone back up, no new events; full-sync must converge
+    state, _ = _apply(state, cfg, Load(members=members), {}, {})
+    oracle.load(members)
+    for rnd in range(ADV_SETTLE):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle,
+                     f"adv-seed{seed}-settle{rnd}")
+
+    # CONVERGENCE: identical record sets per community — the assertion
+    # the fold-time-only Timeline fails on late-revoke orderings.
+    sg = np.asarray(state.store_gt)
+    cols = [np.asarray(c) for c in
+            (state.store_gt, state.store_member, state.store_meta,
+             state.store_payload, state.store_aux)]
+
+    def recset(i):
+        live = sg[i] != EMPTY_U32_
+        return {tuple(int(c[i, j]) for c in cols)
+                for j in np.flatnonzero(live)}
+
+    ref = recset(members[0])
+    for m in members[1:]:
+        assert recset(m) == ref, \
+            (f"adv-seed{seed}: stores diverged between peer {members[0]} "
+             f"and {m} after settle — order-dependent permission state? "
+             f"cfg={cfg!r}")
+
+
+from dispersy_tpu.config import EMPTY_U32 as EMPTY_U32_  # noqa: E402
+
+
+def test_fuzz_adversarial_0():
+    run_adversarial_draw(3000)
+
+
+def test_fuzz_adversarial_1():
+    run_adversarial_draw(3001)
+
+
+def test_fuzz_adversarial_2():
+    run_adversarial_draw(3002)
+
+
+def test_fuzz_adversarial_3():
+    run_adversarial_draw(3003)
 
 
 def test_fuzz_draw_0():

@@ -1,0 +1,190 @@
+"""Active missing-message / missing-identity round trips.
+
+The reference releases delayed packets by ASKING for what they are
+missing: an undo that names an unseen target triggers
+dispersy-missing-message(member, global_time) to the packet's sender
+(reference: community.py on_missing_message, payload.py
+MissingMessagePayload, message.py DelayPacketByMissingMessage), and a
+packet from an unknown member triggers dispersy-missing-identity(mid)
+(reference: community.py on_missing_identity, conversion.py
+DelayPacketByMissingMember).  Here the same round trips run through the
+engine's pen + receipt channel (phases 4m/4i, config.msg_requests /
+identity_required / identity_requests), engine and oracle side by side,
+bit-for-bit — including under 30% packet loss.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispersy_tpu import engine as E
+from dispersy_tpu import state as S
+from dispersy_tpu.config import (ConfigError, META_AUTHORIZE,
+                                 META_UNDO_OTHER, CommunityConfig, perm_bit)
+from dispersy_tpu.crypto import MemberRegistry, create_identities
+from dispersy_tpu.oracle import sim as O
+from dispersy_tpu.state import FLAG_UNDONE
+
+from test_oracle import assert_match
+
+CFG_MM = CommunityConfig(
+    n_peers=20, n_trackers=2, msg_capacity=32, bloom_capacity=8,
+    k_candidates=8, request_inbox=4, tracker_inbox=8, response_budget=1,
+    timeline_enabled=True, n_meta=8, k_authorized=8,
+    delay_inbox=4, msg_requests=True, proof_inbox=4,
+    auto_load=False)
+
+FOUNDER = CFG_MM.founder
+A, U, X = 9, 10, 5      # record author, granted undoer, late joiner
+
+
+def both(cfg, seed=0):
+    state = S.init_state(cfg, jax.random.PRNGKey(seed))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=4)
+    oracle.seed_overlay(degree=4)
+    return state, oracle
+
+
+def mk_create(cfg, state_box, oracle):
+    def create(author, meta, payload, aux=0):
+        mask = np.arange(cfg.n_peers) == author
+        pl = np.full(cfg.n_peers, payload, np.uint32)
+        ax = np.full(cfg.n_peers, aux, np.uint32)
+        state_box[0] = E.create_messages(
+            state_box[0], cfg, jnp.asarray(mask), meta,
+            jnp.asarray(pl), jnp.asarray(ax))
+        oracle.create_messages(mask, meta, pl, aux=ax)
+        assert_match(jax.block_until_ready(state_box[0]), oracle,
+                     f"create {meta}")
+    return create
+
+
+def mk_run(cfg, state_box, oracle):
+    def run(rounds, tag):
+        for rnd in range(rounds):
+            state_box[0] = E.step(state_box[0], cfg)
+            oracle.step()
+            assert_match(jax.block_until_ready(state_box[0]), oracle,
+                         f"{tag}{rnd}")
+    return run
+
+
+def _undo_before_target(cfg):
+    """Late joiner X receives a granted undo-other BEFORE its target
+    (control records outrank user records in the serving order), parks
+    it, and — with msg_requests — fetches the target by name."""
+    state_box = [None]
+    state_box[0], oracle = both(cfg)
+    create = mk_create(cfg, state_box, oracle)
+    run = mk_run(cfg, state_box, oracle)
+
+    create(A, 0, 777)                        # the future undo target
+    tgt_gt = int(np.asarray(state_box[0].global_time)[A])
+    run(5, "spread-record")
+    create(FOUNDER, META_AUTHORIZE, U, perm_bit(0, "undo"))
+    run(5, "spread-grant")
+    mask_x = np.arange(cfg.n_peers) == X
+    state_box[0] = E.unload_members(state_box[0], cfg, jnp.asarray(mask_x))
+    oracle.unload([X])
+    # X's community memory (store included? no — store persists, but X
+    # holds the target already).  Wipe X's store rows for the target so
+    # the reload genuinely lacks it (a peer that joined after the spread).
+    sg = state_box[0].store_gt
+    hit = ((state_box[0].store_member == jnp.uint32(A))
+           & (sg == jnp.uint32(tgt_gt)))
+    hit = hit & (jnp.arange(cfg.n_peers) == X)[:, None]
+    from dispersy_tpu.ops import store as st
+    stc = st.StoreCols(gt=sg, member=state_box[0].store_member,
+                       meta=state_box[0].store_meta,
+                       payload=state_box[0].store_payload,
+                       aux=state_box[0].store_aux,
+                       flags=state_box[0].store_flags)
+    rm = st.store_remove(stc, hit)
+    state_box[0] = state_box[0].replace(
+        store_gt=rm.store.gt, store_member=rm.store.member,
+        store_meta=rm.store.meta, store_payload=rm.store.payload,
+        store_aux=rm.store.aux, store_flags=rm.store.flags)
+    oracle.peers[X].store = [
+        r for r in oracle.peers[X].store
+        if not (r.member == A and r.gt == tgt_gt)]
+    assert_match(jax.block_until_ready(state_box[0]), oracle, "surgery")
+
+    create(U, META_UNDO_OTHER, A, tgt_gt)    # granted undo, target known
+    run(4, "spread-undo")
+    state_box[0] = E.load_members(state_box[0], jnp.asarray(mask_x))
+    oracle.load([X])
+    run(10, "x-rejoins")
+    return state_box[0]
+
+
+def test_trace_undo_before_target_active_fetch():
+    state = _undo_before_target(CFG_MM)
+    # X ends with the target record stored AND undone-marked
+    has = ((np.asarray(state.store_member[X]) == A)
+           & (np.asarray(state.store_gt[X]) != 0xFFFFFFFF)
+           & (np.asarray(state.store_meta[X]) == 0))
+    assert has.any(), "X must recover the undo target"
+    flags = np.asarray(state.store_flags[X])[has]
+    assert (flags & FLAG_UNDONE).all(), "recovered target must be undone"
+    # the active channel actually carried traffic
+    assert int(np.asarray(state.stats.mm_requests).sum()) > 0
+    assert int(np.asarray(state.stats.mm_records).sum()) > 0
+
+
+def test_trace_missing_channels_under_loss():
+    """Both active channels stay bit-exact with 30% packet loss."""
+    _undo_before_target(CFG_MM.replace(packet_loss=0.3))
+    _identity_gate(CFG_ID.replace(packet_loss=0.3), rounds=10)
+
+
+CFG_ID = CommunityConfig(
+    n_peers=16, n_trackers=2, msg_capacity=48, bloom_capacity=16,
+    k_candidates=8, request_inbox=4, tracker_inbox=8, response_budget=2,
+    timeline_enabled=True, n_meta=8, k_authorized=8,
+    identity_enabled=True, identity_required=True, identity_requests=True,
+    delay_inbox=4, proof_inbox=4)
+
+
+def _identity_gate(cfg, rounds=12):
+    state_box = [None]
+    state_box[0], oracle = both(cfg, seed=1)
+    create = mk_create(cfg, state_box, oracle)
+    run = mk_run(cfg, state_box, oracle)
+    reg = MemberRegistry(n_peers=cfg.n_peers)
+    mask = np.arange(cfg.n_peers) >= cfg.n_trackers
+    state_box[0] = create_identities(state_box[0], cfg, reg)
+    payload = np.zeros(cfg.n_peers, np.uint32)
+    rows = np.flatnonzero(mask)
+    payload[rows] = [reg.member(int(i)).mid32 for i in rows]
+    from dispersy_tpu.config import META_IDENTITY
+    oracle.create_messages(mask, META_IDENTITY, payload)
+    assert_match(jax.block_until_ready(state_box[0]), oracle, "identities")
+    create(A, 0, 4242)       # spreads ahead of the low-priority identities
+    run(rounds, "spread")
+    return state_box[0]
+
+
+def test_trace_identity_gate_and_active_fetch():
+    state = _identity_gate(CFG_ID)
+    # the record still spread (identity fetched actively, not by luck)
+    holders = int(np.sum(np.any(
+        (np.asarray(state.store_payload) == 4242)
+        & (np.asarray(state.store_member) == A), axis=1)))
+    assert holders > CFG_ID.n_peers // 2
+    assert int(np.asarray(state.stats.id_requests).sum()) > 0
+    assert int(np.asarray(state.stats.id_records).sum()) > 0
+    # and some records were identity-parked along the way
+    assert int(np.asarray(state.stats.msgs_delayed).sum()) > 0
+
+
+def test_missing_request_config_validation():
+    with pytest.raises(ConfigError):
+        CFG_MM.replace(delay_inbox=0)          # pen required
+    with pytest.raises(ConfigError):
+        CommunityConfig(n_peers=8, n_trackers=1, identity_requests=True,
+                        identity_enabled=True, timeline_enabled=True,
+                        delay_inbox=2)         # needs identity_required
+    with pytest.raises(ConfigError):
+        CommunityConfig(n_peers=8, n_trackers=1, identity_required=True)

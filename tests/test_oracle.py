@@ -29,14 +29,16 @@ FIELDS = ["alive", "loaded", "session", "global_time",
           "fwd_gt", "fwd_member", "fwd_meta", "fwd_payload", "fwd_aux",
           "dly_gt", "dly_member", "dly_meta", "dly_payload", "dly_aux",
           "dly_since", "dly_src",
-          "auth_member", "auth_mask", "auth_gt", "auth_rev", "mal_member",
+          "auth_member", "auth_mask", "auth_gt", "auth_rev", "auth_issuer",
+          "mal_member",
           "sig_target", "sig_meta", "sig_payload", "sig_gt", "sig_since"]
 STAT_FIELDS = ["walk_success", "walk_fail", "msgs_stored", "msgs_dropped",
                "requests_dropped", "punctures", "msgs_forwarded",
                "msgs_rejected", "msgs_direct", "msgs_delayed",
                "proof_requests", "proof_records", "seq_requests", "seq_records",
+               "mm_requests", "mm_records", "id_requests", "id_records",
                "sig_signed", "sig_done", "sig_expired", "conflicts",
-               "convictions_rx",
+               "convictions_rx", "auth_unwound", "msgs_retro",
                "bytes_up", "bytes_down", "accepted_by_meta"]
 
 

@@ -39,18 +39,22 @@ P_REVOKE = perm_bit(PROT, PERM_REVOKE)
 P_UNDO = perm_bit(PROT, PERM_UNDO)
 
 
-def mk_table(rows, n=1, a=4):
-    """rows: (member, mask, gt) or (member, mask, gt, rev) -> AuthTable
-    [n, a] (row 0 filled)."""
+def mk_table(rows, n=1, a=4, founder=99):
+    """rows: (member, mask, gt[, rev[, issuer]]) -> AuthTable [n, a]
+    (row 0 filled; issuer defaults to the founder so hand-built tables
+    are chain-consistent under revalidate)."""
     member = np.full((n, a), EMPTY_U32, np.uint32)
     mask = np.zeros((n, a), np.uint32)
     gt = np.zeros((n, a), np.uint32)
     rev = np.zeros((n, a), bool)
+    issuer = np.full((n, a), EMPTY_U32, np.uint32)
     for j, row in enumerate(rows):
         member[0, j], mask[0, j], gt[0, j] = row[:3]
         rev[0, j] = bool(row[3]) if len(row) > 3 else False
+        issuer[0, j] = row[4] if len(row) > 4 else founder
     return tl.AuthTable(member=jnp.asarray(member), mask=jnp.asarray(mask),
-                        gt=jnp.asarray(gt), rev=jnp.asarray(rev))
+                        gt=jnp.asarray(gt), rev=jnp.asarray(rev),
+                        issuer=jnp.asarray(issuer))
 
 
 def ck(tab, member, meta, gt, founder=99, perm=PERM_PERMIT):
@@ -105,7 +109,8 @@ def test_fold_dedup_and_capacity():
         target=jnp.asarray([[7, 7]], jnp.uint32),
         mask=jnp.asarray([[2, 2]], jnp.uint32),
         gt=jnp.asarray([[3, 3]], jnp.uint32),
-        is_revoke=jnp.zeros((1, 2), bool))
+        is_revoke=jnp.zeros((1, 2), bool),
+        issuer=jnp.asarray([[99, 99]], jnp.uint32))
     r1 = tl.fold(tab, valid=jnp.ones((1, 2), bool), **args)
     # identical rows: second is a dup, only one slot used
     assert int(jnp.sum(r1.table.member != jnp.uint32(EMPTY_U32))) == 1
@@ -116,16 +121,34 @@ def test_fold_dedup_and_capacity():
                   mask=jnp.asarray([[2, 2]], jnp.uint32),
                   gt=jnp.asarray([[3, 3]], jnp.uint32),
                   is_revoke=jnp.ones((1, 2), bool),
-                  valid=jnp.ones((1, 2), bool))
+                  valid=jnp.ones((1, 2), bool),
+                  issuer=jnp.asarray([[99, 99]], jnp.uint32))
     assert int(jnp.sum(r1b.table.member != jnp.uint32(EMPTY_U32))) == 2
-    # fill the table, then overflow drops and counts
+    # overflow keeps the top-A rows by (gt, member, mask, rev, issuer):
+    # higher-keyed arrivals EVICT the minimum row in place; lower-keyed
+    # arrivals drop.  Both counted (tl.fold docstring).
     r2 = tl.fold(r1b.table,
                  target=jnp.asarray([[8, 9]], jnp.uint32),
                  mask=jnp.asarray([[2, 2]], jnp.uint32),
                  gt=jnp.asarray([[4, 5]], jnp.uint32),
                  is_revoke=jnp.zeros((1, 2), bool),
-                 valid=jnp.ones((1, 2), bool))
-    assert int(r2.n_dropped[0]) == 2
+                 valid=jnp.ones((1, 2), bool),
+                 issuer=jnp.asarray([[99, 99]], jnp.uint32))
+    assert int(r2.n_evicted[0]) == 2          # gt-3 rows displaced in turn
+    assert int(r2.n_dropped[0]) == 0
+    assert sorted(
+        (int(g), int(m)) for g, m in
+        zip(np.asarray(r2.table.gt[0]), np.asarray(r2.table.member[0]))
+    ) == [(4, 8), (5, 9)]
+    # a LOWER-keyed arrival against the now-(4,5) table drops instead
+    r3 = tl.fold(r2.table,
+                 target=jnp.asarray([[11]], jnp.uint32),
+                 mask=jnp.asarray([[2]], jnp.uint32),
+                 gt=jnp.asarray([[2]], jnp.uint32),
+                 is_revoke=jnp.zeros((1, 1), bool),
+                 valid=jnp.ones((1, 1), bool),
+                 issuer=jnp.asarray([[99]], jnp.uint32))
+    assert int(r3.n_dropped[0]) == 1 and int(r3.n_evicted[0]) == 0
 
 
 def run_both_script(cfg, script, rounds, seed=0, warm=4):
@@ -188,8 +211,9 @@ def test_trace_authorize_then_protected_sync():
     state = state.replace(
         auth_member=state.auth_member.at[9, 0].set(9),
         auth_mask=state.auth_mask.at[9, 0].set(P_PERMIT),
-        auth_gt=state.auth_gt.at[9, 0].set(1))
-    oracle.peers[9].auth.append(O.AuthRow(9, P_PERMIT, 1))
+        auth_gt=state.auth_gt.at[9, 0].set(1),
+        auth_issuer=state.auth_issuer.at[9, 0].set(FOUNDER))
+    oracle.peers[9].auth.append(O.AuthRow(9, P_PERMIT, 1, issuer=FOUNDER))
 
     def create(author, meta, payload, aux):
         nonlocal state
@@ -523,7 +547,8 @@ def test_check_grant_cross_form_equal():
         tab = tl.AuthTable(
             member=jnp.asarray(member), mask=jnp.asarray(mask),
             gt=jnp.asarray(rng.integers(1, 20, (n, a)), jnp.uint32),
-            rev=jnp.asarray(rev))
+            rev=jnp.asarray(rev),
+            issuer=jnp.asarray(rng.integers(0, 8, (n, a)), jnp.uint32))
         q_member = jnp.asarray(rng.integers(0, 8, (n, b)), jnp.uint32)
         q_mask = jnp.asarray(
             rng.integers(0, 1 << 32, (n, b), dtype=np.uint64)
@@ -537,3 +562,198 @@ def test_check_grant_cross_form_equal():
             np.testing.assert_array_equal(
                 np.asarray(got_b), np.asarray(got_c),
                 err_msg=f"trial {trial} perm {perm}")
+
+
+# ---- order independence: retroactive re-walk (reference: timeline.py
+# lazy chain re-validation — VERDICT r4 #2) ------------------------------
+
+def test_revalidate_unwinds_late_revoke_transitively():
+    """tl.revalidate: a revoke pre-dating a delegated grant unwinds that
+    grant AND everything issued under it, regardless of fold order."""
+    F = 99
+    # chain-consistent table: founder->7 authorize@2, 7->8 permit@6
+    tab = mk_table([(7, P_AUTH, 2), (8, P_PERMIT, 6, False, 7)])
+    keep = np.asarray(tl.revalidate(tab, F, 8))
+    assert keep[0, :2].all()
+    # + late revoke founder->7 authorize@3 (BEFORE the delegated grant)
+    tab2 = mk_table([(7, P_AUTH, 2), (8, P_PERMIT, 6, False, 7),
+                     (7, P_AUTH, 3, True)])
+    keep2 = np.asarray(tl.revalidate(tab2, F, 8))
+    assert keep2[0, 0] and keep2[0, 2]       # founder rows stand
+    assert not keep2[0, 1]                   # delegated grant unwound
+    # transitive: founder->7 auth@2, 7->8 auth@6, 8->9 permit@8, revoke@3
+    tab3 = mk_table([(7, P_AUTH, 2), (8, P_AUTH, 6, False, 7),
+                     (9, P_PERMIT, 8, False, 8), (7, P_AUTH, 3, True)])
+    keep3 = np.asarray(tl.revalidate(tab3, F, 8))
+    assert keep3[0, 0] and keep3[0, 3]
+    assert not keep3[0, 1] and not keep3[0, 2]   # whole chain unwound
+    # a LATER revoke (gt 7 > the grant chain) unwinds nothing historical
+    tab4 = mk_table([(7, P_AUTH, 2), (8, P_PERMIT, 6, False, 7),
+                     (7, P_AUTH, 7, True)])
+    keep4 = np.asarray(tl.revalidate(tab4, F, 8))
+    assert keep4[0, :3].all()
+    # a self-grant cannot witness itself once its support is revoked
+    tab5 = mk_table([(7, P_AUTH, 2), (7, P_AUTH, 6, False, 7),
+                     (7, P_AUTH, 3, True)])
+    keep5 = np.asarray(tl.revalidate(tab5, F, 8))
+    assert not keep5[0, 1]
+
+
+def test_trace_opposite_order_revoke_converges():
+    """VERDICT r4 done-criterion: two peers that receive {grant-chain,
+    revoke} in OPPOSITE orders converge to identical permission verdicts
+    AND identical stores (reference: timeline.py Timeline.check is
+    order-independent via lazy re-validation).
+
+    The founder authors a revoke of A's authorize authority and is
+    immediately unloaded, so the revoke sits dark in its store while A's
+    delegated grant to B — and B's protected records under it — spread to
+    everyone else.  When the founder re-loads, the revoke (whose
+    global_time PRE-DATES the delegated grant) syncs out late: every peer
+    that folded grant-then-revoke must unwind to exactly the state of the
+    founder, which saw revoke-then-grant and never accepted any of it.
+    """
+    cfg = CFG.replace(auto_load=False)
+    A, B, X = 9, 10, 5                 # granter, grantee, bystander
+    state = S.init_state(cfg, jax.random.PRNGKey(3))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=4)
+    oracle.seed_overlay(degree=4)
+
+    def create(author, meta, payload, aux=0):
+        nonlocal state
+        mask = np.arange(cfg.n_peers) == author
+        pl = np.full(cfg.n_peers, payload, np.uint32)
+        ax = np.full(cfg.n_peers, aux, np.uint32)
+        state = E.create_messages(state, cfg, jnp.asarray(mask), meta,
+                                  jnp.asarray(pl), jnp.asarray(ax))
+        oracle.create_messages(mask, meta, pl, aux=ax)
+        assert_match(jax.block_until_ready(state), oracle, f"create {meta}")
+
+    def run(rounds, tag):
+        nonlocal state
+        for rnd in range(rounds):
+            state = E.step(state, cfg)
+            oracle.step()
+            assert_match(jax.block_until_ready(state), oracle,
+                         f"{tag}{rnd}")
+
+    create(FOUNDER, META_AUTHORIZE, A, P_AUTH)   # founder -> A: authorize
+    run(5, "spread-grant")
+    # the revoke claims its global_time NOW (pre-dating A's grant below),
+    # then goes dark before it can sync anywhere
+    create(FOUNDER, META_REVOKE, A, P_AUTH)
+    mask_f = np.arange(cfg.n_peers) == FOUNDER
+    state = E.unload_members(state, cfg, jnp.asarray(mask_f))
+    oracle.unload([FOUNDER])
+    assert_match(jax.block_until_ready(state), oracle, "founder-dark")
+    create(X, 0, 4242)                 # filler: clocks rise past the revoke
+    run(3, "clock-rise")
+    create(A, META_AUTHORIZE, B, P_PERMIT)       # A -> B: permit (later gt)
+    run(4, "spread-deleg")
+    create(B, PROT, 555)               # B's record under the doomed grant
+    run(5, "spread-record")
+    holders = int(jnp.sum(jnp.any(
+        (state.store_payload == 555) & (state.store_member == B), axis=1)))
+    assert holders > 1, "grant-first peers must accept B's record first"
+
+    state = E.load_members(state, jnp.asarray(mask_f))
+    oracle.load([FOUNDER])
+    assert_match(jax.block_until_ready(state), oracle, "founder-back")
+    run(18, "revoke-sync")
+
+    # Convergence: B's record and A's grant are gone EVERYWHERE — the
+    # grant-first majority unwound to the founder's revoke-first view.
+    holders = int(jnp.sum(jnp.any(
+        (state.store_payload == 555) & (state.store_member == B), axis=1)))
+    assert holders == 0, "retro-reject must remove B's record everywhere"
+    deleg = int(jnp.sum(jnp.any(
+        (state.store_meta == jnp.uint32(META_AUTHORIZE))
+        & (state.store_member == A), axis=1)))
+    assert deleg == 0, "the delegated grant record must be unwound"
+    assert int(jnp.sum(state.stats.auth_unwound)) > 0
+    assert int(jnp.sum(state.stats.msgs_retro)) > 0
+    # identical stores: founder (revoke-first) vs bystander (grant-first)
+    def recset(i):
+        keep = np.asarray(state.store_gt[i]) != EMPTY_U32
+        return {tuple(int(np.asarray(c[i])[j]) for c in
+                      (state.store_gt, state.store_member, state.store_meta,
+                       state.store_payload, state.store_aux))
+                for j in range(len(keep)) if keep[j]}
+    assert recset(FOUNDER) == recset(X), \
+        "opposite arrival orders must converge to identical stores"
+
+
+def test_trace_opposite_order_undo_grant_revoke_converges():
+    """Review-found corner: a DELEGATED undo-other accepted under a
+    later-revoked UNDO grant must unwind — record removed AND the
+    target's undone mark cleared — so grant-first peers converge to the
+    revoke-first view (reference: lazy Timeline.check covers undo
+    authority like any other permission)."""
+    cfg = CFG.replace(auto_load=False)
+    A, U, X = 9, 10, 5                 # record author, undoer, bystander
+    state = S.init_state(cfg, jax.random.PRNGKey(5))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=4)
+    oracle.seed_overlay(degree=4)
+
+    def create(author, meta, payload, aux=0):
+        nonlocal state
+        mask = np.arange(cfg.n_peers) == author
+        pl = np.full(cfg.n_peers, payload, np.uint32)
+        ax = np.full(cfg.n_peers, aux, np.uint32)
+        state = E.create_messages(state, cfg, jnp.asarray(mask), meta,
+                                  jnp.asarray(pl), jnp.asarray(ax))
+        oracle.create_messages(mask, meta, pl, aux=ax)
+        assert_match(jax.block_until_ready(state), oracle, f"create {meta}")
+
+    def run(rounds, tag):
+        nonlocal state
+        for rnd in range(rounds):
+            state = E.step(state, cfg)
+            oracle.step()
+            assert_match(jax.block_until_ready(state), oracle,
+                         f"{tag}{rnd}")
+
+    create(A, 0, 888)                            # the undo target
+    tgt_gt = int(np.asarray(state.global_time)[A])
+    U_BIT = perm_bit(0, "undo")                  # undo authority on META 0
+    create(FOUNDER, META_AUTHORIZE, U, U_BIT)
+    run(5, "spread")
+    # the revoke of U's undo authority claims its global_time NOW,
+    # then goes dark while U's undo spreads at higher global_times
+    create(FOUNDER, META_REVOKE, U, U_BIT)
+    mask_f = np.arange(cfg.n_peers) == FOUNDER
+    state = E.unload_members(state, cfg, jnp.asarray(mask_f))
+    oracle.unload([FOUNDER])
+    create(X, 0, 4243)                 # filler: clocks rise past the revoke
+    run(3, "clock-rise")
+    create(U, META_UNDO_OTHER, A, tgt_gt)
+    run(6, "spread-undo")
+    marked = int(jnp.sum(jnp.any(
+        (state.store_member == jnp.uint32(A))
+        & (state.store_gt == jnp.uint32(tgt_gt))
+        & ((state.store_flags & jnp.uint32(1)) != 0), axis=1)))
+    assert marked > 1, "grant-first peers must apply the undo first"
+
+    state = E.load_members(state, jnp.asarray(mask_f))
+    oracle.load([FOUNDER])
+    run(18, "revoke-sync")
+    # the undo record is gone everywhere and every undone mark with it
+    undos = int(jnp.sum(jnp.any(
+        (state.store_meta == jnp.uint32(META_UNDO_OTHER))
+        & (state.store_member == jnp.uint32(U)), axis=1)))
+    assert undos == 0, "the doomed undo record must be unwound"
+    marked = int(jnp.sum(jnp.any(
+        (state.store_member == jnp.uint32(A))
+        & (state.store_gt == jnp.uint32(tgt_gt))
+        & ((state.store_flags & jnp.uint32(1)) != 0), axis=1)))
+    assert marked == 0, "undone marks must be re-derived without the undo"
+
+    def recset(i):
+        keep = np.asarray(state.store_gt[i]) != EMPTY_U32
+        return {tuple(int(np.asarray(c[i])[j]) for c in
+                      (state.store_gt, state.store_member, state.store_meta,
+                       state.store_payload, state.store_aux))
+                for j in range(len(keep)) if keep[j]}
+    assert recset(FOUNDER) == recset(X)

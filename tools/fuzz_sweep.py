@@ -32,14 +32,33 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--start", type=int, default=2000)
     ap.add_argument("--count", type=int, default=50)
-    ap.add_argument("--out", default="artifacts/fuzz_sweep.json")
+    ap.add_argument("--adversarial", action="store_true",
+                    help="permission-heavy draws: random grant/revoke/undo "
+                         "interleavings + dark authors + cross-peer store "
+                         "convergence assert (test_fuzz_configs."
+                         "run_adversarial_draw)")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default: artifacts/fuzz_sweep.json,"
+                         " or artifacts/fuzz_sweep_adversarial.json with"
+                         " --adversarial)")
     args = ap.parse_args()
+    if args.out is None:
+        args.out = ("artifacts/fuzz_sweep_adversarial.json"
+                    if args.adversarial else "artifacts/fuzz_sweep.json")
 
-    from test_fuzz_configs import run_draw   # pulls in jax (CPU-pinned)
+    from test_fuzz_configs import run_adversarial_draw, run_draw  # noqa: E501  pulls in jax (CPU-pinned)
     import jax
+    if args.adversarial:
+        run_draw = run_adversarial_draw
 
     passed, skipped, failed = [], [], []
     t0 = time.time()
+    doc = {
+        "tool": "fuzz_sweep", "seed_start": args.start, "seeds_run": 0,
+        "adversarial": bool(args.adversarial),
+        "passed": 0, "skipped_invalid_config": 0, "failed": 0,
+        "failed_seeds": [], "wall_seconds": 0.0,
+    }
     for i, seed in enumerate(range(args.start, args.start + args.count)):
         if i and i % 10 == 0:
             # Every drawn config compiles a full fresh step program;
@@ -66,6 +85,7 @@ def main() -> None:
         doc = {
             "tool": "fuzz_sweep", "seed_start": args.start,
             "seeds_run": seed - args.start + 1,
+            "adversarial": bool(args.adversarial),
             "passed": len(passed), "skipped_invalid_config": len(skipped),
             "failed": len(failed), "failed_seeds": failed,
             "wall_seconds": round(time.time() - t0, 1),

@@ -59,7 +59,8 @@ def _everything_on_config(n_peers: int):
         double_meta_mask=0b100, sig_inbox=2,
         last_sync_history=(0, 0, 0, 2, 0, 0, 0, 0),
         seq_meta_mask=0b1000000, seq_requests=True,
-        delay_inbox=2, proof_requests=True, identity_enabled=True,
+        delay_inbox=2, proof_requests=True, msg_requests=True,
+        identity_enabled=True,
         malicious_enabled=True, k_malicious=4, malicious_gossip=True,
         churn_rate=0.03, packet_loss=0.1, p_symmetric=0.2)
 
@@ -81,10 +82,14 @@ def _broadcast_config(n_peers: int):
 def _worker(args) -> None:
     import jax
 
+    # initialization_timeout raised from the 300 s default: at 1M peers a
+    # single-core box timeslices both ranks through minutes of init and
+    # compile before the coordinator handshake settles (VERDICT r4 #5).
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{args.port}",
         num_processes=args.num_processes,
-        process_id=args.process_id)
+        process_id=args.process_id,
+        initialization_timeout=900)
 
     import jax.numpy as jnp
     import numpy as np
@@ -108,7 +113,10 @@ def _worker(args) -> None:
     n_local = len(jax.local_devices())
     n_global = len(jax.devices())
     hb(f"cluster up: {n_local} local / {n_global} global devices")
-    assert n_global == args.num_processes * DEVICES_PER_PROCESS
+    # the hash-verify REFERENCE is one process owning the whole mesh
+    expect = (args.hash_groups if args.num_processes == 1
+              and args.hash_groups > 1 else args.num_processes)
+    assert n_global == expect * DEVICES_PER_PROCESS
 
     if args.mode == "broadcast":
         cfg = _broadcast_config(args.peers)
@@ -146,34 +154,72 @@ def _worker(args) -> None:
     step_sharded = jax.jit(engine.step, static_argnums=1,
                            in_shardings=(shardings,),
                            out_shardings=shardings)
+
+    import hashlib as _hl
+
+    def group_hash(tree, devs):
+        """SHA256 over the group's addressable shards in (leaf, device)
+        order — the scale-friendly bit-equality witness: identical shard
+        layout + identical bytes <=> identical hash, with no allgather
+        and no full-state replay (both of which are what skewed rank 0
+        minutes past Gloo's 30 s collective deadline at 1M peers)."""
+        h = _hl.sha256()
+        for leaf in jax.tree_util.tree_leaves(tree):
+            shards = {s.device: s for s in leaf.addressable_shards}
+            for d in devs:
+                s = shards.get(d)
+                if s is not None:
+                    h.update(np.ascontiguousarray(
+                        np.asarray(s.data)).tobytes())
+        return h.hexdigest()
+
+    if args.verify == "hash":
+        local = None      # symmetric ranks: nobody replays single-device
     t0 = time.time()
     curve = []
     for rnd in range(args.rounds):
         gstate = jax.block_until_ready(step_sharded(gstate, cfg))
-        if args.process_id == 0:
+        if args.verify != "hash" and args.process_id == 0:
             # Only rank 0 pays for the full single-device replay — the
             # replicas would be bit-identical on every rank anyway
             # (same PRNGKey), and the parent requires rank 0's rc.
             local = jax.block_until_ready(engine.step(local, cfg))
         if rnd == 0:
             hb(f"round 0 done (+{time.time() - t0:.1f}s incl. compiles)")
-        # Bit-exact cross-check.  process_allgather is a COLLECTIVE —
-        # every rank participates; only the numpy compare is rank-0-only.
-        gathered = jax.tree.map(
-            lambda g: multihost_utils.process_allgather(g, tiled=True),
-            gstate)
-        if args.process_id == 0:
-            mism = diff_leaves(gathered, local)
-            assert not mism, f"round {rnd}: sharded != local at {mism}"
-            hb(f"round {rnd}: {len(jax.tree_util.tree_leaves(local))} "
-               f"leaves bit-equal across {args.num_processes} processes")
+        if args.verify == "hash":
+            # Per-rank shard hashes; the parent compares them against a
+            # single-process run over the SAME global mesh layout.
+            if args.num_processes == 1 and args.hash_groups > 1:
+                all_devs = jax.devices()
+                per = len(all_devs) // args.hash_groups
+                for g in range(args.hash_groups):
+                    hh = group_hash(gstate, all_devs[g * per:(g + 1) * per])
+                    print(f"HASH {rnd} {g} {hh}", flush=True)
+            else:
+                hh = group_hash(gstate, jax.local_devices())
+                print(f"HASH {rnd} {args.process_id} {hh}", flush=True)
+        else:
+            # Bit-exact cross-check.  process_allgather is a COLLECTIVE —
+            # every rank participates; only the numpy compare is
+            # rank-0-only.
+            gathered = jax.tree.map(
+                lambda g: multihost_utils.process_allgather(g, tiled=True),
+                gstate)
+            if args.process_id == 0:
+                mism = diff_leaves(gathered, local)
+                assert not mism, f"round {rnd}: sharded != local at {mism}"
+                hb(f"round {rnd}: {len(jax.tree_util.tree_leaves(local))} "
+                   f"leaves bit-equal across {args.num_processes} "
+                   f"processes")
         if args.mode == "broadcast":
-            # Every rank computes coverage from the GATHERED (full)
-            # state so the early-exit decision is identical everywhere —
-            # a rank-0-only break would leave the others blocked in the
+            # Every rank computes coverage identically (from the gathered
+            # state, or — hash mode — as a sharded reduction on the
+            # global state) so the early-exit decision matches everywhere
+            # — a rank-0-only break would leave the others blocked in the
             # next collective.
             cov = float(engine.coverage(
-                gathered, member=cfg.n_trackers + 1, gt=gt0, meta=0,
+                gstate if args.verify == "hash" else gathered,
+                member=cfg.n_trackers + 1, gt=gt0, meta=0,
                 payload=42))
             curve.append(round(cov, 6))
             if args.process_id == 0:
@@ -229,6 +275,15 @@ def main() -> None:
                     help="broadcast = config #2's rounds-to-99% metric, "
                          "measured ON the cluster")
     ap.add_argument("--out", default="artifacts/multihost_cpu.json")
+    ap.add_argument("--verify", choices=["full", "hash"], default="full",
+                    help="full = per-round allgather vs a single-device "
+                         "replay on rank 0 (leaf-exact, memory-heavy); "
+                         "hash = per-rank shard SHA256s compared against "
+                         "a single-process run over the same global mesh "
+                         "(scale path — no allgather, no replay, ranks "
+                         "stay symmetric so Gloo's 30 s collective "
+                         "deadline cannot fire on init skew)")
+    ap.add_argument("--hash-groups", type=int, default=1)
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--process-id", type=int, default=0)
     ap.add_argument("--port", type=int, default=0)
@@ -236,6 +291,42 @@ def main() -> None:
     if args.worker:
         _worker(args)
         return
+    if args.verify == "hash" and args.mode != "broadcast":
+        ap.error("--verify hash is the broadcast-mode scale path")
+
+    ref_hashes: dict[tuple[int, int], str] = {}
+    ref_curve = None
+    if args.verify == "hash":
+        # Reference: ONE process owning the whole virtual mesh, hashing
+        # its shards grouped exactly as the cluster's ranks will.
+        env1 = cpu_env(n_devices=DEVICES_PER_PROCESS * args.num_processes)
+        env1.pop("JAX_COMPILATION_CACHE_DIR", None)
+        rport = _free_port()
+        ref_log = f"/tmp/multihost_ref_{rport}.log"
+        with open(ref_log, "w") as lf:
+            rc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker",
+                 "--process-id", "0", "--port", str(rport),
+                 "--num-processes", "1",
+                 "--peers", str(args.peers), "--rounds", str(args.rounds),
+                 "--mode", args.mode, "--verify", "hash",
+                 "--hash-groups", str(args.num_processes)],
+                env=env1, stdout=lf, stderr=subprocess.STDOUT,
+                timeout=WORKER_TIMEOUT_S).returncode
+        with open(ref_log) as f:
+            ref_out = f.read()
+        if rc != 0:
+            sys.stderr.write(f"reference run failed rc={rc}:\n"
+                             f"{ref_out[-3000:]}\n")
+            sys.exit(1)
+        for line in ref_out.splitlines():
+            if line.startswith("HASH "):
+                _, r, g, h = line.split()
+                ref_hashes[(int(r), int(g))] = h
+            if line.startswith("CURVE "):
+                ref_curve = json.loads(line[6:])
+        sys.stderr.write(f"reference run: {len(ref_hashes)} group-hashes "
+                         f"over {len(ref_curve or [])} rounds\n")
 
     env = cpu_env(n_devices=DEVICES_PER_PROCESS)
     # No persistent compile cache for cluster workers: ASYMMETRIC cache
@@ -256,14 +347,17 @@ def main() -> None:
         logs = [f"/tmp/multihost_w{i}_{port}.log"
                 for i in range(args.num_processes)]
         procs = []
+        log_handles = []
         for i in range(args.num_processes):
+            lf = open(logs[i], "w")
+            log_handles.append(lf)
             procs.append(subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__), "--worker",
                  "--process-id", str(i), "--port", str(port),
                  "--num-processes", str(args.num_processes),
                  "--peers", str(args.peers), "--rounds", str(args.rounds),
-                 "--mode", args.mode],
-                env=env, stdout=open(logs[i], "w"),
+                 "--mode", args.mode, "--verify", args.verify],
+                env=env, stdout=lf,
                 stderr=subprocess.STDOUT, start_new_session=True))
         deadline = time.time() + WORKER_TIMEOUT_S
         ok = True
@@ -282,10 +376,21 @@ def main() -> None:
                         pass
                 p.wait()
         ok = ok and all(p.returncode == 0 for p in procs)
+        for lf in log_handles:
+            lf.close()
         outs = []
         for lg in logs:
             with open(lg) as f:
                 outs.append(f.read())
+        if not ok:
+            # Keep full logs for post-mortem (only a 3000-char tail is
+            # printed below); move them out of the per-attempt names so
+            # retries don't accumulate unbounded files in /tmp.
+            for i, lg in enumerate(logs):
+                try:
+                    os.replace(lg, f"/tmp/multihost_failed_w{i}.log")
+                except OSError:
+                    pass
         # _free_port closes its probe socket before the coordinator
         # rebinds (TOCTOU): if the coordinator lost the port to another
         # process, retry once on a fresh one.
@@ -297,6 +402,19 @@ def main() -> None:
     wall = time.time() - t0
     for i, out in enumerate(outs):
         sys.stderr.write(f"--- worker {i} ---\n{out[-3000:]}\n")
+    hash_ok = None
+    if args.verify == "hash" and ok:
+        got: dict[tuple[int, int], str] = {}
+        for out in outs:
+            for line in out.splitlines():
+                if line.startswith("HASH "):
+                    _, r, g, h = line.split()
+                    got[(int(r), int(g))] = h
+        hash_ok = bool(got) and got == ref_hashes
+        sys.stderr.write(
+            f"hash verify: {len(got)} cluster group-hashes vs "
+            f"{len(ref_hashes)} reference — "
+            f"{'EQUAL' if hash_ok else 'MISMATCH'}\n")
     doc = {
         "tool": "multihost",
         "mode": args.mode,
@@ -304,7 +422,11 @@ def main() -> None:
         "devices_per_process": DEVICES_PER_PROCESS,
         "n_peers": args.peers,
         "rounds_requested": args.rounds,
-        "bit_equal_vs_single_device": ok,
+        "verify": args.verify,
+        "bit_equal_vs_single_device": (ok if args.verify == "full"
+                                       else bool(ok and hash_ok)),
+        "hash_rounds_compared": (len(ref_hashes) // args.num_processes
+                                 if args.verify == "hash" else None),
         "wall_seconds": round(wall, 1),
         "config": ("config #2 broadcast (rounds-to-99% measured on the "
                    "cluster)" if args.mode == "broadcast" else
@@ -321,6 +443,8 @@ def main() -> None:
             doc["rounds_to_99pct"] = (
                 next((i + 1 for i, c in enumerate(curve) if c >= 0.99),
                      None))
+            if ref_curve is not None:
+                doc["curve_matches_reference"] = curve == ref_curve
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=1)

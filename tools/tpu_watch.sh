@@ -61,6 +61,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     rm -f "$MARK"; say "small bench failed; backing off 600s"; sleep 600
     continue
   fi
+  # the worker prints the headline line first and a combined line last;
+  # keep only the last line so the artifact is a single JSON document
+  tail -n 1 artifacts/bench_tpu_64k.json > artifacts/.bench64k.tmp \
+    && mv artifacts/.bench64k.tmp artifacts/bench_tpu_64k.json
   # the direct --worker call bypasses bench.py's platform guard: a worker
   # whose jax silently fell back to CPU exits 0 with platform "cpu" —
   # that is NOT a TPU capture, and the 1M stages would hammer a dead tunnel

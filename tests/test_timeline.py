@@ -757,3 +757,28 @@ def test_trace_opposite_order_undo_grant_revoke_converges():
                        state.store_payload, state.store_aux))
                 for j in range(len(keep)) if keep[j]}
     assert recset(FOUNDER) == recset(X)
+
+
+def test_revalidate_documented_cycle_boundary():
+    """Pin the DOCUMENTED divergence (ops/timeline.py module docstring,
+    PARITY.md known boundaries): a mutually-granting same-global_time row
+    pair keeps witnessing itself through the greatest-fixed-point re-walk
+    after its root is revoked — where the reference's visited-set proof
+    walk would reject it.  If revalidate ever changes to a least-fixed-
+    point or visited-set walk, this test flips and the docs must follow."""
+    F = 99
+    # root: founder->7 authorize@2; cycle: 7->8 and 8->7 authorize@5;
+    # late revoke of 7's authorize@3 severs the root
+    tab = mk_table([(7, P_AUTH, 2), (8, P_AUTH, 5, False, 7),
+                    (7, P_AUTH, 5, False, 8), (7, P_AUTH, 3, True)])
+    keep = np.asarray(tl.revalidate(tab, F, 8))
+    assert keep[0, 0] and keep[0, 3]          # founder rows stand
+    # the cycle self-sustains: each row's issuer is granted by the other
+    # at the same gt (<= comparison), the diagonal exclusion only blocks
+    # SELF-support — the documented bounded-walk divergence
+    assert keep[0, 1] and keep[0, 2]
+    # without the cycle partner, the same row dies with its root
+    tab2 = mk_table([(7, P_AUTH, 2), (8, P_AUTH, 5, False, 7),
+                     (7, P_AUTH, 3, True)])
+    keep2 = np.asarray(tl.revalidate(tab2, F, 8))
+    assert not keep2[0, 1]

@@ -59,13 +59,15 @@ def main() -> None:
         "passed": 0, "skipped_invalid_config": 0, "failed": 0,
         "failed_seeds": [], "wall_seconds": 0.0,
     }
+    # Every drawn config compiles a full fresh step program; too many in
+    # one process exhaust LLVM's code memory (observed: "LLVM compilation
+    # error: Cannot allocate memory" at draw ~52 of a knob sweep, and at
+    # draw 8 of an ADVERSARIAL sweep — those draws compile several
+    # create/step/unload variants each).  Dropping the in-process caches
+    # bounds the growth; adversarial draws need it every draw.
+    clear_every = 1 if args.adversarial else 10
     for i, seed in enumerate(range(args.start, args.start + args.count)):
-        if i and i % 10 == 0:
-            # Every drawn config compiles a full fresh step program;
-            # 100+ of them in one process exhaust LLVM's code memory
-            # (observed: "LLVM compilation error: Cannot allocate
-            # memory" at draw ~52 of a 100-draw run).  Dropping the
-            # in-process caches bounds the growth.
+        if i and i % clear_every == 0:
             jax.clear_caches()
         t1 = time.time()
         try:

@@ -363,6 +363,10 @@ def main() -> None:
         ap.error("--cluster-rounds is the hash-mode prefix knob; with "
                  "--verify full every round is verified, so a reduced "
                  "round count must be an explicit --rounds")
+    if args.cluster_rounds > args.rounds:
+        ap.error("--cluster-rounds beyond --rounds would compare the "
+                 "cluster against reference rounds that never ran — "
+                 "guaranteed spurious MISMATCH")
 
     ref_hashes: dict[tuple[int, int], str] = {}
     ref_curve = None
@@ -506,6 +510,8 @@ def main() -> None:
                                  if args.verify == "hash" else None),
         "reference_hash_rounds": (len(ref_hashes) // args.num_processes
                                   if args.verify == "hash" else None),
+        "cluster_rounds": ((args.cluster_rounds or args.rounds)
+                           if args.verify == "hash" else None),
         "wall_seconds": round(wall, 1),
         "config": ("config #2 broadcast (rounds-to-99% measured on the "
                    "cluster)" if args.mode == "broadcast" else
@@ -529,6 +535,21 @@ def main() -> None:
                 doc["reference_rounds_to_99pct"] = next(
                     (i + 1 for i, c in enumerate(ref_curve) if c >= 0.99),
                     None)
+    if args.verify == "hash":
+        # COMPLETENESS: prefix-subset matching alone would let missing
+        # hash lines (a rank looping one round short, garbled stdout)
+        # pass silently — require a contiguous round range, every rank
+        # present for every round, and the count agreeing with the
+        # rounds the cluster actually ran (rank 0's curve length).
+        rounds_seen = {r for r, _ in got}
+        complete = (bool(got)
+                    and rounds_seen == set(range(len(rounds_seen)))
+                    and all((r, g) in got for r in rounds_seen
+                            for g in range(args.num_processes))
+                    and len(rounds_seen) == doc.get("rounds_run", -1))
+        doc["hash_coverage_complete"] = complete
+        doc["bit_equal_vs_single_device"] = bool(
+            doc["bit_equal_vs_single_device"] and complete)
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=1)

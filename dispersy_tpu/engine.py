@@ -234,6 +234,47 @@ def _author_linear(state: PeerState, cfg: CommunityConfig, meta: int,
     return jnp.where(best > 0, (best & 1) == 1, static)
 
 
+def _rebuild_valid_table(stc: st.StoreCols, cfg: CommunityConfig,
+                         founder_col: jnp.ndarray, a_slots: int):
+    """(table, rows_unwound): the auth table as a PURE FUNCTION of the
+    store — fold every stored authorize/revoke record in canonical store
+    order into an empty top-A window, re-walk chain validity
+    (tl.revalidate), and compact the survivors.  Convergent stores give
+    convergent tables; incremental fold histories do not (an evicted or
+    dropped row can never re-fold — its record is in the store, so never
+    ``fresh`` again — which left peers with equal stores but permanently
+    different windows; adversarial sweep seed 3051).  Rebuild
+    bookkeeping (drops/evictions) is not a new loss: uncounted."""
+    n = stc.gt.shape[0]
+    is_rev_row = stc.meta == jnp.uint32(META_REVOKE)
+    is_crow = (stc.meta == jnp.uint32(META_AUTHORIZE)) | is_rev_row
+    user_bits = jnp.uint32(user_perm_mask(cfg.n_meta))
+    empty_tab = tl.AuthTable(
+        member=jnp.full((n, a_slots), EMPTY_U32, jnp.uint32),
+        mask=jnp.zeros((n, a_slots), jnp.uint32),
+        gt=jnp.zeros((n, a_slots), jnp.uint32),
+        rev=jnp.zeros((n, a_slots), bool),
+        issuer=jnp.full((n, a_slots), EMPTY_U32, jnp.uint32))
+    auth = tl.fold(empty_tab, target=stc.payload,
+                   mask=stc.aux & user_bits, gt=stc.gt,
+                   is_revoke=is_rev_row,
+                   valid=is_crow, issuer=stc.member).table
+    keep = tl.revalidate(auth, founder_col, cfg.n_meta)
+    live = auth.member != jnp.uint32(EMPTY_U32)
+    n_unwound = jnp.sum((live & ~keep).astype(jnp.int32), axis=-1)
+    # Compact survivors left (order preserved) so later folds fill from
+    # the end again — the same dense-slots invariant fold maintains.
+    rank = jnp.cumsum(keep.astype(jnp.int32), axis=-1) - 1
+    slot = jnp.where(keep, rank, a_slots)
+    auth = tl.AuthTable(
+        member=st.rank_compact(auth.member, slot, a_slots, EMPTY_U32),
+        mask=st.rank_compact(auth.mask, slot, a_slots, 0),
+        gt=st.rank_compact(auth.gt, slot, a_slots, 0),
+        rev=st.rank_compact(auth.rev, slot, a_slots, False),
+        issuer=st.rank_compact(auth.issuer, slot, a_slots, EMPTY_U32))
+    return auth, n_unwound
+
+
 def _retro_pass(auth: tl.AuthTable, stc: st.StoreCols, cfg: CommunityConfig,
                 founder_col: jnp.ndarray):
     """Retroactive permission re-walk after a revoke folds.
@@ -262,21 +303,21 @@ def _retro_pass(auth: tl.AuthTable, stc: st.StoreCols, cfg: CommunityConfig,
     monotone), and undo marks on surviving records stay — only record
     EXISTENCE is re-decided here.  Returns (auth', store', rows_unwound
     i32[N], records_removed i32[N]).
+
+    Step 0 REBUILDS the table from the store's control records in store
+    order before anything else.  Incremental folding alone is not
+    order-independent at the bounded window: a row evicted (or dropped)
+    while the table was full can never re-fold — its record is already
+    in the store, so it is never ``fresh`` again — leaving two peers
+    with convergent STORES but permanently different TABLES when their
+    eviction histories differed (found by the adversarial sweep, seed
+    3051).  Rebuilding from the store's canonical (gt, member, ...)
+    order makes the table a pure function of the store, which does
+    converge; the trigger set (any revoke fold, any eviction) guarantees
+    a rebuild fires whenever windows could have disagreed.
     """
-    keep = tl.revalidate(auth, founder_col, cfg.n_meta)
-    live = auth.member != jnp.uint32(EMPTY_U32)
-    n_unwound = jnp.sum((live & ~keep).astype(jnp.int32), axis=-1)
-    # Compact survivors left (order preserved) so later folds fill from
-    # the end again — the same dense-slots invariant fold maintains.
     a_slots = auth.member.shape[-1]
-    rank = jnp.cumsum(keep.astype(jnp.int32), axis=-1) - 1
-    slot = jnp.where(keep, rank, a_slots)
-    auth = tl.AuthTable(
-        member=st.rank_compact(auth.member, slot, a_slots, EMPTY_U32),
-        mask=st.rank_compact(auth.mask, slot, a_slots, 0),
-        gt=st.rank_compact(auth.gt, slot, a_slots, 0),
-        rev=st.rank_compact(auth.rev, slot, a_slots, False),
-        issuer=st.rank_compact(auth.issuer, slot, a_slots, EMPTY_U32))
+    auth, n_unwound = _rebuild_valid_table(stc, cfg, founder_col, a_slots)
 
     fcol = founder_col[:, None]
     user_bits = jnp.uint32(user_perm_mask(cfg.n_meta))
@@ -336,6 +377,11 @@ def _retro_pass(auth: tl.AuthTable, stc: st.StoreCols, cfg: CommunityConfig,
         (stc.meta < 32) & um,
         stc.flags | jnp.uint32(FLAG_UNDONE),
         stc.flags & ~jnp.uint32(FLAG_UNDONE)))
+    # Final rebuild from the POST-prune store: the stage 1-3 removals
+    # freed window slots that stored-but-previously-dropped rows must be
+    # able to claim, or the table is top-A of a store that no longer
+    # exists (the residual order dependence a review pass flagged).
+    auth, _ = _rebuild_valid_table(stc, cfg, founder_col, a_slots)
     return (auth, stc, n_unwound,
             r1.n_removed + r2.n_removed + r3.n_removed)
 

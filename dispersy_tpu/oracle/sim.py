@@ -501,7 +501,8 @@ class OracleSim:
         return self._auth_bit(owner, member, tmeta, gt, PERM_UNDO)
 
     def _auth_fold(self, owner: int, target: int, mask: int, gt: int,
-                   is_revoke: bool, issuer: int) -> bool:
+                   is_revoke: bool, issuer: int,
+                   count: bool = True) -> bool:
         """tl.fold for one accepted authorize/revoke record.  Returns True
         when an existing row was EVICTED (the engine's retro trigger).
 
@@ -523,7 +524,8 @@ class OracleSim:
         mi = min(range(len(p.auth)), key=lambda j: key(p.auth[j]))
         newk = (int(gt), int(target), int(mask), int(bool(is_revoke)),
                 int(issuer))
-        p.msgs_dropped += 1        # a row is lost either way
+        if count:                  # a row is lost either way; the retro
+            p.msgs_dropped += 1    # REBUILD's bookkeeping is not a loss
         if key(p.auth[mi]) < newk:
             p.auth[mi] = AuthRow(target, mask, gt, is_revoke, issuer)
             return True
@@ -537,38 +539,19 @@ class OracleSim:
         protected user rows under the surviving flip set)."""
         cfg, p = self.cfg, self.peers[owner]
         f = self._founder(owner)
+        # step 0: REBUILD the table from the store's control records in
+        # store order (engine._retro_pass step 0 — the bounded window is
+        # only order-independent as a pure function of the store);
+        # rebuild bookkeeping is not counted as a loss
+        gmask0 = user_perm_mask(cfg.n_meta)
+        p.auth = []
+        for r in p.store:
+            if r.meta in (META_AUTHORIZE, META_REVOKE):
+                self._auth_fold(owner, r.payload, r.aux & gmask0, r.gt,
+                                r.meta == META_REVOKE, issuer=r.member,
+                                count=False)
         rows = p.auth
-        keep = [True] * len(rows)
-        for _ in range(cfg.k_authorized):
-            new_keep = []
-            for ri, r in enumerate(rows):
-                if r.issuer == f:
-                    new_keep.append(True)
-                    continue
-                if r.mask == 0:
-                    new_keep.append(False)
-                    continue
-                perm = PERM_REVOKE if r.rev else PERM_AUTHORIZE
-                ok = True
-                for k in range(cfg.n_meta):
-                    if not (r.mask >> (4 * k)) & 0xF:
-                        continue
-                    sup = [s for si, s in enumerate(rows)
-                           if keep[si] and si != ri
-                           and s.member == r.issuer
-                           and (s.mask >> (4 * k + perm)) & 1
-                           and s.gt <= r.gt]
-                    if not sup:
-                        ok = False
-                        break
-                    best = max(s.gt for s in sup)
-                    at_best = [s for s in sup if s.gt == best]
-                    if not (any(not s.rev for s in at_best)
-                            and not any(s.rev for s in at_best)):
-                        ok = False
-                        break
-                new_keep.append(ok)
-            keep = new_keep
+        keep = self._revalidate_keep(owner, rows)
         p.auth_unwound += sum(1 for kk in keep if not kk)
         p.auth = [r for r, kk in zip(rows, keep) if kk]
 
@@ -643,6 +626,55 @@ class OracleSim:
                     r.flags |= FLAG_UNDONE
                 else:
                     r.flags &= ~FLAG_UNDONE
+        # final rebuild from the POST-prune store (engine mirror): freed
+        # window slots must be claimable by stored rows
+        p.auth = []
+        for r in p.store:
+            if r.meta in (META_AUTHORIZE, META_REVOKE):
+                self._auth_fold(owner, r.payload, r.aux & gmask0, r.gt,
+                                r.meta == META_REVOKE, issuer=r.member,
+                                count=False)
+        rows = p.auth
+        keep = self._revalidate_keep(owner, rows)
+        p.auth = [r for r, kk in zip(rows, keep) if kk]
+
+    def _revalidate_keep(self, owner: int, rows) -> list:
+        """tl.revalidate mirror over ``rows`` (k_authorized iterations,
+        greatest fixed point, diagonal excluded)."""
+        cfg = self.cfg
+        f = self._founder(owner)
+        keep = [True] * len(rows)
+        for _ in range(cfg.k_authorized):
+            new_keep = []
+            for ri, r in enumerate(rows):
+                if r.issuer == f:
+                    new_keep.append(True)
+                    continue
+                if r.mask == 0:
+                    new_keep.append(False)
+                    continue
+                perm = PERM_REVOKE if r.rev else PERM_AUTHORIZE
+                ok = True
+                for k in range(cfg.n_meta):
+                    if not (r.mask >> (4 * k)) & 0xF:
+                        continue
+                    sup = [s for si, s in enumerate(rows)
+                           if keep[si] and si != ri
+                           and s.member == r.issuer
+                           and (s.mask >> (4 * k + perm)) & 1
+                           and s.gt <= r.gt]
+                    if not sup:
+                        ok = False
+                        break
+                    best = max(s.gt for s in sup)
+                    at_best = [s for s in sup if s.gt == best]
+                    if not (any(not s.rev for s in at_best)
+                            and not any(s.rev for s in at_best)):
+                        ok = False
+                        break
+                new_keep.append(ok)
+            keep = new_keep
+        return keep
 
     def _has_identity(self, owner: int, member: int) -> bool:
         """ik.identity_stored for one member vs one peer's store."""

@@ -782,3 +782,58 @@ def test_revalidate_documented_cycle_boundary():
                      (7, P_AUTH, 3, True)])
     keep2 = np.asarray(tl.revalidate(tab2, F, 8))
     assert not keep2[0, 1]
+
+
+def test_trace_create_eviction_triggers_retro():
+    """A grant CREATED at a full table evicts the minimum row (top-A
+    window) — and the eviction itself must trigger the retro re-walk,
+    unwinding rows the displaced grant proved (engine create_messages'
+    lax.cond on fr.n_evicted; same trigger as the intake's).  Engine and
+    oracle stay bit-equal throughout; the dependent chain dies on both
+    sides the moment its proof leaves the window."""
+    cfg = CFG.replace(k_authorized=3)
+    n = cfg.n_peers
+    state = S.init_state(cfg, jax.random.PRNGKey(11))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=4)
+    oracle.seed_overlay(degree=4)
+
+    def create(author, meta, payload, aux=0):
+        nonlocal state
+        mask = np.arange(n) == author
+        pl = np.full(n, payload, np.uint32)
+        ax = np.full(n, aux, np.uint32)
+        state = E.create_messages(state, cfg, jnp.asarray(mask), meta,
+                                  jnp.asarray(pl), jnp.asarray(ax))
+        oracle.create_messages(mask, meta, pl, aux=ax)
+        assert_match(jax.block_until_ready(state), oracle, f"create {meta}")
+
+    def run(rounds, tag):
+        nonlocal state
+        for rnd in range(rounds):
+            state = E.step(state, cfg)
+            oracle.step()
+            assert_match(jax.block_until_ready(state), oracle,
+                         f"{tag}{rnd}")
+
+    # founder's own table: grant A authorize (slot 1 of 3), A delegates
+    # to B once the grant spreads — the founder folds A->B as a row too
+    create(FOUNDER, META_AUTHORIZE, 9, P_AUTH)
+    run(4, "spread")
+    create(9, META_AUTHORIZE, 10, P_PERMIT)   # delegated, rides on slot 2
+    run(4, "deleg")
+    full = int(jnp.sum(state.auth_member[FOUNDER]
+                       != jnp.uint32(EMPTY_U32)))
+    assert full >= 2
+    # fill + overflow the founder's 3-slot window with HIGHER-keyed
+    # grants: the founder->A root eventually evicts, and the A->B row
+    # (still inside the window, proved by the evicted root) must unwind
+    for k, target in enumerate((11, 12, 13)):
+        create(FOUNDER, META_AUTHORIZE, target, P_PERMIT)
+        run(1, f"fill{k}")
+    run(6, "settle")
+    # bit-equality held every round (assert_match above); check the
+    # EFFECT: some eviction happened and the retro counters moved
+    assert int(jnp.sum(state.stats.msgs_dropped)) > 0
+    assert int(jnp.sum(state.stats.auth_unwound)) > 0, \
+        "evicting the root grant must unwind the delegated row"

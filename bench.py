@@ -71,19 +71,20 @@ def _hb(msg: str) -> None:
 
 
 def _worker(n_peers_override: int | None = None) -> None:
-    # Durable in-repo compile cache on TPU only (entries target the chip,
-    # so they survive across attempts and rounds).  On CPU this is a
-    # no-op: the CPU fallback compiles cold, trading ~1 min of compile
-    # inside the 900 s budget for a tail free of the XLA:CPU AOT loader's
-    # cross-host SIGILL hazard (see dispersy_tpu/cpuenv.py).
-    from dispersy_tpu.cpuenv import enable_repo_cache
-    enable_repo_cache()
+    # Durable compile cache on TPU ONLY (entries target the chip and
+    # survive across attempts and rounds — the 26-40 s first-step
+    # compiles are what burned the r04/r05 tunnel windows).  CPU workers
+    # always compile cold: a same-host persistent CPU cache was tried
+    # (2026-08-03) and the warm-run executable segfaults
+    # deterministically — see cpuenv.enable_bench_cache / BENCH.md.
+    from dispersy_tpu.cpuenv import enable_bench_cache
+    enable_bench_cache()
 
     import jax
     import jax.numpy as jnp
 
     from dispersy_tpu import engine
-    from dispersy_tpu.config import CommunityConfig
+    from dispersy_tpu.profiling import bench_config
     from dispersy_tpu.state import init_state
 
     _hb("importing jax / resolving backend")
@@ -91,22 +92,16 @@ def _worker(n_peers_override: int | None = None) -> None:
     _hb(f"backend ready: {platform}")
     if platform == "tpu":
         # Config #3-shaped load (Bloom-sync with a real backlog) at the
-        # largest population one chip holds comfortably.
-        n = n_peers_override or (1 << 20)  # 1,048,576 peers
-        cfg = CommunityConfig(
-            n_peers=n, n_trackers=8, k_candidates=16, msg_capacity=48,
-            bloom_capacity=48, request_inbox=4, tracker_inbox=1024,
-            response_budget=8, churn_rate=0.0)
+        # largest population one chip holds comfortably.  The shape is
+        # SHARED with tools/profile_round.py via profiling.bench_config,
+        # so bench and profile numbers describe one layout.
+        cfg = bench_config(n_peers_override or (1 << 20), "tpu")
     else:
-        # CPU fallback (no TPU attached): same shape at 64k peers — the
-        # largest population that compiles + times comfortably inside
+        # CPU fallback (no TPU attached): the 64k rung — the largest
+        # population that compiles + times comfortably inside
         # CPU_TIMEOUT_S on one core (VERDICT r4 weak #7: the old 8k
         # number was information-free at 0.8% of the target population).
-        cfg = CommunityConfig(
-            n_peers=n_peers_override or (1 << 16), n_trackers=4,
-            k_candidates=16, msg_capacity=64,
-            bloom_capacity=64, request_inbox=4, tracker_inbox=256,
-            response_budget=8, churn_rate=0.0)
+        cfg = bench_config(n_peers_override or (1 << 16), "cpu")
 
     _hb(f"init_state at n_peers={cfg.n_peers}")
     state = init_state(cfg, jax.random.PRNGKey(0))
@@ -241,6 +236,17 @@ def _parse_result(stdout) -> dict | None:
     return None
 
 
+def _peers_override(argv) -> int | None:
+    """Population override for smoke-sized runs: ``--peers N`` beats the
+    ``BENCH_PEERS`` env var; None means the per-platform defaults (1M on
+    TPU, 64k CPU fallback) and the TPU retry ladder."""
+    if "--peers" in argv:
+        return int(argv[argv.index("--peers") + 1])
+    if os.environ.get("BENCH_PEERS"):
+        return int(os.environ["BENCH_PEERS"])
+    return None
+
+
 def main() -> None:
     # The TPU tunnel is *intermittently* up (BENCH.md's optimization log
     # got TPU runs through on the same day BENCH_r02 recorded a CPU
@@ -250,11 +256,13 @@ def main() -> None:
     # CPU fallback.
     deadline = time.monotonic() + TOTAL_BUDGET_S
     result = None
+    peers = _peers_override(sys.argv)
     # Population ladder: a timed-out 1M attempt retries smaller — an
     # honest TPU number at 256k (vs_baseline scales by population) beats
     # a CPU fallback at 8k.  The r4 manual sweep saw the 1M worker hit
-    # its 900 s ceiling while smaller TPU runs fit comfortably.
-    ladder = [None, 1 << 18, 1 << 16]
+    # its 900 s ceiling while smaller TPU runs fit comfortably.  An
+    # explicit --peers/BENCH_PEERS override pins every rung instead.
+    ladder = [peers] if peers else [None, 1 << 18, 1 << 16]
     rung = 0   # advances only when a WORKER ran and failed — wedged-tunnel
     #            probe retries must not shrink a 1M run never attempted
     if os.environ.get("JAX_PLATFORMS", "") != "cpu":
@@ -290,7 +298,7 @@ def main() -> None:
             if progressed:   # init OK -> the workload was the problem;
                 rung += 1    # an init hang must not shrink an unrun 1M
     if result is None:
-        result, _ = _try_worker(cpu_env(), CPU_TIMEOUT_S)
+        result, _ = _try_worker(cpu_env(), CPU_TIMEOUT_S, n_peers=peers)
     if result is not None and result.get("platform") != "tpu":
         # Make a CPU-fallback line self-explanatory to whoever reads the
         # recorded artifact: the TPU attempt failed (tunnel down/wedged),
@@ -315,6 +323,8 @@ if __name__ == "__main__":
         n_over = None
         if "--n-peers" in sys.argv:
             n_over = int(sys.argv[sys.argv.index("--n-peers") + 1])
+        if n_over is None:
+            n_over = _peers_override(sys.argv)
         _worker(n_over)
     else:
         main()

@@ -46,8 +46,26 @@ from dispersy_tpu.state import PeerState, init_state, wipe_instance_memory
 # v5: + the pen's deliverer column (dly_src) and the proof_requests /
 #     proof_records counters (active missing-proof round trips).
 # v6: PeerState gained the `loaded` leaf.
-FORMAT_VERSION = 7   # v7: + auth_issuer (retro re-walk handle) and the
-#     auth_unwound/msgs_retro + mm_*/id_* counter leaves
+# v7: + auth_issuer (retro re-walk handle) and the auth_unwound/msgs_retro
+#     + mm_*/id_* counter leaves.
+FORMAT_VERSION = 8   # v8: store_meta/fwd_meta/dly_meta narrowed to uint8
+#     (EMPTY_META holes) and store_flags to uint8 — the bandwidth diet
+#     (config.META_DTYPE/FLAGS_DTYPE).  v7 archives still load: the
+#     sentinel is EMPTY_U32's low byte, so plain uint32 -> uint8
+#     truncation is the lossless up-conversion (_upconvert_v7).
+
+# Leaves whose dtype narrowed u32 -> u8 at v8; a v7 archive's u32 arrays
+# convert by truncation (0xFFFFFFFF -> 0xFF, real values < 256 unchanged).
+_NARROWED_V8 = frozenset(
+    {"store_meta", "store_flags", "fwd_meta", "dly_meta"})
+
+
+def _upconvert_v7(name: str, arr: np.ndarray,
+                  want_dtype: np.dtype) -> np.ndarray:
+    if (name in _NARROWED_V8 and arr.dtype == np.uint32
+            and np.dtype(want_dtype) == np.uint8):
+        return arr.astype(np.uint8)
+    return arr
 
 
 def _fingerprint(cfg: CommunityConfig) -> str:
@@ -84,7 +102,7 @@ def restore(path: str, cfg: CommunityConfig,
     """
     with np.load(path) as z:
         version = int(z["meta:version"])
-        if version != FORMAT_VERSION:
+        if version not in (7, FORMAT_VERSION):
             raise CheckpointError(f"checkpoint format {version}, "
                              f"expected {FORMAT_VERSION}")
         stored_cfg = bytes(z["meta:config"]).decode()
@@ -101,6 +119,8 @@ def restore(path: str, cfg: CommunityConfig,
             if key not in z:
                 raise CheckpointError(f"checkpoint missing field {n}")
             arr = z[key]
+            if version < FORMAT_VERSION:
+                arr = _upconvert_v7(n, arr, t.dtype)
             if arr.shape != t.shape or arr.dtype != t.dtype:
                 raise CheckpointError(
                     f"field {n}: checkpoint {arr.shape}/{arr.dtype} vs "
@@ -216,7 +236,7 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
 
     with np.load(os.path.join(dirpath, "meta.npz")) as z:
         version = int(z["meta:version"])
-        if version != FORMAT_VERSION:
+        if version not in (7, FORMAT_VERSION):
             raise CheckpointError(f"checkpoint format {version}, "
                              f"expected {FORMAT_VERSION}")
         stored_cfg = bytes(z["meta:config"]).decode()
@@ -245,6 +265,8 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
                     raise CheckpointError(f"{spath}: unknown leaf {name}")
                 arr = z[key]
                 want = filled[name]
+                if version < FORMAT_VERSION:
+                    arr = _upconvert_v7(name, arr, want.dtype)
                 if arr.shape[1:] != want.shape[1:] or arr.dtype != want.dtype:
                     raise CheckpointError(
                         f"field {name} rows [{lo},{hi}): shard "
@@ -256,6 +278,8 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
     for name, t in zip(names, t_leaves):
         if name in meta_leaves:
             arr = meta_leaves[name]
+            if version < FORMAT_VERSION:
+                arr = _upconvert_v7(name, arr, t.dtype)
             if arr.shape != t.shape or arr.dtype != t.dtype:
                 raise CheckpointError(
                     f"field {name}: checkpoint {arr.shape}/{arr.dtype} vs "

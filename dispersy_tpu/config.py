@@ -26,6 +26,21 @@ EMPTY_U32 = 0xFFFFFFFF
 # Sentinel peer index for "no peer" in int32 index fields.
 NO_PEER = -1
 
+# ---- narrowed record-column dtypes (the byte diet, BENCH.md roofline) ----
+# The fused round is memory-bandwidth-bound, so persistent columns whose
+# value range provably fits a narrower word are stored narrow.  Meta ids
+# fit 8 bits: user metas stay < MAX_USER_META (24), the dispersy-* control
+# band tops out at META_MALICIOUS (0xF7), and the empty-slot sentinel is
+# EMPTY_META = 0xFF — exactly the low byte of EMPTY_U32, so plain uint32
+# <-> uint8 truncation is the lossless up/down conversion on the reachable
+# value set (checkpoint.restore uses this to load pre-narrowing archives).
+# Flags carry single bits (bit 0 = undone).  gt / member / payload / aux
+# stay uint32: clocks and payloads are genuinely 32-bit, and aux carries
+# full permission-nibble masks (4 bits x 8 metas).
+EMPTY_META = 0xFF
+META_DTYPE = "uint8"
+FLAGS_DTYPE = "uint8"
+
 # Candidate categories (reference: candidate.py WalkCandidate tracks separate
 # walk/stumble/intro timestamps; categories drive the walk split).
 CAT_NONE = 0

@@ -70,6 +70,17 @@ def enable_repo_cache() -> None:
         _enable_cache(TPU_CACHE_DIR)
 
 
+def enable_bench_cache() -> None:
+    """Persistent compile cache for the bench worker: the committed
+    chip-targeted cache on TPU (the 26-40 s first-step compiles it
+    amortizes are what burned the r04/r05 tunnel windows); NOTHING on
+    CPU.  A same-host CPU cache was tried (2026-08-03) and the warm-run
+    executable SEGFAULTS deterministically — the AOT-loader hazard
+    documented at TPU_CACHE_DIR bites same-host deserialization too, so
+    CPU workers always compile cold.  Imports jax lazily."""
+    enable_repo_cache()
+
+
 def enable_tool_cache(path: str = "/tmp/jax_cache") -> None:
     """Compile cache for local tools (scaling/profile sweeps).
 

@@ -52,7 +52,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_U32,
+from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_META, EMPTY_U32,
                                  IDENTITY_PRIORITY,
                                  INTRO_REQUEST_BASE_BYTES,
                                  INTRO_RESPONSE_BYTES, META_AUTHORIZE,
@@ -375,8 +375,8 @@ def _retro_pass(auth: tl.AuthTable, stc: st.StoreCols, cfg: CommunityConfig,
     um = ik.undo_marked(stc, stc.member, stc.gt)
     stc = stc._replace(flags=jnp.where(
         (stc.meta < 32) & um,
-        stc.flags | jnp.uint32(FLAG_UNDONE),
-        stc.flags & ~jnp.uint32(FLAG_UNDONE)))
+        stc.flags | jnp.uint8(FLAG_UNDONE),
+        stc.flags & ~jnp.uint8(FLAG_UNDONE)))
     # Final rebuild from the POST-prune store: the stage 1-3 removals
     # freed window slots that stored-but-previously-dropped rows must be
     # able to claim, or the table is top-A of a store that no longer
@@ -435,18 +435,21 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         stc = st.StoreCols(
             gt=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.gt),
             member=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.member),
-            meta=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.meta),
+            meta=jnp.where(r1, jnp.uint8(EMPTY_META), stc.meta),
             payload=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.payload),
             aux=jnp.where(r1, jnp.uint32(0), stc.aux),
-            flags=jnp.where(r1, jnp.uint32(0), stc.flags))
-        fwd = tuple(jnp.where(r1, jnp.uint32(EMPTY_U32), c) for c in
+            flags=jnp.where(r1, jnp.uint8(0), stc.flags))
+        # Per-column empty sentinel: EMPTY_U32 truncated to each column's
+        # dtype (EMPTY_META on the narrowed u8 meta column).
+        fwd = tuple(jnp.where(r1, jnp.asarray(st.empty_of(c.dtype), c.dtype),
+                              c) for c in
                     (state.fwd_gt, state.fwd_member, state.fwd_meta,
                      state.fwd_payload, state.fwd_aux))
         # The delayed-message pen dies with the process (reference: delayed
         # batches live in the in-memory RequestCache, not the database).
         dly = (jnp.where(r1, jnp.uint32(EMPTY_U32), state.dly_gt),
                jnp.where(r1, jnp.uint32(EMPTY_U32), state.dly_member),
-               jnp.where(r1, jnp.uint32(EMPTY_U32), state.dly_meta),
+               jnp.where(r1, jnp.uint8(EMPTY_META), state.dly_meta),
                jnp.where(r1, jnp.uint32(EMPTY_U32), state.dly_payload),
                jnp.where(r1, jnp.uint32(0), state.dly_aux),
                jnp.where(r1, jnp.uint32(0), state.dly_since),
@@ -566,8 +569,19 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         # repair converges to 100% even against static stores (see
         # ops/bloom._h1_h2).  Round-synchronous, so the responder derives
         # the identical salt from its own round counter.
-        my_bloom = bloom.bloom_build(rec_h, in_slice, cfg.bloom_bits,
-                                     cfg.bloom_hashes, salt=rnd)
+        # On gather backends (CPU) the probe tensor materializes ONCE and
+        # is shared by the build here and every responder-slot query
+        # below — re-deriving the double-hash chain per call was a
+        # first-order byte cost of the round (bit-identical either way).
+        if bloom.gather_backend():
+            rec_probes = bloom.probe_bits(rec_h, cfg.bloom_bits,
+                                          cfg.bloom_hashes, salt=rnd)
+            my_bloom = bloom.bloom_build_from(rec_probes, in_slice,
+                                              cfg.bloom_bits)
+        else:
+            rec_probes = None
+            my_bloom = bloom.bloom_build(rec_h, in_slice, cfg.bloom_bits,
+                                         cfg.bloom_hashes, salt=rnd)
     else:
         zu = jnp.zeros((n,), jnp.uint32)
         sl = st.SyncSlice(time_low=zu, time_high=zu, modulo=zu, offset=zu)
@@ -634,7 +648,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             * jnp.uint32(RECORD_BYTES)
     else:
         p0 = jnp.zeros((n, 0), jnp.uint32)
-        ph_gt = ph_member = ph_meta = ph_payload = ph_aux = p0
+        ph_gt = ph_member = ph_payload = ph_aux = p0
+        ph_meta = jnp.zeros((n, 0), jnp.uint8)
         ph_ok = jnp.zeros((n, 0), bool)
         ph_src = jnp.zeros((n, 0), jnp.int32)
 
@@ -993,14 +1008,17 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         # The completed double-signed record, as one intake column.
         db_gt = jnp.where(completed, sg_gt, jnp.uint32(EMPTY_U32))[:, None]
         db_member = idx.astype(jnp.uint32)[:, None]
-        db_meta = sg_meta[:, None]
+        # sig_meta stays u32 state (one scalar slot per peer); the record
+        # column is the narrowed meta dtype — lossless, meta < n_meta.
+        db_meta = sg_meta.astype(jnp.uint8)[:, None]
         db_payload = sg_payload[:, None]
         db_aux = jnp.where(sg_target == NO_PEER, 0,
                            sg_target).astype(jnp.uint32)[:, None]
         db_ok = completed[:, None]
     else:
         d0 = jnp.zeros((n, 0), jnp.uint32)
-        db_gt = db_member = db_meta = db_payload = db_aux = d0
+        db_gt = db_member = db_payload = db_aux = d0
+        db_meta = jnp.zeros((n, 0), jnp.uint8)
         db_ok = jnp.zeros((n, 0), bool)
 
     # ---- phase 2b/5: sync responder + store insert ---------------------
@@ -1012,9 +1030,17 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     if cfg.sync_enabled:
         b = cfg.response_budget
         # The responder serves from its ordered view (priority DESC, gt
-        # ASC/DESC per meta); identity for default communities.
+        # ASC/DESC per meta); identity for default communities — in which
+        # case the claim's record hashes (and, on gather backends, the
+        # materialized probe tensor) are reused verbatim.
         stv = _response_order(stc, cfg)
-        rec_h2 = record_hash(stv.member, stv.gt, stv.meta, stv.payload)
+        if cfg.needs_response_order:
+            rec_h2 = record_hash(stv.member, stv.gt, stv.meta, stv.payload)
+            q_probes = (bloom.probe_bits(rec_h2, cfg.bloom_bits,
+                                         cfg.bloom_hashes, salt=rnd)
+                        if bloom.gather_backend() else None)
+        else:
+            rec_h2, q_probes = rec_h, rec_probes
         # A hard-killed responder serves nothing but the destroy record —
         # the reference's HardKilledCommunity answers every packet with the
         # packed dispersy-destroy-community message.
@@ -1031,9 +1057,12 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             in_sl = st.slice_mask(stv.gt, sl_s)                   # [N, M]
             if servable is not None:
                 in_sl = in_sl & servable
-            present = bloom.bloom_query(rq_bloom[:, s], rec_h2,
-                                        cfg.bloom_bits, cfg.bloom_hashes,
-                                        salt=rnd)
+            if q_probes is not None:
+                present = bloom.bloom_query_from(rq_bloom[:, s], q_probes)
+            else:
+                present = bloom.bloom_query(rq_bloom[:, s], rec_h2,
+                                            cfg.bloom_bits,
+                                            cfg.bloom_hashes, salt=rnd)
             if cfg.timeline_enabled:
                 # A hard-killed responder answers every request with the
                 # destroy record UNCONDITIONALLY (reference:
@@ -1047,12 +1076,17 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             # responder's ORDER BY under dispersy_sync_response_limit.
             rank = jnp.cumsum(missing.astype(jnp.int32), axis=1) - 1
             slot = jnp.where(missing & (rank < b), rank, b)
-            gts.append(st.rank_compact(stv.gt, slot, b, EMPTY_U32))
-            members.append(st.rank_compact(stv.member, slot, b, EMPTY_U32))
-            metas.append(st.rank_compact(stv.meta, slot, b, EMPTY_U32))
-            payloads.append(st.rank_compact(stv.payload, slot, b, EMPTY_U32))
-            auxs.append(st.rank_compact(stv.aux, slot, b, 0))
-            valids.append(st.rank_compact(missing, slot, b, False))
+            o_gt, o_member, o_meta, o_payload, o_aux, o_ok = \
+                st.rank_compact_many(
+                    [(stv.gt, EMPTY_U32), (stv.member, EMPTY_U32),
+                     (stv.meta, EMPTY_META), (stv.payload, EMPTY_U32),
+                     (stv.aux, 0), (missing, False)], slot, b)
+            gts.append(o_gt)
+            members.append(o_member)
+            metas.append(o_meta)
+            payloads.append(o_payload)
+            auxs.append(o_aux)
+            valids.append(o_ok)
         obox = [jnp.stack(c, axis=1)
                 for c in (gts, members, metas, payloads, auxs)]
         obox_ok = jnp.stack(valids, axis=1)                       # [N, R, b]
@@ -1070,7 +1104,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             * jnp.uint32(RECORD_BYTES)
     else:
         s0 = jnp.zeros((n, 0), jnp.uint32)
-        sy_gt = sy_member = sy_meta = sy_payload = sy_aux = s0
+        sy_gt = sy_member = sy_payload = sy_aux = s0
+        sy_meta = jnp.zeros((n, 0), jnp.uint8)
         sy_ok = jnp.zeros((n, 0), bool)
 
     if cfg.delay_enabled:
@@ -1078,7 +1113,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         dl_ok = (dl_gt != jnp.uint32(EMPTY_U32)) & act[:, None]
     else:
         z0 = jnp.zeros((n, 0), jnp.uint32)
-        dl_gt = dl_member = dl_meta = dl_payload = dl_aux = dl_since = z0
+        dl_gt = dl_member = dl_payload = dl_aux = dl_since = z0
+        dl_meta = jnp.zeros((n, 0), jnp.uint8)
         dl_src = jnp.zeros((n, 0), jnp.int32)
         dl_ok = jnp.zeros((n, 0), bool)
 
@@ -1132,7 +1168,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             pouts.append(tuple(st.rank_compact(col, pslot, pb, fill)
                                for col, fill in
                                ((stc.gt, EMPTY_U32), (stc.member, EMPTY_U32),
-                                (stc.meta, EMPTY_U32),
+                                (stc.meta, EMPTY_META),
                                 (stc.payload, EMPTY_U32), (stc.aux, 0),
                                 (m_s, False))))
         pbox = [jnp.stack([o[i] for o in pouts], axis=1)
@@ -1163,7 +1199,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             * jnp.uint32(RECORD_BYTES)
     else:
         q0 = jnp.zeros((n, 0), jnp.uint32)
-        pr_gt = pr_member = pr_meta = pr_payload = pr_aux = q0
+        pr_gt = pr_member = pr_payload = pr_aux = q0
+        pr_meta = jnp.zeros((n, 0), jnp.uint8)
         pr_ok = jnp.zeros((n, 0), bool)
         pr_src = jnp.zeros((n, 0), jnp.int32)
 
@@ -1232,7 +1269,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             qouts.append(tuple(st.rank_compact(col, qslot, qb, fill)
                                for col, fill in
                                ((stc.gt, EMPTY_U32), (stc.member, EMPTY_U32),
-                                (stc.meta, EMPTY_U32),
+                                (stc.meta, EMPTY_META),
                                 (stc.payload, EMPTY_U32), (stc.aux, 0),
                                 (m_s, False))))
         qbox = [jnp.stack([o[i] for o in qouts], axis=1)
@@ -1262,7 +1299,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             * jnp.uint32(RECORD_BYTES)
     else:
         m0 = jnp.zeros((n, 0), jnp.uint32)
-        mq_gt = mq_member = mq_meta = mq_payload = mq_aux = m0
+        mq_gt = mq_member = mq_payload = mq_aux = m0
+        mq_meta = jnp.zeros((n, 0), jnp.uint8)
         mq_ok = jnp.zeros((n, 0), bool)
         mq_src = jnp.zeros((n, 0), jnp.int32)
 
@@ -1316,7 +1354,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             mouts.append(tuple(st.rank_compact(col, mslot, 1, fill)
                                for col, fill in
                                ((stc.gt, EMPTY_U32), (stc.member, EMPTY_U32),
-                                (stc.meta, EMPTY_U32),
+                                (stc.meta, EMPTY_META),
                                 (stc.payload, EMPTY_U32), (stc.aux, 0),
                                 (m_s, False))))
         mbox = [jnp.stack([o[i] for o in mouts], axis=1)
@@ -1343,7 +1381,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             * jnp.uint32(RECORD_BYTES)
     else:
         mm0 = jnp.zeros((n, 0), jnp.uint32)
-        mm_gt = mm_member = mm_meta = mm_payload = mm_aux = mm0
+        mm_gt = mm_member = mm_payload = mm_aux = mm0
+        mm_meta = jnp.zeros((n, 0), jnp.uint8)
         mm_ok = jnp.zeros((n, 0), bool)
         mm_src = jnp.zeros((n, 0), jnp.int32)
 
@@ -1391,7 +1430,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             iouts.append(tuple(st.rank_compact(col, islot, 1, fill)
                                for col, fill in
                                ((stc.gt, EMPTY_U32), (stc.member, EMPTY_U32),
-                                (stc.meta, EMPTY_U32),
+                                (stc.meta, EMPTY_META),
                                 (stc.payload, EMPTY_U32), (stc.aux, 0),
                                 (m_s, False))))
         ibox = [jnp.stack([o[i] for o in iouts], axis=1)
@@ -1418,7 +1457,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             * jnp.uint32(RECORD_BYTES)
     else:
         ii0 = jnp.zeros((n, 0), jnp.uint32)
-        ii_gt = ii_member = ii_meta = ii_payload = ii_aux = ii0
+        ii_gt = ii_member = ii_payload = ii_aux = ii0
+        ii_meta = jnp.zeros((n, 0), jnp.uint8)
         ii_ok = jnp.zeros((n, 0), bool)
         ii_src = jnp.zeros((n, 0), jnp.int32)
 
@@ -1548,7 +1588,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         in_store = ik.in_store(stc, in_member, in_gt)
         dup_in_batch = ik.dup_earlier(in_member, in_gt, in_ok)
 
-        in_flags = jnp.zeros_like(in_gt)
+        in_flags = jnp.zeros(in_gt.shape, jnp.uint8)
         if cfg.timeline_enabled:
             # The receive pipeline's check step (reference: dispersy.py
             # _on_batch_cache -> meta.check_callback -> timeline.py
@@ -1668,8 +1708,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             # pre-undone (the reference re-marks on re-insert attempts).
             pre_undone = ((in_meta < 32)
                           & ik.undo_marked(stc, in_member, in_gt))
-            in_flags = jnp.where(pre_undone, jnp.uint32(FLAG_UNDONE),
-                                 jnp.uint32(0))
+            in_flags = jnp.where(pre_undone, jnp.uint8(FLAG_UNDONE),
+                                 jnp.uint8(0))
             stats = stats.replace(
                 msgs_dropped=stats.msgs_dropped
                 + (fr.n_dropped + fr2.n_dropped
@@ -1815,7 +1855,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             hit = ik.undo_hits_store(stc, in_payload, in_aux, batch_undo)
             hit = hit & (stc.meta < 32)
             stc = stc._replace(flags=jnp.where(
-                hit, stc.flags | jnp.uint32(FLAG_UNDONE), stc.flags))
+                hit, stc.flags | jnp.uint8(FLAG_UNDONE), stc.flags))
 
         if cfg.malicious_enabled and cfg.malicious_gossip:
             # The eyewitness authors its dispersy-malicious-proof record
@@ -1829,9 +1869,9 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                 st.StoreCols(
                     gt=g_gt_new[:, None],
                     member=idx.astype(jnp.uint32)[:, None],
-                    meta=jnp.full((n, 1), META_MALICIOUS, jnp.uint32),
+                    meta=jnp.full((n, 1), META_MALICIOUS, jnp.uint8),
                     payload=g_member[:, None], aux=g_gt[:, None],
-                    flags=jnp.zeros((n, 1), jnp.uint32)),
+                    flags=jnp.zeros((n, 1), jnp.uint8)),
                 new_mask=gossip_now[:, None], history=cfg.history)
             stc = gins.store
             global_time = jnp.where(gossip_now, g_gt_new, global_time)
@@ -1863,9 +1903,10 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         else:
             rank = jnp.cumsum(fresh.astype(jnp.int32), axis=1) - 1
         fslot = jnp.where(fresh & (rank < fb), rank, fb)
-        fwd = tuple(st.rank_compact(col, fslot, fb, EMPTY_U32)
-                    for col in (in_gt, in_member, in_meta, in_payload,
-                                in_aux))
+        fwd = tuple(st.rank_compact_many(
+            [(col, st.empty_of(col.dtype))
+             for col in (in_gt, in_member, in_meta, in_payload, in_aux)],
+            fslot, fb))
         if cfg.malicious_enabled and cfg.malicious_gossip and fb > 0:
             # The authored proof record claims a forward slot the way
             # create_messages does: first free, displacing the newest
@@ -1879,7 +1920,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                     jnp.where(gossip_now, val, cur[rowsg, gput]))
             fwd = (gbuf(fwd[0], g_gt_new),
                    gbuf(fwd[1], idx.astype(jnp.uint32)),
-                   gbuf(fwd[2], jnp.full((n,), META_MALICIOUS, jnp.uint32)),
+                   gbuf(fwd[2], jnp.full((n,), META_MALICIOUS, jnp.uint8)),
                    gbuf(fwd[3], g_member),
                    gbuf(fwd[4], g_gt))
 
@@ -1889,13 +1930,11 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             # delayed records stamp this round).
             dd = cfg.delay_inbox
             dslot = jnp.where(parked, drank, dd)
-            dly = (st.rank_compact(in_gt, dslot, dd, EMPTY_U32),
-                   st.rank_compact(in_member, dslot, dd, EMPTY_U32),
-                   st.rank_compact(in_meta, dslot, dd, EMPTY_U32),
-                   st.rank_compact(in_payload, dslot, dd, EMPTY_U32),
-                   st.rank_compact(in_aux, dslot, dd, 0),
-                   st.rank_compact(in_since, dslot, dd, 0),
-                   st.rank_compact(in_src, dslot, dd, NO_PEER))
+            dly = tuple(st.rank_compact_many(
+                [(in_gt, EMPTY_U32), (in_member, EMPTY_U32),
+                 (in_meta, EMPTY_META), (in_payload, EMPTY_U32),
+                 (in_aux, 0), (in_since, 0), (in_src, NO_PEER)],
+                dslot, dd))
             stats = stats.replace(
                 msgs_delayed=stats.msgs_delayed
                 + jnp.sum(parked & (in_since == rnd),
@@ -1923,7 +1962,9 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                 msgs_retro=stats.msgs_retro + n_ret.astype(jnp.uint32))
     else:
         e0 = jnp.full((n, cfg.forward_buffer), EMPTY_U32, jnp.uint32)
-        fwd = (e0, e0, e0, e0, e0)
+        fwd = (e0, e0,
+               jnp.full((n, cfg.forward_buffer), EMPTY_META, jnp.uint8),
+               e0, e0)
 
     # ---- wrap up --------------------------------------------------------
     if cfg.malicious_enabled:
@@ -2137,10 +2178,10 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
     new = st.StoreCols(
         gt=gt_new[:, None],
         member=idx[:, None],
-        meta=jnp.full((n, 1), meta, jnp.uint32),
+        meta=jnp.full((n, 1), meta, jnp.uint8),
         payload=payload[:, None],
         aux=aux[:, None],
-        flags=jnp.zeros((n, 1), jnp.uint32))
+        flags=jnp.zeros((n, 1), jnp.uint8))
     # Direct records are one-shot: pushed, never stored anywhere
     # (reference: DirectDistribution messages live outside the sync table).
     store_mask = (jnp.zeros((n,), bool) if is_direct_meta else author_mask)
@@ -2182,7 +2223,7 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
         hit = (author_mask[:, None] & (stc.member == payload[:, None])
                & (stc.gt == aux[:, None]) & (stc.meta < 32))
         stc = stc._replace(flags=jnp.where(
-            hit, stc.flags | jnp.uint32(FLAG_UNDONE), stc.flags))
+            hit, stc.flags | jnp.uint8(FLAG_UNDONE), stc.flags))
 
     # A created record ALWAYS enters the forward batch (the reference calls
     # store_update_forward on create — forward=True pushes it

@@ -83,13 +83,71 @@ def probe_bits(item_hash: jnp.ndarray, n_bits: int, n_hashes: int,
                salt=None) -> jnp.ndarray:
     """Bit indices probed for an item: shape ``item_hash.shape + (n_hashes,)``.
 
-    Reference/oracle view of the probe sequence; the hot kernels below never
-    materialize this axis (see module docstring).
+    Oracle/reference view of the probe sequence.  On gather backends
+    (:func:`gather_backend`) this tensor is ALSO the hot kernels' shared
+    input — the engine computes it once per round and feeds it to both
+    the build and every per-request-slot query, instead of re-deriving
+    the double-hash chain per call; on TPU the kernels keep the fused
+    compare form and never materialize the hash axis (module docstring).
     """
     h1, h2 = _h1_h2(item_hash, salt)
     j = jnp.arange(n_hashes, dtype=jnp.uint32)
     idx = (h1[..., None] + j * h2[..., None]) % jnp.uint32(n_bits)
     return idx.astype(jnp.int32)
+
+
+def gather_backend(impl: str | None = None) -> bool:
+    """Should callers precompute/share :func:`probe_bits` tensors?  True
+    exactly when the kernels below pick their gather/scatter forms."""
+    return _auto_impl(impl) == "gather"
+
+
+def bloom_build_from(probes: jnp.ndarray, mask: jnp.ndarray,
+                     n_bits: int) -> jnp.ndarray:
+    """Gather-form build from precomputed ``probes`` (:func:`probe_bits`,
+    ``[..., M, K]`` i32): ONE flat scatter sets every probed bit, then the
+    bitmap packs to words.  Bit-identical to :func:`bloom_build`."""
+    assert n_bits % 32 == 0, "n_bits must pack into uint32 words"
+    w = n_bits // 32
+    lead = probes.shape[:-2]
+    flat = 1
+    for d in lead:
+        flat *= d
+    stride = n_bits + 1
+    tgt = jnp.where(mask[..., None], probes,
+                    jnp.int32(n_bits))                     # [..., M, K]
+    if flat * stride < 2 ** 31:
+        # Flat one-component indices (cheapest scatter layout)...
+        row0 = (jnp.arange(flat, dtype=jnp.int32) * stride)[:, None]
+        flat_ix = (row0 + tgt.reshape(flat, -1)).reshape(-1)
+        bits = (jnp.zeros((flat * stride,), jnp.bool_)
+                .at[flat_ix].set(True).reshape(flat, stride))
+    else:
+        # ...but row*stride overflows int32 past 2^31 elements (e.g. the
+        # default 2464-bit filter above ~870k rows), so large shapes keep
+        # the 2-D (row, bit) index form; x64 is off, so no int64 escape.
+        rows = jnp.arange(flat, dtype=jnp.int32)[:, None]
+        bits = (jnp.zeros((flat, stride), jnp.bool_)
+                .at[rows, tgt.reshape(flat, -1)].set(True))
+    return pack_bits(bits[:, :n_bits]).reshape(*lead, w)
+
+
+def bloom_query_from(words: jnp.ndarray,
+                     probes: jnp.ndarray) -> jnp.ndarray:
+    """Gather-form membership test from precomputed ``probes``
+    (``[..., M, K]`` i32): per-item word fetches + bit tests, no hash
+    re-derivation.  Bit-identical to :func:`bloom_query` — the engine's
+    responder uses this to share one probe tensor across all request
+    slots."""
+    w = words.shape[-1]
+    word_ix = probes >> jnp.int32(5)                       # [..., M, K]
+    lead_shape = probes.shape[:-2] + (probes.shape[-2] * probes.shape[-1],)
+    sel = jnp.take_along_axis(
+        jnp.broadcast_to(words, probes.shape[:-2] + (w,)),
+        word_ix.reshape(lead_shape), axis=-1).reshape(probes.shape)
+    bit = (sel >> (probes.astype(jnp.uint32) & jnp.uint32(31))) \
+        & jnp.uint32(1)
+    return jnp.all(bit == 1, axis=-1)
 
 
 def bloom_build(item_hashes: jnp.ndarray, mask: jnp.ndarray,
@@ -106,22 +164,14 @@ def bloom_build(item_hashes: jnp.ndarray, mask: jnp.ndarray,
     """
     assert n_bits % 32 == 0, "n_bits must pack into uint32 words"
     w = n_bits // 32
-    h1, h2 = _h1_h2(item_hashes, salt)
     if _auto_impl(impl) == "gather":
-        # Bitmap scatter: set bool bits at [..., n_bits], then pack.
-        # Duplicate probes just re-set the same bit; masked items aim at
-        # the trimmed spill column n_bits.
-        lead = item_hashes.shape[:-1]
-        flat = 1
-        for d in lead:
-            flat *= d
-        bits = jnp.zeros((flat, n_bits + 1), jnp.bool_)
-        rows = jnp.arange(flat)[:, None]
-        for j in range(n_hashes):
-            idx = (h1 + jnp.uint32(j) * h2) % jnp.uint32(n_bits)
-            tgt = jnp.where(mask, idx, jnp.uint32(n_bits))
-            bits = bits.at[rows, tgt.reshape(flat, -1)].set(True)
-        return pack_bits(bits[:, :n_bits]).reshape(*lead, w)
+        # Bitmap scatter on the probe tensor: ONE flat scatter covers all
+        # n_hashes probes (the old per-hash loop rewrote the [N, n_bits]
+        # bitmap n_hashes times — the dominant byte cost of the CPU
+        # build, measured 5.4 KB/peer at the bench shape).
+        return bloom_build_from(
+            probe_bits(item_hashes, n_bits, n_hashes, salt), mask, n_bits)
+    h1, h2 = _h1_h2(item_hashes, salt)
     w_ix = jnp.arange(w, dtype=jnp.uint32)                    # [W]
     words = jnp.zeros(item_hashes.shape[:-1] + (w,), jnp.uint32)
     for j in range(n_hashes):
@@ -157,23 +207,20 @@ def bloom_query(words: jnp.ndarray, item_hashes: jnp.ndarray,
     (standard Bloom semantics: false positives at the configured error rate,
     never false negatives).  ``impl``/``salt`` as in :func:`bloom_build`.
     """
+    if _auto_impl(impl) == "gather":
+        # Per-item word fetches on the probe tensor; row-local along the
+        # last axis, cheap where gathers are cheap.
+        return bloom_query_from(
+            words, probe_bits(item_hashes, n_bits, n_hashes, salt))
     h1, h2 = _h1_h2(item_hashes, salt)
     ok = jnp.ones(item_hashes.shape, jnp.bool_)
-    gather = _auto_impl(impl) == "gather"
     w_ix = jnp.arange(words.shape[-1], dtype=jnp.uint32)      # [W]
     for j in range(n_hashes):
         idx = (h1 + jnp.uint32(j) * h2) % jnp.uint32(n_bits)  # [..., M]
-        if gather:
-            # Per-item word fetch; row-local along the last axis, cheap
-            # where gathers are cheap.
-            sel = jnp.take_along_axis(
-                jnp.broadcast_to(words, idx.shape[:-1] + words.shape[-1:]),
-                (idx >> jnp.uint32(5)).astype(jnp.int32), axis=-1)
-        else:
-            # Select each item's word by broadcast-compare (no gather).
-            sel = jnp.sum(jnp.where(
-                (idx >> jnp.uint32(5))[..., None] == w_ix,
-                words[..., None, :], jnp.uint32(0)),
-                axis=-1, dtype=jnp.uint32)                    # [..., M]
+        # Select each item's word by broadcast-compare (no gather).
+        sel = jnp.sum(jnp.where(
+            (idx >> jnp.uint32(5))[..., None] == w_ix,
+            words[..., None, :], jnp.uint32(0)),
+            axis=-1, dtype=jnp.uint32)                        # [..., M]
         ok = ok & (((sel >> (idx & jnp.uint32(31))) & jnp.uint32(1)) == 1)
     return ok

@@ -7,13 +7,27 @@ reference's ``Endpoint`` hands raw UDP datagrams to
 The simulation replaces the socket with an *edge list*: every logical packet
 this round is a (destination, payload-columns) row, and delivery is
 
-    stable sort by destination  ->  rank within destination group
+    sort by destination  ->  rank within destination group
     ->  bounded scatter into a [N, B] inbox, slots >= B dropped.
 
 Dropping on overflow is deliberate fidelity, not a limitation: UDP has no
 delivery guarantee and the reference's 65k recv buffer drops bursts the same
 way (modeled, counted, never an error).  Packet loss is the caller's
 Bernoulli mask on ``valid``.
+
+Bandwidth notes (the round is memory-bound, BENCH.md roofline):
+
+- Only the ROUTING information rides the sort.  When ``(destination,
+  edge-position)`` packs into one uint32 — ``bits(n_peers) +
+  bits(E) <= 32`` — a single packed key is sorted (keys are unique, so
+  the sort needs no stability and no tie-break operand); otherwise the
+  two-key ``(key, pos)`` form runs.  Both orders are identical:
+  lexicographic (key, pos) IS the packed integer order.
+- Payload columns never ride the sort at all: each edge's inbox slot is
+  scattered back to edge order first, and the columns then scatter
+  STRAIGHT from edge order into the inbox — one pass per column instead
+  of the previous gather-to-sorted-order + scatter (this is where the
+  [E, bloom_words] introduction-request payload used to pay double).
 
 Under a sharded peer axis the ``lax.sort`` + scatter lower to XLA
 all-to-all/collective-permute over ICI — exactly where the reference's
@@ -35,6 +49,17 @@ class Delivery(NamedTuple):
     edge_slot: jnp.ndarray    # i32[E] slot each edge landed in, -1 if dropped
 
 
+def packed_key_bits(n_peers: int, n_edges: int) -> int | None:
+    """Bits needed for the packed (destination, position) sort key, or
+    None when it cannot fit uint32.  The key space is [0, n_peers]
+    (``n_peers`` = the park value for undeliverable packets) shifted
+    above ``bits(n_edges - 1)`` position bits."""
+    pos_bits = max(1, (n_edges - 1).bit_length()) if n_edges else 1
+    key_bits = max(1, n_peers.bit_length())
+    total = key_bits + pos_bits
+    return pos_bits if total <= 32 else None
+
+
 def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
             valid: jnp.ndarray, n_peers: int, inbox_size: int) -> Delivery:
     """Deliver an edge list of logical packets into per-peer inboxes.
@@ -45,8 +70,9 @@ def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
     bool[E] — packets already lost (loss mask, dead sender) are simply
     invalid.
 
-    Delivery order within one destination is edge-list order (lax.sort is
-    stable), so the oracle can reproduce inboxes exactly.
+    Delivery order within one destination is edge-list order (the sort
+    key carries the edge position as tie-break), so the oracle can
+    reproduce inboxes exactly.
 
     ``edge_slot`` is the *receipt*: the inbox slot each edge landed in (or -1
     for dropped/invalid).  It lets the sender later fetch a per-slot reply
@@ -63,11 +89,21 @@ def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
     # index must never reach the scatter (it would wrap to another inbox).
     ok = valid & (dst >= 0) & (dst < n_peers)
     key = jnp.where(ok, dst, n_peers).astype(jnp.int32)
-    pos = jnp.arange(e, dtype=jnp.int32)  # carries stability through sort
-    skey, spos = lax.sort((key, pos), dimension=0, num_keys=2)
-    # Only (key, pos) ride the sort; payload columns follow via one gather —
-    # this is what lets columns carry trailing dims.
-    scols = tuple(jnp.take(c, spos, axis=0) for c in cols)
+    pos = jnp.arange(e, dtype=jnp.int32)  # carries order through the sort
+    pos_bits = packed_key_bits(n_peers, e)
+    if pos_bits is not None:
+        # One uint32 key: (key << pos_bits) | pos.  Keys are globally
+        # unique, so the sort may be unstable and carries ONE operand.
+        packed = ((key.astype(jnp.uint32) << pos_bits)
+                  | pos.astype(jnp.uint32))
+        (spacked,) = lax.sort((packed,), dimension=0, is_stable=False,
+                              num_keys=1)
+        skey = (spacked >> pos_bits).astype(jnp.int32)
+        spos = (spacked & jnp.uint32((1 << pos_bits) - 1)).astype(jnp.int32)
+    else:
+        # (key, pos) pairs are unique, so stability is still unnecessary.
+        skey, spos = lax.sort((key, pos), dimension=0, is_stable=False,
+                              num_keys=2)
 
     # Rank within destination group = index - first index of that key, with
     # the group starts found by a cummax scan (a searchsorted here would be
@@ -78,21 +114,26 @@ def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
     first = lax.cummax(jnp.where(is_start, iota, 0))
     slot = iota - first
     keep = (skey < n_peers) & (slot < inbox_size)
-    flat = jnp.where(keep, skey * inbox_size + slot, n_peers * inbox_size)
+    # Each edge's slot back in EDGE order (one i32 scatter); payload
+    # columns then go straight from edge order into the inbox without
+    # ever being permuted into sorted order.
+    edge_slot = (jnp.zeros((e,), jnp.int32)
+                 .at[spos].set(jnp.where(keep, slot, -1)))
+    kept_e = edge_slot >= 0
+    flat = jnp.where(kept_e, key * inbox_size + edge_slot,
+                     n_peers * inbox_size)
 
     inbox = tuple(
         jnp.zeros((n_peers * inbox_size,) + c.shape[1:], c.dtype)
         .at[flat].set(c, mode="drop")
         .reshape((n_peers, inbox_size) + c.shape[1:])
-        for c in scols)
+        for c in cols)
     inbox_valid = (jnp.zeros((n_peers * inbox_size,), bool)
                    .at[flat].set(True, mode="drop")
                    .reshape(n_peers, inbox_size))
-    overflow = (skey < n_peers) & (slot >= inbox_size)
+    overflow = ok & ~kept_e
     n_dropped = (jnp.zeros((n_peers,), jnp.int32)
-                 .at[jnp.where(overflow, skey, n_peers)]
+                 .at[jnp.where(overflow, key, n_peers)]
                  .add(1, mode="drop"))
-    edge_slot = (jnp.zeros((e,), jnp.int32)
-                 .at[spos].set(jnp.where(keep, slot, -1)))
     return Delivery(inbox=inbox, inbox_valid=inbox_valid, n_dropped=n_dropped,
                     edge_slot=edge_slot)

@@ -30,9 +30,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from dispersy_tpu.config import EMPTY_U32
+from dispersy_tpu.config import EMPTY_META, EMPTY_U32, FLAGS_DTYPE, META_DTYPE
 
 _EMPTY = np.uint32(EMPTY_U32)
+
+
+def empty_of(dtype) -> int:
+    """Empty-slot sentinel for one record-column dtype: the all-ones
+    value (EMPTY_U32 truncated to the column's width) — EMPTY_U32 for
+    u32 columns, EMPTY_META for the narrowed u8 meta column.  One
+    definition so every fill site stays correct as columns narrow."""
+    return int(np.iinfo(np.dtype(dtype)).max)  # host-ok: static dtype math
 
 
 class StoreCols(NamedTuple):
@@ -58,9 +66,11 @@ class StoreCols(NamedTuple):
 
 def empty_records(shape) -> StoreCols:
     e = jnp.full(shape, _EMPTY, jnp.uint32)
-    return StoreCols(gt=e, member=e, meta=e, payload=e,
+    return StoreCols(gt=e, member=e,
+                     meta=jnp.full(shape, EMPTY_META, META_DTYPE),
+                     payload=e,
                      aux=jnp.zeros(shape, jnp.uint32),
-                     flags=jnp.zeros(shape, jnp.uint32))
+                     flags=jnp.zeros(shape, FLAGS_DTYPE))
 
 
 def count_valid(gt: jnp.ndarray) -> jnp.ndarray:
@@ -78,11 +88,49 @@ def rank_compact(col: jnp.ndarray, slot: jnp.ndarray, width: int,
     idiom used by the store merge, the sync-responder outbox, the forward
     buffer, and the delayed-message pen — linear, where a second sort
     would be O(W log W).
+
+    The scatter runs on FLAT indices (row * (width+1) + slot) rather than
+    (rows, slot) pairs: one [N, W] i32 index tensor instead of a
+    two-component [N, W, 2] one — the responder loop runs 6 of these per
+    request slot, so the index traffic is a first-order byte cost
+    (measured ~35% of the scatter's bytes at the 1M-peer shape).
     """
-    n = col.shape[0]
-    rows = jnp.arange(n)[:, None]
-    return (jnp.full((n, width + 1), fill, col.dtype)
-            .at[rows, slot].set(col)[..., :width])
+    n, w = col.shape
+    stride = width + 1
+    if n * stride >= 2 ** 31:
+        # row*stride would overflow int32 (x64 is off); the 2-D index
+        # form costs more index bytes but stays correct at any shape.
+        rows = jnp.arange(n)[:, None]
+        return (jnp.full((n, stride), fill, col.dtype)
+                .at[rows, slot].set(col)[..., :width])
+    flat = (jnp.arange(n, dtype=jnp.int32)[:, None] * stride
+            + slot.astype(jnp.int32)).reshape(-1)
+    return (jnp.full((n * stride,), fill, col.dtype)
+            .at[flat].set(col.reshape(-1))
+            .reshape(n, stride)[..., :width])
+
+
+def rank_compact_many(cols_fills, slot: jnp.ndarray, width: int) -> list:
+    """:func:`rank_compact` for SEVERAL same-shaped columns sharing one
+    ``slot`` map — ``cols_fills`` is ``[(col, fill), ...]``.
+
+    On CPU one permutation scatters once and every column follows by
+    row-local gather (gathers are cheap there; per-column scatters were
+    the store path's dominant wall cost).  On TPU each column scatters
+    individually — cross-lane gathers serialize there (ops/bloom.py
+    module note).  Both forms are bit-identical to per-column
+    :func:`rank_compact` calls.
+    """
+    if jax.default_backend() == "tpu":
+        return [rank_compact(c, slot, width, f) for c, f in cols_fills]
+    n, w = slot.shape
+    src = jnp.broadcast_to(jnp.arange(w, dtype=jnp.int32), (n, w))
+    perm = rank_compact(src, slot, width, w)          # w = "empty" slot
+    ix = jnp.minimum(perm, w - 1)
+    live = perm < w
+    return [jnp.where(live, jnp.take_along_axis(c, ix, axis=-1),
+                      jnp.asarray(f, c.dtype))
+            for c, f in cols_fills]
 
 
 class InsertResult(NamedTuple):
@@ -120,11 +168,21 @@ def store_insert(store: StoreCols, new: StoreCols,
     ``store``: [N, M] columns; ``new``: [N, B] columns; ``new_mask``: [N, B].
     """
     m = store.gt.shape[-1]
+    # The batch's narrowed columns follow the STORE's dtypes (truncation
+    # maps EMPTY_U32 -> EMPTY_META, real values are unchanged — the
+    # reachable value set fits either width).  Mixed-width inputs would
+    # otherwise make the sort form promote while the merge form
+    # truncates, silently breaking their bit-identity.
+    if (new.meta.dtype != store.meta.dtype
+            or new.flags.dtype != store.flags.dtype):
+        new = new._replace(meta=new.meta.astype(store.meta.dtype),
+                           flags=new.flags.astype(store.flags.dtype))
     n_before = count_valid(store.gt)
+    meta_empty = jnp.asarray(empty_of(new.meta.dtype), new.meta.dtype)
     masked = StoreCols(
         gt=jnp.where(new_mask, new.gt, _EMPTY),
         member=jnp.where(new_mask, new.member, _EMPTY),
-        meta=jnp.where(new_mask, new.meta, _EMPTY),
+        meta=jnp.where(new_mask, new.meta, meta_empty),
         payload=jnp.where(new_mask, new.payload, _EMPTY),
         aux=jnp.where(new_mask, new.aux, 0),
         flags=jnp.where(new_mask, new.flags, 0),
@@ -169,12 +227,9 @@ def store_insert(store: StoreCols, new: StoreCols,
     rank = jnp.cumsum(keep.astype(jnp.int32), axis=-1) - 1
     # survivors beyond capacity (rank >= m) drop into the spill slot m
     slot = jnp.where(keep & (rank < m), rank, m)
-    out = StoreCols(gt=rank_compact(gt, slot, m, _EMPTY),
-                    member=rank_compact(member, slot, m, _EMPTY),
-                    meta=rank_compact(meta, slot, m, _EMPTY),
-                    payload=rank_compact(payload, slot, m, _EMPTY),
-                    aux=rank_compact(aux, slot, m, 0),
-                    flags=rank_compact(flags, slot, m, 0))
+    out = StoreCols(*rank_compact_many(
+        [(gt, _EMPTY), (member, _EMPTY), (meta, empty_of(meta.dtype)),
+         (payload, _EMPTY), (aux, 0), (flags, 0)], slot, m))
     kept = keep & (rank < m)
     n_inserted = jnp.sum(kept & (origin == 1), axis=-1).astype(jnp.int32)
     n_surviving_old = jnp.sum(kept & (origin == 0),
@@ -205,19 +260,42 @@ def _prefer_merge(width: int) -> bool:
 
 def _sort_ordered(store: StoreCols, masked: StoreCols):
     """SORT form of the merge step (small stores): one lexicographic sort
-    over the concatenation.  Origin as 3rd key makes the existing entry
-    the first of any (gt, member) duplicate group regardless of its
-    (meta, payload) relative to the duplicate's.  aux is a key too:
-    lax.sort is not stable, so two same-keyed records differing only in
-    aux must still order deterministically for the oracle to replay."""
+    over the concatenation, on keys (gt, member, position-in-concat).
+
+    Position as the tie-break key does three jobs at once: store rows
+    precede batch rows in the concat, so the existing entry leads any
+    (gt, member) duplicate group (the UNIQUE rule's "existing wins");
+    same-keyed BATCH records order by delivery position (first-seen wins
+    — exactly the reference's keep-first-packet rule, which the oracle
+    mirrors with its stable sort); and the key triple is globally unique,
+    so the sort needs no stability and no further content keys — where
+    the pre-v8 form paid 6 key passes over 7 operands, this pays 3 keys,
+    with the non-key columns either riding as values (TPU, where
+    cross-lane gathers serialize) or applied afterwards by row-local
+    gather on the recovered position (CPU, where the gather is cheap and
+    the sort's data movement is the bottleneck).  Both forms are
+    bit-identical.
+    """
     cat = StoreCols(*(jnp.concatenate([a, b], axis=-1)
                       for a, b in zip(store, masked)))
-    origin = jnp.concatenate(
-        [jnp.zeros_like(store.gt), jnp.ones_like(masked.gt)], axis=-1)
-    return lax.sort(
-        (cat.gt, cat.member, origin, cat.meta, cat.payload, cat.aux,
-         cat.flags),
-        dimension=-1, num_keys=6)
+    m_w = store.gt.shape[-1]
+    w = cat.gt.shape[-1]
+    pos = jnp.broadcast_to(jnp.arange(w, dtype=jnp.uint32),
+                           cat.gt.shape)
+    if jax.default_backend() == "tpu":
+        gt, member, spos, meta, payload, aux, flags = lax.sort(
+            (cat.gt, cat.member, pos, cat.meta, cat.payload, cat.aux,
+             cat.flags), dimension=-1, is_stable=False, num_keys=3)
+    else:
+        gt, member, spos = lax.sort(
+            (cat.gt, cat.member, pos), dimension=-1, is_stable=False,
+            num_keys=3)
+        ix = spos.astype(jnp.int32)
+        meta, payload, aux, flags = (
+            jnp.take_along_axis(c, ix, axis=-1)
+            for c in (cat.meta, cat.payload, cat.aux, cat.flags))
+    origin = (spos >= jnp.uint32(m_w)).astype(jnp.uint32)
+    return gt, member, origin, meta, payload, aux, flags
 
 
 def _merge_ordered(store: StoreCols, masked: StoreCols):
@@ -239,12 +317,16 @@ def _merge_ordered(store: StoreCols, masked: StoreCols):
     Replaces the O((M+B) log²(M+B)) 7-operand bitonic sort with O(M·B)
     fusable compares + two scatters — the store path's cost becomes
     linear in capacity.  Ties between store and batch resolve
-    store-first, exactly what the sort form's origin key encodes; the
-    cross-form equality test and every oracle trace pin the identity.
+    store-first, and ties WITHIN the batch by delivery position — both
+    exactly what the sort form's position key encodes; the cross-form
+    equality test and every oracle trace pin the identity.
     """
-    b_gt, b_member, b_meta, b_payload, b_aux, b_flags = lax.sort(
-        (masked.gt, masked.member, masked.meta, masked.payload,
-         masked.aux, masked.flags), dimension=-1, num_keys=5)
+    bpos = jnp.broadcast_to(
+        jnp.arange(masked.gt.shape[-1], dtype=jnp.uint32), masked.gt.shape)
+    b_gt, b_member, _, b_meta, b_payload, b_aux, b_flags = lax.sort(
+        (masked.gt, masked.member, bpos, masked.meta, masked.payload,
+         masked.aux, masked.flags), dimension=-1, is_stable=False,
+        num_keys=3)
     s_gt, s_member = store.gt, store.member
     # ONE [N, B, M] compare: store_key <= batch_key (equality counts:
     # batch sorts after).  Its complement is batch_key < store_key, so
@@ -256,15 +338,30 @@ def _merge_ordered(store: StoreCols, masked: StoreCols):
              + jnp.sum(s_le_b, axis=-1))                      # [N, B]
     pos_s = (jnp.arange(s_gt.shape[-1])[None, :]
              + jnp.sum(~s_le_b, axis=-2))                     # [N, M]
-    rows = jnp.arange(s_gt.shape[0])[:, None]
+    n = s_gt.shape[0]
     width = s_gt.shape[-1] + b_gt.shape[-1]
+    if n * width < 2 ** 31:
+        # Flat scatter indices (same one-component layout as
+        # rank_compact; same int32-overflow guard).
+        row0 = jnp.arange(n, dtype=jnp.int32)[:, None] * width
+        flat_s = (row0 + pos_s.astype(jnp.int32)).reshape(-1)
+        flat_b = (row0 + pos_b.astype(jnp.int32)).reshape(-1)
 
-    def interleave(s_col, b_col):
-        out = jnp.zeros((s_gt.shape[0], width), s_col.dtype)
-        out = out.at[rows, pos_s].set(s_col)
-        return out.at[rows, pos_b].set(b_col)
-    origin = jnp.zeros((s_gt.shape[0], width), s_gt.dtype
-                       ).at[rows, pos_b].set(1)
+        def interleave(s_col, b_col):
+            out = jnp.zeros((n * width,), s_col.dtype)
+            out = out.at[flat_s].set(s_col.reshape(-1))
+            return out.at[flat_b].set(b_col.reshape(-1)).reshape(n, width)
+        origin = (jnp.zeros((n * width,), s_gt.dtype)
+                  .at[flat_b].set(1).reshape(n, width))
+    else:
+        rows = jnp.arange(n)[:, None]
+
+        def interleave(s_col, b_col):
+            out = jnp.zeros((n, width), s_col.dtype)
+            out = out.at[rows, pos_s].set(s_col)
+            return out.at[rows, pos_b].set(b_col)
+        origin = (jnp.zeros((n, width), s_gt.dtype)
+                  .at[rows, pos_b].set(1))
     return (interleave(store.gt, b_gt),
             interleave(store.member, b_member),
             origin,
@@ -293,12 +390,11 @@ def store_remove(store: StoreCols, kill: jnp.ndarray) -> RemoveResult:
     keep = store.valid & ~kill
     rank = jnp.cumsum(keep.astype(jnp.int32), axis=-1) - 1
     slot = jnp.where(keep, rank, m)
-    out = StoreCols(gt=rank_compact(store.gt, slot, m, _EMPTY),
-                    member=rank_compact(store.member, slot, m, _EMPTY),
-                    meta=rank_compact(store.meta, slot, m, _EMPTY),
-                    payload=rank_compact(store.payload, slot, m, _EMPTY),
-                    aux=rank_compact(store.aux, slot, m, 0),
-                    flags=rank_compact(store.flags, slot, m, 0))
+    out = StoreCols(*rank_compact_many(
+        [(store.gt, _EMPTY), (store.member, _EMPTY),
+         (store.meta, empty_of(store.meta.dtype)),
+         (store.payload, _EMPTY), (store.aux, 0), (store.flags, 0)],
+        slot, m))
     n_removed = jnp.sum((store.valid & kill).astype(jnp.int32), axis=-1)
     return RemoveResult(store=out, n_removed=n_removed)
 

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_U32,
+from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_META, EMPTY_U32,
                                  INTRO_REQUEST_BASE_BYTES,
                                  INTRO_RESPONSE_BYTES, MAX_TIMELINE_META,
                                  META_AUTHORIZE,
@@ -365,11 +365,14 @@ class OracleSim:
         m = self.cfg.msg_capacity
         n_before = len(p.store)
         n_new_valid = len(batch)
-        # (record_key, origin); sort by (gt, member, origin, meta, payload,
-        # aux) — the engine's 6 sort keys
+        # (record_key, origin); sort by (gt, member, position-in-concat) —
+        # the engine's keys (store rows precede batch rows, so a stable
+        # sort on (gt, member, origin) IS position order).  Ties between
+        # same-(gt, member) batch records resolve by DELIVERY order
+        # (first-seen wins — the reference keeps the first-seen packet),
+        # not by record content as before v8.
         rows = ([(r, 0) for r in p.store] + [(r, 1) for r in batch])
-        rows.sort(key=lambda ro: (ro[0].gt, ro[0].member, ro[1],
-                                  ro[0].meta, ro[0].payload, ro[0].aux))
+        rows.sort(key=lambda ro: (ro[0].gt, ro[0].member, ro[1]))
         kept: list[tuple[Record, int]] = []
         for r, o in rows:
             if kept and kept[-1][0].gt == r.gt and kept[-1][0].member == r.member:
@@ -1879,14 +1882,17 @@ class OracleSim:
             "cand_last_intro": np.full((n, k), NEVER, np.float32),
             "store_gt": np.full((n, m), EMPTY_U32, np.uint32),
             "store_member": np.full((n, m), EMPTY_U32, np.uint32),
-            "store_meta": np.full((n, m), EMPTY_U32, np.uint32),
+            # meta/flags mirror the engine's narrowed column dtypes
+            # (config.META_DTYPE / FLAGS_DTYPE): u8 with EMPTY_META holes.
+            "store_meta": np.full((n, m), EMPTY_META, np.uint8),
             "store_payload": np.full((n, m), EMPTY_U32, np.uint32),
             "store_aux": np.zeros((n, m), np.uint32),
-            "store_flags": np.zeros((n, m), np.uint32),
+            "store_flags": np.zeros((n, m), np.uint8),
             "fwd_gt": np.full((n, cfg.forward_buffer), EMPTY_U32, np.uint32),
             "fwd_member": np.full((n, cfg.forward_buffer), EMPTY_U32,
                                   np.uint32),
-            "fwd_meta": np.full((n, cfg.forward_buffer), EMPTY_U32, np.uint32),
+            "fwd_meta": np.full((n, cfg.forward_buffer), EMPTY_META,
+                                np.uint8),
             "fwd_payload": np.full((n, cfg.forward_buffer), EMPTY_U32,
                                    np.uint32),
             "fwd_aux": np.full((n, cfg.forward_buffer), EMPTY_U32, np.uint32),
@@ -1902,7 +1908,7 @@ class OracleSim:
             "dly_gt": np.full((n, cfg.delay_inbox), EMPTY_U32, np.uint32),
             "dly_member": np.full((n, cfg.delay_inbox), EMPTY_U32,
                                   np.uint32),
-            "dly_meta": np.full((n, cfg.delay_inbox), EMPTY_U32, np.uint32),
+            "dly_meta": np.full((n, cfg.delay_inbox), EMPTY_META, np.uint8),
             "dly_payload": np.full((n, cfg.delay_inbox), EMPTY_U32,
                                    np.uint32),
             "dly_aux": np.zeros((n, cfg.delay_inbox), np.uint32),

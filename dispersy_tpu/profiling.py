@@ -1,0 +1,295 @@
+"""Per-phase cost accounting for the fused round.
+
+The round is memory-bandwidth-bound (BENCH.md roofline), so the number
+that matters for every perf PR is *bytes moved per round* — wall clock
+through the TPU tunnel is noise-dominated (±50% on identical configs,
+BENCH.md r2), but XLA's static cost analysis of the compiled executable
+is exact and available on any backend, including compile-only runs at
+populations this host could never execute (the 1M-peer bench shape).
+
+Three layers, consumed by ``tools/profile_round.py``:
+
+- :func:`step_cost` — lower + compile the REAL fused ``engine.step`` at a
+  config's exact shapes from ``jax.ShapeDtypeStruct``s (no state is ever
+  materialized, so 1M-peer cost analysis runs on a laptop) and report
+  XLA's flops / bytes-accessed totals.
+- :func:`phase_kernels` — the step's named phases (churn, walk, deliver,
+  bloom, store-merge, timeline) as standalone jitted calls of the SAME
+  ops functions at the step's exact shapes, each with its own cost
+  analysis and optional wall timing.  Phases are honest proxies: the
+  fused step shares reads between neighbors, so phase bytes sum past the
+  step total; they answer "where do the bytes go", not "what adds up".
+- :func:`bench_config` — the bench.py worker's config shape at a chosen
+  population, so profile numbers and bench numbers describe one shape.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+from dispersy_tpu.config import CommunityConfig
+
+
+def bench_config(n_peers: int, platform: str = "tpu") -> CommunityConfig:
+    """bench.py's worker config at ``n_peers`` — THE shared definition
+    (bench.py imports this), so profile numbers and bench numbers always
+    describe one shape per platform.
+
+    ``platform="tpu"``: the 1M-peer roofline shape (M=48 store slots,
+    bloom_capacity=48 -> 480 filter bits = 15 words).  ``"cpu"``: the
+    64k fallback rung's shape (M=64, bloom_capacity=64).  Tracker counts
+    scale with population, capped at each platform's recorded values.
+    """
+    if platform == "cpu":
+        return CommunityConfig(
+            n_peers=n_peers, n_trackers=max(2, min(4, n_peers // 1024)),
+            k_candidates=16, msg_capacity=64, bloom_capacity=64,
+            request_inbox=4,
+            tracker_inbox=max(64, min(256, n_peers // 64)),
+            response_budget=8, churn_rate=0.0)
+    return CommunityConfig(
+        n_peers=n_peers, n_trackers=max(2, min(8, n_peers // 1024)),
+        k_candidates=16, msg_capacity=48, bloom_capacity=48,
+        request_inbox=4, tracker_inbox=max(64, min(1024, n_peers // 64)),
+        response_budget=8, churn_rate=0.0)
+
+
+def _extract_cost(compiled) -> dict:
+    """flops / bytes-accessed out of ``compiled.cost_analysis()`` across
+    the JAX versions that return a dict, a list of dicts, or nested
+    per-device lists."""
+    ca = compiled.cost_analysis()
+    while isinstance(ca, (list, tuple)):
+        if not ca:
+            return {}
+        ca = ca[0]
+    if not isinstance(ca, dict):
+        return {}
+    out = {}
+    for key, name in (("flops", "flops"),
+                      ("bytes accessed", "bytes_accessed"),
+                      ("transcendentals", "transcendentals"),
+                      ("optimal_seconds", "optimal_seconds")):
+        if key in ca:
+            out[name] = float(ca[key])
+    return out
+
+
+def state_shapes(cfg: CommunityConfig):
+    """A ``jax.ShapeDtypeStruct`` pytree of ``PeerState`` at ``cfg``'s
+    shapes — lets ``step`` lower/compile without materializing a byte."""
+    import jax
+
+    from dispersy_tpu.state import init_state
+
+    key = jax.random.PRNGKey(0)
+    return jax.eval_shape(functools.partial(init_state, cfg), key)
+
+
+def step_cost(cfg: CommunityConfig) -> dict:
+    """Compile the fused round at ``cfg`` and return
+    ``{"flops", "bytes_accessed", "compile_seconds"}``.
+
+    Works at any population: only abstract shapes flow into the compiler.
+    """
+    import jax
+
+    from dispersy_tpu import engine
+
+    shapes = state_shapes(cfg)
+    t0 = time.perf_counter()
+    compiled = (jax.jit(engine.step.__wrapped__, static_argnums=1)
+                .lower(shapes, cfg).compile())
+    out = _extract_cost(compiled)
+    out["compile_seconds"] = round(time.perf_counter() - t0, 2)
+    return out
+
+
+def _timed(fn, *args, reps: int = 3) -> float:
+    """Median wall seconds per call of an already-compiled jitted fn."""
+    import jax
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2]
+
+
+def phase_kernels(cfg: CommunityConfig, time_phases: bool = False) -> dict:
+    """Cost-analyze (and optionally wall-time) the step's named phases.
+
+    Each phase is the REAL ops kernel at the engine's call-site shapes
+    (engine.py phase comments name the sites).  Returns
+    ``{phase: {"bytes_accessed", "flops"[, "seconds"]}}``.
+
+    ``time_phases=True`` additionally executes each kernel (inputs
+    materialize), so only use it at populations the host holds.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from dispersy_tpu.ops import bloom as bl
+    from dispersy_tpu.ops import candidates as cand
+    from dispersy_tpu.ops import inbox as ib
+    from dispersy_tpu.ops import rng as prng
+    from dispersy_tpu.ops import store as st
+    from dispersy_tpu.state import NEVER
+
+    n, w, m = cfg.n_peers, cfg.bloom_words, cfg.msg_capacity
+    key = jax.random.PRNGKey(7)
+    out = {}
+
+    def run(name, fn, *args):
+        jitted = jax.jit(fn)
+        compiled = jitted.lower(*args).compile()
+        entry = _extract_cost(compiled)
+        if time_phases:
+            entry["seconds"] = round(_timed(jitted, *args), 4)
+        out[name] = entry
+
+    # --- phase 0: churn — the rebirth wipe's where-pass over the state
+    # columns (engine.py phase 0; only the store half, the dominant bytes).
+    def churn_wipe(reborn, gt, member, meta, payload, aux, flags):
+        r1 = reborn[:, None]
+        return st.StoreCols(
+            gt=jnp.where(r1, jnp.uint32(0xFFFFFFFF), gt),
+            member=jnp.where(r1, jnp.uint32(0xFFFFFFFF), member),
+            meta=jnp.where(r1, jnp.uint8(0xFF), meta),
+            payload=jnp.where(r1, jnp.uint32(0xFFFFFFFF), payload),
+            aux=jnp.where(r1, jnp.uint32(0), aux),
+            flags=jnp.where(r1, jnp.uint8(0), flags))
+
+    stc = st.empty_records((n, m))
+    reborn = jnp.zeros((n,), bool)
+    run("churn", churn_wipe, reborn, *stc)
+
+    # --- phase 1: walker sampling (dispersy_get_walk_candidate).
+    tab = cand.CandTable(
+        peer=jnp.zeros((n, cfg.k_candidates), jnp.int32),
+        last_walk=jnp.full((n, cfg.k_candidates), NEVER, jnp.float32),
+        last_stumble=jnp.full((n, cfg.k_candidates), NEVER, jnp.float32),
+        last_intro=jnp.full((n, cfg.k_candidates), NEVER, jnp.float32))
+    idx = jnp.arange(n, dtype=jnp.int32)
+    boot_base = jnp.zeros((n,), jnp.int32)
+    boot_count = jnp.full((n,), cfg.n_trackers, jnp.int32)
+    def walk_sample(tab_, now, seed, rnd, idx_, bb, bc):
+        return cand.sample_walk_target(tab_, now, cfg, seed, rnd, idx_,
+                                       bb, bc)
+
+    run("walk", walk_sample,
+        tab, jnp.float32(0.0), jnp.uint32(1), jnp.uint32(3), idx,
+        boot_base, boot_count)
+
+    # --- deliver: the request fan-in (E = N edges, 6 u32 scalars + the
+    # [E, W] bloom payload) and the push fan-out (E = N·F·C edges).
+    dst = jax.random.randint(key, (n,), -1, n, jnp.int32)
+    scalars = [jnp.ones((n,), jnp.uint32) for _ in range(6)]
+    bloom_col = jnp.ones((n, w), jnp.uint32)
+    valid = jnp.ones((n,), bool)
+    run("deliver_request",
+        functools.partial(ib.deliver, n_peers=n,
+                          inbox_size=cfg.request_inbox),
+        dst, scalars + [bloom_col], valid)
+    e = n * cfg.forward_buffer * cfg.forward_fanout
+    if e:
+        pdst = jax.random.randint(key, (e,), 0, n, jnp.int32)
+        pcols = [jnp.ones((e,), jnp.uint32) for _ in range(4)] \
+            + [jnp.ones((e,), jnp.uint8)]
+        run("deliver_push",
+            functools.partial(ib.deliver, n_peers=n,
+                              inbox_size=cfg.push_inbox),
+            pdst, pcols, jnp.ones((e,), bool))
+
+    # --- bloom build (claim) + query (responder membership test).
+    items = (jax.random.randint(key, (n, m), 0, 1 << 30, jnp.int32)
+             .astype(jnp.uint32))
+    imask = jnp.ones((n, m), bool)
+    build = functools.partial(bl.bloom_build, n_bits=cfg.bloom_bits,
+                              n_hashes=cfg.bloom_hashes)
+    run("bloom_build", build, items, imask)
+    bits = jax.jit(build)(items, imask) if time_phases else \
+        jnp.zeros((n, w), jnp.uint32)
+    run("bloom_query",
+        functools.partial(bl.bloom_query, n_bits=cfg.bloom_bits,
+                          n_hashes=cfg.bloom_hashes),
+        bits, items)
+
+    # --- store merge (phase 5 insert: [N, M] store + [N, B] batch).
+    b = cfg.request_inbox * cfg.response_budget + cfg.push_inbox
+    batch = st.StoreCols(
+        gt=(jax.random.randint(key, (n, b), 1, 1000, jnp.int32)
+            .astype(jnp.uint32)),
+        member=(jax.random.randint(key, (n, b), 0, n, jnp.int32)
+                .astype(jnp.uint32)),
+        meta=jnp.ones((n, b), jnp.uint8),
+        payload=jnp.zeros((n, b), jnp.uint32),
+        aux=jnp.zeros((n, b), jnp.uint32),
+        flags=jnp.zeros((n, b), jnp.uint8))
+    run("store_merge",
+        functools.partial(st.store_insert, history=cfg.history),
+        stc, batch, jnp.ones((n, b), bool))
+
+    # --- timeline: the retro re-walk's table rebuild (only compiled in
+    # for permission communities; engine._retro_pass).
+    if cfg.timeline_enabled:
+        from dispersy_tpu import engine as eng
+        founder_col = jnp.full((n,), cfg.founder, jnp.uint32)
+
+        def rebuild(stc_, founder_):
+            return eng._rebuild_valid_table(stc_, cfg, founder_,
+                                            cfg.k_authorized)
+
+        run("timeline", rebuild, stc, founder_col)
+    return out
+
+
+def profile_round(cfg: CommunityConfig, time_phases: bool = False,
+                  rounds: int = 0, trace_dir: str | None = None) -> dict:
+    """The full report: whole-step cost analysis + per-phase table, and
+    optionally measured step wall time (``rounds > 0``) and a
+    ``jax.profiler`` trace dump."""
+    import jax
+
+    result = {"n_peers": cfg.n_peers,
+              "platform": jax.devices()[0].platform,
+              "step": step_cost(cfg),
+              "phases": phase_kernels(cfg, time_phases=time_phases)}
+    if rounds > 0:
+        import jax.numpy as jnp
+
+        from dispersy_tpu import engine
+        from dispersy_tpu.state import init_state
+
+        state = init_state(cfg, jax.random.PRNGKey(0))
+        state = engine.seed_overlay(state, cfg, degree=8)
+        authors = jnp.arange(cfg.n_peers) % 64 == 63
+        state = engine.create_messages(
+            state, cfg, author_mask=authors, meta=1,
+            payload=jnp.arange(cfg.n_peers, dtype=jnp.uint32))
+        for _ in range(2):     # compile + warm stores
+            state = engine.step(state, cfg)
+        jax.block_until_ready(state)
+
+        def timed_rounds():
+            nonlocal state
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                state = engine.step(state, cfg)
+            jax.block_until_ready(state)
+            return (time.perf_counter() - t0) / rounds
+
+        if trace_dir:
+            import os
+            os.makedirs(trace_dir, exist_ok=True)
+            with jax.profiler.trace(trace_dir,
+                                    create_perfetto_trace=True):
+                result["step"]["seconds"] = round(timed_rounds(), 4)
+            result["trace_dir"] = trace_dir
+        else:
+            result["step"]["seconds"] = round(timed_rounds(), 4)
+        result["step"]["rounds_per_sec"] = round(
+            1.0 / result["step"]["seconds"], 3)
+    return result

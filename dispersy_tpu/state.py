@@ -32,7 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
-from dispersy_tpu.config import EMPTY_U32, NO_PEER, CommunityConfig
+from dispersy_tpu.config import (EMPTY_META, EMPTY_U32, FLAGS_DTYPE,
+                                 META_DTYPE, NO_PEER, CommunityConfig)
 
 NEVER = -1.0e9  # "timestamp never happened" for float32 sim-seconds fields
 
@@ -121,10 +122,10 @@ class PeerState:
     # ---- message store [N, M], sorted by (gt, member, meta, payload) ----
     store_gt: jnp.ndarray      # u32, EMPTY_U32 = hole
     store_member: jnp.ndarray  # u32
-    store_meta: jnp.ndarray    # u32
+    store_meta: jnp.ndarray    # u8, EMPTY_META = hole (config.META_DTYPE)
     store_payload: jnp.ndarray  # u32
     store_aux: jnp.ndarray     # u32 second payload word (see StoreCols.aux)
-    store_flags: jnp.ndarray   # u32 bit0 = undone (sync table's `undone` column)
+    store_flags: jnp.ndarray   # u8 bit0 = undone (sync table's `undone` column)
 
     # ---- forward buffer [N, F]: records to push next round -------------
     # (reference: dispersy.py store_update_forward -> _forward sends each
@@ -132,7 +133,7 @@ class PeerState:
     #  per CommunityDestination; EMPTY_U32 gt marks an empty slot)
     fwd_gt: jnp.ndarray       # u32
     fwd_member: jnp.ndarray   # u32
-    fwd_meta: jnp.ndarray     # u32
+    fwd_meta: jnp.ndarray     # u8, EMPTY_META = empty slot
     fwd_payload: jnp.ndarray  # u32
     fwd_aux: jnp.ndarray      # u32
 
@@ -155,7 +156,7 @@ class PeerState:
     #      dies with the process on churn; config.delay_inbox) ----
     dly_gt: jnp.ndarray       # u32, EMPTY_U32 = free slot
     dly_member: jnp.ndarray   # u32
-    dly_meta: jnp.ndarray     # u32
+    dly_meta: jnp.ndarray     # u8, EMPTY_META = free slot
     dly_payload: jnp.ndarray  # u32
     dly_aux: jnp.ndarray      # u32
     dly_since: jnp.ndarray    # u32 round the record was first parked
@@ -234,14 +235,17 @@ def wipe_instance_memory(state: PeerState, mask) -> PeerState:
     lands on a device), jax leaves stay jax (engine.unload_members runs
     on live device state)."""
     n = np.shape(mask)[0]
-    fills = {"no_peer": NO_PEER, "never": NEVER, "empty": EMPTY_U32,
-             "zero": 0}
+    fills = {"no_peer": NO_PEER, "never": NEVER, "zero": 0}
     updates = {}
     for name, kind in INSTANCE_MEMORY_FIELDS:
         arr = getattr(state, name)
         xp = np if isinstance(arr, np.ndarray) else jnp
         m = xp.reshape(xp.asarray(mask), (n,) + (1,) * (arr.ndim - 1))
-        updates[name] = xp.where(m, xp.asarray(fills[kind], dtype=arr.dtype),
+        # "empty" is the all-ones sentinel of the column's OWN dtype
+        # (EMPTY_U32 for u32 columns, EMPTY_META for narrowed u8 metas).
+        fill = (np.iinfo(np.dtype(arr.dtype)).max if kind == "empty"
+                else fills[kind])
+        updates[name] = xp.where(m, xp.asarray(fill, dtype=arr.dtype),
                                  arr)
     return state.replace(**updates)
 
@@ -271,18 +275,18 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         cand_last_intro=never(),
         store_gt=jnp.full((n, m), EMPTY_U32, jnp.uint32),
         store_member=jnp.full((n, m), EMPTY_U32, jnp.uint32),
-        store_meta=jnp.full((n, m), EMPTY_U32, jnp.uint32),
+        store_meta=jnp.full((n, m), EMPTY_META, META_DTYPE),
         store_payload=jnp.full((n, m), EMPTY_U32, jnp.uint32),
         store_aux=jnp.zeros((n, m), jnp.uint32),
-        store_flags=jnp.zeros((n, m), jnp.uint32),
+        store_flags=jnp.zeros((n, m), FLAGS_DTYPE),
         fwd_gt=jnp.full((n, f), EMPTY_U32, jnp.uint32),
         fwd_member=jnp.full((n, f), EMPTY_U32, jnp.uint32),
-        fwd_meta=jnp.full((n, f), EMPTY_U32, jnp.uint32),
+        fwd_meta=jnp.full((n, f), EMPTY_META, META_DTYPE),
         fwd_payload=jnp.full((n, f), EMPTY_U32, jnp.uint32),
         fwd_aux=jnp.full((n, f), EMPTY_U32, jnp.uint32),
         dly_gt=jnp.full((n, config.delay_inbox), EMPTY_U32, jnp.uint32),
         dly_member=jnp.full((n, config.delay_inbox), EMPTY_U32, jnp.uint32),
-        dly_meta=jnp.full((n, config.delay_inbox), EMPTY_U32, jnp.uint32),
+        dly_meta=jnp.full((n, config.delay_inbox), EMPTY_META, META_DTYPE),
         dly_payload=jnp.full((n, config.delay_inbox), EMPTY_U32, jnp.uint32),
         dly_aux=jnp.zeros((n, config.delay_inbox), jnp.uint32),
         dly_since=jnp.zeros((n, config.delay_inbox), jnp.uint32),

@@ -10,12 +10,19 @@ sharding tests require exactly this topology, so a pre-set JAX_PLATFORMS
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-# Persistent compile cache: JAX CPU first-compiles dominate test wall-clock.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+# NO persistent compile cache on CPU — measured hazard, not caution: a
+# COLD run of the fused-step executable passes and the very next WARM
+# run segfaults inside the deserialized executable (reproduced 2026-08-03
+# on tests/test_checkpoint.py::test_roundtrip_resumes_bit_exact; cold
+# pass -> warm SIGSEGV, deterministic).  The XLA:CPU AOT loader hazard
+# cpuenv.py documents for cross-host caches evidently bites same-host
+# round trips too.  CPU compiles stay cold; the TPU cache (chip-targeted,
+# artifacts/jax_cache/tpu) remains safe and in use by bench.py.
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 from dispersy_tpu.cpuenv import with_codegen_split  # noqa: E402 — no jax
 
 _flags = os.environ.get("XLA_FLAGS", "")

@@ -79,6 +79,61 @@ def test_config_mismatch_rejected(tmp_path):
         ckpt.restore(path, CFG.replace(churn_rate=0.06))
 
 
+def _as_v7(src: str, dst: str) -> None:
+    """Rewrite a v8 archive as its pre-narrowing v7 equivalent: the four
+    narrowed leaves widened back to uint32 (EMPTY_META -> EMPTY_U32 on
+    the meta sentinels) and the version stamp set to 7 — byte-compatible
+    with what a round-5 checkpoint actually contained."""
+    from dispersy_tpu.config import EMPTY_META, EMPTY_U32
+    with np.load(src) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["meta:version"] = np.asarray(7)
+    for name in ("store_meta", "fwd_meta", "dly_meta"):
+        a8 = arrays[f"leaf:{name}"]
+        assert a8.dtype == np.uint8
+        wide = a8.astype(np.uint32)
+        wide[a8 == EMPTY_META] = EMPTY_U32
+        arrays[f"leaf:{name}"] = wide
+    arrays["leaf:store_flags"] = \
+        arrays["leaf:store_flags"].astype(np.uint32)
+    np.savez_compressed(dst, **arrays)
+
+
+def test_pre_narrowing_v7_snapshot_still_loads(tmp_path):
+    """The dtype narrowing (v8) must not orphan old snapshots: a v7
+    archive with uint32 meta/flags columns up-converts by truncation and
+    resumes the IDENTICAL trajectory as its v8 twin."""
+    v8 = str(tmp_path / "ck_v8.npz")
+    v7 = str(tmp_path / "ck_v7.npz")
+    st = prep(CFG, 4)
+    ckpt.save(v8, st, CFG)
+    _as_v7(v8, v7)
+
+    rst7 = ckpt.restore(v7, CFG)
+    rst8 = ckpt.restore(v8, CFG)
+    assert np.asarray(rst7.store_meta).dtype == np.uint8
+    assert np.asarray(rst7.store_flags).dtype == np.uint8
+    for la, lb in zip(jax.tree.leaves(rst7), jax.tree.leaves(rst8)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    # and the up-converted state steps bit-identically
+    a = jax.block_until_ready(E.step(rst7, CFG))
+    b = jax.block_until_ready(E.step(rst8, CFG))
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_unknown_version_rejected(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    st = prep(CFG, 1)
+    ckpt.save(path, st, CFG)
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["meta:version"] = np.asarray(6)
+    np.savez_compressed(path, **arrays)
+    with pytest.raises(ValueError, match="checkpoint format 6"):
+        ckpt.restore(path, CFG)
+
+
 def test_sharded_state_saves_and_restores(tmp_path):
     from dispersy_tpu.parallel import make_mesh, shard_state
     path = str(tmp_path / "ck.npz")

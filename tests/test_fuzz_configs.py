@@ -27,6 +27,22 @@ from test_oracle import assert_match
 
 ROUNDS = 12
 
+# The bandwidth diet narrowed these PeerState leaves to uint8 (config.
+# META_DTYPE / FLAGS_DTYPE).  A single unguarded write site — e.g.
+# `meta | jnp.uint32(...)` — silently promotes the carried state back to
+# uint32: values stay equal (so oracle bit-equality alone cannot see it)
+# but every later round moves 4x the bytes and the donated-buffer reuse
+# breaks.  Assert the dtypes every fuzzed round, next to the value check.
+_NARROWED_DTYPES = {"store_meta": np.uint8, "store_flags": np.uint8,
+                    "fwd_meta": np.uint8, "dly_meta": np.uint8}
+
+
+def assert_narrow_dtypes(state, ctx: str) -> None:
+    for field, want in _NARROWED_DTYPES.items():
+        got = np.asarray(getattr(state, field)).dtype
+        assert got == want, \
+            f"{ctx}: {field} dtype drifted to {got} (want {want})"
+
 
 def draw_config(rng: np.random.Generator) -> CommunityConfig:
     multi = bool(rng.integers(0, 2))     # two row blocks vs one community
@@ -126,6 +142,7 @@ def run_draw(seed: int) -> None:
         oracle.step()
         assert_match(jax.block_until_ready(state), oracle,
                      f"seed{seed}-round{rnd} cfg={cfg!r}")
+        assert_narrow_dtypes(state, f"seed{seed}-round{rnd}")
 
 
 # resolved at import so draw bodies stay readable
@@ -325,6 +342,7 @@ def run_adversarial_draw(seed: int) -> None:
         oracle.step()
         assert_match(jax.block_until_ready(state), oracle,
                      f"adv-seed{seed}-round{rnd} cfg={cfg!r}")
+        assert_narrow_dtypes(state, f"adv-seed{seed}-round{rnd}")
 
     # settle: everyone back up, no new events; full-sync must converge
     state, _ = _apply(state, cfg, Load(members=members), {}, {})
@@ -404,3 +422,35 @@ def test_fuzz_draw_6():
 
 def test_fuzz_draw_7():
     run_draw(1007)
+
+
+def test_step_preserves_every_leaf_dtype_and_shape():
+    """The fused step must return EXACTLY the pytree it took: one leaf
+    promoted (u8 -> u32) retraces the jit, breaks buffer donation, and
+    quadruples that column's traffic — the failure mode the narrowed
+    layout makes possible and this pins down across every policy axis
+    at once (timeline + pen + seq + malicious gossip + double-signed +
+    identity + churn): every branch's meta/flags write sites are
+    compiled into this one step, so a single promotion anywhere fails
+    the leaf-dtype comparison."""
+    cfg = CommunityConfig(
+        n_peers=24, n_trackers=2, msg_capacity=24, bloom_capacity=8,
+        k_candidates=4, request_inbox=2, tracker_inbox=4,
+        response_budget=2, churn_rate=0.05, packet_loss=0.1,
+        timeline_enabled=True, protected_meta_mask=0b10, k_authorized=4,
+        delay_inbox=2, proof_requests=True, seq_meta_mask=0b100,
+        seq_requests=True, msg_requests=True,
+        malicious_enabled=True, k_malicious=4, malicious_gossip=True,
+        n_meta=4, double_meta_mask=0b1000, identity_enabled=True,
+        identity_required=True, identity_requests=True)
+    state = S.init_state(cfg, jax.random.PRNGKey(3))
+    want = [(np.asarray(leaf).dtype, np.asarray(leaf).shape)
+            for leaf in jax.tree.leaves(state)]
+    state = E.seed_overlay(state, cfg, degree=2)
+    for _ in range(3):
+        state = E.step(state, cfg)
+    state = jax.block_until_ready(state)
+    got = [(np.asarray(leaf).dtype, np.asarray(leaf).shape)
+           for leaf in jax.tree.leaves(state)]
+    assert got == want
+    assert_narrow_dtypes(state, "dtype-stability")

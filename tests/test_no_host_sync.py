@@ -1,0 +1,48 @@
+"""Tier-1 static gate: no host-sync constructs in the hot path.
+
+Wires tools/check_host_sync.py (AST scan of ``dispersy_tpu/ops/`` and
+``engine.step``/``multi_step`` for ``.item()`` / ``np.asarray`` /
+``float()``-on-tracer constructs) into the suite, so a host round-trip
+sneaking into the fused round fails CI instead of silently turning the
+async-dispatch pipeline into ~300 us/call tunnel round-trips (BENCH.md
+dispatch-overhead study).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from check_host_sync import collect_violations  # noqa: E402
+
+
+def test_hot_path_has_no_host_sync_constructs():
+    violations = collect_violations()
+    assert not violations, (
+        "host-sync constructs in dispersy_tpu/ops/ or engine.step — "
+        "each is a forced device->host transfer in the fused round:\n"
+        + "\n".join(f"{p}:{ln}: {what}\n    {src}"
+                    for p, ln, what, src in violations))
+
+
+def test_checker_catches_a_seeded_violation(tmp_path):
+    """The gate must actually bite: a synthetic ops file carrying every
+    forbidden construct (and one host-ok exemption) is flagged
+    correctly."""
+    import ast
+
+    from check_host_sync import _check_tree
+
+    src = (
+        "x = arr.item()\n"
+        "y = np.asarray(arr)\n"
+        "z = float(arr)\n"
+        "w = int(np.iinfo('u4').max)  # host-ok: static dtype math\n"
+    )
+    hits = _check_tree(str(tmp_path / "fake_op.py"), ast.parse(src), src)
+    kinds = [what for _, _, what, _ in hits]
+    assert len(hits) == 3, hits
+    assert any(".item()" in k for k in kinds)
+    assert any("asarray" in k for k in kinds)
+    assert any("float" in k for k in kinds)

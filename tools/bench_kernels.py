@@ -1,0 +1,186 @@
+"""Kernel microbenchmarks: deliver / store merge / bloom, one JSON line each.
+
+Makes kernel-level regressions visible BETWEEN rounds without running the
+whole bench: each hot kernel is compiled and timed standalone at the
+bench config's exact shapes, and one JSON line per kernel goes to stdout
+(machine-diffable against the previous round's artifact).  Wall time is
+the median of ``--reps`` runs; XLA cost-analysis bytes ride along so a
+layout regression shows even when host timing is noisy.
+
+The store merge is timed in BOTH its bit-identical forms (sort / merge —
+ops/store.py ``_prefer_merge``), so the backend gate's threshold has a
+measured basis per shape.
+
+Usage:
+    python tools/bench_kernels.py --peers 65536 \
+        --out artifacts/bench_kernels.json
+    python tools/bench_kernels.py --peers 16384 --reps 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dispersy_tpu.cpuenv import cpu_env  # jax-free import
+
+WORKER_TIMEOUT_S = int(os.environ.get("BENCH_KERNELS_TIMEOUT", "1200"))
+
+
+def _worker(args) -> None:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from dispersy_tpu.cpuenv import enable_tool_cache
+    from dispersy_tpu.ops import bloom as bl
+    from dispersy_tpu.ops import inbox as ib
+    from dispersy_tpu.ops import store as st
+    from dispersy_tpu.profiling import _extract_cost, bench_config
+
+    enable_tool_cache()
+    cfg = bench_config(args.peers, args.shape)
+    n, w, m = cfg.n_peers, cfg.bloom_words, cfg.msg_capacity
+    key = jax.random.PRNGKey(11)
+    platform = jax.devices()[0].platform
+
+    def timed(jitted, *a, reps=args.reps):
+        jax.block_until_ready(jitted(*a))      # compile outside the clock
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jitted(*a))
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[len(times) // 2]
+
+    def emit(name, fn, *a):
+        jitted = jax.jit(fn)
+        row = {"kernel": name, "n_peers": n, "platform": platform,
+               "seconds": round(timed(jitted, *a), 5)}
+        row.update(_extract_cost(jitted.lower(*a).compile()))
+        print("KERNEL_JSON:" + json.dumps(row))
+
+    # --- delivery: the request fan-in (bloom payload riding) and the
+    # push fan-out — engine.py phases 1/1f.
+    dst = jax.random.randint(key, (n,), -1, n, jnp.int32)
+    cols = [jnp.ones((n,), jnp.uint32) for _ in range(6)] \
+        + [jnp.ones((n, w), jnp.uint32)]
+    emit("deliver_request",
+         functools.partial(ib.deliver, n_peers=n,
+                           inbox_size=cfg.request_inbox),
+         dst, cols, jnp.ones((n,), bool))
+    e = n * cfg.forward_buffer * cfg.forward_fanout
+    pdst = jax.random.randint(key, (e,), 0, n, jnp.int32)
+    pcols = [jnp.ones((e,), jnp.uint32) for _ in range(4)] \
+        + [jnp.ones((e,), jnp.uint8)]
+    emit("deliver_push",
+         functools.partial(ib.deliver, n_peers=n,
+                           inbox_size=cfg.push_inbox),
+         pdst, pcols, jnp.ones((e,), bool))
+
+    # --- store merge, both bit-identical forms (ops/store._prefer_merge).
+    b = cfg.request_inbox * cfg.response_budget + cfg.push_inbox
+    gt = jnp.sort(jax.random.randint(key, (n, m), 1, 1000, jnp.int32)
+                  .astype(jnp.uint32), axis=-1)
+    store = st.StoreCols(
+        gt=gt,
+        member=(jax.random.randint(key, (n, m), 0, n, jnp.int32)
+                .astype(jnp.uint32)),
+        meta=jnp.ones((n, m), jnp.uint8),
+        payload=jnp.zeros((n, m), jnp.uint32),
+        aux=jnp.zeros((n, m), jnp.uint32),
+        flags=jnp.zeros((n, m), jnp.uint8))
+    batch = st.StoreCols(
+        gt=(jax.random.randint(key, (n, b), 1, 1000, jnp.int32)
+            .astype(jnp.uint32)),
+        member=(jax.random.randint(key, (n, b), 0, n, jnp.int32)
+                .astype(jnp.uint32)),
+        meta=jnp.ones((n, b), jnp.uint8),
+        payload=jnp.zeros((n, b), jnp.uint32),
+        aux=jnp.zeros((n, b), jnp.uint32),
+        flags=jnp.zeros((n, b), jnp.uint8))
+    mask = jnp.ones((n, b), bool)
+
+    def insert_forced(form):
+        def f(s_, b_, m_):
+            import dispersy_tpu.ops.store as stm
+            orig = stm._prefer_merge
+            stm._prefer_merge = lambda width: form == "merge"
+            try:
+                return stm.store_insert(s_, b_, m_, history=cfg.history)
+            finally:
+                stm._prefer_merge = orig
+        return f
+
+    emit("store_insert_sort", insert_forced("sort"), store, batch, mask)
+    emit("store_insert_merge", insert_forced("merge"), store, batch, mask)
+
+    # --- bloom build + query at the claim/responder shapes.
+    items = (jax.random.randint(key, (n, m), 0, 1 << 30, jnp.int32)
+             .astype(jnp.uint32))
+    imask = jnp.ones((n, m), bool)
+    build = functools.partial(bl.bloom_build, n_bits=cfg.bloom_bits,
+                              n_hashes=cfg.bloom_hashes)
+    emit("bloom_build", build, items, imask)
+    bits = jax.jit(build)(items, imask)
+    emit("bloom_query",
+         functools.partial(bl.bloom_query, n_bits=cfg.bloom_bits,
+                           n_hashes=cfg.bloom_hashes),
+         bits, items)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--peers", type=int, default=65536)
+    ap.add_argument("--shape", choices=("tpu", "cpu"), default="tpu",
+                    help="which bench.py worker shape to use "
+                         "(profiling.bench_config)")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--tpu", action="store_true",
+                    help="use the ambient (tunnel) env instead of the "
+                         "scrubbed CPU env")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--worker", action="store_true")
+    args = ap.parse_args()
+    if args.worker:
+        _worker(args)
+        return
+
+    env = dict(os.environ) if args.tpu else cpu_env()
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+           "--peers", str(args.peers), "--reps", str(args.reps),
+           "--shape", args.shape]
+    try:
+        proc = subprocess.run(cmd, env=env, timeout=WORKER_TIMEOUT_S,
+                              capture_output=True, text=True,
+                              cwd=os.path.dirname(os.path.dirname(
+                                  os.path.abspath(__file__))))
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"error": f"bench_kernels worker timed out "
+                                   f"({WORKER_TIMEOUT_S}s)"}))
+        sys.exit(1)
+    sys.stderr.write(proc.stderr[-3000:])
+    rows = [json.loads(line[len("KERNEL_JSON:"):])
+            for line in proc.stdout.splitlines()
+            if line.startswith("KERNEL_JSON:")]
+    if not rows:
+        print(json.dumps({"error": f"worker rc={proc.returncode}, "
+                                   f"no kernel lines"}))
+        sys.exit(1)
+    for row in rows:
+        print(json.dumps(row))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

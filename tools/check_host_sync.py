@@ -1,0 +1,138 @@
+"""AST check: no host-sync constructs in the hot path.
+
+The fused round's performance contract is that NOTHING inside it forces
+a device->host transfer: one ``.item()`` / ``np.asarray`` / ``float()``
+on a tracer turns the async-dispatched pipeline into a round-trip per
+call (the dispatch-overhead study in BENCH.md measured ~300 us each
+through the TPU tunnel).  The engine avoids them by construction; this
+checker keeps it that way, as a tier-1 test (tests/test_no_host_sync.py)
+instead of a code-review convention.
+
+Scanned scope:
+- every module under ``dispersy_tpu/ops/`` (whole files — ops are
+  device-side by definition), and
+- the bodies of ``engine.step`` and ``engine.multi_step`` (the fused
+  round; the engine's host-side helpers — create_messages and friends —
+  legitimately touch numpy for setup work).
+
+Forbidden constructs:
+- ``<expr>.item()`` — the canonical scalar sync;
+- ``np.asarray(...)`` / ``np.array(...)`` / ``numpy.asarray(...)`` /
+  ``jax.device_get(...)`` — host materialization;
+- ``float(...)`` / ``int(...)`` / ``bool(...)`` — tracer concretization
+  (``jnp.float32``/``jnp.uint32`` wrappers stay device-side and are
+  untouched).
+
+A line whose source carries a ``host-ok`` comment is exempt — for
+provably static host math (e.g. dtype-sentinel computation from a
+``np.dtype``, which never sees a tracer).
+
+Usage:
+    python tools/check_host_sync.py            # scan, report, exit 1 on hits
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_FORBIDDEN_CALLS = {
+    ("np", "asarray"), ("np", "array"),
+    ("numpy", "asarray"), ("numpy", "array"),
+    ("jax", "device_get"),
+}
+_FORBIDDEN_BUILTINS = {"float", "int", "bool"}
+_EXEMPT_MARKER = "host-ok"
+
+
+def _dotted(node: ast.AST) -> tuple | None:
+    """("np", "asarray") for an ``np.asarray`` attribute chain."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return (node.value.id, node.attr)
+    return None
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, path: str, source_lines: list):
+        self.path = path
+        self.lines = source_lines
+        self.violations: list = []
+
+    def _flag(self, node: ast.Call, what: str) -> None:
+        line = self.lines[node.lineno - 1] if node.lineno <= len(
+            self.lines) else ""
+        if _EXEMPT_MARKER in line:
+            return
+        self.violations.append(
+            (self.path, node.lineno, what, line.strip()))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr == "item"
+                and not node.args and not node.keywords):
+            self._flag(node, ".item() host sync")
+        dotted = _dotted(fn)
+        if dotted in _FORBIDDEN_CALLS:
+            self._flag(node, f"{dotted[0]}.{dotted[1]}() host "
+                             "materialization")
+        if isinstance(fn, ast.Name) and fn.id in _FORBIDDEN_BUILTINS:
+            self._flag(node, f"builtin {fn.id}() tracer concretization")
+        self.generic_visit(node)
+
+
+def _check_tree(path: str, tree: ast.AST, source: str) -> list:
+    checker = _Checker(os.path.relpath(path, REPO_ROOT),
+                       source.splitlines())
+    checker.visit(tree)
+    return checker.violations
+
+
+def _engine_hot_functions(tree: ast.Module, names=("step", "multi_step")):
+    """The FunctionDef nodes of the fused-round entry points, wherever
+    decoration (functools.partial(jax.jit, ...)) put them."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in names:
+            yield node
+
+
+def collect_violations(repo_root: str = REPO_ROOT) -> list:
+    """[(path, lineno, what, source_line)] across the scanned scope."""
+    violations = []
+    ops_dir = os.path.join(repo_root, "dispersy_tpu", "ops")
+    for fname in sorted(os.listdir(ops_dir)):
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(ops_dir, fname)
+        with open(path) as f:
+            source = f.read()
+        violations += _check_tree(path, ast.parse(source), source)
+
+    engine_path = os.path.join(repo_root, "dispersy_tpu", "engine.py")
+    with open(engine_path) as f:
+        source = f.read()
+    tree = ast.parse(source)
+    for fn in _engine_hot_functions(tree):
+        violations += _check_tree(engine_path, fn, source)
+    return violations
+
+
+def main() -> int:
+    violations = collect_violations()
+    for path, lineno, what, line in violations:
+        print(f"{path}:{lineno}: {what}\n    {line}")
+    if violations:
+        print(f"\n{len(violations)} host-sync construct(s) in the hot "
+              "path — move them out of dispersy_tpu/ops/ & engine.step, "
+              "or mark provably-static host math with a 'host-ok' "
+              "comment.")
+        return 1
+    print("host-sync check: clean "
+          "(dispersy_tpu/ops/* + engine.step/multi_step)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

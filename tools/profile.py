@@ -124,11 +124,13 @@ def kernel_proxies(cfg, state, mesh=None) -> dict:
         deliver_req, dst, scalars + [bloom_col], valid)
 
     # --- push-forward delivery (engine.py `push = inbox.deliver(...)`):
-    # E = N * forward_buffer * forward_fanout edges, 5 u32 columns.
+    # E = N * forward_buffer * forward_fanout edges, 4 u32 + 1 u8 (meta)
+    # columns.
     e = n * cfg.forward_buffer * cfg.forward_fanout
     if e:
         pdst = put(jax.random.randint(key, (e,), 0, n, jnp.int32))
-        pcols = [put(jnp.ones((e,), jnp.uint32)) for _ in range(5)]
+        pcols = [put(jnp.ones((e,), jnp.uint32)) for _ in range(4)] \
+            + [put(jnp.ones((e,), jnp.uint8))]
         pvalid = put(jnp.ones((e,), bool))
         deliver_push = jax.jit(functools.partial(
             ib.deliver, n_peers=n, inbox_size=cfg.push_inbox))
@@ -144,10 +146,10 @@ def kernel_proxies(cfg, state, mesh=None) -> dict:
                .astype(jnp.uint32)),
         member=put(jax.random.randint(key, (n, b), 0, n, jnp.int32)
                    .astype(jnp.uint32)),
-        meta=put(jnp.ones((n, b), jnp.uint32)),
+        meta=put(jnp.ones((n, b), jnp.uint8)),
         payload=put(jnp.zeros((n, b), jnp.uint32)),
         aux=put(jnp.zeros((n, b), jnp.uint32)),
-        flags=put(jnp.zeros((n, b), jnp.uint32)))
+        flags=put(jnp.zeros((n, b), jnp.uint8)))
     mask = put(jnp.ones((n, b), bool))
     insert = jax.jit(functools.partial(st.store_insert,
                                        history=cfg.history))

@@ -1,0 +1,129 @@
+"""Per-phase round profiler: XLA cost analysis + optional wall time/trace.
+
+The companion to tools/profile.py (which times kernels standalone): this
+tool reports where the round's BYTES go — the quantity the
+memory-bandwidth roofline (BENCH.md) says governs rounds/sec — using
+XLA's static cost analysis of the compiled executables.  Because cost
+analysis needs only abstract shapes, the default mode profiles the
+1M-peer bench shape on any host in compile time alone.
+
+Usage:
+    # compile-only cost analysis at the 1M-peer bench shape (any host):
+    python tools/profile_round.py --peers 1048576 \
+        --out artifacts/profile_round_1M.json
+
+    # + measured per-phase and whole-step wall time (population must fit):
+    python tools/profile_round.py --peers 65536 --time --rounds 5
+
+    # + a jax.profiler perfetto trace of the timed rounds:
+    python tools/profile_round.py --peers 65536 --time --rounds 5 \
+        --trace-dir artifacts/profile_round_trace
+
+Output: one JSON object — ``step`` holds the fused round's totals
+(bytes_accessed / flops / compile_seconds, plus seconds & rounds_per_sec
+when ``--time``), ``phases`` the per-phase breakdown (churn, walk,
+deliver_request, deliver_push, bloom_build, bloom_query, store_merge,
+timeline).  Phases are standalone compilations of the REAL ops kernels
+at the step's exact shapes; fusion inside the full step shares reads, so
+phase bytes legitimately sum past the step total.
+
+Every JAX-touching run happens in a bounded subprocess (the axon tunnel
+discipline — dispersy_tpu/cpuenv.py); the parent writes the artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dispersy_tpu.cpuenv import cpu_env  # jax-free import
+
+WORKER_TIMEOUT_S = int(os.environ.get("PROFILE_TIMEOUT", "1800"))
+
+
+def _worker(args) -> None:
+    from dispersy_tpu.cpuenv import enable_tool_cache
+    enable_tool_cache()
+
+    from dispersy_tpu.profiling import bench_config, profile_round
+
+    cfg = bench_config(args.peers, args.shape)
+    if args.timeline:
+        cfg = cfg.replace(timeline_enabled=True, protected_meta_mask=0b10,
+                          k_authorized=8)
+    result = profile_round(
+        cfg, time_phases=args.time,
+        rounds=args.rounds if args.time else 0,
+        trace_dir=args.trace_dir or None)
+    print("PROFILE_JSON:" + json.dumps(result))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--peers", type=int, default=1 << 20,
+                    help="population (default: the 1M-peer bench shape)")
+    ap.add_argument("--shape", choices=("tpu", "cpu"), default="tpu",
+                    help="which bench.py worker shape to profile: the "
+                         "TPU 1M roofline shape (M=48) or the CPU "
+                         "fallback rung's (M=64)")
+    ap.add_argument("--time", action="store_true",
+                    help="also execute kernels/rounds for wall time "
+                         "(population must fit this host)")
+    ap.add_argument("--rounds", type=int, default=5,
+                    help="timed full rounds when --time is set")
+    ap.add_argument("--timeline", action="store_true",
+                    help="profile the timeline-enabled config variant")
+    ap.add_argument("--trace-dir", default=None,
+                    help="dump a jax.profiler trace of the timed rounds")
+    ap.add_argument("--tpu", action="store_true",
+                    help="use the ambient (tunnel) env instead of the "
+                         "scrubbed CPU env")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--worker", action="store_true")
+    args = ap.parse_args()
+    if args.worker:
+        _worker(args)
+        return
+
+    env = dict(os.environ) if args.tpu else cpu_env()
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+           "--peers", str(args.peers), "--rounds", str(args.rounds),
+           "--shape", args.shape]
+    if args.time:
+        cmd.append("--time")
+    if args.timeline:
+        cmd.append("--timeline")
+    if args.trace_dir:
+        cmd += ["--trace-dir", args.trace_dir]
+    try:
+        proc = subprocess.run(cmd, env=env, timeout=WORKER_TIMEOUT_S,
+                              capture_output=True, text=True,
+                              cwd=os.path.dirname(os.path.dirname(
+                                  os.path.abspath(__file__))))
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"error": f"profile worker timed out "
+                                   f"({WORKER_TIMEOUT_S}s)"}))
+        sys.exit(1)
+    sys.stderr.write(proc.stderr[-3000:])
+    result = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("PROFILE_JSON:"):
+            result = json.loads(line[len("PROFILE_JSON:"):])
+    if result is None:
+        print(json.dumps({"error": f"worker rc={proc.returncode}, "
+                                   f"no result line"}))
+        sys.exit(1)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -733,7 +733,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
 
         def ring_write(full, vals, ok):
             cur = jnp.take_along_axis(full[:t], slot_b, axis=1)
-            return full.at[trows, slot_b].set(jnp.where(ok, vals, cur))
+            return full.at[trows, slot_b].set(jnp.where(ok, vals, cur),
+                                              mode="drop")
 
         tab = cand.CandTable(
             peer=ring_write(tab.peer, ring_src, ring_ok),
@@ -1917,7 +1918,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
 
             def gbuf(cur, val):
                 return cur.at[rowsg, gput].set(
-                    jnp.where(gossip_now, val, cur[rowsg, gput]))
+                    jnp.where(gossip_now, val, cur[rowsg, gput]),
+                    mode="drop")
             fwd = (gbuf(fwd[0], g_gt_new),
                    gbuf(fwd[1], idx.astype(jnp.uint32)),
                    gbuf(fwd[2], jnp.full((n,), META_MALICIOUS, jnp.uint8)),
@@ -2238,7 +2240,7 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
 
     def buf(cur, val):
         return cur.at[rows, put[0]].set(
-            jnp.where(can_buf, val, cur[rows, put[0]]))
+            jnp.where(can_buf, val, cur[rows, put[0]]), mode="drop")
     return state.replace(
         store_gt=stc.gt, store_member=stc.member,
         store_meta=stc.meta, store_payload=stc.payload,

@@ -25,8 +25,14 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from dispersy_tpu.ops.contracts import Spec, contract, host_helper
 from dispersy_tpu.ops.hashing import (BLOOM_SALT_SEED, BLOOM_SEED_1,
                                       BLOOM_SEED_2, hash_u32)
+
+# Canonical contract inputs shared by the bloom kernels: n_bits packs
+# exactly into W uint32 words, probes carry H hash functions.
+_N_BITS = lambda d: 32 * d["W"]  # noqa: E731
+_N_HASHES = lambda d: d["H"]  # noqa: E731
 
 
 def _auto_impl(impl: str | None) -> str:
@@ -79,6 +85,9 @@ def _h1_h2(item_hash: jnp.ndarray,
     return h1, h2
 
 
+@contract(out=Spec("int32", ("M", "H")),
+          item_hash=Spec("uint32", ("M",)), n_bits=_N_BITS,
+          n_hashes=_N_HASHES, salt=None)
 def probe_bits(item_hash: jnp.ndarray, n_bits: int, n_hashes: int,
                salt=None) -> jnp.ndarray:
     """Bit indices probed for an item: shape ``item_hash.shape + (n_hashes,)``.
@@ -96,12 +105,16 @@ def probe_bits(item_hash: jnp.ndarray, n_bits: int, n_hashes: int,
     return idx.astype(jnp.int32)
 
 
+@host_helper
 def gather_backend(impl: str | None = None) -> bool:
     """Should callers precompute/share :func:`probe_bits` tensors?  True
     exactly when the kernels below pick their gather/scatter forms."""
     return _auto_impl(impl) == "gather"
 
 
+@contract(out=Spec("uint32", ("N", "W")),
+          probes=Spec("int32", ("N", "M", "H")),
+          mask=Spec("bool", ("N", "M")), n_bits=_N_BITS)
 def bloom_build_from(probes: jnp.ndarray, mask: jnp.ndarray,
                      n_bits: int) -> jnp.ndarray:
     """Gather-form build from precomputed ``probes`` (:func:`probe_bits`,
@@ -121,17 +134,21 @@ def bloom_build_from(probes: jnp.ndarray, mask: jnp.ndarray,
         row0 = (jnp.arange(flat, dtype=jnp.int32) * stride)[:, None]
         flat_ix = (row0 + tgt.reshape(flat, -1)).reshape(-1)
         bits = (jnp.zeros((flat * stride,), jnp.bool_)
-                .at[flat_ix].set(True).reshape(flat, stride))
+                .at[flat_ix].set(True, mode="drop")
+                .reshape(flat, stride))
     else:
         # ...but row*stride overflows int32 past 2^31 elements (e.g. the
         # default 2464-bit filter above ~870k rows), so large shapes keep
         # the 2-D (row, bit) index form; x64 is off, so no int64 escape.
         rows = jnp.arange(flat, dtype=jnp.int32)[:, None]
         bits = (jnp.zeros((flat, stride), jnp.bool_)
-                .at[rows, tgt.reshape(flat, -1)].set(True))
+                .at[rows, tgt.reshape(flat, -1)].set(True, mode="drop"))
     return pack_bits(bits[:, :n_bits]).reshape(*lead, w)
 
 
+@contract(out=Spec("bool", ("N", "M")),
+          words=Spec("uint32", ("N", "W")),
+          probes=Spec("int32", ("N", "M", "H")))
 def bloom_query_from(words: jnp.ndarray,
                      probes: jnp.ndarray) -> jnp.ndarray:
     """Gather-form membership test from precomputed ``probes``
@@ -150,6 +167,10 @@ def bloom_query_from(words: jnp.ndarray,
     return jnp.all(bit == 1, axis=-1)
 
 
+@contract(out=Spec("uint32", ("N", "W")),
+          item_hashes=Spec("uint32", ("N", "M")),
+          mask=Spec("bool", ("N", "M")), n_bits=_N_BITS,
+          n_hashes=_N_HASHES, impl=None, salt=None)
 def bloom_build(item_hashes: jnp.ndarray, mask: jnp.ndarray,
                 n_bits: int, n_hashes: int,
                 impl: str | None = None, salt=None) -> jnp.ndarray:
@@ -184,6 +205,12 @@ def bloom_build(item_hashes: jnp.ndarray, mask: jnp.ndarray,
     return words
 
 
+# pack/unpack sizes are coupled (BITS = 32·W, PW = N·BITS/32), which the
+# Spec grammar cannot express — so the dims are PINNED per-op here rather
+# than inherited: a legitimate edit to the global canonical DIMS must not
+# fail R3 on these healthy ops.
+@contract(out=Spec("uint32", ("PW",)), dense=Spec("bool", ("N", "BITS")),
+          dims={"N": 4, "BITS": 64, "PW": 8})
 def pack_bits(dense: jnp.ndarray) -> jnp.ndarray:
     """bool[n_bits] -> uint32[n_bits//32], bit i of word w == bit 32w+i."""
     w = dense.reshape(-1, 32).astype(jnp.uint32)
@@ -191,12 +218,18 @@ def pack_bits(dense: jnp.ndarray) -> jnp.ndarray:
     return (w << shifts).sum(axis=-1, dtype=jnp.uint32)
 
 
+@contract(out=Spec("bool", ("N", "BITS")), words=Spec("uint32", ("N", "W")),
+          dims={"W": 2, "BITS": 64})    # BITS = 32·W, pinned as above
 def unpack_bits(words: jnp.ndarray) -> jnp.ndarray:
     """uint32[W] -> bool[32·W] (inverse of :func:`pack_bits`)."""
     shifts = jnp.arange(32, dtype=jnp.uint32)
     return (((words[..., None] >> shifts) & 1) > 0).reshape(*words.shape[:-1], -1)
 
 
+@contract(out=Spec("bool", ("N", "M")),
+          words=Spec("uint32", ("N", "W")),
+          item_hashes=Spec("uint32", ("N", "M")), n_bits=_N_BITS,
+          n_hashes=_N_HASHES, impl=None, salt=None)
 def bloom_query(words: jnp.ndarray, item_hashes: jnp.ndarray,
                 n_bits: int, n_hashes: int,
                 impl: str | None = None, salt=None) -> jnp.ndarray:

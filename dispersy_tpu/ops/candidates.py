@@ -30,6 +30,7 @@ from jax import lax
 from dispersy_tpu.config import (CAT_INTRODUCED, CAT_NONE, CAT_STUMBLED,
                                  CAT_WALKED, NO_PEER, CommunityConfig)
 from dispersy_tpu.ops import rng
+from dispersy_tpu.ops.contracts import Spec, contract
 
 # Update kinds for upsert_many (which timestamp an observation refreshes).
 KIND_WALK = 0     # we walked to it and got a response
@@ -46,6 +47,27 @@ class CandTable(NamedTuple):
     last_intro: jnp.ndarray    # f32[N, K]
 
 
+# Canonical contract inputs: an [N, K] table and a config whose table
+# sizes agree with the canonical dims (one tracker so the bootstrap
+# branch traces; fan-out C <= K as __post_init__ requires).
+_TAB = CandTable(peer=Spec("int32", ("N", "K")),
+                 last_walk=Spec("float32", ("N", "K")),
+                 last_stumble=Spec("float32", ("N", "K")),
+                 last_intro=Spec("float32", ("N", "K")))
+
+
+def _canon_cfg(d) -> CommunityConfig:
+    return CommunityConfig(n_peers=d["N"], n_trackers=1,
+                           k_candidates=d["K"], forward_fanout=d["C"])
+
+
+_NOW = Spec("float32", ())
+_SEED = Spec("uint32", ())
+_ROUND = Spec("uint32", ())
+_SELF = Spec("int32", ("N",))
+
+
+@contract(out=Spec("int32", ("N", "K")), tab=_TAB, now=_NOW, cfg=_canon_cfg)
 def categories(tab: CandTable, now: jnp.ndarray,
                cfg: CommunityConfig) -> jnp.ndarray:
     """Per-slot category, derived from timestamp freshness.
@@ -64,6 +86,8 @@ def categories(tab: CandTable, now: jnp.ndarray,
                   jnp.where(intro, CAT_INTRODUCED, CAT_NONE)))
 
 
+@contract(out=Spec("bool", ("N", "K")), tab=_TAB,
+          cats=Spec("int32", ("N", "K")), now=_NOW, cfg=_canon_cfg)
 def is_eligible(tab: CandTable, cats: jnp.ndarray, now: jnp.ndarray,
                 cfg: CommunityConfig) -> jnp.ndarray:
     """``WalkCandidate.is_eligible_for_walk``: fresh category + walk cooldown."""
@@ -78,6 +102,10 @@ def _activity(tab: CandTable) -> jnp.ndarray:
     return jnp.where(tab.peer == NO_PEER, _NEVER * 2.0, act)
 
 
+@contract(out=_TAB, tab=_TAB, upd_peer=Spec("int32", ("N", "U")),
+          upd_kind=Spec("int32", ("N", "U")),
+          upd_valid=Spec("bool", ("N", "U")), now=_NOW, self_idx=_SELF,
+          n_trackers=1)
 def upsert_many(tab: CandTable, upd_peer: jnp.ndarray, upd_kind: jnp.ndarray,
                 upd_valid: jnp.ndarray, now: jnp.ndarray,
                 self_idx: jnp.ndarray, n_trackers: int = 0) -> CandTable:
@@ -132,6 +160,8 @@ def upsert_many(tab: CandTable, upd_peer: jnp.ndarray, upd_kind: jnp.ndarray,
     return lax.fori_loop(0, u, body, tab) if u > 0 else tab
 
 
+@contract(out=_TAB, tab=_TAB, peer=Spec("int32", ("N",)),
+          valid=Spec("bool", ("N",)))
 def remove(tab: CandTable, peer: jnp.ndarray, valid: jnp.ndarray) -> CandTable:
     """Drop one candidate per row (walk-timeout eviction).
 
@@ -159,6 +189,9 @@ def _pick_by_priority(mask: jnp.ndarray, prio: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(any_, best, -1)
 
 
+@contract(out=Spec("int32", ("N",)), tab=_TAB, now=_NOW, cfg=_canon_cfg,
+          seed=_SEED, round_index=_ROUND, self_idx=_SELF,
+          boot_base=None, boot_count=None)
 def sample_walk_target(tab: CandTable, now: jnp.ndarray, cfg: CommunityConfig,
                        seed: jnp.ndarray, round_index: jnp.ndarray,
                        self_idx: jnp.ndarray,
@@ -225,6 +258,8 @@ def sample_walk_target(tab: CandTable, now: jnp.ndarray, cfg: CommunityConfig,
     return jnp.where(jnp.any(avail, axis=0), target, NO_PEER).astype(jnp.int32)
 
 
+@contract(out=Spec("int32", ("N", "C")), tab=_TAB, now=_NOW, cfg=_canon_cfg,
+          seed=_SEED, round_index=_ROUND, self_idx=_SELF)
 def sample_forward_targets(tab: CandTable, now: jnp.ndarray,
                            cfg: CommunityConfig, seed: jnp.ndarray,
                            round_index: jnp.ndarray,
@@ -252,6 +287,10 @@ def sample_forward_targets(tab: CandTable, now: jnp.ndarray,
     return jnp.where(ok, picked, NO_PEER).astype(jnp.int32)
 
 
+@contract(out=Spec("int32", ("N", "S")), tab=_TAB, now=_NOW, cfg=_canon_cfg,
+          seed=_SEED, round_index=_ROUND, self_idx=_SELF,
+          exclude=Spec("int32", ("N", "S")), salt_base=0,
+          req_sym=Spec("bool", ("N", "S")), slot_sym=Spec("bool", ("N", "K")))
 def sample_introductions(tab: CandTable, now: jnp.ndarray, cfg: CommunityConfig,
                          seed: jnp.ndarray, round_index: jnp.ndarray,
                          self_idx: jnp.ndarray, exclude: jnp.ndarray,

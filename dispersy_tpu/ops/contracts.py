@@ -1,0 +1,197 @@
+"""Op-level shape/dtype contracts: machine-checked performance invariants.
+
+The fused round is memory-bandwidth-bound (BENCH.md roofline), and its
+byte diet rests on dtype discipline: meta/flags columns are uint8, hashes
+and clocks uint32, slot indices int32.  One accidental promotion — a
+``jnp.int32`` literal where a ``jnp.uint8`` belonged, a comparison that
+widens, a fill value of the wrong width — silently multiplies the bytes a
+column moves per round, and nothing crashes.  PR 1's uint8 packing is
+exactly the kind of win that erodes this way.
+
+So every public op in ``dispersy_tpu/ops/`` declares its contract:
+
+    @contract(out=Spec("uint32", ("N", "W")),
+              item_hashes=Spec("uint32", ("N", "M")),
+              mask=Spec("bool", ("N", "M")),
+              n_bits=64, n_hashes=3)
+    def bloom_build(item_hashes, mask, n_bits, n_hashes, ...): ...
+
+The decorator is METADATA ONLY: it attaches the declaration to the
+function and returns it unchanged — zero tracing, zero wrapping, zero
+hot-path cost.  ``tools/graftlint`` rule R3 later traces each contracted
+op with ``jax.eval_shape`` at the declared canonical sizes (abstract
+shapes only — no arrays materialize, safe on any backend including a
+CPU-only lint run) and diffs the inferred output dtypes/shapes against
+the declaration.  A dtype regression fails lint before it ever reaches a
+benchmark.
+
+Vocabulary:
+
+- :class:`Spec` — one abstract array: dtype name + shape of ints and/or
+  symbolic dim names resolved through ``DIMS`` (contract-local ``dims=``
+  overrides).  Specs nest freely inside tuples / lists / dicts /
+  NamedTuples for structured inputs (``StoreCols``, ``CandTable``) and
+  outputs (``Delivery``, ``InsertResult``).
+- callables as input values — evaluated at CHECK time with the resolved
+  dims dict (``lambda d: CommunityConfig(n_peers=d["N"], ...)``), so ops
+  needing host-side config objects stay declarable without importing or
+  constructing anything at decoration time.
+- :func:`host_helper` — marks a public function that is deliberately NOT
+  a traced op (backend predicates, static size math).  R3 requires every
+  public symbol to carry one of the two markers, so an op added without
+  a contract is itself a lint finding.
+
+Canonical sizes are deliberately tiny (tracing cost only) and chosen so
+no two dims collide — a transposed output shape cannot masquerade as
+correct.
+"""
+
+from __future__ import annotations
+
+# Default canonical sizes for symbolic dims.  All PAIRWISE DISTINCT and
+# all tiny: eval_shape never materializes data, these only need to make
+# shapes unambiguous — distinctness is what lets R3 catch a transposed
+# output (two dims sharing a size would make the swap invisible).
+# Contracts may override per-op via ``dims={...}``; constraint to keep:
+# C (fan-out) <= K (candidate slots), per CommunityConfig.__post_init__.
+DIMS = {
+    "N": 4,     # peers
+    "M": 6,     # store slots per peer
+    "B": 3,     # intake batch entries per peer
+    "E": 8,     # edges (logical packets) per round
+    "W": 2,     # bloom words per filter
+    "K": 14,    # candidate-table slots
+    "A": 7,     # auth-table rows
+    "S": 9,     # per-request slots / member-set slots
+    "U": 13,    # candidate observations per round
+    "C": 10,    # forward fan-out
+    "H": 11,    # bloom hash functions
+    "Q": 12,    # inbox slots per destination
+}
+assert len(set(DIMS.values())) == len(DIMS), "canonical dims must differ"
+
+
+class Spec:
+    """One abstract array in a contract: dtype name + symbolic shape."""
+
+    __slots__ = ("dtype", "shape")
+
+    def __init__(self, dtype: str, shape: tuple = ()):
+        self.dtype = dtype
+        self.shape = tuple(shape)
+
+    def __repr__(self) -> str:
+        dims = ", ".join(str(d) for d in self.shape)
+        return f"Spec({self.dtype!r}, ({dims}))"
+
+    def resolve(self, dims: dict) -> tuple:
+        """Concrete (dtype, shape) under a dims table."""
+        return (self.dtype,
+                tuple(dims[d] if isinstance(d, str) else d
+                      for d in self.shape))
+
+
+def contract(out, dims: dict | None = None, **inputs):
+    """Attach a shape/dtype contract to an op.  Metadata only — the
+    function is returned unchanged; ``tools/graftlint`` R3 does the
+    checking offline via ``jax.eval_shape``.
+
+    ``out``: pytree of :class:`Spec` matching the op's return structure.
+    ``dims``: per-op overrides of the canonical :data:`DIMS` sizes.
+    ``**inputs``: one entry per parameter — a Spec (abstract array), a
+    pytree containing Specs (NamedTuple/tuple/list/dict inputs), a
+    zero-arg-of-dims callable (host objects built at check time), or any
+    concrete value (static args passed through verbatim).
+    """
+    def mark(fn):
+        fn.__graft_contract__ = {"out": out, "dims": dims or {},
+                                 "inputs": inputs}
+        return fn
+    return mark
+
+
+def host_helper(fn):
+    """Mark a public ops-module function as deliberately uncontracted:
+    host-side planning math (backend predicates, static size
+    computation), never traced, never on the wire."""
+    fn.__graft_host_helper__ = True
+    return fn
+
+
+def _materialize(value, dims: dict):
+    """Spec -> ShapeDtypeStruct; containers recurse; callables get the
+    dims table; everything else passes through as a static value."""
+    import jax
+    import numpy as np
+
+    if isinstance(value, Spec):
+        dtype, shape = value.resolve(dims)
+        return jax.ShapeDtypeStruct(shape, np.dtype(dtype))
+    if callable(value) and not isinstance(value, type):
+        return value(dims)
+    if isinstance(value, tuple) and hasattr(value, "_fields"):
+        return type(value)(*(_materialize(v, dims) for v in value))
+    if isinstance(value, tuple):
+        return tuple(_materialize(v, dims) for v in value)
+    if isinstance(value, list):
+        return [_materialize(v, dims) for v in value]
+    if isinstance(value, dict):
+        return {k: _materialize(v, dims) for k, v in value.items()}
+    return value
+
+
+def check_contract(fn) -> list:
+    """Trace ``fn`` with ``jax.eval_shape`` at its contract's canonical
+    sizes and diff declared vs inferred output dtypes/shapes.
+
+    Returns a list of human-readable mismatch strings (empty == clean).
+    Tracing only — no array is ever materialized, so this is safe to run
+    on any backend, at any declared size.
+    """
+    import jax
+
+    spec = fn.__graft_contract__
+    dims = {**DIMS, **spec["dims"]}
+    try:
+        kwargs = {k: _materialize(v, dims)
+                  for k, v in spec["inputs"].items()}
+        declared = _materialize(spec["out"], dims)
+    except Exception as e:  # noqa: BLE001 — a typo'd dim/dtype name in
+        #   the declaration itself must surface as an R3 finding, not
+        #   crash run() and suppress every rule's report
+        return [f"contract declaration invalid: {type(e).__name__}: {e}"]
+    # Partition: parameters whose value tree carries abstract arrays are
+    # traced; everything else (sizes, dtypes, configs, None) is closed
+    # over as a static value — exactly how the engine calls these ops.
+    traced = {k: v for k, v in kwargs.items()
+              if any(isinstance(leaf, jax.ShapeDtypeStruct)
+                     for leaf in jax.tree_util.tree_leaves(v))}
+    static = {k: v for k, v in kwargs.items() if k not in traced}
+    try:
+        inferred = jax.eval_shape(
+            lambda **kw: fn(**kw, **static), **traced)
+    except Exception as e:  # noqa: BLE001 — any trace failure IS the finding
+        return [f"eval_shape failed: {type(e).__name__}: {e}"]
+
+    decl_leaves = jax.tree_util.tree_leaves(declared)
+    inf_leaves = jax.tree_util.tree_leaves(inferred)
+    problems = []
+    if len(decl_leaves) != len(inf_leaves):
+        problems.append(
+            f"output arity: declared {len(decl_leaves)} array leaves, "
+            f"inferred {len(inf_leaves)}")
+        return problems
+    for i, (d, got) in enumerate(zip(decl_leaves, inf_leaves)):
+        want_dtype = str(getattr(d, "dtype", d))
+        got_dtype = str(got.dtype)
+        if want_dtype != got_dtype:
+            problems.append(
+                f"leaf {i}: dtype {got_dtype}, contract declares "
+                f"{want_dtype}")
+        want_shape = tuple(getattr(d, "shape", ()))
+        got_shape = tuple(got.shape)
+        if want_shape != got_shape:
+            problems.append(
+                f"leaf {i}: shape {got_shape}, contract declares "
+                f"{want_shape}")
+    return problems

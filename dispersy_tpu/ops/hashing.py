@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from dispersy_tpu.ops.contracts import Spec, contract
+
 GOLDEN = 0x9E3779B9
 _C1 = 0x85EBCA6B
 _C2 = 0xC2B2AE35
@@ -33,6 +35,7 @@ BLOOM_SEED_2 = 0xCA62C1D6
 BLOOM_SALT_SEED = 0x6ED9EBA1
 
 
+@contract(out=Spec("uint32", ("B",)), x=Spec("uint32", ("B",)))
 def fmix32(x: jnp.ndarray) -> jnp.ndarray:
     """murmur3 32-bit finalizer: a bijective avalanche mix on uint32."""
     x = x.astype(jnp.uint32)
@@ -44,17 +47,23 @@ def fmix32(x: jnp.ndarray) -> jnp.ndarray:
     return x
 
 
+@contract(out=Spec("uint32", ("B",)), x=Spec("uint32", ("B",)), seed=BLOOM_SEED_1)
 def hash_u32(x: jnp.ndarray, seed: int) -> jnp.ndarray:
     """Seeded hash of a uint32 value."""
     return fmix32(x.astype(jnp.uint32) ^ fmix32(jnp.uint32(seed)))
 
 
+@contract(out=Spec("uint32", ("B",)),
+          h=Spec("uint32", ("B",)), v=Spec("uint32", ("B",)))
 def combine(h: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
     """Fold value ``v`` into running hash ``h`` (boost::hash_combine-style)."""
     h = h.astype(jnp.uint32)
     return h ^ (fmix32(v) + jnp.uint32(GOLDEN) + (h << 6) + (h >> 2))
 
 
+@contract(out=Spec("uint32", ("B",)),
+          member=Spec("uint32", ("B",)), global_time=Spec("uint32", ("B",)),
+          meta=Spec("uint8", ("B",)), payload=Spec("uint32", ("B",)))
 def record_hash(member: jnp.ndarray, global_time: jnp.ndarray,
                 meta: jnp.ndarray, payload: jnp.ndarray) -> jnp.ndarray:
     """Hash of one sync record — the simulation analogue of the packet sha1.

@@ -41,6 +41,8 @@ from typing import NamedTuple, Sequence
 import jax.numpy as jnp
 from jax import lax
 
+from dispersy_tpu.ops.contracts import Spec, contract, host_helper
+
 
 class Delivery(NamedTuple):
     inbox: tuple          # tuple of [N, B] arrays, one per payload column
@@ -49,6 +51,7 @@ class Delivery(NamedTuple):
     edge_slot: jnp.ndarray    # i32[E] slot each edge landed in, -1 if dropped
 
 
+@host_helper
 def packed_key_bits(n_peers: int, n_edges: int) -> int | None:
     """Bits needed for the packed (destination, position) sort key, or
     None when it cannot fit uint32.  The key space is [0, n_peers]
@@ -60,6 +63,15 @@ def packed_key_bits(n_peers: int, n_edges: int) -> int | None:
     return pos_bits if total <= 32 else None
 
 
+@contract(out=Delivery(inbox=(Spec("uint32", ("N", "Q")),
+                              Spec("uint32", ("N", "Q", "W"))),
+                       inbox_valid=Spec("bool", ("N", "Q")),
+                       n_dropped=Spec("int32", ("N",)),
+                       edge_slot=Spec("int32", ("E",))),
+          dst=Spec("int32", ("E",)),
+          cols=[Spec("uint32", ("E",)), Spec("uint32", ("E", "W"))],
+          valid=Spec("bool", ("E",)),
+          n_peers=lambda d: d["N"], inbox_size=lambda d: d["Q"])
 def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
             valid: jnp.ndarray, n_peers: int, inbox_size: int) -> Delivery:
     """Deliver an edge list of logical packets into per-peer inboxes.
@@ -118,7 +130,7 @@ def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
     # columns then go straight from edge order into the inbox without
     # ever being permuted into sorted order.
     edge_slot = (jnp.zeros((e,), jnp.int32)
-                 .at[spos].set(jnp.where(keep, slot, -1)))
+                 .at[spos].set(jnp.where(keep, slot, -1), mode="drop"))
     kept_e = edge_slot >= 0
     flat = jnp.where(kept_e, key * inbox_size + edge_slot,
                      n_peers * inbox_size)

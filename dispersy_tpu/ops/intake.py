@@ -38,6 +38,16 @@ from jax import lax
 
 from dispersy_tpu.config import (EMPTY_U32, META_DYNAMIC, META_IDENTITY,
                                  META_UNDO_OTHER, META_UNDO_OWN)
+from dispersy_tpu.ops.contracts import Spec, contract
+from dispersy_tpu.ops.store import stc_spec
+
+# Canonical [N, M] receiver-store spec shared by every intake contract —
+# store.py's one StoreCols spec definition, so a column narrowing there
+# (the byte-diet dtypes R3 exists to defend) propagates here by
+# construction.
+_STC = stc_spec("N", "M")
+_U32_NB = Spec("uint32", ("N", "B"))
+_BOOL_NB = Spec("bool", ("N", "B"))
 
 # Live-memory bound for the broadcast form's product tensor, in elements.
 # 2**28 bools = 256 MB — comfortably under this host's RAM even with
@@ -56,6 +66,7 @@ def _auto_impl(impl: str | None, product_elems: int) -> str:
     return "chunked" if product_elems > _BROADCAST_ELEM_LIMIT else "broadcast"
 
 
+@contract(out=_BOOL_NB, stc=_STC, member=_U32_NB, gt=_U32_NB, impl=None)
 def in_store(stc, member: jnp.ndarray, gt: jnp.ndarray,
              impl: str | None = None) -> jnp.ndarray:
     """bool[N, B]: is (member, gt) already a stored row?  (The UNIQUE
@@ -77,6 +88,9 @@ def in_store(stc, member: jnp.ndarray, gt: jnp.ndarray,
     return lax.fori_loop(0, b, body, jnp.zeros((n, b), bool))
 
 
+@contract(out=_BOOL_NB, stc=_STC, member=_U32_NB, gt=_U32_NB,
+          meta=Spec("uint8", ("N", "B")), payload=_U32_NB, aux=_U32_NB,
+          impl=None)
 def conflict(stc, member: jnp.ndarray, gt: jnp.ndarray, meta: jnp.ndarray,
              payload: jnp.ndarray, aux: jnp.ndarray,
              impl: str | None = None) -> jnp.ndarray:
@@ -109,6 +123,7 @@ def conflict(stc, member: jnp.ndarray, gt: jnp.ndarray, meta: jnp.ndarray,
     return lax.fori_loop(0, b, body, jnp.zeros((n, b), bool))
 
 
+@contract(out=_BOOL_NB, member=_U32_NB, gt=_U32_NB, ok=_BOOL_NB, impl=None)
 def dup_earlier(member: jnp.ndarray, gt: jnp.ndarray, ok: jnp.ndarray,
                 impl: str | None = None) -> jnp.ndarray:
     """bool[N, B]: does an EARLIER valid entry of this batch carry the same
@@ -134,6 +149,7 @@ def dup_earlier(member: jnp.ndarray, gt: jnp.ndarray, ok: jnp.ndarray,
     return lax.fori_loop(0, b, body, jnp.zeros((n, b), bool))
 
 
+@contract(out=_U32_NB, stc=_STC, q_meta=_U32_NB, q_gt=_U32_NB, impl=None)
 def flip_best(stc, q_meta: jnp.ndarray, q_gt: jnp.ndarray,
               impl: str | None = None) -> jnp.ndarray:
     """u32[N, Q]: per (meta, gt) query, the max ``gt*2 | policy`` key over
@@ -149,6 +165,10 @@ def flip_best(stc, q_meta: jnp.ndarray, q_gt: jnp.ndarray,
         stc.aux, q_meta, q_gt, impl=impl)
 
 
+@contract(out=_U32_NB, flip_ok=Spec("bool", ("N", "M")),
+          payload=Spec("uint32", ("N", "M")), gt=Spec("uint32", ("N", "M")),
+          aux=Spec("uint32", ("N", "M")), q_meta=_U32_NB, q_gt=_U32_NB,
+          impl=None)
 def flip_best_batch(flip_ok: jnp.ndarray, payload: jnp.ndarray,
                     gt: jnp.ndarray, aux: jnp.ndarray,
                     q_meta: jnp.ndarray, q_gt: jnp.ndarray,
@@ -183,6 +203,7 @@ def flip_best_batch(flip_ok: jnp.ndarray, payload: jnp.ndarray,
     return lax.fori_loop(0, b, body, jnp.zeros((n, b), jnp.uint32))
 
 
+@contract(out=_BOOL_NB, stc=_STC, member=_U32_NB, gt=_U32_NB, impl=None)
 def undo_marked(stc, member: jnp.ndarray, gt: jnp.ndarray,
                 impl: str | None = None) -> jnp.ndarray:
     """bool[N, B]: is a stored undo row targeting (member, gt) present?
@@ -208,6 +229,8 @@ def undo_marked(stc, member: jnp.ndarray, gt: jnp.ndarray,
     return lax.fori_loop(0, b, body, jnp.zeros((n, b), bool))
 
 
+@contract(out=Spec("bool", ("N", "M")), stc=_STC, target_member=_U32_NB,
+          target_gt=_U32_NB, valid=_BOOL_NB, impl=None)
 def undo_hits_store(stc, target_member: jnp.ndarray,
                     target_gt: jnp.ndarray, valid: jnp.ndarray,
                     impl: str | None = None) -> jnp.ndarray:
@@ -232,6 +255,7 @@ def undo_hits_store(stc, target_member: jnp.ndarray,
     return lax.fori_loop(0, b, body, jnp.zeros((n, m), bool))
 
 
+@contract(out=_BOOL_NB, stc=_STC, member=_U32_NB, impl=None)
 def identity_stored(stc, member: jnp.ndarray,
                     impl: str | None = None) -> jnp.ndarray:
     """bool[N, B]: does the receiver's store hold a dispersy-identity
@@ -255,6 +279,7 @@ def identity_stored(stc, member: jnp.ndarray,
     return lax.fori_loop(0, b, body, jnp.zeros((n, b), bool))
 
 
+@contract(out=_U32_NB, stc=_STC, member=_U32_NB, gt=_U32_NB, impl=None)
 def stored_meta_of(stc, member: jnp.ndarray, gt: jnp.ndarray,
                    impl: str | None = None) -> jnp.ndarray:
     """u32[N, B]: meta id of the stored USER row at (member, gt), else
@@ -285,6 +310,8 @@ def stored_meta_of(stc, member: jnp.ndarray, gt: jnp.ndarray,
     return lax.fori_loop(0, b, body, jnp.full((n, b), sentinel))
 
 
+@contract(out=_U32_NB, stc=_STC, member=_U32_NB,
+          meta=Spec("uint8", ("N", "B")), impl=None)
 def seq_stored_max(stc, member: jnp.ndarray, meta: jnp.ndarray,
                    impl: str | None = None) -> jnp.ndarray:
     """u32[N, B]: per batch entry, the highest stored sequence number

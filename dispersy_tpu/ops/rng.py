@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from dispersy_tpu.ops.contracts import Spec, contract
 from dispersy_tpu.ops.hashing import combine, fmix32
 
 # Purpose tags: domain separation between independent random streams.
@@ -40,11 +41,15 @@ P_NAT = 9        # connection-type assignment (public vs symmetric NAT);
 #                  NAT is the router's property, surviving churn rebirth
 
 
+@contract(out=Spec("uint32", ()), key=Spec("uint32", (2,)))
 def fold_seed(key: jnp.ndarray) -> jnp.ndarray:
     """uint32[2] state key -> one uint32 stream seed."""
     return combine(fmix32(key[..., 0]), key[..., 1])
 
 
+@contract(out=Spec("uint32", ("N",)),
+          seed=Spec("uint32", ()), round_index=Spec("uint32", ()),
+          peer=Spec("int32", ("N",)), purpose=P_SLOT, salt=0)
 def rand_u32(seed: jnp.ndarray, round_index: jnp.ndarray, peer: jnp.ndarray,
              purpose: int, salt: jnp.ndarray | int = 0) -> jnp.ndarray:
     """Deterministic uint32 draw; broadcasts over peer/salt shapes."""
@@ -54,6 +59,9 @@ def rand_u32(seed: jnp.ndarray, round_index: jnp.ndarray, peer: jnp.ndarray,
     return combine(h, jnp.asarray(salt, jnp.uint32))
 
 
+@contract(out=Spec("float32", ("N",)),
+          seed=Spec("uint32", ()), round_index=Spec("uint32", ()),
+          peer=Spec("int32", ("N",)), purpose=P_CATEGORY, salt=0)
 def rand_uniform(seed, round_index, peer, purpose: int, salt=0) -> jnp.ndarray:
     """float32 in [0, 1) from the same counter stream."""
     u = rand_u32(seed, round_index, peer, purpose, salt)

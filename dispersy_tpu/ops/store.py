@@ -31,10 +31,12 @@ import numpy as np
 from jax import lax
 
 from dispersy_tpu.config import EMPTY_META, EMPTY_U32, FLAGS_DTYPE, META_DTYPE
+from dispersy_tpu.ops.contracts import Spec, contract, host_helper
 
 _EMPTY = np.uint32(EMPTY_U32)
 
 
+@host_helper
 def empty_of(dtype) -> int:
     """Empty-slot sentinel for one record-column dtype: the all-ones
     value (EMPTY_U32 truncated to the column's width) — EMPTY_U32 for
@@ -64,6 +66,23 @@ class StoreCols(NamedTuple):
         return self.gt != _EMPTY
 
 
+# Canonical contract specs: the [N, M] store and an [N, B] arriving batch,
+# both carrying the narrowed uint8 meta/flags columns the byte diet
+# depends on — a promotion anywhere in the merge shows up as an R3 diff.
+# The ONE StoreCols spec definition: intake.py's contracts import this so
+# the next column narrowing is mirrored everywhere by construction.
+@host_helper
+def stc_spec(*dims) -> StoreCols:
+    return StoreCols(gt=Spec("uint32", dims), member=Spec("uint32", dims),
+                     meta=Spec("uint8", dims), payload=Spec("uint32", dims),
+                     aux=Spec("uint32", dims), flags=Spec("uint8", dims))
+
+
+_STORE_NM = stc_spec("N", "M")
+_BATCH_NB = stc_spec("N", "B")
+
+
+@contract(out=_STORE_NM, shape=lambda d: (d["N"], d["M"]))
 def empty_records(shape) -> StoreCols:
     e = jnp.full(shape, _EMPTY, jnp.uint32)
     return StoreCols(gt=e, member=e,
@@ -73,10 +92,13 @@ def empty_records(shape) -> StoreCols:
                      flags=jnp.zeros(shape, FLAGS_DTYPE))
 
 
+@contract(out=Spec("int32", ("N",)), gt=Spec("uint32", ("N", "M")))
 def count_valid(gt: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum((gt != _EMPTY).astype(jnp.int32), axis=-1)
 
 
+@contract(out=Spec("uint32", ("N", "B")), col=Spec("uint32", ("N", "M")),
+          slot=Spec("int32", ("N", "M")), width=lambda d: d["B"], fill=0)
 def rank_compact(col: jnp.ndarray, slot: jnp.ndarray, width: int,
                  fill) -> jnp.ndarray:
     """Rank-scatter compaction: keep entries whose ``slot`` < ``width``.
@@ -102,14 +124,18 @@ def rank_compact(col: jnp.ndarray, slot: jnp.ndarray, width: int,
         # form costs more index bytes but stays correct at any shape.
         rows = jnp.arange(n)[:, None]
         return (jnp.full((n, stride), fill, col.dtype)
-                .at[rows, slot].set(col)[..., :width])
+                .at[rows, slot].set(col, mode="drop")[..., :width])
     flat = (jnp.arange(n, dtype=jnp.int32)[:, None] * stride
             + slot.astype(jnp.int32)).reshape(-1)
     return (jnp.full((n * stride,), fill, col.dtype)
-            .at[flat].set(col.reshape(-1))
+            .at[flat].set(col.reshape(-1), mode="drop")
             .reshape(n, stride)[..., :width])
 
 
+@contract(out=[Spec("uint32", ("N", "B")), Spec("uint8", ("N", "B"))],
+          cols_fills=[(Spec("uint32", ("N", "M")), 0),
+                      (Spec("uint8", ("N", "M")), 0)],
+          slot=Spec("int32", ("N", "M")), width=lambda d: d["B"])
 def rank_compact_many(cols_fills, slot: jnp.ndarray, width: int) -> list:
     """:func:`rank_compact` for SEVERAL same-shaped columns sharing one
     ``slot`` map — ``cols_fills`` is ``[(col, fill), ...]``.
@@ -140,6 +166,12 @@ class InsertResult(NamedTuple):
     n_evicted: jnp.ndarray   # i32[N] existing records lost to overflow
 
 
+@contract(out=InsertResult(store=_STORE_NM,
+                           n_inserted=Spec("int32", ("N",)),
+                           n_dropped=Spec("int32", ("N",)),
+                           n_evicted=Spec("int32", ("N",))),
+          store=_STORE_NM, new=_BATCH_NB, new_mask=Spec("bool", ("N", "B")),
+          history=())
 def store_insert(store: StoreCols, new: StoreCols,
                  new_mask: jnp.ndarray,
                  history: tuple = ()) -> InsertResult:
@@ -349,19 +381,20 @@ def _merge_ordered(store: StoreCols, masked: StoreCols):
 
         def interleave(s_col, b_col):
             out = jnp.zeros((n * width,), s_col.dtype)
-            out = out.at[flat_s].set(s_col.reshape(-1))
-            return out.at[flat_b].set(b_col.reshape(-1)).reshape(n, width)
+            out = out.at[flat_s].set(s_col.reshape(-1), mode="drop")
+            return (out.at[flat_b].set(b_col.reshape(-1), mode="drop")
+                    .reshape(n, width))
         origin = (jnp.zeros((n * width,), s_gt.dtype)
-                  .at[flat_b].set(1).reshape(n, width))
+                  .at[flat_b].set(1, mode="drop").reshape(n, width))
     else:
         rows = jnp.arange(n)[:, None]
 
         def interleave(s_col, b_col):
             out = jnp.zeros((n, width), s_col.dtype)
-            out = out.at[rows, pos_s].set(s_col)
-            return out.at[rows, pos_b].set(b_col)
+            out = out.at[rows, pos_s].set(s_col, mode="drop")
+            return out.at[rows, pos_b].set(b_col, mode="drop")
         origin = (jnp.zeros((n, width), s_gt.dtype)
-                  .at[rows, pos_b].set(1))
+                  .at[rows, pos_b].set(1, mode="drop"))
     return (interleave(store.gt, b_gt),
             interleave(store.member, b_member),
             origin,
@@ -376,6 +409,9 @@ class RemoveResult(NamedTuple):
     n_removed: jnp.ndarray  # i32[N] records deleted
 
 
+@contract(out=RemoveResult(store=_STORE_NM,
+                           n_removed=Spec("int32", ("N",))),
+          store=_STORE_NM, kill=Spec("bool", ("N", "M")))
 def store_remove(store: StoreCols, kill: jnp.ndarray) -> RemoveResult:
     """Delete masked records; survivors compact left, holes to the end.
 
@@ -412,6 +448,14 @@ class SyncSlice(NamedTuple):
     offset: jnp.ndarray     # u32[N]
 
 
+_SLICE_SPEC = SyncSlice(time_low=Spec("uint32", ("N",)),
+                        time_high=Spec("uint32", ("N",)),
+                        modulo=Spec("uint32", ("N",)),
+                        offset=Spec("uint32", ("N",)))
+
+
+@contract(out=Spec("bool", ("N", "M")), gt=Spec("uint32", ("N", "M")),
+          s=_SLICE_SPEC)
 def slice_mask(gt: jnp.ndarray, s: SyncSlice) -> jnp.ndarray:
     """[N, M] membership of store entries in an advertised slice."""
     valid = gt != _EMPTY
@@ -422,6 +466,8 @@ def slice_mask(gt: jnp.ndarray, s: SyncSlice) -> jnp.ndarray:
     return valid & lo & hi & mod
 
 
+@contract(out=_SLICE_SPEC, gt=Spec("uint32", ("N", "M")),
+          capacity=lambda d: d["B"])
 def claim_slice_largest(gt: jnp.ndarray, capacity: int) -> SyncSlice:
     """"Largest" bloom-claim strategy: the most recent ≤capacity entries.
 
@@ -442,6 +488,8 @@ def claim_slice_largest(gt: jnp.ndarray, capacity: int) -> SyncSlice:
                      offset=jnp.zeros_like(time_low))
 
 
+@contract(out=_SLICE_SPEC, gt=Spec("uint32", ("N", "M")),
+          capacity=lambda d: d["B"], round_index=Spec("uint32", ()))
 def claim_slice_modulo(gt: jnp.ndarray, capacity: int,
                        round_index: jnp.ndarray) -> SyncSlice:
     """"Modulo" strategy: stripe the whole store across successive rounds.

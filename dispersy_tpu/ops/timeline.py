@@ -60,6 +60,7 @@ from jax import lax
 
 from dispersy_tpu.config import (EMPTY_U32, MAX_TIMELINE_META, PERM_AUTHORIZE,
                                  PERM_PERMIT, PERM_REVOKE)
+from dispersy_tpu.ops.contracts import Spec, contract
 
 
 class AuthTable(NamedTuple):
@@ -74,6 +75,16 @@ class AuthTable(NamedTuple):
     #   authentication member, walked by Timeline.check)
 
 
+# Canonical [N, A] grant-table spec shared by the timeline contracts.
+_TAB = AuthTable(member=Spec("uint32", ("N", "A")),
+                 mask=Spec("uint32", ("N", "A")),
+                 gt=Spec("uint32", ("N", "A")),
+                 rev=Spec("bool", ("N", "A")),
+                 issuer=Spec("uint32", ("N", "A")))
+_U32_NB = Spec("uint32", ("N", "B"))
+_BOOL_NB = Spec("bool", ("N", "B"))
+
+
 def _latest_row_verdict(match, row_gt_masked, is_rev):
     """Shared latest-wins rule: the highest-gt matching row decides;
     a revoke row beats a grant row at the same global_time."""
@@ -84,6 +95,8 @@ def _latest_row_verdict(match, row_gt_masked, is_rev):
             & jnp.any(match, axis=-1))
 
 
+@contract(out=_BOOL_NB, tab=_TAB, member=_U32_NB, meta=_U32_NB, gt=_U32_NB,
+          founder=1, perm=PERM_PERMIT)
 def check(tab: AuthTable, member: jnp.ndarray, meta: jnp.ndarray,
           gt: jnp.ndarray, founder, perm: int = PERM_PERMIT) -> jnp.ndarray:
     """Does ``member`` hold permission ``perm`` for ``meta`` at ``gt``?
@@ -115,6 +128,8 @@ def check(tab: AuthTable, member: jnp.ndarray, meta: jnp.ndarray,
     return granted | (member == jnp.asarray(founder, jnp.uint32))
 
 
+@contract(out=_BOOL_NB, tab=_TAB, member=_U32_NB, mask=_U32_NB, gt=_U32_NB,
+          n_meta=2, perm=PERM_AUTHORIZE, impl=None)
 def check_grant(tab: AuthTable, member: jnp.ndarray, mask: jnp.ndarray,
                 gt: jnp.ndarray, n_meta: int,
                 perm: int = PERM_AUTHORIZE,
@@ -199,6 +214,10 @@ def _row_lt(ag, am, ak, ar, ai, bg, bm, bk, br, bi):
                      | ((ar == br) & (ai < bi)))))))))
 
 
+@contract(out=FoldResult(table=_TAB, n_dropped=Spec("int32", ("N",)),
+                         n_evicted=Spec("int32", ("N",))),
+          tab=_TAB, target=_U32_NB, mask=_U32_NB, gt=_U32_NB,
+          is_revoke=_BOOL_NB, valid=_BOOL_NB, issuer=_U32_NB)
 def fold(tab: AuthTable, target: jnp.ndarray, mask: jnp.ndarray,
          gt: jnp.ndarray, is_revoke: jnp.ndarray,
          valid: jnp.ndarray, issuer: jnp.ndarray) -> FoldResult:
@@ -279,6 +298,7 @@ def fold(tab: AuthTable, target: jnp.ndarray, mask: jnp.ndarray,
     return FoldResult(table=t, n_dropped=dropped, n_evicted=evicted)
 
 
+@contract(out=Spec("bool", ("N", "A")), tab=_TAB, founder=1, n_meta=2)
 def revalidate(tab: AuthTable, founder, n_meta: int) -> jnp.ndarray:
     """Re-walk every row's granting chain; bool[N, A] rows that survive.
 
@@ -332,6 +352,10 @@ class SetFoldResult(NamedTuple):
     n_dropped: jnp.ndarray   # i32[N] members lost to a full table
 
 
+@contract(out=SetFoldResult(table=Spec("uint32", ("N", "S")),
+                            n_inserted=Spec("int32", ("N",)),
+                            n_dropped=Spec("int32", ("N",))),
+          tab=Spec("uint32", ("N", "S")), member=_U32_NB, valid=_BOOL_NB)
 def fold_set(tab: jnp.ndarray, member: jnp.ndarray,
              valid: jnp.ndarray) -> SetFoldResult:
     """Insert [N, B] member ids into each row's bounded member set.

@@ -139,7 +139,12 @@ def phase_kernels(cfg: CommunityConfig, time_phases: bool = False) -> dict:
     from dispersy_tpu.state import NEVER
 
     n, w, m = cfg.n_peers, cfg.bloom_words, cfg.msg_capacity
+    # One key per synthetic input (graftlint R5): reusing a single key
+    # across draws makes the "random" benchmark inputs correlated —
+    # e.g. store gt and member columns tracking each other, which skews
+    # any value-dependent path (sort duplicate groups, bloom collisions).
     key = jax.random.PRNGKey(7)
+    k_dst, k_push, k_items, k_gt, k_member = jax.random.split(key, 5)
     out = {}
 
     def run(name, fn, *args):
@@ -185,7 +190,7 @@ def phase_kernels(cfg: CommunityConfig, time_phases: bool = False) -> dict:
 
     # --- deliver: the request fan-in (E = N edges, 6 u32 scalars + the
     # [E, W] bloom payload) and the push fan-out (E = N·F·C edges).
-    dst = jax.random.randint(key, (n,), -1, n, jnp.int32)
+    dst = jax.random.randint(k_dst, (n,), -1, n, jnp.int32)
     scalars = [jnp.ones((n,), jnp.uint32) for _ in range(6)]
     bloom_col = jnp.ones((n, w), jnp.uint32)
     valid = jnp.ones((n,), bool)
@@ -195,7 +200,7 @@ def phase_kernels(cfg: CommunityConfig, time_phases: bool = False) -> dict:
         dst, scalars + [bloom_col], valid)
     e = n * cfg.forward_buffer * cfg.forward_fanout
     if e:
-        pdst = jax.random.randint(key, (e,), 0, n, jnp.int32)
+        pdst = jax.random.randint(k_push, (e,), 0, n, jnp.int32)
         pcols = [jnp.ones((e,), jnp.uint32) for _ in range(4)] \
             + [jnp.ones((e,), jnp.uint8)]
         run("deliver_push",
@@ -204,7 +209,7 @@ def phase_kernels(cfg: CommunityConfig, time_phases: bool = False) -> dict:
             pdst, pcols, jnp.ones((e,), bool))
 
     # --- bloom build (claim) + query (responder membership test).
-    items = (jax.random.randint(key, (n, m), 0, 1 << 30, jnp.int32)
+    items = (jax.random.randint(k_items, (n, m), 0, 1 << 30, jnp.int32)
              .astype(jnp.uint32))
     imask = jnp.ones((n, m), bool)
     build = functools.partial(bl.bloom_build, n_bits=cfg.bloom_bits,
@@ -220,9 +225,9 @@ def phase_kernels(cfg: CommunityConfig, time_phases: bool = False) -> dict:
     # --- store merge (phase 5 insert: [N, M] store + [N, B] batch).
     b = cfg.request_inbox * cfg.response_budget + cfg.push_inbox
     batch = st.StoreCols(
-        gt=(jax.random.randint(key, (n, b), 1, 1000, jnp.int32)
+        gt=(jax.random.randint(k_gt, (n, b), 1, 1000, jnp.int32)
             .astype(jnp.uint32)),
-        member=(jax.random.randint(key, (n, b), 0, n, jnp.int32)
+        member=(jax.random.randint(k_member, (n, b), 0, n, jnp.int32)
                 .astype(jnp.uint32)),
         meta=jnp.ones((n, b), jnp.uint8),
         payload=jnp.zeros((n, b), jnp.uint32),

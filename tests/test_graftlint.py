@@ -1,0 +1,429 @@
+"""Per-rule fixture coverage for tools/graftlint: each rule must bite on
+a known-bad snippet, stay quiet on a known-good one, and honor waivers.
+
+These are AST/eval_shape fixtures — no kernel executes, so the whole
+module costs milliseconds of the tier-1 window (the one jit-adjacent
+piece, R3, uses ``jax.eval_shape`` only: tracing, never compilation).
+"""
+
+import ast
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from tools.graftlint import apply_waivers, report_json, unwaived
+from tools.graftlint.core import Module
+from tools.graftlint.registry import default_rules, rules_by_id
+from tools.graftlint.rule_contracts import ContractRule
+from tools.graftlint.rules_ast import (HostSyncRule, KeyReuseRule,
+                                       RecompileRule, ScatterModeRule)
+
+
+def fake_module(src: str, rel: str = "dispersy_tpu/ops/fake_op.py"):
+    """A Module fixture; the default rel path scopes it as a hot-path
+    ops file."""
+    return Module(path="/" + rel, rel=rel, source=src,
+                  lines=src.splitlines(), tree=ast.parse(src))
+
+
+def run_rule(rule, src: str, rel: str = "dispersy_tpu/ops/fake_op.py",
+             file_waivers=()):
+    mod = fake_module(src, rel)
+    findings = rule.scan([mod], "/")
+    apply_waivers(findings, [mod], file_waivers=list(file_waivers))
+    return findings
+
+
+# ------------------------------------------------------------------ R1
+
+R1_BAD = (
+    "x = arr.item()\n"
+    "y = np.asarray(arr)\n"
+    "z = float(arr)\n"
+    "w = int(np.iinfo('u4').max)  # host-ok: static dtype math\n"
+)
+
+
+def test_r1_flags_each_construct_and_honors_host_ok():
+    findings = run_rule(HostSyncRule(), R1_BAD)
+    assert len(findings) == 4
+    bad = unwaived(findings)
+    kinds = [f.message for f in bad]
+    assert len(bad) == 3
+    assert any(".item()" in k for k in kinds)
+    assert any("asarray" in k for k in kinds)
+    assert any("float" in k for k in kinds)
+    waived = [f for f in findings if f.waived]
+    assert len(waived) == 1 and "host-ok" in waived[0].waiver
+
+
+def test_r1_scope_excludes_engine_helpers():
+    """Only step/multi_step bodies are scanned in engine.py — a host
+    helper calling np.asarray is legitimate."""
+    src = ("def helper(x):\n"
+           "    return np.asarray(x)\n"
+           "def step(state, cfg):\n"
+           "    return state.item()\n")
+    findings = run_rule(HostSyncRule(), src, rel="dispersy_tpu/engine.py")
+    assert [f.message for f in unwaived(findings)] == [".item() host sync"]
+
+
+# ------------------------------------------------------------------ R2
+
+
+def test_r2_flags_tracer_branches_not_static_ones():
+    src = ("def op(x, impl=None):\n"
+           "    if impl is None:\n"              # static: fine
+           "        impl = 'gather'\n"
+           "    if jnp.any(x > 0):\n"            # tracer branch
+           "        x = x + 1\n"
+           "    while lax.lt(x, y):\n"           # tracer loop
+           "        x = x + 1\n"
+           "    assert jnp.all(x > 0)\n"         # tracer assert
+           "    assert n % 32 == 0\n"            # static assert: fine
+           "    return x\n")
+    findings = unwaived(run_rule(RecompileRule(), src))
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 3, findings
+    assert "`if`" in msgs and "`while`" in msgs and "`assert`" in msgs
+
+
+def test_r2_flags_tensor_valued_and_unhashable_jit_statics():
+    src = ("@functools.partial(jax.jit, static_argnums=(1, 2))\n"
+           "def good(state, cfg: CommunityConfig, k: int):\n"
+           "    return state\n"
+           "@functools.partial(jax.jit, static_argnums=1)\n"
+           "def bad_tensor(state, idx: jnp.ndarray):\n"
+           "    return state\n"
+           "@jax.jit(static_argnames='opts')\n"
+           "def bad_unhashable(state, opts=[]):\n"
+           "    return state\n"
+           "@functools.partial(jax.jit, static_argnums=NUMS)\n"
+           "def bad_nonliteral(state, cfg):\n"
+           "    return state\n"
+           "@partial(jax.jit, static_argnums=1)\n"     # bare-partial form
+           "def bad_bare_partial(state, idx: jnp.ndarray):\n"
+           "    return state\n")
+    findings = unwaived(run_rule(RecompileRule(), src,
+                                 rel="dispersy_tpu/fake_host.py"))
+    msgs = [f.message for f in findings]
+    assert len(findings) == 4, msgs
+    assert sum("tensor-valued" in m for m in msgs) == 2
+    assert any("unhashable" in m for m in msgs)
+    assert any("not a literal" in m for m in msgs)
+
+
+# ------------------------------------------------------------------ R3
+
+
+def test_r3_contract_catches_dtype_widening_and_shape_drift():
+    from dispersy_tpu.ops.contracts import (Spec, check_contract,
+                                            contract)
+
+    @contract(out=Spec("uint8", ("N",)), x=Spec("uint8", ("N",)))
+    def widens(x):
+        return x + jnp.int32(1)       # uint8 -> int32 promotion
+
+    @contract(out=Spec("uint8", ("N",)), x=Spec("uint8", ("N",)))
+    def clean(x):
+        return x + jnp.uint8(1)
+
+    @contract(out=Spec("uint32", ("N",)), x=Spec("uint32", ("N", "M")))
+    def transposes(x):
+        return x.sum(axis=0)          # wrong reduce axis
+
+    assert any("int32" in p for p in check_contract(widens))
+    assert check_contract(clean) == []
+    assert any("shape" in p for p in check_contract(transposes))
+
+
+def test_r3_malformed_declaration_is_a_finding_not_a_crash():
+    """A typo'd symbolic dim (or dtype) in the DECLARATION itself must
+    come back as a mismatch string — not raise out of check_contract and
+    take the whole lint run (every rule's report) down with it."""
+    from dispersy_tpu.ops.contracts import Spec, check_contract, contract
+
+    @contract(out=Spec("uint8", ("N", "Z")),       # "Z" is not a dim
+              x=Spec("uint8", ("N",)))
+    def bad_out_dim(x):
+        return x
+
+    @contract(out=Spec("uint8", ("N",)),
+              x=Spec("uint33", ("N",)))            # no such dtype
+    def bad_in_dtype(x):
+        return x
+
+    for fn in (bad_out_dim, bad_in_dtype):
+        problems = check_contract(fn)
+        assert problems and all("declaration invalid" in p
+                                for p in problems), problems
+
+
+def test_r3_repo_scan_reports_uncontracted_public_op(monkeypatch):
+    """An op module growing a public function without @contract /
+    @host_helper is itself a finding."""
+    import dispersy_tpu.ops.hashing as hashing
+
+    def naked_op(x):
+        return x
+
+    naked_op.__module__ = hashing.__name__
+    naked_op.__qualname__ = "naked_op"
+    monkeypatch.setattr(hashing, "naked_op", naked_op, raising=False)
+    import tools.graftlint.core as core
+    findings = ContractRule().scan(core.load_modules(), core.REPO_ROOT)
+    assert any("naked_op" in f.message and "neither @contract" in f.message
+               for f in findings)
+
+
+# ------------------------------------------------------------------ R4
+
+R4_SRC = (
+    "def op(x, idx, rows, slot, cfg, t, meta):\n"
+    "    a = x.at[idx].set(1.0)\n"                        # bad
+    "    b = x.at[idx].set(1.0, mode='drop')\n"           # explicit: fine
+    "    c = x.at[:t].set(1.0)\n"                         # slice: fine
+    "    d = x.at[:, cfg.n_meta].add(1)\n"                # static attr: fine
+    "    e = x.at[rows, slot].set(1.0)  # graftlint: ok[R4] proven\n"
+    "    f = x.at[:, min(meta, cfg.n)].add(1)\n"          # Name in min: bad
+    "    return a\n"
+)
+
+
+def test_r4_flags_modeless_advanced_scatters_only():
+    findings = run_rule(ScatterModeRule(), R4_SRC)
+    assert len(findings) == 3
+    bad = unwaived(findings)
+    assert [f.lineno for f in bad] == [2, 7]
+    waived = [f for f in findings if f.waived]
+    assert len(waived) == 1 and waived[0].lineno == 6
+
+
+def test_r4_file_waiver_applies_by_substring():
+    waiver = ("R4", "dispersy_tpu/ops/fake_op.py", "min(meta",
+              "meta is a static int")
+    findings = run_rule(ScatterModeRule(), R4_SRC, file_waivers=[waiver])
+    assert [f.lineno for f in unwaived(findings)] == [2]
+
+
+# ------------------------------------------------------------------ R5
+
+
+def test_r5_flags_reuse_and_respects_split_rebinds():
+    src = ("def bad(key):\n"
+           "    a = jax.random.uniform(key, (3,))\n"
+           "    b = jax.random.normal(key, (3,))\n"       # reuse: bad
+           "def split_consumes(key):\n"
+           "    k1, k2 = jax.random.split(key)\n"
+           "    c = jax.random.uniform(key, (3,))\n"      # after split: bad
+           "def good(key):\n"
+           "    k1, k2 = jax.random.split(key)\n"
+           "    d = jax.random.uniform(k1, (3,))\n"
+           "    e = jax.random.normal(k2, (3,))\n"
+           "def rebind(key):\n"
+           "    f = jax.random.uniform(key, (3,))\n"
+           "    key = jax.random.PRNGKey(1)\n"
+           "    g = jax.random.uniform(key, (3,))\n")
+    findings = unwaived(run_rule(KeyReuseRule(), src,
+                                 rel="dispersy_tpu/fake_host.py"))
+    assert [f.lineno for f in findings] == [3, 6]
+
+
+def test_r5_if_else_branches_are_mutually_exclusive():
+    src = ("def branchy(key, cond):\n"
+           "    if cond:\n"
+           "        a = jax.random.uniform(key, (3,))\n"   # one path
+           "    else:\n"
+           "        b = jax.random.normal(key, (3,))\n"    # other path: fine
+           "def after(key, cond):\n"
+           "    if cond:\n"
+           "        a = jax.random.uniform(key, (3,))\n"
+           "    c = jax.random.normal(key, (3,))\n"        # maybe-2nd: bad
+           "def rebound_both(key, cond):\n"
+           "    if cond:\n"
+           "        key = jax.random.PRNGKey(0)\n"
+           "    else:\n"
+           "        key = jax.random.PRNGKey(1)\n"
+           "    d = jax.random.uniform(key, (3,))\n")      # fine
+    findings = unwaived(run_rule(KeyReuseRule(), src,
+                                 rel="dispersy_tpu/fake_host.py"))
+    assert [f.lineno for f in findings] == [9]
+
+
+def test_r5_scans_module_level_and_async_scopes():
+    src = ("key = jax.random.PRNGKey(0)\n"
+           "a = jax.random.uniform(key, (3,))\n"
+           "b = jax.random.normal(key, (3,))\n"            # module: bad
+           "async def agen(key2):\n"
+           "    c = jax.random.uniform(key2, (3,))\n"
+           "    d = jax.random.normal(key2, (3,))\n")      # async: bad
+    findings = unwaived(run_rule(KeyReuseRule(), src,
+                                 rel="dispersy_tpu/fake_host.py"))
+    assert [f.lineno for f in findings] == [3, 6]
+
+
+def test_r2_flags_call_site_jit_statics():
+    src = ("def helper(state, probes: jnp.ndarray):\n"
+           "    return state\n"
+           "fast = jax.jit(helper, static_argnames='probes')\n"  # bad
+           "ok = jax.jit(helper)\n"                              # no statics
+           "opaque = jax.jit(mod.fn.__wrapped__, static_argnums=1)\n")
+    findings = unwaived(run_rule(RecompileRule(), src,
+                                 rel="dispersy_tpu/fake_host.py"))
+    msgs = [f.message for f in findings]
+    assert len(findings) == 1, msgs
+    assert "tensor-valued" in msgs[0] and "probes" in msgs[0]
+
+
+def test_r5_prngkey_construction_does_not_consume():
+    src = ("def make():\n"
+           "    key = jax.random.PRNGKey(0)\n"
+           "    raw = jax.random.key_data(key)\n"
+           "    a = jax.random.uniform(key, (3,))\n")
+    assert unwaived(run_rule(KeyReuseRule(), src)) == []
+
+
+def test_r5_fold_in_derivation_idiom_is_clean():
+    """fold_in(key, i) with distinct data derives independent keys —
+    the canonical per-item idiom must not be flagged as reuse."""
+    src = ("def derive(key):\n"
+           "    k0 = jax.random.fold_in(key, 0)\n"
+           "    k1 = jax.random.fold_in(key, 1)\n"
+           "    a = jax.random.uniform(k0, (3,))\n"
+           "    b = jax.random.normal(k1, (3,))\n")
+    assert unwaived(run_rule(KeyReuseRule(), src)) == []
+
+
+def test_r2_flags_ternary_tracer_branches():
+    """`x if jnp.any(c) else y` is the same hazard as the statement form
+    — the expression spelling must not slip through."""
+    src = ("def op(x, c, impl=None):\n"
+           "    y = x + 1 if jnp.any(c) else x\n"       # tracer ternary
+           "    impl = 'gather' if impl is None else impl\n"   # static: fine
+           "    return y\n")
+    findings = unwaived(run_rule(RecompileRule(), src))
+    assert len(findings) == 1 and findings[0].lineno == 2, findings
+
+
+def test_r2_list_form_static_argnums_gets_the_real_diagnosis():
+    """jax.jit accepts any Sequence[int]; static_argnums=[1] must reach
+    the per-arg checks, not be misreported as 'not a literal'."""
+    src = ("@functools.partial(jax.jit, static_argnums=[1])\n"
+           "def bad_tensor(state, idx: jnp.ndarray):\n"
+           "    return state\n")
+    msgs = [f.message for f in unwaived(
+        run_rule(RecompileRule(), src, rel="dispersy_tpu/fake_host.py"))]
+    assert len(msgs) == 1 and "tensor-valued" in msgs[0], msgs
+
+
+# ------------------------------------------------------- report plumbing
+
+
+def test_json_report_schema_and_counts():
+    rule = ScatterModeRule()
+    findings = run_rule(rule, R4_SRC)
+    doc = json.loads(report_json(findings, [rule]))
+    assert doc["tool"] == "graftlint"
+    assert doc["rules"]["R4"]["findings"] == 3
+    assert doc["rules"]["R4"]["unwaived"] == 2
+    assert doc["summary"]["unwaived"] == 2
+    assert {f["rule"] for f in doc["findings"]} == {"R4"}
+
+
+def test_unparseable_file_becomes_an_unwaivable_finding(tmp_path):
+    """A syntax-broken file in scope must fail the gate NAMING the file,
+    not crash every rule with an anonymous SyntaxError."""
+    from tools.graftlint.core import load_modules, run
+
+    pkg = tmp_path / "dispersy_tpu"
+    pkg.mkdir()
+    (pkg / "broken.py").write_text("def broken(:\n")
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "bench.py").write_text("")
+    mods = load_modules(str(tmp_path))
+    assert any(m.parse_error for m in mods)
+    findings = run(repo_root=str(tmp_path), rules=[])
+    assert len(findings) == 1
+    f = findings[0]
+    assert (f.rule, f.path, f.waived) == ("R0", "dispersy_tpu/broken.py",
+                                          False)
+    assert "does not parse" in f.message
+
+
+def test_r3_import_failure_is_a_finding_not_a_crash(monkeypatch):
+    """A broken ops module must not take down the whole report with a
+    raw traceback — R3 reports it and the other rules still run."""
+    import tools.graftlint.rule_contracts as rc
+
+    monkeypatch.setattr(rc, "OPS_MODULES", ("hashing", "nonexistent_op"))
+    import tools.graftlint.core as core
+    findings = ContractRule().scan(core.load_modules(), core.REPO_ROOT)
+    assert any(f.path == "dispersy_tpu/ops/nonexistent_op.py"
+               and "fails to import" in f.message for f in findings)
+
+
+def test_missing_scan_target_fails_loud(tmp_path):
+    """A wrong --root must never read as a clean tree."""
+    from tools.graftlint.core import load_modules
+
+    with pytest.raises(FileNotFoundError, match="scan target missing"):
+        load_modules(str(tmp_path / "nope"))
+
+
+def test_r0_has_no_waiver_path(tmp_path):
+    """Neither an inline marker on line 1 nor a waivers.txt entry can
+    waive a parse failure — a file no rule can see is never an
+    intentional exception."""
+    from tools.graftlint.core import apply_waivers as apply_w
+
+    src = "def broken(:  # graftlint: ok[R0] nice try\n"
+    mod = fake_module("x = 1\n")
+    mod.lines = src.splitlines()
+    mod.source = src
+    from tools.graftlint.core import Finding
+    f = Finding(rule="R0", path=mod.rel, lineno=1,
+                message="file does not parse", source="")
+    apply_w([f], [mod], file_waivers=[("R0", mod.rel, "broken", "no")])
+    assert not f.waived
+
+
+def test_empty_waiver_substring_is_rejected(tmp_path):
+    from tools.graftlint.core import load_file_waivers
+
+    wf = tmp_path / "waivers.txt"
+    wf.write_text('R4 dispersy_tpu/x.py "" -- blanket\n')
+    with pytest.raises(ValueError, match="empty substring"):
+        load_file_waivers(str(wf))
+
+
+def test_shim_surfaces_hot_path_parse_failures(tmp_path):
+    """The legacy gate must fail LOUD on a broken ops file (pre-graftlint
+    it raised SyntaxError; silence would be a green gate over a file the
+    scan cannot see)."""
+    import importlib
+    import os
+    import sys
+
+    from tools.graftlint.core import REPO_ROOT
+
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    shim = importlib.import_module("check_host_sync")
+
+    ops = tmp_path / "dispersy_tpu" / "ops"
+    ops.mkdir(parents=True)
+    (ops / "bad_op.py").write_text("def broken(:\n")
+    (tmp_path / "dispersy_tpu" / "engine.py").write_text(
+        "def step(state, cfg):\n    return state\n")
+    violations = shim.collect_violations(str(tmp_path))
+    assert len(violations) == 1
+    path, lineno, what, _src = violations[0]
+    assert path == "dispersy_tpu/ops/bad_op.py"
+    assert "does not parse" in what
+
+
+def test_rules_by_id_selects_and_rejects():
+    assert [r.rule_id for r in rules_by_id(["R1", "R4"])] == ["R1", "R4"]
+    assert len(default_rules()) == 5
+    with pytest.raises(KeyError):
+        rules_by_id(["R9"])

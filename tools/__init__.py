@@ -1,0 +1,4 @@
+# Makes tools/ importable as a package so `python -m tools.graftlint`
+# works from the repo root.  Standalone-script usage (`python
+# tools/check_host_sync.py`, tests inserting tools/ on sys.path) is
+# unaffected.

@@ -48,7 +48,12 @@ def _worker(args) -> None:
     enable_tool_cache()
     cfg = bench_config(args.peers, args.shape)
     n, w, m = cfg.n_peers, cfg.bloom_words, cfg.msg_capacity
+    # One key per synthetic input (graftlint R5): a shared key makes
+    # same-shape draws identical — store gt/member would be monotone
+    # functions of each other, aligning the merge's duplicate groups.
     key = jax.random.PRNGKey(11)
+    (k_dst, k_push, k_sgt, k_smember, k_bgt, k_bmember,
+     k_items) = jax.random.split(key, 7)
     platform = jax.devices()[0].platform
 
     def timed(jitted, *a, reps=args.reps):
@@ -69,7 +74,7 @@ def _worker(args) -> None:
 
     # --- delivery: the request fan-in (bloom payload riding) and the
     # push fan-out — engine.py phases 1/1f.
-    dst = jax.random.randint(key, (n,), -1, n, jnp.int32)
+    dst = jax.random.randint(k_dst, (n,), -1, n, jnp.int32)
     cols = [jnp.ones((n,), jnp.uint32) for _ in range(6)] \
         + [jnp.ones((n, w), jnp.uint32)]
     emit("deliver_request",
@@ -77,7 +82,7 @@ def _worker(args) -> None:
                            inbox_size=cfg.request_inbox),
          dst, cols, jnp.ones((n,), bool))
     e = n * cfg.forward_buffer * cfg.forward_fanout
-    pdst = jax.random.randint(key, (e,), 0, n, jnp.int32)
+    pdst = jax.random.randint(k_push, (e,), 0, n, jnp.int32)
     pcols = [jnp.ones((e,), jnp.uint32) for _ in range(4)] \
         + [jnp.ones((e,), jnp.uint8)]
     emit("deliver_push",
@@ -87,20 +92,20 @@ def _worker(args) -> None:
 
     # --- store merge, both bit-identical forms (ops/store._prefer_merge).
     b = cfg.request_inbox * cfg.response_budget + cfg.push_inbox
-    gt = jnp.sort(jax.random.randint(key, (n, m), 1, 1000, jnp.int32)
+    gt = jnp.sort(jax.random.randint(k_sgt, (n, m), 1, 1000, jnp.int32)
                   .astype(jnp.uint32), axis=-1)
     store = st.StoreCols(
         gt=gt,
-        member=(jax.random.randint(key, (n, m), 0, n, jnp.int32)
+        member=(jax.random.randint(k_smember, (n, m), 0, n, jnp.int32)
                 .astype(jnp.uint32)),
         meta=jnp.ones((n, m), jnp.uint8),
         payload=jnp.zeros((n, m), jnp.uint32),
         aux=jnp.zeros((n, m), jnp.uint32),
         flags=jnp.zeros((n, m), jnp.uint8))
     batch = st.StoreCols(
-        gt=(jax.random.randint(key, (n, b), 1, 1000, jnp.int32)
+        gt=(jax.random.randint(k_bgt, (n, b), 1, 1000, jnp.int32)
             .astype(jnp.uint32)),
-        member=(jax.random.randint(key, (n, b), 0, n, jnp.int32)
+        member=(jax.random.randint(k_bmember, (n, b), 0, n, jnp.int32)
                 .astype(jnp.uint32)),
         meta=jnp.ones((n, b), jnp.uint8),
         payload=jnp.zeros((n, b), jnp.uint32),
@@ -123,7 +128,7 @@ def _worker(args) -> None:
     emit("store_insert_merge", insert_forced("merge"), store, batch, mask)
 
     # --- bloom build + query at the claim/responder shapes.
-    items = (jax.random.randint(key, (n, m), 0, 1 << 30, jnp.int32)
+    items = (jax.random.randint(k_items, (n, m), 0, 1 << 30, jnp.int32)
              .astype(jnp.uint32))
     imask = jnp.ones((n, m), bool)
     build = functools.partial(bl.bloom_build, n_bits=cfg.bloom_bits,

@@ -1,122 +1,73 @@
 """AST check: no host-sync constructs in the hot path.
 
-The fused round's performance contract is that NOTHING inside it forces
-a device->host transfer: one ``.item()`` / ``np.asarray`` / ``float()``
-on a tracer turns the async-dispatched pipeline into a round-trip per
-call (the dispatch-overhead study in BENCH.md measured ~300 us each
-through the TPU tunnel).  The engine avoids them by construction; this
-checker keeps it that way, as a tier-1 test (tests/test_no_host_sync.py)
-instead of a code-review convention.
+THIN SHIM — the checker itself moved into the multi-rule analyzer as
+``tools/graftlint`` rule R1 (see LINTING.md for the full catalog and
+waiver syntax).  This module keeps PR 1's CLI, exit codes, and import
+surface (``collect_violations`` / ``_check_tree``) exactly as they were,
+so ``tests/test_no_host_sync.py`` and every doc reference keep working
+unchanged:
 
-Scanned scope:
-- every module under ``dispersy_tpu/ops/`` (whole files — ops are
-  device-side by definition), and
-- the bodies of ``engine.step`` and ``engine.multi_step`` (the fused
-  round; the engine's host-side helpers — create_messages and friends —
-  legitimately touch numpy for setup work).
-
-Forbidden constructs:
-- ``<expr>.item()`` — the canonical scalar sync;
-- ``np.asarray(...)`` / ``np.array(...)`` / ``numpy.asarray(...)`` /
-  ``jax.device_get(...)`` — host materialization;
-- ``float(...)`` / ``int(...)`` / ``bool(...)`` — tracer concretization
-  (``jnp.float32``/``jnp.uint32`` wrappers stay device-side and are
-  untouched).
-
-A line whose source carries a ``host-ok`` comment is exempt — for
-provably static host math (e.g. dtype-sentinel computation from a
-``np.dtype``, which never sees a tracer).
+- scope: ``dispersy_tpu/ops/`` whole files + ``engine.step`` /
+  ``multi_step`` bodies;
+- forbidden: ``.item()``, ``np.asarray``/``np.array``/``jax.device_get``
+  host materialization, ``float()``/``int()``/``bool()`` tracer
+  concretization;
+- a line carrying a ``host-ok`` comment is exempt.
 
 Usage:
     python tools/check_host_sync.py            # scan, report, exit 1 on hits
+    python -m tools.graftlint --rules R1       # same rule, new reporter
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
 
-_FORBIDDEN_CALLS = {
-    ("np", "asarray"), ("np", "array"),
-    ("numpy", "asarray"), ("numpy", "array"),
-    ("jax", "device_get"),
-}
-_FORBIDDEN_BUILTINS = {"float", "int", "bool"}
-_EXEMPT_MARKER = "host-ok"
+from tools.graftlint.core import (HOST_OK_MARKER,  # noqa: E402
+                                  apply_waivers, load_modules, unwaived)
+from tools.graftlint.rules_ast import HostSyncRule  # noqa: E402
 
-
-def _dotted(node: ast.AST) -> tuple | None:
-    """("np", "asarray") for an ``np.asarray`` attribute chain."""
-    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
-        return (node.value.id, node.attr)
-    return None
+_EXEMPT_MARKER = HOST_OK_MARKER
 
 
-class _Checker(ast.NodeVisitor):
-    def __init__(self, path: str, source_lines: list):
-        self.path = path
-        self.lines = source_lines
-        self.violations: list = []
-
-    def _flag(self, node: ast.Call, what: str) -> None:
-        line = self.lines[node.lineno - 1] if node.lineno <= len(
-            self.lines) else ""
-        if _EXEMPT_MARKER in line:
-            return
-        self.violations.append(
-            (self.path, node.lineno, what, line.strip()))
-
-    def visit_Call(self, node: ast.Call) -> None:
-        fn = node.func
-        if (isinstance(fn, ast.Attribute) and fn.attr == "item"
-                and not node.args and not node.keywords):
-            self._flag(node, ".item() host sync")
-        dotted = _dotted(fn)
-        if dotted in _FORBIDDEN_CALLS:
-            self._flag(node, f"{dotted[0]}.{dotted[1]}() host "
-                             "materialization")
-        if isinstance(fn, ast.Name) and fn.id in _FORBIDDEN_BUILTINS:
-            self._flag(node, f"builtin {fn.id}() tracer concretization")
-        self.generic_visit(node)
+def _as_tuples(findings) -> list:
+    return [(f.path, f.lineno, f.message, f.source) for f in findings]
 
 
-def _check_tree(path: str, tree: ast.AST, source: str) -> list:
-    checker = _Checker(os.path.relpath(path, REPO_ROOT),
-                       source.splitlines())
-    checker.visit(tree)
-    return checker.violations
-
-
-def _engine_hot_functions(tree: ast.Module, names=("step", "multi_step")):
-    """The FunctionDef nodes of the fused-round entry points, wherever
-    decoration (functools.partial(jax.jit, ...)) put them."""
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and node.name in names:
-            yield node
+def _check_tree(path: str, tree, source: str) -> list:
+    """[(path, lineno, what, source_line)] for one parsed tree —
+    host-ok-exempt lines excluded, exactly the pre-graftlint behavior."""
+    rel = os.path.relpath(path, REPO_ROOT) if os.path.isabs(path) else path
+    findings = HostSyncRule().check_tree(rel, tree, source.splitlines())
+    return _as_tuples(f for f in findings
+                      if _EXEMPT_MARKER not in f.source)
 
 
 def collect_violations(repo_root: str = REPO_ROOT) -> list:
-    """[(path, lineno, what, source_line)] across the scanned scope."""
-    violations = []
-    ops_dir = os.path.join(repo_root, "dispersy_tpu", "ops")
-    for fname in sorted(os.listdir(ops_dir)):
-        if not fname.endswith(".py"):
-            continue
-        path = os.path.join(ops_dir, fname)
-        with open(path) as f:
-            source = f.read()
-        violations += _check_tree(path, ast.parse(source), source)
-
-    engine_path = os.path.join(repo_root, "dispersy_tpu", "engine.py")
-    with open(engine_path) as f:
-        source = f.read()
-    tree = ast.parse(source)
-    for fn in _engine_hot_functions(tree):
-        violations += _check_tree(engine_path, fn, source)
-    return violations
+    """[(path, lineno, what, source_line)] across the scanned scope
+    (unwaived findings only).  Waivers follow graftlint's full rules —
+    inline ``host-ok`` AND waivers.txt entries — so this gate and
+    ``python -m tools.graftlint --rules R1`` can never diverge.  Only
+    the package is loaded (R1's scope): this gate's pass/fail must not
+    depend on the parseability of unrelated host tooling.  A hot-path
+    file that does not PARSE is reported as a violation (the scan is
+    blind to it — silence would be a green gate over a broken file;
+    pre-graftlint this raised SyntaxError)."""
+    modules = load_modules(repo_root, targets=("dispersy_tpu",))
+    findings = HostSyncRule().scan(modules, repo_root)
+    apply_waivers(findings, modules)
+    out = _as_tuples(unwaived(findings))
+    for mod in modules:
+        if mod.parse_error and (mod.is_ops or mod.is_engine):
+            out.append((mod.rel, 1,
+                        f"file does not parse ({mod.parse_error}) — "
+                        "host-sync scan is blind to it", ""))
+    return out
 
 
 def main() -> int:

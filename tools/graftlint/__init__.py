@@ -1,0 +1,31 @@
+"""graftlint: the repo's multi-rule JAX hot-path analyzer.
+
+Grown from PR 1's single-purpose ``tools/check_host_sync.py`` into the
+codebase's correctness-tooling layer: five rules that machine-check the
+performance contracts every perf PR lands against, wired into tier-1
+(tests/test_graftlint_repo.py) and runnable standalone:
+
+    python -m tools.graftlint                # all rules, text report
+    python -m tools.graftlint --format=json  # machine-readable report
+    python -m tools.graftlint --rules R1,R4  # a subset
+
+Rules (catalog + waiver syntax + how-to-add: LINTING.md):
+
+  R1 host-sync        — no device->host syncs in the fused round
+  R2 recompile-hazard — no Python branches on tracers; no tensor-valued
+                        or unhashable jit static args
+  R3 dtype-contract   — every public op's output dtypes/shapes match its
+                        @contract declaration under jax.eval_shape
+  R4 scatter-mode     — advanced-index scatters declare mode= explicitly
+  R5 key-reuse        — no jax.random key consumed twice without a split
+
+Exit code: non-zero iff any unwaived finding exists.
+"""
+
+from .core import (Finding, apply_waivers, load_modules, report_json,
+                   report_text, run, unwaived)
+from .registry import default_rules, rules_by_id
+
+__all__ = ["Finding", "apply_waivers", "default_rules", "load_modules",
+           "report_json", "report_text", "rules_by_id", "run",
+           "unwaived"]

@@ -1,0 +1,72 @@
+"""CLI: ``python -m tools.graftlint`` — run the lint suite, exit
+non-zero on unwaived findings.
+
+``--format=json`` emits the machine-readable report (schema documented
+in LINTING.md); ``--output`` additionally writes it to a file — that is
+how the committed baseline artifact
+(``artifacts/graftlint_baseline.json``) is produced for
+round-over-round diffing, mirroring ``tools/bench_kernels.py``'s
+BENCH_r0x.json flow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> int:
+    from tools.graftlint import (core, report_json, report_text,
+                                 rules_by_id, run, unwaived)
+    from tools.graftlint.registry import default_rules
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="static analysis of dispersy_tpu/'s JAX hot path")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset, e.g. R1,R4")
+    ap.add_argument("--output", default=None,
+                    help="also write the report (in the selected "
+                         "--format) to this path")
+    ap.add_argument("--root", default=core.REPO_ROOT,
+                    help="repo root to scan (default: this checkout)")
+    args = ap.parse_args(argv)
+
+    try:
+        rules = (rules_by_id([r.strip() for r in args.rules.split(",")])
+                 if args.rules else default_rules())
+    except KeyError as e:
+        # Usage error, not a lint failure: a typo'd --rules in CI must
+        # not read as "unwaived findings exist" (exit 1).
+        print(f"graftlint: {e.args[0]}", file=sys.stderr)
+        return 2
+    if (os.path.realpath(args.root) != os.path.realpath(core.REPO_ROOT)
+            and any(r.rule_id == "R3" for r in rules)):
+        # R3 traces the IMPORTABLE dispersy_tpu (and waivers come from
+        # this checkout) — mixing that with another tree's AST scan
+        # would report a chimera of two checkouts.  Fail fast instead.
+        print("graftlint: --root points at a different checkout; rule "
+              "R3 (and waivers.txt) always follow THIS checkout. Run "
+              "graftlint from that checkout, or pass --rules without "
+              "R3.", file=sys.stderr)
+        return 2
+    findings = run(repo_root=args.root, rules=rules)
+    report = (report_json(findings, rules) if args.format == "json"
+              else report_text(findings, rules))
+    print(report)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(report)
+            f.write("\n")
+    return 1 if unwaived(findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

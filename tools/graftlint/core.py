@@ -1,0 +1,274 @@
+"""graftlint core: findings, waivers, scope model, and the runner.
+
+The framework half of ``tools/graftlint`` (rules live in
+``rules_ast.py`` / ``rule_contracts.py``; the CLI in ``__main__.py``).
+Design points:
+
+- A **rule** is an object with ``rule_id`` / ``name`` / ``summary`` and a
+  ``scan(modules, repo_root) -> [Finding]`` method.  AST rules share the
+  pre-parsed module list; the contract rule (R3) imports the ops modules
+  and traces instead.
+- A **finding** is never silently discarded: waivers mark it
+  ``waived=True`` with the justification attached, and it still appears
+  in reports (and in the committed baseline artifact) — only the exit
+  code ignores it.  An invisible exemption is how one-off checkers rot.
+- **Waivers** come in two forms:
+
+  * inline — a ``graftlint: ok[R4]`` comment on the flagged line (the
+    legacy ``host-ok`` marker is R1's spelling of the same thing, kept
+    verbatim so PR 1-era exemptions survive unchanged);
+  * the waiver file ``tools/graftlint/waivers.txt`` — one entry per
+    line, ``RULE path "source substring" -- justification``, for
+    exceptions that deserve more than a comment can carry.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import shlex
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+WAIVER_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "waivers.txt")
+
+# Inline waiver syntax: "graftlint: ok[R1,R4] optional reason".
+_INLINE_RE = re.compile(r"graftlint:\s*ok\[([A-Z0-9, ]+)\]")
+# R1's legacy inline marker (pre-graftlint tools/check_host_sync.py).
+HOST_OK_MARKER = "host-ok"
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str           # "R1".."R5"
+    path: str           # repo-relative, forward slashes
+    lineno: int
+    message: str        # what is wrong and why it costs performance
+    source: str         # the offending source line, stripped
+    waived: bool = False
+    waiver: str = ""    # justification, when waived
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tag = f"  [waived: {self.waiver}]" if self.waived else ""
+        return (f"{self.path}:{self.lineno}: {self.rule} {self.message}"
+                f"{tag}\n    {self.source}")
+
+
+@dataclasses.dataclass
+class Module:
+    """One parsed source file handed to the AST rules."""
+    path: str           # absolute
+    rel: str            # repo-relative
+    source: str
+    lines: list
+    tree: ast.Module
+    parse_error: str = ""   # non-empty -> tree is an empty placeholder
+
+    @property
+    def is_ops(self) -> bool:
+        return self.rel.startswith("dispersy_tpu/ops/")
+
+    @property
+    def is_engine(self) -> bool:
+        return self.rel == "dispersy_tpu/engine.py"
+
+    def line(self, lineno: int) -> str:
+        return self.lines[lineno - 1] if lineno <= len(self.lines) else ""
+
+
+def hot_functions(tree: ast.Module, names=("step", "multi_step")):
+    """The fused-round entry points' FunctionDef nodes (same definition
+    as PR 1's checker: wherever decoration moved them)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in names:
+            yield node
+
+
+# What the repo-wide rules (R2 jit statics, R4, R5) see: the package,
+# the host-side tooling, and the bench entry point.  R5's whole reason
+# to exist here is host tooling — the hot path uses counter-based
+# streams — so tools/ must be in scope or benchmark inputs quietly
+# correlating (the exact defect found in bench_kernels.py and
+# profiling.py) would outlive the rule that names it.
+SCAN_TARGETS = ("dispersy_tpu", "tools", "bench.py")
+
+
+def load_modules(repo_root: str = REPO_ROOT,
+                 targets=SCAN_TARGETS) -> list:
+    """Parse every .py under each target (dir or file) into
+    :class:`Module` objects."""
+    modules = []
+
+    def add(path: str) -> None:
+        with open(path) as f:
+            source = f.read()
+        rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+        try:
+            tree = ast.parse(source, filename=path)
+            err = ""
+        except SyntaxError as e:
+            # An unparseable file must not take the whole gate down
+            # anonymously: record it and let the runner surface it as
+            # an (unwaivable) finding naming the file and line.
+            tree = ast.Module(body=[], type_ignores=[])
+            err = f"line {e.lineno}: {e.msg}"
+        modules.append(Module(path=path, rel=rel, source=source,
+                              lines=source.splitlines(), tree=tree,
+                              parse_error=err))
+
+    for target in targets:
+        root = os.path.join(repo_root, target)
+        if os.path.isfile(root):
+            add(root)
+            continue
+        if not os.path.isdir(root):
+            # Scanning nothing must never read as "clean": a wrong
+            # --root (or renamed target) is a loud error, not exit 0.
+            raise FileNotFoundError(
+                f"graftlint scan target missing: {root}")
+        for dirpath, _dirnames, filenames in sorted(os.walk(root)):
+            for fname in sorted(filenames):
+                if fname.endswith(".py"):
+                    add(os.path.join(dirpath, fname))
+    return modules
+
+
+# ---------------------------------------------------------------- waivers
+
+
+def load_file_waivers(path: str = WAIVER_FILE) -> list:
+    """[(rule, relpath, substring, justification)] from waivers.txt."""
+    waivers = []
+    if not os.path.exists(path):
+        return waivers
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            head, _, why = line.partition("--")
+            parts = shlex.split(head)
+            if len(parts) != 3:
+                raise ValueError(
+                    f"waivers.txt: expected 'RULE path \"substring\" -- "
+                    f"reason', got: {line!r}")
+            if not parts[2]:
+                # "" is a substring of everything — an empty matcher
+                # would blanket-waive a whole file's findings.
+                raise ValueError(
+                    f"waivers.txt: empty substring matcher in: {line!r}")
+            waivers.append((parts[0], parts[1], parts[2], why.strip()))
+    return waivers
+
+
+def apply_waivers(findings: list, modules: list,
+                  file_waivers: list | None = None) -> list:
+    """Mark waived findings in place (inline markers + waiver file)."""
+    if file_waivers is None:
+        file_waivers = load_file_waivers()
+    by_rel = {m.rel: m for m in modules}
+    for f in findings:
+        if f.rule == "R0":
+            continue    # a file no rule can see is never an intentional
+            #             exception — R0 has no waiver path
+        mod = by_rel.get(f.path)
+        line = mod.line(f.lineno) if mod is not None else f.source
+        if f.rule == "R1" and HOST_OK_MARKER in line:
+            f.waived = True
+            f.waiver = "inline host-ok"
+            continue
+        m = _INLINE_RE.search(line)
+        if m and f.rule in {r.strip() for r in m.group(1).split(",")}:
+            f.waived = True
+            f.waiver = "inline graftlint: ok"
+            continue
+        for rule, rel, substr, why in file_waivers:
+            if rule == f.rule and rel == f.path and substr in f.source:
+                f.waived = True
+                f.waiver = why or "waivers.txt"
+                break
+    return findings
+
+
+# ----------------------------------------------------------------- runner
+
+
+def run(repo_root: str = REPO_ROOT, rules: list | None = None) -> list:
+    """Run ``rules`` (default: all five) over the repo; returns findings
+    with waivers applied, sorted by (path, line, rule)."""
+    from .registry import default_rules
+
+    if rules is None:
+        rules = default_rules()
+    modules = load_modules(repo_root)
+    findings = []
+    for mod in modules:
+        if mod.parse_error:
+            # Deliberately NOT waivable: an unparseable file is never an
+            # intentional exception, and every AST rule is blind to it.
+            findings.append(Finding(
+                rule="R0", path=mod.rel, lineno=1,
+                message=f"file does not parse ({mod.parse_error}) — "
+                        "every AST rule is blind to it", source=""))
+    for rule in rules:
+        findings.extend(rule.scan(modules, repo_root))
+    apply_waivers(findings, modules)
+    findings.sort(key=lambda f: (f.path, f.lineno, f.rule))
+    return findings
+
+
+def unwaived(findings: list) -> list:
+    return [f for f in findings if not f.waived]
+
+
+def report_text(findings: list, rules: list) -> str:
+    out = []
+    for f in findings:
+        out.append(f.render())
+    bad = unwaived(findings)
+    n_waived = len(findings) - len(bad)
+    names = ", ".join(r.rule_id for r in rules)
+    if bad:
+        out.append(f"\ngraftlint: {len(bad)} unwaived finding(s) "
+                   f"({n_waived} waived) across {names}")
+    else:
+        out.append(f"graftlint: clean ({names}; {n_waived} waived "
+                   f"finding(s) on record)")
+    return "\n".join(out)
+
+
+def report_json(findings: list, rules: list) -> str:
+    per_rule = {}
+    r0 = [f for f in findings if f.rule == "R0"]
+    if r0:
+        # Synthetic parse-failure findings must be attributable in the
+        # per-rule table too, or the JSON is internally inconsistent
+        # (summary.unwaived > sum of rules[*].unwaived).
+        per_rule["R0"] = {"name": "parse-error", "findings": len(r0),
+                          "unwaived": len(r0)}
+    for r in rules:
+        fr = [f for f in findings if f.rule == r.rule_id]
+        per_rule[r.rule_id] = {
+            "name": r.name,
+            "findings": len(fr),
+            "unwaived": len(unwaived(fr)),
+        }
+    doc = {
+        "tool": "graftlint",
+        "version": 1,
+        "scope": "dispersy_tpu/ + tools/ + bench.py",
+        "rules": per_rule,
+        "summary": {
+            "findings": len(findings),
+            "unwaived": len(unwaived(findings)),
+        },
+        "findings": [f.as_dict() for f in findings],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)

@@ -1,0 +1,28 @@
+"""The rule registry: one place that knows all five rules.
+
+Adding a rule (LINTING.md walks through this): implement an object with
+``rule_id`` / ``name`` / ``summary`` / ``scan(modules, repo_root)``,
+import it here, append it to :func:`default_rules`, document it in
+LINTING.md, and give it known-bad/known-good/waived fixtures in
+tests/test_graftlint.py.
+"""
+
+from __future__ import annotations
+
+from .rule_contracts import ContractRule
+from .rules_ast import (HostSyncRule, KeyReuseRule, RecompileRule,
+                        ScatterModeRule)
+
+
+def default_rules() -> list:
+    return [HostSyncRule(), RecompileRule(), ContractRule(),
+            ScatterModeRule(), KeyReuseRule()]
+
+
+def rules_by_id(ids) -> list:
+    table = {r.rule_id: r for r in default_rules()}
+    missing = [i for i in ids if i not in table]
+    if missing:
+        raise KeyError(f"unknown rule id(s): {', '.join(missing)}; "
+                       f"known: {', '.join(sorted(table))}")
+    return [table[i] for i in ids]

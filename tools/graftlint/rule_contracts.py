@@ -1,0 +1,101 @@
+"""R3: implicit dtype/shape widening against the declared op contracts.
+
+The only non-AST rule: it imports every module under
+``dispersy_tpu/ops/``, requires each public function to carry either
+``@contract`` or ``@host_helper`` (dispersy_tpu/ops/contracts.py), and
+traces each contracted op with ``jax.eval_shape`` at its canonical
+sizes, diffing declared vs inferred output dtypes/shapes.  No array is
+ever materialized — tracing is abstract, so the whole pass is CPU-safe
+and runs in milliseconds per op regardless of the declared sizes.
+
+What it catches: exactly the silent regressions PR 1's byte diet is
+exposed to — a ``uint8`` meta column promoted to ``int32`` by a stray
+literal, a comparison that widens, a transposed output shape.  Nothing
+crashes when these happen; bytes-per-round quietly multiplies.  R3
+turns that into a lint failure with the leaf-level diff in the message.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+
+from .core import Finding
+
+OPS_PACKAGE = "dispersy_tpu.ops"
+# Modules that define ops (the contracts module itself only defines the
+# decorators and checker — its public surface is not ops).
+OPS_MODULES = ("bloom", "candidates", "hashing", "inbox", "intake",
+               "rng", "store", "timeline")
+
+
+def public_functions(mod):
+    """(name, fn) for module-level public functions defined in ``mod``."""
+    for name, fn in sorted(vars(mod).items()):
+        if (inspect.isfunction(fn) and fn.__module__ == mod.__name__
+                and not name.startswith("_")):
+            yield name, fn
+
+
+class ContractRule:
+    rule_id = "R3"
+    name = "dtype-contract"
+    summary = ("public op output dtypes/shapes diffed against their "
+               "@contract declarations via jax.eval_shape")
+
+    def scan(self, modules, repo_root) -> list:
+        # R3 traces the IMPORTABLE dispersy_tpu package — Python import
+        # semantics, not the --root path, decide which checkout that is
+        # (an already-imported package wins over any sys.path edit).  To
+        # keep paths/waivers consistent regardless, each finding's rel
+        # path is computed against the checkout that owns the imported
+        # module file; linting a different checkout's contracts means
+        # running graftlint from that checkout.
+        import sys
+        if repo_root not in sys.path:
+            sys.path.insert(0, repo_root)
+        from dispersy_tpu.ops.contracts import check_contract
+
+        findings = []
+        by_rel = {m.rel: m for m in modules}
+        for modname in OPS_MODULES:
+            try:
+                mod = importlib.import_module(f"{OPS_PACKAGE}.{modname}")
+            except Exception as e:  # noqa: BLE001 — the failure IS the
+                #   finding: a crash here would suppress every other
+                #   rule's report (and the R0 parse finding) with a raw
+                #   traceback naming no rule
+                findings.append(Finding(
+                    rule=self.rule_id,
+                    path=f"dispersy_tpu/ops/{modname}.py", lineno=1,
+                    message=f"ops module fails to import — contracts "
+                            f"unverifiable: {type(e).__name__}: {e}",
+                    source=""))
+                continue
+            mod_file = os.path.abspath(mod.__file__)
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(mod_file)))     # <root>/dispersy_tpu/ops/x.py
+            rel = os.path.relpath(mod_file, pkg_root).replace(os.sep, "/")
+            src = by_rel.get(rel)
+            for name, fn in public_functions(mod):
+                lineno = fn.__code__.co_firstlineno
+                line = src.line(lineno).strip() if src is not None else ""
+                if getattr(fn, "__graft_host_helper__", False):
+                    continue
+                if not hasattr(fn, "__graft_contract__"):
+                    findings.append(Finding(
+                        rule=self.rule_id, path=rel, lineno=lineno,
+                        message=f"public op `{name}` carries neither "
+                                "@contract nor @host_helper — every op's "
+                                "dtypes must be declared "
+                                "(dispersy_tpu/ops/contracts.py)",
+                        source=line))
+                    continue
+                for problem in check_contract(fn):
+                    findings.append(Finding(
+                        rule=self.rule_id, path=rel, lineno=lineno,
+                        message=f"`{name}` violates its contract: "
+                                f"{problem}",
+                        source=line))
+        return findings

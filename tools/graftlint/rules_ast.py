@@ -1,0 +1,538 @@
+"""The AST rules: R1 host-sync, R2 recompile hazards, R4 scatter mode,
+R5 PRNG key reuse.
+
+Each rule documents its scope and its heuristic precisely — a static
+analyzer that overclaims trains people to waive reflexively.  LINTING.md
+carries the user-facing catalog; keep the two in sync.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, hot_functions
+
+# --------------------------------------------------------------- R1
+
+
+class HostSyncRule:
+    """R1: no host-sync constructs in the hot path.
+
+    Ported verbatim from PR 1's ``tools/check_host_sync.py`` (same
+    forbidden set, same scope, same ``host-ok`` inline waiver): one
+    ``.item()`` / ``np.asarray`` / ``float()`` on a tracer turns the
+    async-dispatched fused round into a ~300 us/call device->host round
+    trip (BENCH.md dispatch-overhead study).
+
+    Scope: every module under ``dispersy_tpu/ops/`` (ops are device-side
+    by definition) and the bodies of ``engine.step`` / ``multi_step``
+    (the engine's host-side helpers legitimately touch numpy).
+    """
+
+    rule_id = "R1"
+    name = "host-sync"
+    summary = ("device->host syncs (.item / np.asarray / float|int|bool "
+               "on tracers) in the fused round")
+
+    FORBIDDEN_CALLS = {
+        ("np", "asarray"), ("np", "array"),
+        ("numpy", "asarray"), ("numpy", "array"),
+        ("jax", "device_get"),
+    }
+    FORBIDDEN_BUILTINS = {"float", "int", "bool"}
+
+    def scan(self, modules, repo_root) -> list:
+        findings = []
+        for mod in modules:
+            if mod.is_ops:
+                findings += self.check_tree(mod.rel, mod.tree, mod.lines)
+            elif mod.is_engine:
+                for fn in hot_functions(mod.tree):
+                    findings += self.check_tree(mod.rel, fn, mod.lines)
+        return findings
+
+    def check_tree(self, rel: str, tree: ast.AST, lines: list) -> list:
+        """All R1 findings in one tree (also the shim's entry point)."""
+        findings = []
+
+        def flag(node: ast.Call, what: str) -> None:
+            line = lines[node.lineno - 1] if node.lineno <= len(lines) \
+                else ""
+            findings.append(Finding(
+                rule=self.rule_id, path=rel, lineno=node.lineno,
+                message=what, source=line.strip()))
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (isinstance(fn, ast.Attribute) and fn.attr == "item"
+                    and not node.args and not node.keywords):
+                flag(node, ".item() host sync")
+            if (isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and (fn.value.id, fn.attr) in self.FORBIDDEN_CALLS):
+                flag(node, f"{fn.value.id}.{fn.attr}() host "
+                           "materialization")
+            if (isinstance(fn, ast.Name)
+                    and fn.id in self.FORBIDDEN_BUILTINS):
+                flag(node, f"builtin {fn.id}() tracer concretization")
+        return findings
+
+
+# --------------------------------------------------------------- R2
+
+
+def _attr_root(node: ast.AST):
+    """("jnp", "any") for ``jnp.any``; None for deeper/other shapes."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return (node.value.id, node.attr)
+    return None
+
+
+class RecompileRule:
+    """R2: constructs that force per-round recompiles (or crash tracing).
+
+    Two sub-checks:
+
+    (a) **tracer branches** — a Python ``if`` / ``while`` / ``assert``
+        (or ternary ``x if c else y``)
+        whose test contains a ``jnp.*`` / ``lax.*`` call produces a
+        traced boolean: branching on it either raises
+        TracerBoolConversionError under jit or, on host-value fallback
+        paths, re-traces the whole step per distinct value.  Scope: the
+        hot path (ops modules + ``engine.step``/``multi_step``), where
+        every other ``if`` is a trace-time-static config branch by
+        construction.
+    (b) **jit static-arg hazards** — a parameter named by
+        ``static_argnums``/``static_argnames`` on a ``jax.jit`` (or
+        ``functools.partial(jax.jit, ...)``) decorator whose annotation
+        is an array type, or whose default is an unhashable literal:
+        tensor-valued statics recompile per value (and unhashable ones
+        raise).  Scope: every module.  Heuristic: annotations are
+        matched textually; call-site values are out of static reach and
+        stay a review concern (LINTING.md).
+    """
+
+    rule_id = "R2"
+    name = "recompile-hazard"
+    summary = ("Python branches on traced values; tensor-valued or "
+               "unhashable jit static args")
+
+    TRACED_ROOTS = {"jnp", "lax"}
+    ARRAYISH = ("jnp.ndarray", "jax.Array", "jnp.array", "ndarray",
+                "ArrayLike")
+
+    def scan(self, modules, repo_root) -> list:
+        findings = []
+        for mod in modules:
+            if mod.is_ops:
+                findings += self._tracer_branches(mod, mod.tree)
+            elif mod.is_engine:
+                for fn in hot_functions(mod.tree):
+                    findings += self._tracer_branches(mod, fn)
+            findings += self._jit_static_hazards(mod)
+        return findings
+
+    def _test_is_traced(self, test: ast.AST) -> bool:
+        for node in ast.walk(test):
+            if isinstance(node, ast.Call):
+                root = _attr_root(node.func)
+                if root is not None and root[0] in self.TRACED_ROOTS:
+                    return True
+        return False
+
+    def _tracer_branches(self, mod, tree) -> list:
+        findings = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)) and \
+                    self._test_is_traced(node.test):
+                kind = ("while" if isinstance(node, ast.While)
+                        else "if" if isinstance(node, ast.If)
+                        else "x if c else y")
+                findings.append(Finding(
+                    rule=self.rule_id, path=mod.rel, lineno=node.lineno,
+                    message=f"Python `{kind}` on a traced value "
+                            "(jnp/lax call in the test) — crashes under "
+                            "jit or re-traces per value; use jnp.where/"
+                            "lax.cond/lax.while_loop",
+                    source=mod.line(node.lineno).strip()))
+            elif isinstance(node, ast.Assert) and \
+                    self._test_is_traced(node.test):
+                findings.append(Finding(
+                    rule=self.rule_id, path=mod.rel, lineno=node.lineno,
+                    message="`assert` on a traced value — concretizes "
+                            "the tracer; use checkify or move the check "
+                            "to host setup",
+                    source=mod.line(node.lineno).strip()))
+        return findings
+
+    # -- (b) jit static args ------------------------------------------
+
+    @staticmethod
+    def _is_jit_call(call: ast.Call) -> bool:
+        """``jax.jit(...)`` / ``jit(...)`` / ``[functools.]partial(jax.jit,
+        ...)`` — decorator or plain call site."""
+        target = call.func
+        is_jit = _attr_root(target) == ("jax", "jit") or (
+            isinstance(target, ast.Name) and target.id == "jit")
+        is_partial = (_attr_root(target) == ("functools", "partial")
+                      or (isinstance(target, ast.Name)
+                          and target.id == "partial"))
+        return is_jit or (
+            is_partial and bool(call.args)
+            and (_attr_root(call.args[0]) == ("jax", "jit")
+                 or (isinstance(call.args[0], ast.Name)
+                     and call.args[0].id == "jit")))
+
+    @staticmethod
+    def _static_kwargs(call: ast.Call):
+        """(static_argnums_node, static_argnames_node) of a jit call."""
+        nums = names = None
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                nums = kw.value
+            elif kw.arg == "static_argnames":
+                names = kw.value
+        return nums, names
+
+    def _jit_decorators(self, fn: ast.FunctionDef):
+        """Yield (decorator_node, static_argnums_node, static_argnames_node)
+        for jax.jit-style decorators on ``fn``."""
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call) and self._is_jit_call(dec):
+                yield (dec,) + self._static_kwargs(dec)
+
+    @staticmethod
+    def _literal_ints(node: ast.AST):
+        """[ints] from a Constant/tuple-of-Constant node, else None."""
+        if node is None:
+            return []
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in node.elts):
+            return [e.value for e in node.elts]
+        return None
+
+    @staticmethod
+    def _literal_strs(node: ast.AST):
+        if node is None:
+            return []
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in node.elts):
+            return [e.value for e in node.elts]
+        return None
+
+    def _check_jit_site(self, mod, site, nums_node, names_node,
+                        fn: ast.FunctionDef | None) -> list:
+        """Hazard checks for one jit site (decorator or plain call).
+        ``fn`` is the wrapped FunctionDef when resolvable; without it
+        only the literal-ness of the static spec can be verified."""
+        findings = []
+        nums = self._literal_ints(nums_node)
+        names = self._literal_strs(names_node)
+        if nums is None or names is None:
+            findings.append(Finding(
+                rule=self.rule_id, path=mod.rel, lineno=site.lineno,
+                message="static_argnums/static_argnames is not a "
+                        "literal — unverifiable jit cache key",
+                source=mod.line(site.lineno).strip()))
+            return findings
+        if fn is None:
+            return findings
+        # Positional params in order (posonly first — the index space
+        # static_argnums addresses); kwonly params are reachable via
+        # static_argnames only.
+        params = fn.args.posonlyargs + fn.args.args
+        chosen = [params[i] for i in nums if i < len(params)]
+        chosen += [p for p in params + fn.args.kwonlyargs
+                   if names and p.arg in names]
+        defaults = dict(zip(
+            [p.arg for p in params[len(params)
+                                   - len(fn.args.defaults):]],
+            fn.args.defaults))
+        defaults.update({
+            p.arg: d for p, d in zip(fn.args.kwonlyargs,
+                                     fn.args.kw_defaults)
+            if d is not None})
+        for p in chosen:
+            ann = ast.unparse(p.annotation) if p.annotation else ""
+            if any(a in ann for a in self.ARRAYISH):
+                findings.append(Finding(
+                    rule=self.rule_id, path=mod.rel, lineno=site.lineno,
+                    message=f"static arg `{p.arg}` is annotated "
+                            f"`{ann}` — a tensor-valued static "
+                            "recompiles per value",
+                    source=mod.line(site.lineno).strip()))
+            d = defaults.get(p.arg)
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                findings.append(Finding(
+                    rule=self.rule_id, path=mod.rel, lineno=site.lineno,
+                    message=f"static arg `{p.arg}` defaults to an "
+                            "unhashable literal — jit cache keys "
+                            "must hash",
+                    source=mod.line(site.lineno).strip()))
+        return findings
+
+    def _jit_static_hazards(self, mod) -> list:
+        findings = []
+        fn_defs = {}           # name -> FunctionDef, for call-site lookup
+        decorator_calls = set()
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            fn_defs.setdefault(fn.name, fn)
+            for dec, nums_node, names_node in self._jit_decorators(fn):
+                decorator_calls.add(id(dec))
+                findings += self._check_jit_site(mod, dec, nums_node,
+                                                 names_node, fn)
+        # Plain call sites: step2 = jax.jit(step_fn, static_argnums=...).
+        # The wrapped function resolves when named directly; attribute
+        # targets (engine.step.__wrapped__) only get the literal check.
+        for call in ast.walk(mod.tree):
+            if not (isinstance(call, ast.Call)
+                    and self._is_jit_call(call)
+                    and id(call) not in decorator_calls):
+                continue
+            nums_node, names_node = self._static_kwargs(call)
+            if nums_node is None and names_node is None:
+                continue
+            wrapped = None
+            if call.args and isinstance(call.args[0], ast.Name):
+                wrapped = fn_defs.get(call.args[0].id)
+            findings += self._check_jit_site(mod, call, nums_node,
+                                             names_node, wrapped)
+        return findings
+
+
+# --------------------------------------------------------------- R4
+
+
+class ScatterModeRule:
+    """R4: advanced-index scatters must carry an explicit ``mode=``.
+
+    XLA never raises on out-of-bounds scatter indices: with JAX's
+    default mode an OOB update is silently *dropped* — which is exactly
+    what the delivery/park idiom wants, and exactly what a subtly wrong
+    rank computation does NOT want.  The difference between "engineered
+    drop" and "silent corruption mask" is invisible at the call site
+    unless the mode is written down.  The rule: every
+    ``x.at[<advanced index>].set/add/...(...)`` must pass ``mode=``
+    (``"drop"`` for park/spill designs, ``"promise_in_bounds"`` only
+    with a proof in the comment).
+
+    Static indices — pure slices (Python slice semantics clamp), int
+    constants, config attributes, and min/max/len over those — are
+    trace-time bounds-checked by JAX itself and exempt.  Scope: every
+    module (host-built scatters hit the same trap).
+    """
+
+    rule_id = "R4"
+    name = "scatter-mode"
+    summary = ("`.at[...].set/add` with array indices and no explicit "
+               "mode= (the XLA OOB-drop trap)")
+
+    SCATTER_METHODS = {"set", "add", "subtract", "mul", "multiply",
+                       "divide", "div", "power", "min", "max", "apply"}
+    STATIC_CALLS = {"min", "max", "len"}
+
+    def _static_index(self, node: ast.AST) -> bool:
+        if isinstance(node, (ast.Slice, ast.Constant)):
+            return True
+        if isinstance(node, ast.Attribute):
+            return True     # dotted config access (cfg.n_meta); an
+            #                 array-valued attribute index is possible
+            #                 but unused in this codebase (LINTING.md)
+        if isinstance(node, ast.Tuple):
+            return all(self._static_index(e) for e in node.elts)
+        if isinstance(node, ast.Call):
+            return (isinstance(node.func, ast.Name)
+                    and node.func.id in self.STATIC_CALLS
+                    and all(self._static_index(a) for a in node.args))
+        if isinstance(node, ast.BinOp):
+            return (self._static_index(node.left)
+                    and self._static_index(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self._static_index(node.operand)
+        return False
+
+    def scan(self, modules, repo_root) -> list:
+        findings = []
+        for mod in modules:
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in self.SCATTER_METHODS):
+                    continue
+                sub = node.func.value
+                if not (isinstance(sub, ast.Subscript)
+                        and isinstance(sub.value, ast.Attribute)
+                        and sub.value.attr == "at"):
+                    continue
+                if any(kw.arg == "mode" for kw in node.keywords):
+                    continue
+                if self._static_index(sub.slice):
+                    continue
+                findings.append(Finding(
+                    rule=self.rule_id, path=mod.rel, lineno=node.lineno,
+                    message=f".at[...].{node.func.attr}() scatter with "
+                            "array indices and no explicit mode= — OOB "
+                            "indices drop silently; declare mode=\"drop\" "
+                            "(engineered) or mode=\"promise_in_bounds\" "
+                            "(proven)",
+                    source=mod.line(node.lineno).strip()))
+        return findings
+
+
+# --------------------------------------------------------------- R5
+
+
+class KeyReuseRule:
+    """R5: a ``jax.random`` PRNG key consumed twice without a split.
+
+    Reusing a key across two draws makes them identical/correlated —
+    statistically invisible in smoke tests, devastating in anything
+    that samples.  The hot path avoids ``jax.random`` entirely
+    (ops/rng.py's counter-based streams), so in THIS repo every finding
+    is in host-side tooling — kept linted anyway, because benchmark and
+    init data quietly correlating is how "representative" inputs stop
+    being representative.
+
+    Heuristic (documented, linear): within one scope (a function body,
+    async or not, or the module top level), in
+    statement order, a name passed as the first argument to a consuming
+    ``jax.random.*`` call (every API except key construction/conversion
+    and derivation — ``fold_in(key, i)`` with distinct data is the
+    canonical per-item idiom and does NOT consume; ``split`` does) while
+    its last event was already a consumption, without an intervening
+    rebind, is flagged.  ``if``/``else`` branches are mutually exclusive: each
+    branch starts from the pre-branch state, and the post-branch state
+    is the conservative merge (consumed-anywhere wins, so a consume
+    AFTER the branch still flags).  Loops and aliasing are out of
+    scope; the fixture tests pin exactly what is and is not caught.
+    """
+
+    rule_id = "R5"
+    name = "key-reuse"
+    summary = "the same jax.random key consumed twice without a split"
+
+    NONCONSUMING = {"PRNGKey", "key", "key_data", "wrap_key_data",
+                    "default_prng_impl", "key_impl",
+                    # fold_in derives an independent key per distinct
+                    # data value — flagging it would punish the idiom
+                    # JAX recommends.  Cost: fold_in after a real draw
+                    # on the same key goes unflagged (same-data reuse
+                    # needs value tracking this heuristic doesn't do).
+                    "fold_in"}
+
+    def _random_fn(self, func: ast.AST):
+        """'split' for jax.random.split / jrandom.split / jr.split."""
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        if (isinstance(base, ast.Attribute) and base.attr == "random"
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "jax"):
+            return func.attr
+        if isinstance(base, ast.Name) and base.id in ("jrandom", "jr"):
+            return func.attr
+        return None
+
+    def scan(self, modules, repo_root) -> list:
+        findings = []
+        for mod in modules:
+            # Module level is a scope too — host bench scripts (R5's
+            # reason to scan tools/) commonly consume keys at top level.
+            findings += self._scan_function(mod, mod.tree)
+            for fn in ast.walk(mod.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    findings += self._scan_function(mod, fn)
+        return findings
+
+    def _scan_function(self, mod, fn) -> list:
+        rule = self
+        events = []      # (kind, name, lineno) in execution-ish order
+
+        class V(ast.NodeVisitor):
+            def visit_FunctionDef(self, node):
+                if node is not fn:
+                    return      # nested functions scanned separately
+                self.generic_visit(node)
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def _bind(self, target):
+                for node in ast.walk(target):
+                    if isinstance(node, ast.Name):
+                        events.append(("bind", node.id, node.lineno))
+
+            def visit_Assign(self, node):
+                self.visit(node.value)          # RHS consumes first
+                for t in node.targets:
+                    self._bind(t)
+
+            def visit_AugAssign(self, node):
+                self.visit(node.value)
+                self._bind(node.target)
+
+            def visit_For(self, node):
+                self.visit(node.iter)
+                self._bind(node.target)
+                for stmt in node.body + node.orelse:
+                    self.visit(stmt)
+
+            def visit_If(self, node):
+                self.visit(node.test)
+                events.append(("if_start", "", node.lineno))
+                for stmt in node.body:
+                    self.visit(stmt)
+                events.append(("if_else", "", node.lineno))
+                for stmt in node.orelse:
+                    self.visit(stmt)
+                events.append(("if_end", "", node.lineno))
+
+            def visit_Call(self, node):
+                name = rule._random_fn(node.func)
+                if (name is not None
+                        and name not in rule.NONCONSUMING
+                        and node.args
+                        and isinstance(node.args[0], ast.Name)):
+                    events.append(
+                        ("consume", node.args[0].id, node.lineno))
+                self.generic_visit(node)
+
+        V().visit(fn)
+        findings = []
+        last = {}
+        branch_stack = []   # (pre-branch state, then-branch final state)
+        for kind, name, lineno in events:
+            if kind == "if_start":
+                branch_stack.append([dict(last), None])
+                continue
+            if kind == "if_else":
+                # else runs from the pre-branch state, not the then-
+                # branch's — the branches are mutually exclusive.
+                branch_stack[-1][1] = last
+                last = dict(branch_stack[-1][0])
+                continue
+            if kind == "if_end":
+                _pre, then_final = branch_stack.pop()
+                # Conservative merge: consumed on either path stays
+                # consumed, so a consume AFTER the branch still flags.
+                for n, k in then_final.items():
+                    if k == "consume" or n not in last:
+                        last[n] = k
+                continue
+            if kind == "consume" and last.get(name) == "consume":
+                findings.append(Finding(
+                    rule=self.rule_id, path=mod.rel, lineno=lineno,
+                    message=f"PRNG key `{name}` consumed again without "
+                            "jax.random.split — correlated draws",
+                    source=mod.line(lineno).strip()))
+            last[name] = kind
+        return findings

@@ -29,14 +29,18 @@ test environments and keeps the format inspectable.
 
 from __future__ import annotations
 
+import functools
 import io
 import os
+import zipfile
+import zlib
 
 import jax
 import numpy as np
 
 from dispersy_tpu.config import CommunityConfig
 from dispersy_tpu.exceptions import CheckpointError
+from dispersy_tpu.faults import FaultModel
 from dispersy_tpu.state import PeerState, init_state, wipe_instance_memory
 
 # v2: PeerState gained the signature request cache (sig_*) and Stats the
@@ -48,16 +52,50 @@ from dispersy_tpu.state import PeerState, init_state, wipe_instance_memory
 # v6: PeerState gained the `loaded` leaf.
 # v7: + auth_issuer (retro re-walk handle) and the auth_unwound/msgs_retro
 #     + mm_*/id_* counter leaves.
-FORMAT_VERSION = 8   # v8: store_meta/fwd_meta/dly_meta narrowed to uint8
+# v8: store_meta/fwd_meta/dly_meta narrowed to uint8
 #     (EMPTY_META holes) and store_flags to uint8 — the bandwidth diet
 #     (config.META_DTYPE/FLAGS_DTYPE).  v7 archives still load: the
 #     sentinel is EMPTY_U32's low byte, so plain uint32 -> uint8
 #     truncation is the lossless up-conversion (_upconvert_v7).
+FORMAT_VERSION = 9   # v9: per-leaf CRC32s (``crc:<leaf>`` keys — a
+#     bit-flipped or short-written archive raises CheckpointError
+#     instead of silently restoring garbage) + the chaos-harness leaves
+#     (health / ge_bad / stats.msgs_corrupt_dropped, knob-sized;
+#     dispersy_tpu/faults.py).  v7/v8 archives still load: they carry no
+#     CRCs to verify, their missing fault leaves default to the
+#     template's empty values, and their config fingerprint predates the
+#     ``faults`` field (_legacy_fingerprint) — restoring one under a
+#     non-default FaultModel is refused.
+_ACCEPTED_VERSIONS = (7, 8, FORMAT_VERSION)
 
 # Leaves whose dtype narrowed u32 -> u8 at v8; a v7 archive's u32 arrays
 # convert by truncation (0xFFFFFFFF -> 0xFF, real values < 256 unchanged).
 _NARROWED_V8 = frozenset(
     {"store_meta", "store_flags", "fwd_meta", "dly_meta"})
+
+# Leaves that did not exist before v9: filled from the config template
+# (all-zero / empty) when restoring an older archive.
+_NEW_V9 = frozenset(
+    {"health", "ge_bad", "stats/msgs_corrupt_dropped"})
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _verify_crc(z, key: str, arr: np.ndarray, what: str) -> None:
+    crc_key = f"crc:{key[len('leaf:'):]}"
+    if crc_key not in z:
+        raise CheckpointError(
+            f"checkpoint {what}: CRC entry {crc_key} missing — "
+            "truncated or foreign archive")
+    want = int(z[crc_key])
+    got = _crc(arr)
+    if got != want:
+        raise CheckpointError(
+            f"checkpoint {what}: CRC mismatch on {key} "
+            f"(stored {want:#010x}, computed {got:#010x}) — corrupt "
+            "archive, refusing to restore")
 
 
 def _upconvert_v7(name: str, arr: np.ndarray,
@@ -73,6 +111,67 @@ def _fingerprint(cfg: CommunityConfig) -> str:
     return repr(cfg)
 
 
+def _want_fingerprint(cfg: CommunityConfig, version: int) -> str:
+    """The fingerprint an archive of ``version`` should carry for
+    ``cfg``.  Pre-v9 archives were written before CommunityConfig grew
+    the ``faults`` field; it is declared LAST, so its repr component
+    strips cleanly — but only a default FaultModel can possibly match
+    what the old writer simulated."""
+    if version >= 9:
+        return _fingerprint(cfg)
+    if cfg.faults != FaultModel():
+        raise CheckpointError(
+            f"checkpoint format {version} predates the fault model; it "
+            "can only restore under the default FaultModel "
+            "(cfg.faults must be FaultModel())")
+    full = repr(cfg)
+    suffix = f", faults={cfg.faults!r})"
+    if not full.endswith(suffix):
+        raise CheckpointError("cannot derive pre-v9 fingerprint: faults "
+                              "is no longer the last config field")
+    return full[:-len(suffix)] + ")"
+
+
+def _np_load(path: str):
+    """np.load that converts unreadable/truncated archives into
+    CheckpointError (a half-written autosave must be REJECTED, and then
+    skipped by resume-from-latest-valid — never a raw zipfile crash)."""
+    try:
+        return np.load(path)
+    except CheckpointError:
+        raise
+    except Exception as e:  # noqa: BLE001 — BadZipFile/EOF/OSError/...
+        raise CheckpointError(
+            f"checkpoint {path} unreadable ({type(e).__name__}: {e}) — "
+            "truncated or torn archive") from e
+
+
+# What a corrupt archive raises MID-READ: np.load only parses the zip
+# directory, so a bit flip inside a member's compressed byte stream
+# surfaces from ``z[key]`` as BadZipFile ("Bad CRC-32") / zlib.error —
+# long before our own per-leaf CRC can even see the bytes.
+_ARCHIVE_ERRORS = (zipfile.BadZipFile, zlib.error, EOFError, OSError,
+                   ValueError)
+
+
+def _archive_guard(fn):
+    """Wrap a restore entry point so corruption surfacing mid-read still
+    becomes CheckpointError — resume's latest-valid scan must be able to
+    skip the snapshot, never crash on a raw zipfile traceback."""
+    @functools.wraps(fn)
+    def wrapped(path, cfg, *args, **kwargs):
+        try:
+            return fn(path, cfg, *args, **kwargs)
+        except CheckpointError:
+            raise
+        except _ARCHIVE_ERRORS as e:
+            raise CheckpointError(
+                f"checkpoint {path}: read failed mid-restore "
+                f"({type(e).__name__}: {e}) — corrupt or torn "
+                "archive") from e
+    return wrapped
+
+
 def _leaves_with_paths(state: PeerState):
     flat, treedef = jax.tree_util.tree_flatten_with_path(state)
     names = ["/".join(str(getattr(k, "name", k)) for k in path)
@@ -81,16 +180,21 @@ def _leaves_with_paths(state: PeerState):
 
 
 def save(path: str, state: PeerState, cfg: CommunityConfig) -> None:
-    """Write the full overlay state to ``path`` (.npz)."""
+    """Write the full overlay state to ``path`` (.npz), with one CRC32
+    per leaf so restore detects bit-flips/truncation (v9)."""
     names, leaves, _ = _leaves_with_paths(state)
     arrays = {f"leaf:{n}": np.asarray(jax.device_get(leaf))
               for n, leaf in zip(names, leaves)}
+    for n in names:
+        arrays[f"crc:{n}"] = np.asarray(_crc(arrays[f"leaf:{n}"]),
+                                        np.uint32)
     arrays["meta:version"] = np.asarray(FORMAT_VERSION)
     arrays["meta:config"] = np.frombuffer(
         _fingerprint(cfg).encode(), dtype=np.uint8)
     _atomic_npz(path, arrays)
 
 
+@_archive_guard
 def restore(path: str, cfg: CommunityConfig,
             fresh_candidates: bool = False) -> PeerState:
     """Load a checkpoint written by :func:`save`.
@@ -100,16 +204,17 @@ def restore(path: str, cfg: CommunityConfig,
     Re-shard the result afterwards with ``parallel.shard_state`` (the
     archive stores unsharded host arrays).
     """
-    with np.load(path) as z:
+    with _np_load(path) as z:
         version = int(z["meta:version"])
-        if version not in (7, FORMAT_VERSION):
+        if version not in _ACCEPTED_VERSIONS:
             raise CheckpointError(f"checkpoint format {version}, "
                              f"expected {FORMAT_VERSION}")
         stored_cfg = bytes(z["meta:config"]).decode()
-        if stored_cfg != _fingerprint(cfg):
+        want_fp = _want_fingerprint(cfg, version)
+        if stored_cfg != want_fp:
             raise CheckpointError(
                 "checkpoint was written under a different config:\n"
-                f"  stored: {stored_cfg}\n  given:  {_fingerprint(cfg)}")
+                f"  stored: {stored_cfg}\n  given:  {want_fp}")
         # Template provides the treedef (and validates shapes below).
         template = init_state(cfg, jax.random.PRNGKey(0))
         names, t_leaves, treedef = _leaves_with_paths(template)
@@ -117,9 +222,16 @@ def restore(path: str, cfg: CommunityConfig,
         for n, t in zip(names, t_leaves):
             key = f"leaf:{n}"
             if key not in z:
+                if version < 9 and n in _NEW_V9:
+                    # pre-chaos-harness archive: the leaf starts at its
+                    # template default (empty latch / all-good channels)
+                    leaves.append(np.asarray(t))
+                    continue
                 raise CheckpointError(f"checkpoint missing field {n}")
             arr = z[key]
-            if version < FORMAT_VERSION:
+            if version >= 9:
+                _verify_crc(z, key, arr, path)
+            if version < 8:
                 arr = _upconvert_v7(n, arr, t.dtype)
             if arr.shape != t.shape or arr.dtype != t.dtype:
                 raise CheckpointError(
@@ -153,6 +265,41 @@ def _wipe_ephemeral(state: PeerState, cfg: CommunityConfig) -> PeerState:
                 else np.asarray(state.loaded, bool)))
 
 
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True      # exists, owned by someone else
+    except OSError:
+        return True      # unknown — do not touch
+    return True
+
+
+def _clean_stale_tmps(path: str) -> None:
+    """Remove ``{path}.tmp.<pid>`` orphans left by a saver that crashed
+    between the write and the os.replace.  Only tmps whose pid is
+    provably dead are removed — a live pid may be a concurrent
+    save_sharded rank mid-write (its unique tmp is the whole point).
+    Best-effort: same-host pid semantics; cross-host shared directories
+    clean their own orphans."""
+    import glob as _glob
+
+    for old in _glob.glob(f"{path}.tmp.*"):
+        suffix = old.rsplit(".", 1)[-1]
+        try:
+            pid = int(suffix)
+        except ValueError:
+            continue
+        if pid != os.getpid() and _pid_alive(pid):
+            continue
+        try:
+            os.remove(old)
+        except OSError:
+            pass
+
+
 def _atomic_npz(path: str, arrays: dict) -> None:
     buf = io.BytesIO()
     np.savez_compressed(buf, **arrays)
@@ -160,11 +307,22 @@ def _atomic_npz(path: str, arrays: dict) -> None:
     # clean_stale=False) all write meta.npz with identical content — a
     # SHARED tmp path would let one rank's os.replace yank another's
     # file mid-write (FileNotFoundError / torn publish); unique tmps
-    # make the last replace win harmlessly.
+    # make the last replace win harmlessly.  Stale tmps from CRASHED
+    # savers are swept first (a crash between write and replace leaks
+    # the tmp forever otherwise), and our own tmp is unlinked on any
+    # failure so the leak cannot recur.
+    _clean_stale_tmps(path)
     tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:       # atomic-ish: no torn checkpoint files
-        f.write(buf.getvalue())
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "wb") as f:   # atomic-ish: no torn checkpoint files
+            f.write(buf.getvalue())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def save_sharded(dirpath: str, state: PeerState,
@@ -209,20 +367,26 @@ def save_sharded(dirpath: str, state: PeerState,
                         and getattr(leaf, "ndim", 0) >= 1
                         and leaf.shape[0] == n and n > 2)
         if not peer_sharded:
-            meta[f"leaf:{name}"] = np.asarray(jax.device_get(leaf))
+            arr = np.asarray(jax.device_get(leaf))
+            meta[f"leaf:{name}"] = arr
+            meta[f"crc:{name}"] = np.asarray(_crc(arr), np.uint32)
             continue
         for sh in leaf.addressable_shards:
             sl = sh.index[0] if sh.index else slice(None)
             lo = 0 if sl.start is None else int(sl.start)
             hi = n if sl.stop is None else int(sl.stop)
-            per_dev.setdefault(sh.device.id, {})[
-                f"leaf:{name}:rows{lo}_{hi}"] = np.asarray(sh.data)
+            arr = np.asarray(sh.data)
+            dev = per_dev.setdefault(sh.device.id, {})
+            dev[f"leaf:{name}:rows{lo}_{hi}"] = arr
+            dev[f"crc:{name}:rows{lo}_{hi}"] = np.asarray(_crc(arr),
+                                                         np.uint32)
     _atomic_npz(os.path.join(dirpath, "meta.npz"), meta)
     for dev_id, arrays in per_dev.items():
         _atomic_npz(os.path.join(dirpath, f"shard_{dev_id:05d}.npz"),
                     arrays)
 
 
+@_archive_guard
 def restore_sharded(dirpath: str, cfg: CommunityConfig,
                     fresh_candidates: bool = False) -> PeerState:
     """Reassemble a :func:`save_sharded` checkpoint (any mesh shape).
@@ -234,16 +398,21 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
     """
     import glob as _glob
 
-    with np.load(os.path.join(dirpath, "meta.npz")) as z:
+    with _np_load(os.path.join(dirpath, "meta.npz")) as z:
         version = int(z["meta:version"])
-        if version not in (7, FORMAT_VERSION):
+        if version not in _ACCEPTED_VERSIONS:
             raise CheckpointError(f"checkpoint format {version}, "
                              f"expected {FORMAT_VERSION}")
         stored_cfg = bytes(z["meta:config"]).decode()
-        if stored_cfg != _fingerprint(cfg):
+        want_fp = _want_fingerprint(cfg, version)
+        if stored_cfg != want_fp:
             raise CheckpointError(
                 "checkpoint was written under a different config:\n"
-                f"  stored: {stored_cfg}\n  given:  {_fingerprint(cfg)}")
+                f"  stored: {stored_cfg}\n  given:  {want_fp}")
+        if version >= 9:
+            for k in z.files:
+                if k.startswith("leaf:"):
+                    _verify_crc(z, k, z[k], "meta.npz")
         meta_leaves = {k[len("leaf:"):]: z[k] for k in z.files
                       if k.startswith("leaf:")}
     template = init_state(cfg, jax.random.PRNGKey(0))
@@ -256,8 +425,12 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
             filled[name] = np.empty(t.shape, t.dtype)
             covered[name] = np.zeros((n,), bool)
     for spath in sorted(_glob.glob(os.path.join(dirpath, "shard_*.npz"))):
-        with np.load(spath) as z:
+        with _np_load(spath) as z:
             for key in z.files:
+                if not key.startswith("leaf:"):
+                    continue
+                if version >= 9:
+                    _verify_crc(z, key, z[key], os.path.basename(spath))
                 body = key[len("leaf:"):]
                 name, _, rng_part = body.rpartition(":rows")
                 lo, hi = (int(x) for x in rng_part.split("_"))
@@ -265,7 +438,7 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
                     raise CheckpointError(f"{spath}: unknown leaf {name}")
                 arr = z[key]
                 want = filled[name]
-                if version < FORMAT_VERSION:
+                if version < 8:
                     arr = _upconvert_v7(name, arr, want.dtype)
                 if arr.shape[1:] != want.shape[1:] or arr.dtype != want.dtype:
                     raise CheckpointError(
@@ -278,13 +451,16 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
     for name, t in zip(names, t_leaves):
         if name in meta_leaves:
             arr = meta_leaves[name]
-            if version < FORMAT_VERSION:
+            if version < 8:
                 arr = _upconvert_v7(name, arr, t.dtype)
             if arr.shape != t.shape or arr.dtype != t.dtype:
                 raise CheckpointError(
                     f"field {name}: checkpoint {arr.shape}/{arr.dtype} vs "
                     f"config {t.shape}/{t.dtype}")
             leaves.append(arr)
+        elif version < 9 and name in _NEW_V9 and not covered[name].any():
+            # pre-chaos-harness archive: template default (state.py)
+            leaves.append(np.asarray(t))
         else:
             if not covered[name].all():
                 missing = int((~covered[name]).sum())

@@ -19,6 +19,7 @@ import dataclasses
 import math
 
 from dispersy_tpu.exceptions import ConfigError
+from dispersy_tpu.faults import FaultModel
 
 # Sentinel for "empty slot" in uint32 record fields: sorts after every real
 # global_time, so ascending sort pushes holes to the end of the store ring.
@@ -504,6 +505,15 @@ class CommunityConfig:
     # -1 = auto: the first non-tracker peer (index n_trackers).
     founder_member: int = -1
 
+    # ---- correlated fault channel + health sentinels (the chaos
+    #      harness — dispersy_tpu/faults.py: Gilbert–Elliott bursty
+    #      loss, region partitions, duplication, corruption, byzantine
+    #      flooders, on-device health bits).  All-defaults compiles to
+    #      exactly the fault-free step (FAULTS.md).  MUST stay the LAST
+    #      field: checkpoint.py reconstructs pre-v9 config fingerprints
+    #      by stripping the trailing ``faults=...`` repr component. ----
+    faults: FaultModel = FaultModel()
+
     # ------------------------------------------------------------------
     @property
     def bloom_bits(self) -> int:
@@ -766,6 +776,28 @@ class CommunityConfig:
             raise ConfigError("identity_required gates on stored "
                               "dispersy-identity records — set "
                               "identity_enabled and create_identities first")
+        fm = self.faults
+        if not isinstance(fm, FaultModel):
+            raise ConfigError("faults must be a FaultModel")
+        for (a_lo, a_hi), (b_lo, b_hi) in fm.partitions:
+            if a_hi > self.n_peers or b_hi > self.n_peers:
+                raise ConfigError(
+                    f"partition ranges must stay inside [0, {self.n_peers})")
+            if not (a_hi <= b_lo or b_hi <= a_lo):
+                raise ConfigError(
+                    f"partition sides [{a_lo},{a_hi}) and [{b_lo},{b_hi}) "
+                    "overlap — a peer on both sides would be cut off from "
+                    "its own side; sides must be disjoint")
+        if fm.flood_enabled:
+            if any(s >= self.n_peers for s in fm.flood_senders):
+                raise ConfigError("flood_senders must be peer indices "
+                                  f"< n_peers ({self.n_peers})")
+            if self.n_peers <= self.n_trackers:
+                raise ConfigError("flooding needs at least one non-tracker "
+                                  "victim")
+            if self.push_inbox < 1:
+                raise ConfigError("flooding rides the push channel: "
+                                  "push_inbox must be >= 1")
         if self.identity_requests:
             if not self.identity_required:
                 raise ConfigError("identity_requests without "

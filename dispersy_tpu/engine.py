@@ -67,7 +67,10 @@ from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_META, EMPTY_U32,
                                  SIGNATURE_REQUEST_BYTES,
                                  SIGNATURE_RESPONSE_BYTES, CommunityConfig,
                                  user_perm_mask)
+from dispersy_tpu.faults import (HEALTH_BLOOM_SAT, HEALTH_COUNTER_WRAP,
+                                 HEALTH_INBOX_DROP, HEALTH_STORE_INVARIANT)
 from dispersy_tpu.ops import bloom, candidates as cand, inbox, rng, store as st
+from dispersy_tpu.ops import faults as flt
 from dispersy_tpu.ops import intake as ik
 from dispersy_tpu.ops import timeline as tl
 from dispersy_tpu.ops.hashing import record_hash
@@ -95,15 +98,41 @@ _LOSS_ID_REQ = 14 << 16
 _LOSS_ID_RESP = 15 << 16
 _TRACKER_SALT = 1 << 15
 _TRACKER_INTRO_SALT = 1 << 20
+# Chaos-harness salt blocks (dispersy_tpu/faults.py): flood sends draw
+# loss from their own block; corruption/duplication draws use dedicated
+# PURPOSES (P_CORRUPT/P_DUP) with one sub-block per delivery channel.
+_LOSS_FLOOD = 16 << 16
+_FAULT_SYNC = 0 << 16
+_FAULT_PUSH = 1 << 16
 
 
-def _lost(seed, rnd, edge_peer, salt_base, salt, p_loss: float):
-    if p_loss <= 0.0:
+def _lost(seed, rnd, edge_peer, salt_base, salt, cfg: CommunityConfig,
+          ge_bad):
+    """Per-packet delivery-loss draw: the base i.i.d. Bernoulli
+    (``cfg.packet_loss``) ORed with the Gilbert–Elliott state-dependent
+    loss (``cfg.faults.ge_*``).  The GE channel belongs to ``edge_peer``
+    — the same peer the base draw has always been keyed on at each call
+    site: the sender's uplink on sends, the receiver's downlink on
+    receipt pickups (FAULTS.md).  Both draws come from independent
+    counter streams (P_LOSS vs P_GE_LOSS) so enabling GE never perturbs
+    the base-loss sequence."""
+    fm = cfg.faults
+    out = None
+    if cfg.packet_loss > 0.0:
+        u = rng.rand_uniform(seed, rnd, edge_peer, rng.P_LOSS,
+                             jnp.asarray(salt) + salt_base)
+        out = u < cfg.packet_loss
+    if fm.ge_enabled:
+        p = jnp.where(ge_bad[edge_peer], jnp.float32(fm.ge_loss_bad),
+                      jnp.float32(fm.ge_loss_good))
+        ug = rng.rand_uniform(seed, rnd, edge_peer, rng.P_GE_LOSS,
+                              jnp.asarray(salt) + salt_base)
+        g = ug < p
+        out = g if out is None else out | g
+    if out is None:
         return jnp.zeros(jnp.broadcast_shapes(
             jnp.shape(edge_peer), jnp.shape(salt)), bool)
-    u = rng.rand_uniform(seed, rnd, edge_peer, rng.P_LOSS,
-                         jnp.asarray(salt) + salt_base)
-    return u < p_loss
+    return out
 
 
 def _tab(state: PeerState) -> cand.CandTable:
@@ -409,6 +438,25 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     rnd = state.round_index
     now = state.time
     stats = state.stats
+    # Chaos harness (dispersy_tpu/faults.py): every fault branch below is
+    # gated on a STATIC FaultModel knob, so all-zero knobs compile to the
+    # identical fault-free round (FAULTS.md; BENCH.md fault-knob note).
+    fm = cfg.faults
+    if fm.ge_enabled:
+        # Advance each peer's Gilbert–Elliott channel once per round;
+        # this round's loss draws condition on the post-transition state.
+        ge_bad = flt.ge_advance(state.ge_bad, seed, rnd, idx,
+                                fm.ge_p_bad, fm.ge_p_good)
+    else:
+        ge_bad = state.ge_bad
+    if fm.health_checks:
+        # Round-start drop counter: the inbox-overload sentinel compares
+        # this round's delta against health_drop_limit at wrap-up.  Both
+        # bounded-queue families count — request-inbox overflow AND
+        # push/store drops (msgs_dropped — where a byzantine flood
+        # lands, since junk saturates the push inbox, not the request
+        # ring).  u32 sums/deltas are wrap-safe.
+        rd0 = state.stats.requests_dropped + state.stats.msgs_dropped
     # Byte-equivalent traffic accounting (endpoint.py total_up/total_down):
     # accumulated per site below, folded into stats at wrap-up.  Sends
     # count pre-loss (sendto), receipts per accepted inbox slot (recvfrom).
@@ -486,6 +534,14 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                state.sig_gt, state.sig_since)
         mal = state.mal_member
         global_time, session = state.global_time, state.session
+
+    if fm.health_checks and cfg.churn_rate > 0.0:
+        # A churn rebirth is a wiped-disk restart: the new process starts
+        # with a clean health latch (the GE channel state is the LINK's,
+        # not the process's — it survives, like the NAT type).
+        health = jnp.where(reborn, jnp.uint32(0), state.health)
+    else:
+        health = state.health
 
     alive = state.alive
     # Community load state (reference: dispersy.py define_auto_load /
@@ -592,71 +648,177 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     # candidates — the epidemic *push* on top of Bloom-sync's pull.  One
     # candidate set per peer per round, shared by the whole batch, exactly
     # like the reference's per-batch candidate pick.
-    if cfg.forward_fanout > 0:
-        f, c = cfg.forward_buffer, cfg.forward_fanout
-        fwd_targets = cand.sample_forward_targets(tab, now, cfg, seed, rnd,
-                                                  idx)          # [N, C]
-        fwd_gt, fwd_member, fwd_meta, fwd_payload, fwd_aux = fwd
-        have_rec = (fwd_gt != jnp.uint32(EMPTY_U32))[:, :, None]  # [N, F, 1]
-        tgt_ok = (fwd_targets != NO_PEER)[:, None, :]             # [N, 1, C]
-        fc_salt = (jnp.arange(f)[:, None] * c
-                   + jnp.arange(c)[None, :])[None, :, :]          # [1, F, C]
-        push_lost = _lost(seed, rnd, idx[:, None, None], _LOSS_FORWARD,
-                          fc_salt, cfg.packet_loss)
-        if cfg.timeline_enabled:
-            # A hard-killed peer pushes NOTHING except destroy records —
-            # HardKilledCommunity actively spreads the kill (the creator
-            # itself is killed the instant its own destroy stores, so
-            # without this the record would never leave the founder).
-            send_rec_ok = (act[:, None]
-                           & (~killed[:, None]
-                              | (fwd_meta == jnp.uint32(META_DESTROY))
-                              ))[:, :, None]                  # [N, F, 1]
-        else:
-            send_rec_ok = act[:, None, None]
-        push_valid = send_rec_ok & have_rec & tgt_ok & ~push_lost
-        push_dst = jnp.broadcast_to(fwd_targets[:, None, :], (n, f, c))
+    if cfg.forward_fanout > 0 or fm.flood_enabled:
+        # Edge-list segments: the real push fan-out, then (flood_enabled)
+        # the byzantine junk blast.  One deliver call serves both — junk
+        # competes for the same bounded victim inboxes, which IS the
+        # saturation attack (FAULTS.md).
+        e_dst, e_valid = [], []
+        e_cols: list[list] = [[] for _ in range(5)]
+        e_src, e_junk = [], []
+        if cfg.forward_fanout > 0:
+            f, c = cfg.forward_buffer, cfg.forward_fanout
+            fwd_targets = cand.sample_forward_targets(tab, now, cfg, seed,
+                                                      rnd, idx)   # [N, C]
+            fwd_gt, fwd_member, fwd_meta, fwd_payload, fwd_aux = fwd
+            have_rec = (fwd_gt != jnp.uint32(EMPTY_U32))[:, :, None]
+            tgt_ok = (fwd_targets != NO_PEER)[:, None, :]         # [N, 1, C]
+            fc_salt = (jnp.arange(f)[:, None] * c
+                       + jnp.arange(c)[None, :])[None, :, :]      # [1, F, C]
+            push_lost = _lost(seed, rnd, idx[:, None, None], _LOSS_FORWARD,
+                              fc_salt, cfg, ge_bad)
+            if cfg.timeline_enabled:
+                # A hard-killed peer pushes NOTHING except destroy records
+                # — HardKilledCommunity actively spreads the kill (the
+                # creator itself is killed the instant its own destroy
+                # stores, so without this the record would never leave
+                # the founder).
+                send_rec_ok = (act[:, None]
+                               & (~killed[:, None]
+                                  | (fwd_meta == jnp.uint32(META_DESTROY))
+                                  ))[:, :, None]              # [N, F, 1]
+            else:
+                send_rec_ok = act[:, None, None]
+            push_valid = send_rec_ok & have_rec & tgt_ok & ~push_lost
+            push_dst = jnp.broadcast_to(fwd_targets[:, None, :], (n, f, c))
+            if fm.partitions:
+                push_valid = push_valid & ~flt.partition_blocked(
+                    jnp.broadcast_to(idx[:, None, None], (n, f, c)),
+                    push_dst, fm.partitions)
 
-        def bcast(col):
-            return jnp.broadcast_to(col[:, :, None], (n, f, c)).reshape(-1)
-        push_cols = [bcast(fwd_gt), bcast(fwd_member), bcast(fwd_meta),
-                     bcast(fwd_payload), bcast(fwd_aux)]
-        if cfg.delay_enabled:
+            def bcast(col):
+                return jnp.broadcast_to(col[:, :, None],
+                                        (n, f, c)).reshape(-1)
+            e_dst.append(push_dst.reshape(-1))
+            e_valid.append(push_valid.reshape(-1))
+            for e_col, col in zip(e_cols, (fwd_gt, fwd_member, fwd_meta,
+                                           fwd_payload, fwd_aux)):
+                e_col.append(bcast(col))
             # The pen tracks each record's deliverer (the missing-proof
             # request target), so pushes carry their sender.
-            push_cols.append(jnp.broadcast_to(
+            e_src.append(jnp.broadcast_to(
                 idx[:, None, None].astype(jnp.uint32), (n, f, c)).reshape(-1))
+            e_junk.append(jnp.zeros((n * f * c,), bool))
+        if fm.flood_enabled:
+            fsrc = jnp.asarray(fm.flood_senders, jnp.int32)       # [L]
+            fl, ff = len(fm.flood_senders), fm.flood_fanout
+            fsalt = jnp.arange(ff)[None, :]                       # [1, Ff]
+            victims = (jnp.int32(t) + (
+                rng.rand_u32(seed, rnd, fsrc[:, None], rng.P_FLOOD, fsalt)
+                % jnp.uint32(n - t)).astype(jnp.int32))           # [L, Ff]
+
+            def junk_field(block):
+                return rng.rand_u32(seed, rnd, fsrc[:, None], rng.P_FLOOD,
+                                    fsalt + (block << 12))
+            alive_f = alive[fsrc]
+            fl_lost = _lost(seed, rnd, fsrc[:, None], _LOSS_FLOOD, fsalt,
+                            cfg, ge_bad)
+            fl_valid = alive_f[:, None] & ~fl_lost
+            if fm.partitions:
+                fl_valid = fl_valid & ~flt.partition_blocked(
+                    jnp.broadcast_to(fsrc[:, None], (fl, ff)), victims,
+                    fm.partitions)
+            e_dst.append(victims.reshape(-1))
+            e_valid.append(fl_valid.reshape(-1))
+            e_cols[0].append(junk_field(1).reshape(-1))           # gt
+            e_cols[1].append(junk_field(2).reshape(-1))           # member
+            e_cols[2].append((junk_field(3)
+                              & jnp.uint32(0xFF)).astype(
+                                  jnp.uint8).reshape(-1))         # meta
+            e_cols[3].append(junk_field(4).reshape(-1))           # payload
+            e_cols[4].append(junk_field(5).reshape(-1))           # aux
+            e_src.append(jnp.broadcast_to(fsrc[:, None].astype(jnp.uint32),
+                                          (fl, ff)).reshape(-1))
+            e_junk.append(jnp.ones((fl * ff,), bool))
+            # The flooder pays sendto bytes for every blast, pre-loss
+            # (byzantine or not, its NIC moves the packets).
+            bup = bup.at[fsrc].add(
+                jnp.where(alive_f, jnp.uint32(ff * RECORD_BYTES),
+                          jnp.uint32(0)), mode="drop")
+        push_cols = [jnp.concatenate(cl) for cl in e_cols]
+        if cfg.delay_enabled:
+            push_cols.append(jnp.concatenate(e_src))
+        if fm.flood_enabled:
+            push_cols.append(jnp.concatenate(e_junk))
         push = inbox.deliver(
-            dst=push_dst.reshape(-1), cols=push_cols,
-            valid=push_valid.reshape(-1), n_peers=n,
+            dst=jnp.concatenate(e_dst), cols=push_cols,
+            valid=jnp.concatenate(e_valid), n_peers=n,
             inbox_size=cfg.push_inbox)
         ph_gt, ph_member, ph_meta, ph_payload, ph_aux = push.inbox[:5]
-        arrivals = arrivals | jnp.any(push.inbox_valid, axis=1)
+        if fm.flood_enabled:
+            ph_junk = push.inbox[-1]                              # bool[N, Q]
+            # Junk never decodes, so it never auto-loads a community
+            # (reference: define_auto_load fires on decoded packets).
+            arrivals = arrivals | jnp.any(push.inbox_valid & ~ph_junk,
+                                          axis=1)
+        else:
+            arrivals = arrivals | jnp.any(push.inbox_valid, axis=1)
         ph_ok = push.inbox_valid & act[:, None]
+        if cfg.forward_fanout > 0:
+            stats = stats.replace(
+                msgs_forwarded=stats.msgs_forwarded
+                + jnp.sum(push_valid, axis=(1, 2)).astype(jnp.uint32),
+                msgs_dropped=stats.msgs_dropped
+                + push.n_dropped.astype(jnp.uint32))
+            push_sent = send_rec_ok & have_rec & tgt_ok          # pre-loss
+            bup = bup + jnp.sum(push_sent, axis=(1, 2)).astype(jnp.uint32) \
+                * jnp.uint32(RECORD_BYTES)
+        else:
+            stats = stats.replace(
+                msgs_dropped=stats.msgs_dropped
+                + push.n_dropped.astype(jnp.uint32))
+        # recvfrom: every delivered packet (junk included) crosses the
+        # receiver's socket before the hash check can reject it.
+        bdown = bdown + jnp.sum(ph_ok, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(RECORD_BYTES)
+        if fm.flood_enabled or fm.corrupt_rate > 0.0:
+            # Intake hash re-verification (modeled): flood junk always
+            # fails it; real records fail with corrupt_rate.  Either way
+            # the record is DROPPED and counted — never ingested as
+            # garbage (FAULTS.md).
+            q_sz = ph_ok.shape[1]
+            bad = jnp.zeros_like(ph_ok)
+            if fm.flood_enabled:
+                bad = bad | (ph_ok & ph_junk)
+            if fm.corrupt_rate > 0.0:
+                cu = rng.rand_uniform(
+                    seed, rnd, idx[:, None], rng.P_CORRUPT,
+                    jnp.arange(q_sz)[None, :] + _FAULT_PUSH)
+                bad = bad | (ph_ok & (cu < jnp.float32(fm.corrupt_rate)))
+            stats = stats.replace(
+                msgs_corrupt_dropped=stats.msgs_corrupt_dropped
+                + jnp.sum(bad, axis=1).astype(jnp.uint32))
+            ph_ok = ph_ok & ~bad
         if cfg.delay_enabled:
             ph_src = jnp.where(ph_ok, push.inbox[5].astype(jnp.int32),
                                NO_PEER)
-        stats = stats.replace(
-            msgs_forwarded=stats.msgs_forwarded
-            + jnp.sum(push_valid, axis=(1, 2)).astype(jnp.uint32),
-            msgs_dropped=stats.msgs_dropped
-            + push.n_dropped.astype(jnp.uint32))
-        push_sent = send_rec_ok & have_rec & tgt_ok              # pre-loss
-        bup = bup + jnp.sum(push_sent, axis=(1, 2)).astype(jnp.uint32) \
-            * jnp.uint32(RECORD_BYTES)
-        bdown = bdown + jnp.sum(ph_ok, axis=1).astype(jnp.uint32) \
-            * jnp.uint32(RECORD_BYTES)
+        if fm.dup_rate > 0.0:
+            # Delivery duplication: a clean delivered push arrives twice
+            # (the duplicate joins the intake batch's tail segment).
+            du = rng.rand_uniform(
+                seed, rnd, idx[:, None], rng.P_DUP,
+                jnp.arange(ph_ok.shape[1])[None, :] + _FAULT_PUSH)
+            ph_dup_ok = ph_ok & (du < jnp.float32(fm.dup_rate))
+            bdown = bdown + jnp.sum(ph_dup_ok, axis=1).astype(jnp.uint32) \
+                * jnp.uint32(RECORD_BYTES)
     else:
         p0 = jnp.zeros((n, 0), jnp.uint32)
         ph_gt = ph_member = ph_payload = ph_aux = p0
         ph_meta = jnp.zeros((n, 0), jnp.uint8)
         ph_ok = jnp.zeros((n, 0), bool)
         ph_src = jnp.zeros((n, 0), jnp.int32)
+        ph_dup_ok = jnp.zeros((n, 0), bool)
 
-    req_lost = _lost(seed, rnd, idx, _LOSS_REQUEST, 0, cfg.packet_loss)
+    req_lost = _lost(seed, rnd, idx, _LOSS_REQUEST, 0, cfg, ge_bad)
     # target is already NO_PEER for dead/tracker/killed peers (phase 1).
     bup = bup + (act & (target != NO_PEER)).astype(jnp.uint32) * req_bytes
     send_ok = act & (target != NO_PEER) & ~req_lost
+    if fm.partitions:
+        # A partitioned walk edge never delivers (loss with p=1): the
+        # whole request/response/sync exchange dies with the request,
+        # since partitions sever both directions.
+        send_ok = send_ok & ~flt.partition_blocked(idx, target,
+                                                   fm.partitions)
     to_tracker = (target >= 0) & (target < t)
     # Every request packet carries the sender's clock *as of round start*:
     # the tracker delivery below must not read a clock already raised by
@@ -805,18 +967,28 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     # puncture-request edges: responder -> C, naming the requester.
     salt_r = jnp.arange(r)[None, :]
     pr_lost = _lost(seed, rnd, idx[:, None], _LOSS_PUNCTURE_REQ, salt_r,
-                    cfg.packet_loss)
+                    cfg, ge_bad)
+    pr_ok_send = rq_ok & (intro != NO_PEER) & ~pr_lost
+    if fm.partitions:
+        pr_ok_send = pr_ok_send & ~flt.partition_blocked(
+            jnp.broadcast_to(idx[:, None], intro.shape), intro,
+            fm.partitions)
     pr_dst = [intro.reshape(-1)]
     pr_target = [rq_src_i.reshape(-1).astype(jnp.uint32)]
-    pr_valid = [(rq_ok & (intro != NO_PEER) & ~pr_lost).reshape(-1)]
+    pr_valid = [pr_ok_send.reshape(-1)]
 
     if t > 0:
         salt_rt = jnp.arange(rt)[None, :] + _TRACKER_SALT
         tpr_lost = _lost(seed, rnd, tidx[:, None], _LOSS_PUNCTURE_REQ, salt_rt,
-                         cfg.packet_loss)
+                         cfg, ge_bad)
+        tpr_ok_send = tq_ok & (intro_t != NO_PEER) & ~tpr_lost
+        if fm.partitions:
+            tpr_ok_send = tpr_ok_send & ~flt.partition_blocked(
+                jnp.broadcast_to(tidx[:, None], intro_t.shape), intro_t,
+                fm.partitions)
         pr_dst.append(intro_t.reshape(-1))
         pr_target.append(tq_src_i.reshape(-1).astype(jnp.uint32))
-        pr_valid.append((tq_ok & (intro_t != NO_PEER) & ~tpr_lost).reshape(-1))
+        pr_valid.append(tpr_ok_send.reshape(-1))
 
     punc_req = inbox.deliver(
         dst=jnp.concatenate(pr_dst), cols=[jnp.concatenate(pr_target)],
@@ -839,8 +1011,12 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     p = cfg.request_inbox
     salt_p = jnp.arange(p)[None, :]
     pu_lost = _lost(seed, rnd, idx[:, None], _LOSS_PUNCTURE, salt_p,
-                    cfg.packet_loss)
+                    cfg, ge_bad)
     pu_ok_send = pq_ok & ~pu_lost
+    if fm.partitions:
+        pu_ok_send = pu_ok_send & ~flt.partition_blocked(
+            jnp.broadcast_to(idx[:, None], pq_target.shape),
+            pq_target.astype(jnp.int32), fm.partitions)
     if nat_sym is not None:
         # Two address-dependent NATs cannot hole-punch: a puncture from a
         # symmetric C toward a symmetric requester never lands (modeled
@@ -881,7 +1057,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         intro_pick = jnp.where(to_tracker, intro_t[tgt_t, slot_t], intro_n)
     else:
         got_raw, intro_pick = got_n, intro_n
-    resp_lost = _lost(seed, rnd, idx, _LOSS_RESPONSE, 0, cfg.packet_loss)
+    resp_lost = _lost(seed, rnd, idx, _LOSS_RESPONSE, 0, cfg, ge_bad)
     got_resp = got_raw & ~resp_lost & act
     bdown = bdown + got_resp.astype(jnp.uint32) \
         * jnp.uint32(INTRO_RESPONSE_BYTES)
@@ -926,13 +1102,17 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     if cfg.double_meta_mask:
         s_sz = cfg.sig_inbox
         sending = act & ~killed & (sg_target != NO_PEER) & (sg_since == rnd)
-        srq_lost = _lost(seed, rnd, idx, _LOSS_SIGREQ, 0, cfg.packet_loss)
+        srq_lost = _lost(seed, rnd, idx, _LOSS_SIGREQ, 0, cfg, ge_bad)
         bup = bup + sending.astype(jnp.uint32) \
             * jnp.uint32(SIGNATURE_REQUEST_BYTES)
+        sig_send_ok = sending & ~srq_lost
+        if fm.partitions:
+            sig_send_ok = sig_send_ok & ~flt.partition_blocked(
+                idx, sg_target, fm.partitions)
         sreq = inbox.deliver(
             dst=jnp.where(sending, sg_target, NO_PEER),
             cols=[idx.astype(jnp.uint32), sg_meta, sg_payload, sg_gt],
-            valid=sending & ~srq_lost, n_peers=n, inbox_size=s_sz)
+            valid=sig_send_ok, n_peers=n, inbox_size=s_sz)
         sq_src, sq_meta, sq_payload, sq_gt = sreq.inbox          # [N, S]
         arrivals = arrivals | jnp.any(sreq.inbox_valid, axis=1)
         # Trackers never countersign (infrastructure, not members); neither
@@ -984,7 +1164,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         tgt_a = jnp.maximum(jnp.where(sending, sg_target, 0), 0)
         slot_a = jnp.maximum(sreq.edge_slot, 0)
         got_sig = (sreq.edge_slot >= 0) & countersign[tgt_a, slot_a]
-        srs_lost = _lost(seed, rnd, idx, _LOSS_SIGRESP, 0, cfg.packet_loss)
+        srs_lost = _lost(seed, rnd, idx, _LOSS_SIGRESP, 0, cfg, ge_bad)
         completed = sending & got_sig & ~srs_lost
         bdown = bdown + completed.astype(jnp.uint32) \
             * jnp.uint32(SIGNATURE_RESPONSE_BYTES)
@@ -1096,18 +1276,36 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         sy_gt, sy_member, sy_meta, sy_payload, sy_aux = (
             c[tgt, slot_n] for c in obox)                         # [N, b]
         sync_lost = _lost(seed, rnd, idx[:, None], _LOSS_SYNC,
-                          jnp.arange(b)[None, :], cfg.packet_loss)
+                          jnp.arange(b)[None, :], cfg, ge_bad)
         sy_ok = (obox_ok[tgt, slot_n] & (req.edge_slot >= 0)[:, None]
                  & act[:, None] & ~sync_lost)
         bup = bup + jnp.sum(obox_ok, axis=(1, 2)).astype(jnp.uint32) \
             * jnp.uint32(RECORD_BYTES)
         bdown = bdown + jnp.sum(sy_ok, axis=1).astype(jnp.uint32) \
             * jnp.uint32(RECORD_BYTES)
+        if fm.corrupt_rate > 0.0:
+            # In-transit bit-flip: the record crossed the socket (bytes
+            # counted above) but fails the intake hash re-check — dropped
+            # and counted, never ingested (FAULTS.md).
+            cu = rng.rand_uniform(seed, rnd, idx[:, None], rng.P_CORRUPT,
+                                  jnp.arange(b)[None, :] + _FAULT_SYNC)
+            sy_bad = sy_ok & (cu < jnp.float32(fm.corrupt_rate))
+            stats = stats.replace(
+                msgs_corrupt_dropped=stats.msgs_corrupt_dropped
+                + jnp.sum(sy_bad, axis=1).astype(jnp.uint32))
+            sy_ok = sy_ok & ~sy_bad
+        if fm.dup_rate > 0.0:
+            du = rng.rand_uniform(seed, rnd, idx[:, None], rng.P_DUP,
+                                  jnp.arange(b)[None, :] + _FAULT_SYNC)
+            sy_dup_ok = sy_ok & (du < jnp.float32(fm.dup_rate))
+            bdown = bdown + jnp.sum(sy_dup_ok, axis=1).astype(jnp.uint32) \
+                * jnp.uint32(RECORD_BYTES)
     else:
         s0 = jnp.zeros((n, 0), jnp.uint32)
         sy_gt = sy_member = sy_payload = sy_aux = s0
         sy_meta = jnp.zeros((n, 0), jnp.uint8)
         sy_ok = jnp.zeros((n, 0), bool)
+        sy_dup_ok = jnp.zeros((n, 0), bool)
 
     if cfg.delay_enabled:
         dl_gt, dl_member, dl_meta, dl_payload, dl_aux, dl_since, dl_src = dly
@@ -1134,12 +1332,17 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         dd_, pb = cfg.delay_inbox, cfg.proof_budget
         have_pen = dl_ok & (dl_src != NO_PEER)                  # [N, D]
         prq_lost = _lost(seed, rnd, idx[:, None], _LOSS_PROOF_REQ,
-                         jnp.arange(dd_)[None, :], cfg.packet_loss)
+                         jnp.arange(dd_)[None, :], cfg, ge_bad)
         bup = bup + jnp.sum(have_pen, axis=1).astype(jnp.uint32) \
             * jnp.uint32(MISSING_PROOF_BYTES)
+        pen_send = have_pen & ~prq_lost
+        if fm.partitions:
+            pen_send = pen_send & ~flt.partition_blocked(
+                jnp.broadcast_to(idx[:, None], dl_src.shape), dl_src,
+                fm.partitions)
         preq = inbox.deliver(
             dst=dl_src.reshape(-1), cols=[dl_member.reshape(-1)],
-            valid=(have_pen & ~prq_lost).reshape(-1), n_peers=n,
+            valid=pen_send.reshape(-1), n_peers=n,
             inbox_size=cfg.proof_inbox)
         (pq_author,) = preq.inbox                               # [N, Pi]
         arrivals = arrivals | jnp.any(preq.inbox_valid, axis=1)
@@ -1188,7 +1391,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         pr_gt, pr_member, pr_meta, pr_payload, pr_aux = (
             pick(c) for c in pbox[:5])
         prs_lost = _lost(seed, rnd, idx[:, None], _LOSS_PROOF_RESP,
-                         jnp.arange(dd_ * pb)[None, :], cfg.packet_loss)
+                         jnp.arange(dd_ * pb)[None, :], cfg, ge_bad)
         pr_ok = (pick(pbox[5])
                  & jnp.repeat(got, pb, axis=1)
                  & act[:, None] & ~prs_lost)
@@ -1231,14 +1434,19 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         want = (dl_ok & (dl_src != NO_PEER) & dl_is_seq
                 & (sq_low <= sq_high))                      # [N, D]
         mrq_lost = _lost(seed, rnd, idx[:, None], _LOSS_SEQ_REQ,
-                         jnp.arange(dd_)[None, :], cfg.packet_loss)
+                         jnp.arange(dd_)[None, :], cfg, ge_bad)
         bup = bup + jnp.sum(want, axis=1).astype(jnp.uint32) \
             * jnp.uint32(MISSING_SEQ_BYTES)
+        seq_send = want & ~mrq_lost
+        if fm.partitions:
+            seq_send = seq_send & ~flt.partition_blocked(
+                jnp.broadcast_to(idx[:, None], dl_src.shape), dl_src,
+                fm.partitions)
         qreq = inbox.deliver(
             dst=dl_src.reshape(-1),
             cols=[dl_member.reshape(-1), dl_meta.reshape(-1),
                   sq_low.reshape(-1), sq_high.reshape(-1)],
-            valid=(want & ~mrq_lost).reshape(-1), n_peers=n,
+            valid=seq_send.reshape(-1), n_peers=n,
             inbox_size=cfg.proof_inbox)
         qq_member, qq_meta, qq_low, qq_high = qreq.inbox    # [N, Qi]
         arrivals = arrivals | jnp.any(qreq.inbox_valid, axis=1)
@@ -1288,7 +1496,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         mq_gt, mq_member, mq_meta, mq_payload, mq_aux = (
             qpick(c) for c in qbox[:5])
         mqs_lost = _lost(seed, rnd, idx[:, None], _LOSS_SEQ_RESP,
-                         jnp.arange(dd_ * qb)[None, :], cfg.packet_loss)
+                         jnp.arange(dd_ * qb)[None, :], cfg, ge_bad)
         mq_ok = (qpick(qbox[5])
                  & jnp.repeat(qgot, qb, axis=1)
                  & act[:, None] & ~mqs_lost)
@@ -1322,13 +1530,18 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         want_mm = (dl_ok & (dl_src != NO_PEER)
                    & (dl_meta == jnp.uint32(META_UNDO_OTHER)))   # [N, D]
         mmq_lost = _lost(seed, rnd, idx[:, None], _LOSS_MSG_REQ,
-                         jnp.arange(dd_)[None, :], cfg.packet_loss)
+                         jnp.arange(dd_)[None, :], cfg, ge_bad)
         bup = bup + jnp.sum(want_mm, axis=1).astype(jnp.uint32) \
             * jnp.uint32(MISSING_MSG_BYTES)
+        mm_send = want_mm & ~mmq_lost
+        if fm.partitions:
+            mm_send = mm_send & ~flt.partition_blocked(
+                jnp.broadcast_to(idx[:, None], dl_src.shape), dl_src,
+                fm.partitions)
         mreq = inbox.deliver(
             dst=dl_src.reshape(-1),
             cols=[dl_payload.reshape(-1), dl_aux.reshape(-1)],
-            valid=(want_mm & ~mmq_lost).reshape(-1), n_peers=n,
+            valid=mm_send.reshape(-1), n_peers=n,
             inbox_size=cfg.proof_inbox)
         mr_member, mr_gt = mreq.inbox                            # [N, Mi]
         arrivals = arrivals | jnp.any(mreq.inbox_valid, axis=1)
@@ -1372,7 +1585,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         mm_gt, mm_member, mm_meta, mm_payload, mm_aux = (
             mpick(c[:, :, 0]) for c in mbox[:5])
         mms_lost = _lost(seed, rnd, idx[:, None], _LOSS_MSG_RESP,
-                         jnp.arange(dd_)[None, :], cfg.packet_loss)
+                         jnp.arange(dd_)[None, :], cfg, ge_bad)
         mm_ok = (mpick(mbox[5][:, :, 0]) & mgot & act[:, None] & ~mms_lost)
         mm_src = dl_src
         stats = stats.replace(
@@ -1402,12 +1615,17 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                    & (dl_meta < cfg.n_meta)
                    & ~ik.identity_stored(stc, dl_member))        # [N, D]
         idq_lost = _lost(seed, rnd, idx[:, None], _LOSS_ID_REQ,
-                         jnp.arange(dd_)[None, :], cfg.packet_loss)
+                         jnp.arange(dd_)[None, :], cfg, ge_bad)
         bup = bup + jnp.sum(want_id, axis=1).astype(jnp.uint32) \
             * jnp.uint32(MISSING_IDENTITY_BYTES)
+        id_send = want_id & ~idq_lost
+        if fm.partitions:
+            id_send = id_send & ~flt.partition_blocked(
+                jnp.broadcast_to(idx[:, None], dl_src.shape), dl_src,
+                fm.partitions)
         ireq = inbox.deliver(
             dst=dl_src.reshape(-1), cols=[dl_member.reshape(-1)],
-            valid=(want_id & ~idq_lost).reshape(-1), n_peers=n,
+            valid=id_send.reshape(-1), n_peers=n,
             inbox_size=cfg.proof_inbox)
         (iq_member,) = ireq.inbox                                # [N, Ii]
         arrivals = arrivals | jnp.any(ireq.inbox_valid, axis=1)
@@ -1448,7 +1666,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         ii_gt, ii_member, ii_meta, ii_payload, ii_aux = (
             ipick(c[:, :, 0]) for c in ibox[:5])
         iis_lost = _lost(seed, rnd, idx[:, None], _LOSS_ID_RESP,
-                         jnp.arange(dd_)[None, :], cfg.packet_loss)
+                         jnp.arange(dd_)[None, :], cfg, ge_bad)
         ii_ok = (ipick(ibox[5][:, :, 0]) & igot & act[:, None] & ~iis_lost)
         ii_src = dl_src
         stats = stats.replace(
@@ -1471,20 +1689,32 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     # records, then this round's countersigned completion, then the
     # missing-proof replies, in delivery order — mirroring the reference's
     # _on_batch_cache handling one grouped batch per meta per window.
-    in_gt = jnp.concatenate([dl_gt, sy_gt, ph_gt, db_gt, pr_gt, mq_gt,
-                             mm_gt, ii_gt], axis=1)            # [N, B]
-    in_member = jnp.concatenate([dl_member, sy_member, ph_member, db_member,
-                                 pr_member, mq_member, mm_member, ii_member],
-                                axis=1)
-    in_meta = jnp.concatenate([dl_meta, sy_meta, ph_meta, db_meta, pr_meta,
-                               mq_meta, mm_meta, ii_meta], axis=1)
-    in_payload = jnp.concatenate([dl_payload, sy_payload, ph_payload,
-                                  db_payload, pr_payload, mq_payload,
-                                  mm_payload, ii_payload], axis=1)
-    in_aux = jnp.concatenate([dl_aux, sy_aux, ph_aux, db_aux, pr_aux,
-                              mq_aux, mm_aux, ii_aux], axis=1)
-    in_ok = jnp.concatenate([dl_ok, sy_ok, ph_ok, db_ok, pr_ok, mq_ok,
-                             mm_ok, ii_ok], axis=1)
+    segs_gt = [dl_gt, sy_gt, ph_gt, db_gt, pr_gt, mq_gt, mm_gt, ii_gt]
+    segs_member = [dl_member, sy_member, ph_member, db_member, pr_member,
+                   mq_member, mm_member, ii_member]
+    segs_meta = [dl_meta, sy_meta, ph_meta, db_meta, pr_meta, mq_meta,
+                 mm_meta, ii_meta]
+    segs_payload = [dl_payload, sy_payload, ph_payload, db_payload,
+                    pr_payload, mq_payload, mm_payload, ii_payload]
+    segs_aux = [dl_aux, sy_aux, ph_aux, db_aux, pr_aux, mq_aux, mm_aux,
+                ii_aux]
+    segs_ok = [dl_ok, sy_ok, ph_ok, db_ok, pr_ok, mq_ok, mm_ok, ii_ok]
+    if fm.dup_rate > 0.0:
+        # Delivery duplicates: the same delivered sync/push records again
+        # at the batch tail, valid where the dup draw fired — the store's
+        # UNIQUE insert and in-batch dedup absorb them (FAULTS.md).
+        segs_gt += [sy_gt, ph_gt]
+        segs_member += [sy_member, ph_member]
+        segs_meta += [sy_meta, ph_meta]
+        segs_payload += [sy_payload, ph_payload]
+        segs_aux += [sy_aux, ph_aux]
+        segs_ok += [sy_dup_ok, ph_dup_ok]
+    in_gt = jnp.concatenate(segs_gt, axis=1)                   # [N, B]
+    in_member = jnp.concatenate(segs_member, axis=1)
+    in_meta = jnp.concatenate(segs_meta, axis=1)
+    in_payload = jnp.concatenate(segs_payload, axis=1)
+    in_aux = jnp.concatenate(segs_aux, axis=1)
+    in_ok = jnp.concatenate(segs_ok, axis=1)
     bb = in_gt.shape[1]
     if cfg.delay_enabled:
         # Round each batch entry was (first) delivered: pen entries keep
@@ -1505,7 +1735,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                   jnp.zeros((n, 0), jnp.int32))
         in_src = jnp.concatenate(
             [dl_src, sy_src, ph_src, db_src, pr_src, mq_src, mm_src,
-             ii_src], axis=1)
+             ii_src] + ([sy_src, ph_src] if fm.dup_rate > 0.0 else []),
+            axis=1)
     if bb > 0:
         # Clock-jump defense before the store accepts anything.
         in_ok = in_ok & (in_gt <= global_time[:, None] + jnp.uint32(
@@ -1986,9 +2217,33 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         # Any community packet that reached an unloaded peer loads its
         # instance for the next round (define_auto_load semantics).
         loaded = loaded | (arrivals & alive)
+    if fm.health_checks:
+        # On-device health sentinels (faults.HEALTH_*): latched into the
+        # `health` bitmask — graceful degradation (saturate, drop, flag)
+        # instead of silent corruption.  The host-side deep checker is
+        # faults.debug_validate; metrics.snapshot surfaces the counts.
+        hb = jnp.zeros((n,), jnp.uint32)
+        wrapped = (((stats.bytes_up + bup) < stats.bytes_up)
+                   | ((stats.bytes_down + bdown) < stats.bytes_down))
+        hb = hb | jnp.where(wrapped, jnp.uint32(HEALTH_COUNTER_WRAP),
+                            jnp.uint32(0))
+        hb = hb | jnp.where(
+            flt.store_invariant_violated(stc.gt, stc.member),
+            jnp.uint32(HEALTH_STORE_INVARIANT), jnp.uint32(0))
+        drop_delta = (stats.requests_dropped
+                      + stats.msgs_dropped) - rd0      # u32, wrap-safe
+        hb = hb | jnp.where(
+            drop_delta >= jnp.uint32(fm.health_drop_limit),
+            jnp.uint32(HEALTH_INBOX_DROP), jnp.uint32(0))
+        if cfg.sync_enabled:
+            fill = jnp.sum(flt.popcount_u32(my_bloom), axis=1)
+            hb = hb | jnp.where(
+                fill * jnp.uint32(8) >= jnp.uint32(cfg.bloom_bits * 7),
+                jnp.uint32(HEALTH_BLOOM_SAT), jnp.uint32(0))
+        health = health | hb
     return state.replace(
         alive=alive, loaded=loaded, session=session,
-        global_time=global_time,
+        global_time=global_time, health=health, ge_bad=ge_bad,
         mal_member=mal,
         cand_peer=tab.peer, cand_last_walk=tab.last_walk,
         cand_last_stumble=tab.last_stumble, cand_last_intro=tab.last_intro,
@@ -2327,6 +2582,26 @@ def create_signature_request(state: PeerState, cfg: CommunityConfig,
         sig_gt=jnp.where(ok, gt_new, state.sig_gt),
         sig_since=jnp.where(ok, state.round_index, state.sig_since),
         global_time=jnp.where(ok, gt_new, state.global_time))
+
+
+# ---- jitted per-event forms (the scenario runner's entry points) -------
+# A SetFault-heavy scenario applies many events between steps; the eager
+# forms above re-trace their full op graph on EVERY call (fine for tests,
+# ~300 us/dispatch through a TPU tunnel for hundreds of ops — not fine
+# for long scripted runs).  These jitted forms compile once per
+# (config, meta) signature and replay from cache, so the only recompiles
+# a scenario pays are the documented config-swap ones (scenario.py).
+# The eager forms stay exported unchanged — the oracle-differential
+# suites rely on their call-by-call semantics and compile cost profile.
+create_messages_jit = functools.partial(
+    jax.jit, static_argnums=(1, 3),
+    static_argnames=("cfg", "meta"))(create_messages)
+create_signature_request_jit = functools.partial(
+    jax.jit, static_argnums=(1, 3),
+    static_argnames=("cfg", "meta"))(create_signature_request)
+unload_members_jit = functools.partial(
+    jax.jit, static_argnums=(1,), static_argnames=("cfg",))(unload_members)
+load_members_jit = jax.jit(load_members)
 
 
 def seed_overlay(state: PeerState, cfg: CommunityConfig,

@@ -1,0 +1,306 @@
+"""The chaos-harness fault model: static knobs + host-side health tools.
+
+The seed engine's fault model was a single i.i.d. Bernoulli coin per
+logical packet (``CommunityConfig.packet_loss``) plus uniform churn
+rebirth — far weaker than what the reference overlay was built for and
+than what the related work attacks (GossipSub's guarantees only held up
+under adversarial model checking; PeerSwap's contribution is randomness
+under adversarial scheduling — PAPERS.md).  This module declares the
+*correlated* fault channel:
+
+- **Gilbert–Elliott bursty loss** — a two-state (good/bad) Markov channel
+  per peer.  The state rides in ``PeerState.ge_bad`` (one bool per peer;
+  the link is the peer's access network, so it survives churn rebirth the
+  way the NAT type does) and advances once per round from the counter RNG
+  (:mod:`dispersy_tpu.ops.rng` ``P_GE``), so the pure-Python oracle
+  replays the chain bit-exactly.  Loss draws then use the state-dependent
+  probability (``ge_loss_bad`` in the bad state) ORed with the base
+  Bernoulli ``packet_loss`` — the classic GE channel on top of the
+  existing i.i.d. floor.  The channel is keyed on the same peer index the
+  engine's existing loss draw uses at each site: the *sender's* uplink on
+  sends, the *receiver's* downlink on receipt-pickups.
+- **Region partitions** — static pairs of peer-index ranges that cannot
+  exchange packets in either direction (``(((lo_a, hi_a), (lo_b,
+  hi_b)), ...)``), generalizing the NAT symmetric<->symmetric delivery
+  gate into arbitrary netsplits.  Deterministic (no RNG): a partitioned
+  edge simply never delivers, exactly like loss with p=1 on that edge.
+- **Packet duplication** — each *delivered* record (sync pull, push
+  forward) is duplicated into the receiver's intake batch with
+  probability ``dup_rate`` (UDP duplicates arrive back-to-back; the
+  store's UNIQUE insert and in-batch dedup must absorb them).
+- **Payload corruption** — each delivered record is bit-flipped in
+  transit with probability ``corrupt_rate``.  The intake models the
+  reference's packet-hash verification: a corrupted record never enters
+  the pipeline; it is dropped and counted in
+  ``stats.msgs_corrupt_dropped`` (graceful drop, not silent ingestion).
+- **Byzantine flood senders** — the peers named in ``flood_senders``
+  each blast ``flood_fanout`` junk record packets per round at random
+  victims through the push-delivery channel.  Junk packets occupy real
+  inbox slots (the saturation attack: legitimate pushes overflow and
+  drop) and then fail the intake hash check like corrupted packets.
+
+**Health sentinels** (``health_checks``): a latched on-device bitmask
+leaf ``PeerState.health`` checked inside the fused step — graceful
+degradation (saturate, drop, flag) instead of silent corruption:
+
+- ``HEALTH_COUNTER_WRAP`` — a byte counter wrapped mod 2^32 this round.
+- ``HEALTH_STORE_INVARIANT`` — the store ring broke its sorted/unique/
+  holes-last invariant (an engine bug sentinel for scales where nothing
+  is inspectable by eye).
+- ``HEALTH_INBOX_DROP`` — this round's dropped packets/records
+  (request-inbox overflow + push/store drops) reached
+  ``health_drop_limit`` (overload / flood detector — a byzantine
+  flood lands in the push inbox, so both drop families count).
+- ``HEALTH_BLOOM_SAT`` — this round's claimed Bloom filter is >= 7/8
+  full (sync repair is degrading toward no-op).
+
+All knobs at their defaults compile to *exactly* the pre-fault step —
+every fault branch is gated on static config, so the disabled fused
+round is cost-analysis-identical (BENCH.md).
+
+Everything here is host-side declaration; the jit-traced kernels live in
+:mod:`dispersy_tpu.ops.faults`, and :func:`debug_validate` is the
+host-side deep checker over a materialized ``PeerState``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from dispersy_tpu.exceptions import ConfigError
+
+# Latched health bits (PeerState.health).  A set bit never clears except
+# through churn rebirth (a wiped-disk restart is a new process).
+HEALTH_COUNTER_WRAP = 1 << 0
+HEALTH_STORE_INVARIANT = 1 << 1
+HEALTH_INBOX_DROP = 1 << 2
+HEALTH_BLOOM_SAT = 1 << 3
+
+HEALTH_BIT_NAMES = {
+    HEALTH_COUNTER_WRAP: "counter_wrap",
+    HEALTH_STORE_INVARIANT: "store_invariant",
+    HEALTH_INBOX_DROP: "inbox_drop",
+    HEALTH_BLOOM_SAT: "bloom_saturated",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultModel:
+    """Static correlated-fault knobs, composed into ``CommunityConfig``.
+
+    Frozen + hashable so the whole config stays a valid static jit
+    argument; a scenario's ``SetFault`` swaps the model at a round
+    boundary (one recompile, like every config swap).
+    """
+
+    # Gilbert–Elliott two-state channel (per peer, advanced per round).
+    ge_p_bad: float = 0.0      # P(good -> bad) per round
+    ge_p_good: float = 0.0     # P(bad -> good) per round
+    ge_loss_good: float = 0.0  # per-packet loss in the good state
+    ge_loss_bad: float = 0.0   # per-packet loss in the bad state
+
+    # Region partitions: ((lo_a, hi_a), (lo_b, hi_b)) index-range pairs
+    # that cannot exchange packets in either direction.
+    partitions: tuple = ()
+
+    # Per-delivered-record duplication / corruption probabilities.
+    dup_rate: float = 0.0
+    corrupt_rate: float = 0.0
+
+    # Byzantine flooders: peer indices + junk packets per flooder/round.
+    flood_senders: tuple = ()
+    flood_fanout: int = 0
+
+    # On-device health sentinels (PeerState.health bits above).
+    health_checks: bool = False
+    health_drop_limit: int = 64   # dropped packets/round that flag a peer
+
+    # ------------------------------------------------------------------
+    @property
+    def ge_enabled(self) -> bool:
+        """Is the GE channel compiled in?  The chain only matters when a
+        state-dependent loss probability exists."""
+        return (self.ge_p_bad > 0.0
+                and (self.ge_loss_bad > 0.0 or self.ge_loss_good > 0.0))
+
+    @property
+    def flood_enabled(self) -> bool:
+        return bool(self.flood_senders) and self.flood_fanout > 0
+
+    @property
+    def any_channel(self) -> bool:
+        """Does any fault-channel knob alter packet delivery?"""
+        return (self.ge_enabled or bool(self.partitions)
+                or self.dup_rate > 0.0 or self.corrupt_rate > 0.0
+                or self.flood_enabled)
+
+    def __post_init__(self) -> None:
+        for name in ("ge_p_bad", "ge_p_good", "ge_loss_good",
+                     "ge_loss_bad", "dup_rate", "corrupt_rate"):
+            v = getattr(self, name)
+            if not (0.0 <= v <= 1.0):
+                raise ConfigError(f"{name} must be in [0, 1], got {v}")
+        if self.ge_p_bad > 0.0 and self.ge_p_good <= 0.0 \
+                and self.ge_loss_bad > 0.0:
+            raise ConfigError(
+                "ge_p_good must be > 0 when ge_p_bad > 0 (an absorbing "
+                "bad state is a permanent partition — model that with "
+                "`partitions` instead)")
+        if (self.ge_loss_bad > 0.0 or self.ge_loss_good > 0.0) \
+                and self.ge_p_bad <= 0.0:
+            raise ConfigError(
+                "ge_loss_* without ge_p_bad > 0 is inert (the channel "
+                "never leaves the good state, so the GE loss is never "
+                "compiled in): set ge_p_bad too, or use packet_loss for "
+                "an i.i.d. loss floor")
+        for pair in self.partitions:
+            if (len(pair) != 2
+                    or any(len(rng_) != 2 for rng_ in pair)):
+                raise ConfigError(
+                    "each partition entry is ((lo_a, hi_a), (lo_b, "
+                    f"hi_b)); got {pair!r}")
+            for lo, hi in pair:
+                if not (0 <= lo < hi):
+                    raise ConfigError(
+                        f"partition range ({lo}, {hi}) must satisfy "
+                        "0 <= lo < hi")
+        if bool(self.flood_senders) != (self.flood_fanout > 0):
+            raise ConfigError(
+                "flood_senders and flood_fanout enable each other: set "
+                "both (the attack) or neither")
+        if len(set(self.flood_senders)) != len(self.flood_senders):
+            raise ConfigError("flood_senders must be distinct")
+        if any(s < 0 for s in self.flood_senders):
+            raise ConfigError("flood_senders must be peer indices >= 0")
+        if self.health_drop_limit < 1:
+            raise ConfigError("health_drop_limit must be >= 1")
+
+    def replace(self, **kw) -> "FaultModel":
+        return dataclasses.replace(self, **kw)
+
+
+def adapt_state(state, old_cfg, new_cfg):
+    """Resize the chaos-harness state leaves across a fault-model swap.
+
+    ``health`` / ``ge_bad`` / ``stats.msgs_corrupt_dropped`` are sized
+    zero-width while their feature is compiled out (state.py), so a
+    ``SetFault`` that flips a knob across zero must resize them before
+    the next step traces.  Enabling starts clean (health unlatched, GE
+    channels all-good, counter at zero); disabling discards — the latch
+    and counter only exist while their subsystem does.  Everything else
+    passes through untouched, so a swap that leaves the enablement
+    boundary alone is an identity.
+    """
+    import jax.numpy as jnp
+
+    n = new_cfg.n_peers
+    of, nf = old_cfg.faults, new_cfg.faults
+    upd = {}
+    if of.health_checks != nf.health_checks:
+        upd["health"] = jnp.zeros((n if nf.health_checks else 0,),
+                                  jnp.uint32)
+    if of.ge_enabled != nf.ge_enabled:
+        upd["ge_bad"] = jnp.zeros((n if nf.ge_enabled else 0,), bool)
+    old_c = of.corrupt_rate > 0.0 or of.flood_enabled
+    new_c = nf.corrupt_rate > 0.0 or nf.flood_enabled
+    if old_c != new_c:
+        upd["stats"] = state.stats.replace(
+            msgs_corrupt_dropped=jnp.zeros((n if new_c else 0,),
+                                           jnp.uint32))
+    return state.replace(**upd) if upd else state
+
+
+def health_report(state, cfg) -> dict:
+    """Host-side summary of the latched health bits: per-bit flagged-peer
+    counts plus the overlay-wide OR.  Cheap (one [N] transfer)."""
+    import numpy as np
+
+    h = np.asarray(state.health)
+    out = {"health_or": int(np.bitwise_or.reduce(h)) if h.size else 0,
+           "health_flagged": int((h != 0).sum())}
+    for bit, name in HEALTH_BIT_NAMES.items():
+        out[f"health_{name}"] = int(((h & bit) != 0).sum())
+    return out
+
+
+def debug_validate(state, cfg, raise_on_error: bool = False) -> list:
+    """Deep host-side invariant check over a materialized ``PeerState``.
+
+    The offline complement of the fused step's on-device sentinels: pulls
+    the state to host and verifies the structural invariants every kernel
+    assumes — run it when a health bit latches, after a checkpoint
+    restore, or from a debugger at any round boundary.  Returns a list of
+    human-readable problem strings (empty == clean); with
+    ``raise_on_error`` raises ``AssertionError`` carrying them instead.
+    """
+    import numpy as np
+
+    from dispersy_tpu.config import EMPTY_META, EMPTY_U32, NO_PEER
+
+    problems: list[str] = []
+    n = cfg.n_peers
+
+    def check(cond: bool, msg: str) -> None:
+        if not cond:
+            problems.append(msg)
+
+    gt = np.asarray(state.store_gt)
+    member = np.asarray(state.store_member)
+    meta = np.asarray(state.store_meta)
+    check(meta.dtype == np.uint8, f"store_meta dtype {meta.dtype} != uint8")
+    check(np.asarray(state.store_flags).dtype == np.uint8,
+          "store_flags dtype drifted from uint8")
+    live = gt != EMPTY_U32
+    # holes sort last: no live row after a hole
+    hole_then_live = (~live[:, :-1]) & live[:, 1:]
+    bad = np.flatnonzero(hole_then_live.any(axis=1))
+    check(bad.size == 0, f"store holes precede live rows on peers "
+                         f"{bad[:8].tolist()}")
+    # sorted ascending + UNIQUE(member, gt) among live rows
+    g0, g1 = gt[:, :-1], gt[:, 1:]
+    m0, m1 = member[:, :-1], member[:, 1:]
+    pair_ok = (~live[:, 1:]) | (g0 < g1) | ((g0 == g1) & (m0 < m1))
+    bad = np.flatnonzero((~pair_ok).any(axis=1))
+    check(bad.size == 0, f"store sort/uniqueness violated on peers "
+                         f"{bad[:8].tolist()}")
+    # hole columns carry hole sentinels end-to-end
+    check(bool((meta[~live] == EMPTY_META).all()),
+          "store holes with non-EMPTY_META meta")
+    check(bool((member[~live] == EMPTY_U32).all()),
+          "store holes with non-sentinel member")
+
+    # candidate table: no duplicate live peer per row, no self, no tracker
+    cp = np.asarray(state.cand_peer)
+    if cp.shape[1] > 1:
+        rows = np.sort(cp, axis=1)
+        dup = (rows[:, 1:] == rows[:, :-1]) & (rows[:, 1:] != NO_PEER)
+        bad = np.flatnonzero(dup.any(axis=1))
+        check(bad.size == 0, f"duplicate candidate entries on peers "
+                             f"{bad[:8].tolist()}")
+    check(not ((cp == np.arange(n)[:, None]) & (cp != NO_PEER)).any(),
+          "candidate table contains self-entries")
+    check(not ((cp >= 0) & (cp < cfg.n_trackers)
+               & (np.arange(n)[:, None] >= cfg.n_trackers)).any(),
+          "member candidate tables contain tracker entries")
+
+    # delayed pen: dense-from-front, src in range
+    dgt = np.asarray(state.dly_gt)
+    if dgt.shape[1]:
+        dlive = dgt != EMPTY_U32
+        check(not ((~dlive[:, :-1]) & dlive[:, 1:]).any(),
+              "delay pen has gaps (must be dense from slot 0)")
+    dsrc = np.asarray(state.dly_src)
+    check(bool(((dsrc == NO_PEER) | ((dsrc >= 0) & (dsrc < n))).all()),
+          "dly_src out of range")
+
+    # scalar sanity
+    check(bool((np.asarray(state.global_time) >= 1).all()),
+          "global_time below 1")
+    check(bool((np.asarray(state.health) < 16).all()),
+          "health carries undefined bits")
+    ge = np.asarray(state.ge_bad)
+    check(ge.dtype == np.bool_, f"ge_bad dtype {ge.dtype} != bool")
+
+    if raise_on_error and problems:
+        raise AssertionError("debug_validate: " + "; ".join(problems))
+    return problems

@@ -26,6 +26,7 @@ import numpy as np
 
 from dispersy_tpu.config import EMPTY_U32, NO_PEER, CommunityConfig
 from dispersy_tpu.engine import killed_mask
+from dispersy_tpu.faults import health_report
 from dispersy_tpu.state import PeerState
 
 logger = logging.getLogger(__name__)
@@ -67,6 +68,10 @@ def snapshot(state: PeerState, cfg: CommunityConfig) -> dict:
         "msgs_forwarded": total(s.msgs_forwarded),
         "msgs_direct": total(s.msgs_direct),
         "msgs_delayed": total(s.msgs_delayed),
+        # chaos harness (dispersy_tpu/faults.py): records dropped by the
+        # intake hash re-check (corruption / flood junk); 0 when the
+        # leaf is compiled out (zero-width)
+        "msgs_corrupt_dropped": total(s.msgs_corrupt_dropped),
         "requests_dropped": total(s.requests_dropped),
         "punctures": total(s.punctures),
         # double-signed flow
@@ -88,6 +93,10 @@ def snapshot(state: PeerState, cfg: CommunityConfig) -> dict:
             members,
             jnp.sum(state.cand_peer != NO_PEER, axis=1) / cfg.k_candidates,
             0)) * (cfg.n_peers / float(n_members))),
+        # health sentinels (faults.HEALTH_* latched bits; zero-width
+        # leaf -> clean zeros when health_checks is off): health_or /
+        # health_flagged / per-bit flagged-peer counts
+        **health_report(state, cfg),
         # per-meta acceptance (statistics.py per-message-name counts);
         # bucket n_meta = the dispersy-* control band
         "accepted_by_meta": [

@@ -1,0 +1,103 @@
+"""Fault-channel kernels: GE chain advance, partition gate, health math.
+
+The jit-traced half of the chaos harness (:mod:`dispersy_tpu.faults`
+declares the static :class:`~dispersy_tpu.faults.FaultModel`; the engine
+composes these into the fused round only when the matching knob is
+non-zero, so a disabled fault model compiles to the identical step).
+Every op mirrors bit-for-bit in the oracle (:mod:`dispersy_tpu.oracle.sim`
+``_ge_advance`` / ``_blocked`` / ``_popcount`` / the store-invariant
+walk), the same lockstep discipline as every other ops module.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dispersy_tpu.ops import rng
+from dispersy_tpu.ops.contracts import Spec, contract
+
+_U32_N = Spec("uint32", ("N",))
+
+
+@contract(out=Spec("bool", ("N",)),
+          ge_bad=Spec("bool", ("N",)), seed=Spec("uint32", ()),
+          rnd=Spec("uint32", ()), idx=Spec("int32", ("N",)),
+          p_bad=0.25, p_good=0.5)
+def ge_advance(ge_bad: jnp.ndarray, seed, rnd, idx: jnp.ndarray,
+               p_bad: float, p_good: float) -> jnp.ndarray:
+    """One Gilbert–Elliott transition for every peer's channel.
+
+    In the good state the channel turns bad with ``p_bad``; in the bad
+    state it recovers with ``p_good``.  One uniform draw per peer per
+    round from the counter stream (purpose ``P_GE``), so the oracle
+    replays the chain exactly; the loss draws themselves then condition
+    on the post-transition state (this round's weather, not last
+    round's).
+    """
+    u = rng.rand_uniform(seed, rnd, idx, rng.P_GE)
+    return jnp.where(ge_bad,
+                     ~(u < jnp.float32(p_good)),
+                     u < jnp.float32(p_bad))
+
+
+@contract(out=Spec("bool", ("N",)),
+          src=Spec("int32", ("N",)), dst=Spec("int32", ("N",)),
+          partitions=(((0, 1), (2, 3)),))
+def partition_blocked(src: jnp.ndarray, dst: jnp.ndarray,
+                      partitions: tuple) -> jnp.ndarray:
+    """bool mask: is the directed edge src -> dst severed by a partition?
+
+    ``partitions`` is the static ``FaultModel.partitions`` tuple of
+    ``((lo_a, hi_a), (lo_b, hi_b))`` range pairs; an edge is blocked when
+    its endpoints fall in opposite ranges of any pair (both directions —
+    a netsplit has no good side).  Broadcasts over any matching
+    src/dst shapes; NO_PEER / out-of-range endpoints are never inside a
+    range, hence never blocked (their packets are already undeliverable).
+    """
+    out = None
+    for (a_lo, a_hi), (b_lo, b_hi) in partitions:
+        src_a = (src >= a_lo) & (src < a_hi)
+        src_b = (src >= b_lo) & (src < b_hi)
+        dst_a = (dst >= a_lo) & (dst < a_hi)
+        dst_b = (dst >= b_lo) & (dst < b_hi)
+        hit = (src_a & dst_b) | (src_b & dst_a)
+        out = hit if out is None else out | hit
+    if out is None:
+        return jnp.zeros(jnp.broadcast_shapes(jnp.shape(src),
+                                              jnp.shape(dst)), bool)
+    return out
+
+
+@contract(out=_U32_N, x=_U32_N)
+def popcount_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """Per-element set-bit count of a uint32 array (SWAR form — wraps
+    mod 2^32 at every step, mirrored with explicit masks in the
+    oracle's ``_popcount``).  Drives the Bloom-saturation sentinel."""
+    x = x.astype(jnp.uint32)
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return (x * jnp.uint32(0x01010101)) >> jnp.uint32(24)
+
+
+@contract(out=Spec("bool", ("N",)),
+          gt=Spec("uint32", ("N", "M")), member=Spec("uint32", ("N", "M")))
+def store_invariant_violated(gt: jnp.ndarray,
+                             member: jnp.ndarray) -> jnp.ndarray:
+    """bool[N]: does any adjacent store-row pair break the sorted /
+    UNIQUE(member, gt) / holes-last invariant?
+
+    The store ring's contract is ascending ``(gt, member)`` with
+    ``EMPTY_U32`` holes compacted to the end; because the hole sentinel
+    sorts after every real clock, a live row following a hole also fails
+    the strict-ascending test — one comparison covers all three clauses.
+    The ``HEALTH_STORE_INVARIANT`` sentinel latches on this instead of
+    letting a corrupt ring silently poison every later merge.
+    """
+    from dispersy_tpu.config import EMPTY_U32
+
+    g0, g1 = gt[:, :-1], gt[:, 1:]
+    m0, m1 = member[:, :-1], member[:, 1:]
+    ok = ((g1 == jnp.uint32(EMPTY_U32))
+          | (g0 < g1) | ((g0 == g1) & (m0 < m1)))
+    return jnp.any(~ok, axis=1)

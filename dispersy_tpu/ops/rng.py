@@ -39,6 +39,13 @@ P_SIGN = 8       # counterparty's countersign decision (allow_signature_func)
 P_NAT = 9        # connection-type assignment (public vs symmetric NAT);
 #                  drawn at round 0 so the type is static per identity —
 #                  NAT is the router's property, surviving churn rebirth
+# Chaos-harness streams (dispersy_tpu/faults.py FaultModel):
+P_GE = 10        # Gilbert–Elliott channel transition (one draw/peer/round)
+P_GE_LOSS = 11   # state-dependent per-packet loss (same salt blocks as
+#                  P_LOSS, independent stream so base loss stays bit-exact)
+P_CORRUPT = 12   # per-delivered-record payload corruption
+P_DUP = 13       # per-delivered-record duplication
+P_FLOOD = 14     # byzantine flood victim + junk-field draws
 
 
 @contract(out=Spec("uint32", ()), key=Spec("uint32", (2,)))

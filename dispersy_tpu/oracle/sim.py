@@ -70,10 +70,15 @@ _LOSS_ID_REQ = 14 << 16
 _LOSS_ID_RESP = 15 << 16
 _TRACKER_SALT = 1 << 15
 _TRACKER_INTRO_SALT = 1 << 20
+# Chaos-harness salt blocks (engine.py mirror).
+_LOSS_FLOOD = 16 << 16
+_FAULT_SYNC = 0 << 16
+_FAULT_PUSH = 1 << 16
 
 # Purpose tags (ops/rng.py).
 P_CATEGORY, P_SLOT, P_INTRO, P_BOOTSTRAP = 1, 2, 3, 4
 P_CHURN, P_LOSS, P_GOSSIP, P_SIGN, P_NAT = 5, 6, 7, 8, 9
+P_GE, P_GE_LOSS, P_CORRUPT, P_DUP, P_FLOOD = 10, 11, 12, 13, 14
 
 KIND_WALK, KIND_STUMBLE, KIND_INTRO = 0, 1, 2
 CAT_NONE, CAT_WALKED, CAT_STUMBLED, CAT_INTRODUCED = 0, 1, 2, 3
@@ -186,6 +191,8 @@ class OraclePeer:
         self.msgs_forwarded = self.msgs_rejected = 0
         self.msgs_direct = 0
         self.msgs_delayed = 0
+        self.msgs_corrupt_dropped = 0
+        self.health = 0        # latched sentinel bits (faults.HEALTH_*)
         self.proof_requests = self.proof_records = 0
         self.seq_requests = self.seq_records = 0
         self.mm_requests = self.mm_records = 0
@@ -208,6 +215,9 @@ class OracleSim:
         self.rnd = 0
         self.now = np.float32(0.0)
         self.peers = [OraclePeer(cfg) for _ in range(cfg.n_peers)]
+        # Gilbert–Elliott channel state (engine: PeerState.ge_bad) —
+        # the link's property, surviving churn rebirth.
+        self.ge_bad = [False] * cfg.n_peers
         # Multi-community layout (engine._layout_cols mirror, same source).
         (self.community, self.boot_base, self.boot_count,
          self.mem_base, self.mem_count) = cfg.layout()
@@ -218,6 +228,25 @@ class OracleSim:
         if self.cfg.communities:
             return int(self.mem_base[owner])
         return self.cfg.founder
+
+    def set_config(self, new_cfg: CommunityConfig) -> None:
+        """Swap the static config mid-run (the SetFault shape) — the
+        mirror of ``faults.adapt_state``: a knob flip that crosses a
+        chaos subsystem's enablement boundary resets that subsystem's
+        state (enabling starts clean, disabling discards the
+        latch/counter/channel), everything else carries over.  A swap
+        that stays on one side of every boundary is an identity."""
+        of, nf = self.cfg.faults, new_cfg.faults
+        if of.ge_enabled != nf.ge_enabled:
+            self.ge_bad = [False] * new_cfg.n_peers
+        if of.health_checks != nf.health_checks:
+            for p in self.peers:
+                p.health = 0
+        if ((of.corrupt_rate > 0.0 or of.flood_enabled)
+                != (nf.corrupt_rate > 0.0 or nf.flood_enabled)):
+            for p in self.peers:
+                p.msgs_corrupt_dropped = 0
+        self.cfg = new_cfg
 
     # ---- helpers mirroring ops/candidates.py --------------------------------
 
@@ -346,10 +375,33 @@ class OracleSim:
         return slots[j].peer if j >= 0 else NO_PEER
 
     def _lost(self, peer: int, salt_base: int, salt: int) -> bool:
-        if self.cfg.packet_loss <= 0.0:
-            return False
-        u = rand_uniform(self.seed, self.rnd, peer, P_LOSS, salt + salt_base)
-        return u < np.float32(self.cfg.packet_loss)
+        """engine._lost mirror: base Bernoulli OR the Gilbert–Elliott
+        state-dependent loss, independent counter streams."""
+        cfg = self.cfg
+        lost = False
+        if cfg.packet_loss > 0.0:
+            u = rand_uniform(self.seed, self.rnd, peer, P_LOSS,
+                             salt + salt_base)
+            lost = u < np.float32(cfg.packet_loss)
+        fm = cfg.faults
+        if fm.ge_enabled:
+            pr = fm.ge_loss_bad if self.ge_bad[peer] else fm.ge_loss_good
+            ug = rand_uniform(self.seed, self.rnd, peer, P_GE_LOSS,
+                              salt + salt_base)
+            lost = lost or (ug < np.float32(pr))
+        return lost
+
+    def _blocked(self, src: int, dst: int) -> bool:
+        """ops/faults.partition_blocked mirror: is the directed edge
+        severed by any static partition pair (both directions)?"""
+        for (a_lo, a_hi), (b_lo, b_hi) in self.cfg.faults.partitions:
+            src_a = a_lo <= src < a_hi
+            src_b = b_lo <= src < b_hi
+            dst_a = a_lo <= dst < a_hi
+            dst_b = b_lo <= dst < b_hi
+            if (src_a and dst_b) or (src_b and dst_a):
+                return True
+        return False
 
     # ---- store (ops/store.py mirror) ----------------------------------------
 
@@ -920,9 +972,26 @@ class OracleSim:
         r = cfg.request_inbox
         rt = cfg.tracker_inbox
         seed, rnd = self.seed, self.rnd
+        fm = cfg.faults
         # community packets seen by each peer this round (auto-load
         # trigger — engine `arrivals`)
         arrivals = [False] * n
+
+        # Gilbert–Elliott channel advance (engine: flt.ge_advance at the
+        # top of step — this round's loss draws see the new state).
+        if fm.ge_enabled:
+            for i in range(n):
+                u = rand_uniform(seed, rnd, i, P_GE)
+                if self.ge_bad[i]:
+                    self.ge_bad[i] = not (u < np.float32(fm.ge_p_good))
+                else:
+                    self.ge_bad[i] = u < np.float32(fm.ge_p_bad)
+        if fm.health_checks:
+            # Round-start counter snapshots for the wrap / drop sentinels.
+            bu0 = [p.bytes_up & M32 for p in self.peers]
+            bd0 = [p.bytes_down & M32 for p in self.peers]
+            rd0 = [p.requests_dropped + p.msgs_dropped
+                   for p in self.peers]
 
         # phase 0: churn
         if cfg.churn_rate > 0.0:
@@ -943,6 +1012,10 @@ class OracleSim:
                     # rebirth = new participant; its join IS an explicit
                     # load, auto_load notwithstanding (engine.unload_members)
                     p.loaded = True
+                    if fm.health_checks:
+                        # wiped-disk restart: clean health latch (the GE
+                        # channel is the LINK's and survives)
+                        p.health = 0
 
         # hard-kill state (engine mirror: derived from the post-churn store)
         if cfg.timeline_enabled:
@@ -980,14 +1053,18 @@ class OracleSim:
             if self.peers[i].alive and targets[i] != NO_PEER:
                 self.peers[i].bytes_up += req_bytes          # sendto, pre-loss
             send_ok[i] = (self.peers[i].alive and targets[i] != NO_PEER
-                          and not self._lost(i, _LOSS_REQUEST, 0))
+                          and not self._lost(i, _LOSS_REQUEST, 0)
+                          and not self._blocked(i, targets[i]))
 
         # phase 1f: push forwarding (engine phase 1f — last round's fresh
         # records to forward_fanout distinct verified candidates, targets
         # sampled from the pre-stumble candidate table)
-        # entries are (record, sender) — the sender is the pen's
-        # missing-proof target should the record park (engine ph_src)
-        push_inbox: list[list[tuple[Record, int]]] = [[] for _ in range(n)]
+        # entries are (record, sender, is_junk) — the sender is the pen's
+        # missing-proof target should the record park (engine ph_src);
+        # is_junk marks byzantine flood packets, which always fail the
+        # intake hash re-check (engine ph_junk)
+        push_inbox: list[list[tuple[Record, int, bool]]] = \
+            [[] for _ in range(n)]
         if cfg.forward_fanout > 0:
             cc = cfg.forward_fanout
             k = cfg.k_candidates
@@ -1010,10 +1087,11 @@ class OracleSim:
                                 and tc != NO_PEER:
                             p.bytes_up += RECORD_BYTES       # pre-loss
                             if not self._lost(i, _LOSS_FORWARD,
-                                              fi * cc + ci):
+                                              fi * cc + ci) \
+                                    and not self._blocked(i, tc):
                                 sent += 1
                                 if len(push_inbox[tc]) < cfg.push_inbox:
-                                    push_inbox[tc].append((rec, i))
+                                    push_inbox[tc].append((rec, i, False))
                                     arrivals[tc] = True
                                     qc = self.peers[tc]
                                     if qc.alive and qc.loaded:
@@ -1021,6 +1099,40 @@ class OracleSim:
                                 else:
                                     self.peers[tc].msgs_dropped += 1
                 p.msgs_forwarded += sent
+        if fm.flood_enabled:
+            # Byzantine junk blast (engine phase 1f flood segment): junk
+            # edges append AFTER every real push edge, so inbox slot
+            # order matches the fused delivery sort exactly.
+            ff = fm.flood_fanout
+            for fs in fm.flood_senders:
+                fp = self.peers[fs]
+                if fp.alive:
+                    # the flooder's NIC moves every blast, pre-loss
+                    fp.bytes_up += ff * RECORD_BYTES
+                for j in range(ff):
+                    victim = t + rand_u32(seed, rnd, fs, P_FLOOD, j) \
+                        % (n - t)
+                    if not fp.alive:
+                        continue
+                    if self._lost(fs, _LOSS_FLOOD, j):
+                        continue
+                    if self._blocked(fs, victim):
+                        continue
+                    rec = Record(
+                        rand_u32(seed, rnd, fs, P_FLOOD, j + (1 << 12)),
+                        rand_u32(seed, rnd, fs, P_FLOOD, j + (2 << 12)),
+                        rand_u32(seed, rnd, fs, P_FLOOD,
+                                 j + (3 << 12)) & 0xFF,
+                        rand_u32(seed, rnd, fs, P_FLOOD, j + (4 << 12)),
+                        rand_u32(seed, rnd, fs, P_FLOOD, j + (5 << 12)))
+                    if len(push_inbox[victim]) < cfg.push_inbox:
+                        # junk never decodes: no auto-load arrival
+                        push_inbox[victim].append((rec, fs, True))
+                        qv = self.peers[victim]
+                        if qv.alive and qv.loaded:
+                            qv.bytes_down += RECORD_BYTES
+                    else:
+                        self.peers[victim].msgs_dropped += 1
 
         # request delivery (normal peers): edge order = sender order
         req_inbox: list[list[int]] = [[] for _ in range(n)]   # sender ids
@@ -1144,7 +1256,8 @@ class OracleSim:
                 c = intro[d][s_ix]
                 a = req_inbox[d][s_ix]
                 if (rq_ok[d][s_ix] and c != NO_PEER
-                        and not self._lost(d, _LOSS_PUNCTURE_REQ, s_ix)):
+                        and not self._lost(d, _LOSS_PUNCTURE_REQ, s_ix)
+                        and not self._blocked(d, c)):
                     pr_edges.append((c, a))
         for d in range(t):
             for s_ix in range(len(tq_inbox[d])):
@@ -1152,7 +1265,8 @@ class OracleSim:
                 a = tq_inbox[d][s_ix]
                 if (tq_ok[d][s_ix] and c != NO_PEER
                         and not self._lost(d, _LOSS_PUNCTURE_REQ,
-                                           s_ix + _TRACKER_SALT)):
+                                           s_ix + _TRACKER_SALT)
+                        and not self._blocked(d, c)):
                     pr_edges.append((c, a))
         punc_req_inbox: list[list[int]] = [[] for _ in range(n)]
         for c, a in pr_edges:
@@ -1178,6 +1292,7 @@ class OracleSim:
         for c in range(n):
             for s_ix, a in enumerate(punc_req_inbox[c]):
                 if (pq_ok[c][s_ix] and not self._lost(c, _LOSS_PUNCTURE, s_ix)
+                        and not self._blocked(c, a)
                         and not (self._nat_sym(c) and self._nat_sym(a))):
                     # symmetric<->symmetric punctures never land (engine's
                     # puncture NAT gate)
@@ -1251,7 +1366,8 @@ class OracleSim:
                               and p.sig_since == rnd)
                 if sending[i]:
                     p.bytes_up += SIGNATURE_REQUEST_BYTES
-                    if not self._lost(i, _LOSS_SIGREQ, 0):
+                    if not self._lost(i, _LOSS_SIGREQ, 0) \
+                            and not self._blocked(i, p.sig_target):
                         d = p.sig_target
                         if len(sig_inbox_[d]) < s_sz:
                             sig_slot[i] = len(sig_inbox_[d])
@@ -1356,7 +1472,8 @@ class OracleSim:
                     if not (p.alive and p.loaded) or src == NO_PEER:
                         continue
                     p.bytes_up += MISSING_PROOF_BYTES       # sendto, pre-loss
-                    if self._lost(i, _LOSS_PROOF_REQ, d):
+                    if self._lost(i, _LOSS_PROOF_REQ, d) \
+                            or self._blocked(i, src):
                         continue
                     if 0 <= src < n:
                         if len(proof_inbox[src]) < cfg.proof_inbox:
@@ -1415,7 +1532,8 @@ class OracleSim:
                     if low > high:
                         continue
                     p.bytes_up += MISSING_SEQ_BYTES     # sendto, pre-loss
-                    if self._lost(i, _LOSS_SEQ_REQ, d):
+                    if self._lost(i, _LOSS_SEQ_REQ, d) \
+                            or self._blocked(i, src):
                         continue
                     if 0 <= src < n:
                         if len(seq_inbox[src]) < cfg.proof_inbox:
@@ -1465,7 +1583,8 @@ class OracleSim:
                             or rec.meta != META_UNDO_OTHER:
                         continue
                     p.bytes_up += MISSING_MSG_BYTES     # sendto, pre-loss
-                    if self._lost(i, _LOSS_MSG_REQ, d):
+                    if self._lost(i, _LOSS_MSG_REQ, d) \
+                            or self._blocked(i, src):
                         continue
                     if 0 <= src < n:
                         if len(mm_inbox[src]) < cfg.proof_inbox:
@@ -1515,7 +1634,8 @@ class OracleSim:
                             or self._has_identity(i, rec.member):
                         continue
                     p.bytes_up += MISSING_IDENTITY_BYTES
-                    if self._lost(i, _LOSS_ID_REQ, d):
+                    if self._lost(i, _LOSS_ID_REQ, d) \
+                            or self._blocked(i, src):
                         continue
                     if 0 <= src < n:
                         if len(id_inbox[src]) < cfg.proof_inbox:
@@ -1564,6 +1684,8 @@ class OracleSim:
             # in_since), and its deliverer (engine in_src; the future
             # missing-proof target should it park).
             batch: list[tuple[Record, int, int]] = []
+            sy_dups: list[tuple[Record, int, int]] = []
+            ph_dups: list[tuple[Record, int, int]] = []
             if delay_on and p.alive and p.loaded:
                 # pen first (engine: dl segment leads the concat)
                 batch.extend(p.delay)
@@ -1571,15 +1693,48 @@ class OracleSim:
                     and req_slot[i] >= 0:
                 recs = outbox.get((targets[i], req_slot[i]), [])
                 for j, r in enumerate(recs):
-                    if not self._lost(i, _LOSS_SYNC, j):
-                        batch.append((Record(r.gt, r.member, r.meta,
-                                             r.payload, r.aux), rnd,
-                                      targets[i]))
+                    if self._lost(i, _LOSS_SYNC, j):
+                        continue
+                    # recvfrom before the hash check can reject (engine
+                    # counts bdown from pre-corrupt sy_ok)
+                    p.bytes_down += RECORD_BYTES
+                    if fm.corrupt_rate > 0.0 and rand_uniform(
+                            seed, rnd, i, P_CORRUPT,
+                            j + _FAULT_SYNC) < np.float32(fm.corrupt_rate):
+                        p.msgs_corrupt_dropped += 1
+                        continue
+                    batch.append((Record(r.gt, r.member, r.meta,
+                                         r.payload, r.aux), rnd,
+                                  targets[i]))
+                    if fm.dup_rate > 0.0 and rand_uniform(
+                            seed, rnd, i, P_DUP,
+                            j + _FAULT_SYNC) < np.float32(fm.dup_rate):
+                        sy_dups.append((Record(r.gt, r.member, r.meta,
+                                               r.payload, r.aux), rnd,
+                                        targets[i]))
                         p.bytes_down += RECORD_BYTES
             if p.alive and p.loaded:
-                batch.extend((Record(r.gt, r.member, r.meta, r.payload,
-                                     r.aux), rnd, src)
-                             for r, src in push_inbox[i])
+                for slot, (r, src, junk) in enumerate(push_inbox[i]):
+                    bad = junk
+                    if not bad and fm.corrupt_rate > 0.0 and rand_uniform(
+                            seed, rnd, i, P_CORRUPT,
+                            slot + _FAULT_PUSH) < np.float32(
+                                fm.corrupt_rate):
+                        bad = True
+                    if bad:
+                        # failed the intake hash re-check: dropped and
+                        # counted, never ingested (engine ph bad mask)
+                        p.msgs_corrupt_dropped += 1
+                        continue
+                    batch.append((Record(r.gt, r.member, r.meta,
+                                         r.payload, r.aux), rnd, src))
+                    if fm.dup_rate > 0.0 and rand_uniform(
+                            seed, rnd, i, P_DUP,
+                            slot + _FAULT_PUSH) < np.float32(fm.dup_rate):
+                        ph_dups.append((Record(r.gt, r.member, r.meta,
+                                               r.payload, r.aux), rnd,
+                                        src))
+                        p.bytes_down += RECORD_BYTES
             if sig_completed[i] is not None:
                 # the record's aux IS the countersigner it came back from
                 batch.append((sig_completed[i], rnd, sig_completed[i].aux))
@@ -1587,6 +1742,10 @@ class OracleSim:
             batch.extend((rec, rnd, src) for rec, src in mq_batch[i])
             batch.extend((rec, rnd, src) for rec, src in sm_batch[i])
             batch.extend((rec, rnd, src) for rec, src in si_batch[i])
+            # delivery duplicates ride at the batch tail, sync then push
+            # (engine: segs_* += [sy_dup, ph_dup])
+            batch.extend(sy_dups)
+            batch.extend(ph_dups)
             # clock-jump defense (engine: post-walk-fold clock), plus the
             # structural countersigner check for double-signed metas
             ok_pairs = [(rec, s, sc) for rec, s, sc in batch
@@ -1860,6 +2019,29 @@ class OracleSim:
                 if arrivals[i] and p.alive:
                     p.loaded = True
 
+        if fm.health_checks:
+            # engine wrap-up health sentinels (faults.HEALTH_* bits,
+            # latched): counter wrap, store invariant, drop rate, Bloom
+            # saturation.
+            for i, p in enumerate(self.peers):
+                bits = 0
+                if ((p.bytes_up & M32) < bu0[i]
+                        or (p.bytes_down & M32) < bd0[i]):
+                    bits |= 1                      # HEALTH_COUNTER_WRAP
+                for a, b2 in zip(p.store, p.store[1:]):
+                    if not (a.gt < b2.gt
+                            or (a.gt == b2.gt and a.member < b2.member)):
+                        bits |= 2                  # HEALTH_STORE_INVARIANT
+                        break
+                if (p.requests_dropped + p.msgs_dropped - rd0[i]
+                        >= fm.health_drop_limit):
+                    bits |= 4                      # HEALTH_INBOX_DROP
+                if cfg.sync_enabled:
+                    fill = sum(blooms[i].bits)
+                    if fill * 8 >= cfg.bloom_bits * 7:
+                        bits |= 8                  # HEALTH_BLOOM_SAT
+                p.health |= bits
+
         self.now = _f32(self.now + np.float32(cfg.walk_interval))
         self.rnd += 1
 
@@ -1932,6 +2114,20 @@ class OracleSim:
                 [p.id_records for p in self.peers], np.uint32),
             "msgs_delayed": np.array([p.msgs_delayed for p in self.peers],
                                      np.uint32),
+            # chaos-harness leaves size to their knobs (state.py): a
+            # disabled feature's leaf is zero-width
+            "msgs_corrupt_dropped": (
+                np.array([p.msgs_corrupt_dropped for p in self.peers],
+                         np.uint32)
+                if (cfg.faults.corrupt_rate > 0.0
+                    or cfg.faults.flood_enabled)
+                else np.zeros((0,), np.uint32)),
+            "health": (np.array([p.health for p in self.peers], np.uint32)
+                       if cfg.faults.health_checks
+                       else np.zeros((0,), np.uint32)),
+            "ge_bad": (np.array(self.ge_bad, bool)
+                       if cfg.faults.ge_enabled
+                       else np.zeros((0,), bool)),
             "mal_member": np.full((n, cfg.k_malicious), EMPTY_U32, np.uint32),
             "conflicts": np.array([p.conflicts for p in self.peers],
                                   np.uint32),

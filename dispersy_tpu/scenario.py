@@ -31,6 +31,9 @@ or from JSON via ``tools/scenario.py`` (the CLI form of scenarioscript).
 from __future__ import annotations
 
 import dataclasses
+import json
+import logging
+import os
 from typing import Sequence
 
 import jax
@@ -39,12 +42,18 @@ import numpy as np
 
 from dispersy_tpu import checkpoint as ckpt
 from dispersy_tpu import engine
+from dispersy_tpu import faults as flts
 from dispersy_tpu.config import (META_AUTHORIZE, META_DESTROY,
                                  META_DYNAMIC,
                                  META_REVOKE, META_UNDO_OTHER, META_UNDO_OWN,
                                  CommunityConfig, perm_mask)
+from dispersy_tpu.exceptions import CheckpointError
 from dispersy_tpu.metrics import MetricsLog
 from dispersy_tpu.state import PeerState, init_state
+
+logger = logging.getLogger(__name__)
+
+AUTOSAVE_PREFIX = "auto" + "_"   # autosave file stem: auto_<round>.npz
 
 
 def _mask(cfg: CommunityConfig, peers) -> jnp.ndarray:
@@ -143,9 +152,57 @@ class Destroy:
 
 @dataclasses.dataclass
 class SetFault:
-    """Swap the fault model mid-run (config change -> recompile)."""
+    """Swap the fault model mid-run (config change -> recompile).
+
+    ``None`` leaves a knob unchanged.  Beyond the original churn/loss
+    pair, every chaos-harness knob (dispersy_tpu/faults.py FaultModel)
+    can be swapped: Gilbert-Elliott burst parameters, region
+    partitions (heal a netsplit by passing ``partitions=()``),
+    duplication/corruption rates, byzantine flooders, and the health
+    sentinels.  Knob flips that enable/disable a whole subsystem
+    resize its state leaves via ``faults.adapt_state`` (enabling
+    starts clean; disabling discards the latch/counter)."""
     churn_rate: float | None = None
     packet_loss: float | None = None
+    ge_p_bad: float | None = None
+    ge_p_good: float | None = None
+    ge_loss_good: float | None = None
+    ge_loss_bad: float | None = None
+    partitions: tuple | None = None
+    dup_rate: float | None = None
+    corrupt_rate: float | None = None
+    flood_senders: tuple | None = None
+    flood_fanout: int | None = None
+    health_checks: bool | None = None
+    health_drop_limit: int | None = None
+
+
+_FAULT_KNOBS = ("ge_p_bad", "ge_p_good", "ge_loss_good", "ge_loss_bad",
+                "partitions", "dup_rate", "corrupt_rate", "flood_senders",
+                "flood_fanout", "health_checks", "health_drop_limit")
+
+
+def _deep_tuple(v):
+    """JSON lists -> tuples, recursively (FaultModel fields must stay
+    hashable for the jitted step's static config argument)."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_deep_tuple(x) for x in v)
+    return v
+
+
+def _setfault_cfg(cfg: CommunityConfig, ev: "SetFault") -> CommunityConfig:
+    """The pure config half of a SetFault — shared by the live event
+    interpreter and the resume-time config replay (run())."""
+    kw = {}
+    if ev.churn_rate is not None:
+        kw["churn_rate"] = ev.churn_rate
+    if ev.packet_loss is not None:
+        kw["packet_loss"] = ev.packet_loss
+    fkw = {k: _deep_tuple(getattr(ev, k)) for k in _FAULT_KNOBS
+           if getattr(ev, k) is not None}
+    if fkw:
+        kw["faults"] = cfg.faults.replace(**fkw)
+    return cfg.replace(**kw) if kw else cfg
 
 
 @dataclasses.dataclass
@@ -189,6 +246,14 @@ class Scenario:
     events: Sequence[tuple]          # (round, event) pairs
     seed_degree: int | None = 8
     snapshot_every: int = 1
+    # Crash-resume (FAULTS.md): every `autosave_every` rounds the runner
+    # checkpoints state (CRC-protected, checkpoint.py v9) plus a JSON
+    # sidecar (metrics rows, tracked records, next round) into
+    # `autosave_dir`; run(..., resume=True) restarts from the latest
+    # snapshot that passes CRC — a corrupt/torn autosave is rejected
+    # with CheckpointError and the previous one is used.  0 = off.
+    autosave_every: int = 0
+    autosave_dir: str | None = None
 
 
 def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
@@ -203,7 +268,7 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
                 "nothing to track")
         gt_before = (int(state.global_time[authors[0]])
                      if len(authors) else 0)
-        state = engine.create_messages(state, cfg, m, ev.meta,
+        state = engine.create_messages_jit(state, cfg, m, ev.meta,
                                        _full(cfg, ev.payload),
                                        _full(cfg, ev.aux))
         if ev.track is not None:
@@ -219,7 +284,7 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
                     "timeline gate — reorder the scenario's events")
             tracked[ev.track] = (author, gt_after, ev.meta, ev.payload)
     elif isinstance(ev, SignatureRequest):
-        state = engine.create_signature_request(
+        state = engine.create_signature_request_jit(
             state, cfg, _mask(cfg, ev.authors), ev.meta,
             jnp.full(cfg.n_peers, ev.counterparty, jnp.int32),
             _full(cfg, ev.payload))
@@ -229,18 +294,18 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
         nibbles = perm_mask([(k, p) for k in range(32)
                              if (ev.metas >> k) & 1 for p in ev.perms])
         for member in ev.members:   # one record per target member
-            state = engine.create_messages(
+            state = engine.create_messages_jit(
                 state, cfg, _mask(cfg, granter), meta,
                 _full(cfg, member), _full(cfg, nibbles))
     elif isinstance(ev, Undo):
         meta = META_UNDO_OWN if ev.own else META_UNDO_OTHER
         author = ev.member if ev.own else (
             founder if ev.by is None else ev.by)
-        state = engine.create_messages(
+        state = engine.create_messages_jit(
             state, cfg, _mask(cfg, author), meta,
             _full(cfg, ev.member), _full(cfg, ev.gt))
     elif isinstance(ev, DynamicSettings):
-        state = engine.create_messages(
+        state = engine.create_messages_jit(
             state, cfg, _mask(cfg, founder), META_DYNAMIC,
             _full(cfg, ev.meta), _full(cfg, int(ev.linear)))
     elif isinstance(ev, Identity):
@@ -253,22 +318,21 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
             state, cfg, registry,
             mask=None if ev.peers is None else _mask(cfg, ev.peers))
     elif isinstance(ev, Destroy):
-        state = engine.create_messages(
+        state = engine.create_messages_jit(
             state, cfg, _mask(cfg, founder), META_DESTROY,
             _full(cfg, 0))
     elif isinstance(ev, Unload):
         m = np.isin(np.arange(cfg.n_peers), list(ev.members))
-        state = engine.unload_members(state, cfg, jnp.asarray(m))
+        state = engine.unload_members_jit(state, cfg, jnp.asarray(m))
     elif isinstance(ev, Load):
         m = np.isin(np.arange(cfg.n_peers), list(ev.members))
-        state = engine.load_members(state, jnp.asarray(m))
+        state = engine.load_members_jit(state, jnp.asarray(m))
     elif isinstance(ev, SetFault):
-        kw = {}
-        if ev.churn_rate is not None:
-            kw["churn_rate"] = ev.churn_rate
-        if ev.packet_loss is not None:
-            kw["packet_loss"] = ev.packet_loss
-        cfg = cfg.replace(**kw)
+        new_cfg = _setfault_cfg(cfg, ev)
+        # Knob flips across the enablement boundary resize the
+        # chaos-harness leaves (zero-width while compiled out).
+        state = flts.adapt_state(state, cfg, new_cfg)
+        cfg = new_cfg
     elif isinstance(ev, Checkpoint):
         ckpt.save(ev.path, state, cfg)
     else:
@@ -276,17 +340,99 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
     return state, cfg
 
 
+def _autosave(dirpath: str, next_round: int, state: PeerState,
+              cfg: CommunityConfig, tracked: dict, log: MetricsLog) -> None:
+    """One crash-resume snapshot: CRC-protected state archive + a JSON
+    sidecar carrying everything the runner itself holds (metrics rows,
+    tracked-record specs, the round to resume at).  Both writes are
+    atomic (tmp + replace), so a crash mid-autosave leaves the previous
+    snapshot intact and the torn one detectably invalid."""
+    os.makedirs(dirpath, exist_ok=True)
+    base = os.path.join(dirpath, f"{AUTOSAVE_PREFIX}{next_round:06d}")
+    ckpt.save(base + ".npz", state, cfg)
+    doc = {"next_round": next_round,
+           "tracked": {k: list(v) for k, v in tracked.items()},
+           "meta": log.meta, "rows": log.rows}
+    # Same tmp hygiene as checkpoint._atomic_npz: sweep orphans from
+    # crashed savers, unlink our own tmp on any failure — a kill between
+    # write and replace must not leak auto_*.json.tmp.<pid> forever.
+    ckpt._clean_stale_tmps(base + ".json")
+    tmp = f"{base}.json.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, base + ".json")
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _cfg_at_round(cfg: CommunityConfig, by_round: dict,
+                  upto: int) -> CommunityConfig:
+    """Replay the schedule's config-affecting events (SetFault) for
+    rounds < ``upto``: the config a snapshot taken after round
+    ``upto - 1`` was saved under.  Pure — no state is touched."""
+    for rnd in sorted(r for r in by_round if r < upto):
+        for ev in by_round[rnd]:
+            if isinstance(ev, SetFault):
+                cfg = _setfault_cfg(cfg, ev)
+    return cfg
+
+
+def _load_latest_autosave(dirpath: str, cfg0: CommunityConfig,
+                          by_round: dict):
+    """Newest-first scan of the autosave directory; returns
+    ``(state, cfg, next_round, sidecar)`` from the latest snapshot whose
+    archive passes the CRC/config checks, or None when no usable
+    snapshot exists.  Corrupt/torn snapshots (CheckpointError) are
+    logged and SKIPPED — never silently restored — so a crash during
+    autosave falls back to the previous good one.  ``*.tmp.*`` leftovers
+    never match the ``.npz`` glob."""
+    import glob as _glob
+
+    def _snap_round(path: str) -> int:
+        stem = os.path.basename(path)[len(AUTOSAVE_PREFIX):-len(".npz")]
+        return int(stem) if stem.isdigit() else -1
+
+    snaps = sorted(_glob.glob(os.path.join(
+        dirpath, AUTOSAVE_PREFIX + "*.npz")), key=_snap_round, reverse=True)
+    for path in snaps:
+        sidecar = path[:-len(".npz")] + ".json"
+        try:
+            with open(sidecar) as f:
+                doc = json.load(f)
+            next_round = int(doc["next_round"])
+            cfg = _cfg_at_round(cfg0, by_round, next_round)
+            state = ckpt.restore(path, cfg)
+        except (CheckpointError, OSError, ValueError, KeyError) as e:
+            logger.warning("autosave %s unusable (%s: %s); falling back "
+                           "to the previous snapshot", path,
+                           type(e).__name__, e)
+            continue
+        return state, cfg, next_round, doc
+    return None
+
+
 def run(cfg: CommunityConfig, scenario: Scenario, key=None,
-        log: MetricsLog | None = None) -> tuple[PeerState, MetricsLog]:
+        log: MetricsLog | None = None,
+        resume: bool = False) -> tuple[PeerState, MetricsLog]:
     """Execute the scenario; returns the final state and the metrics log.
 
     Every logged row carries ``cov_<label>`` for each tracked record —
     the convergence curves the reference's experiment pipeline mined from
     its logs.
+
+    With ``resume=True`` (and ``scenario.autosave_dir`` populated by an
+    earlier autosaving run) execution restarts from the latest valid
+    snapshot and the finished run is BIT-IDENTICAL — final state and
+    metrics log — to an uninterrupted one: restore is the byte-exact
+    ``fresh_candidates=False`` mode, the RNG key/round ride in the
+    archive, and the sidecar restores the metrics rows and tracked
+    records (JSON round-trips Python floats exactly).
     """
-    state = init_state(cfg, key if key is not None else jax.random.PRNGKey(0))
-    if scenario.seed_degree:
-        state = engine.seed_overlay(state, cfg, scenario.seed_degree)
     log = log or MetricsLog(meta={"scenario_rounds": scenario.rounds})
     by_round: dict[int, list] = {}
     for rnd, ev in scenario.events:
@@ -303,10 +449,30 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
                 f"Identity event at round {rnd} requires "
                 "config.identity_enabled=True")
         by_round.setdefault(int(rnd), []).append(ev)
+    if scenario.autosave_every and not scenario.autosave_dir:
+        raise ValueError("autosave_every requires autosave_dir")
     tracked: dict[str, tuple] = {}
     ctx: dict = {}
+    start_round = 0
+    state = None
+    if resume:
+        if not scenario.autosave_dir:
+            raise ValueError("resume=True requires scenario.autosave_dir")
+        got = _load_latest_autosave(scenario.autosave_dir, cfg, by_round)
+        if got is not None:
+            state, cfg, start_round, doc = got
+            tracked = {k: tuple(v) for k, v in doc["tracked"].items()}
+            log.meta = doc.get("meta", log.meta)
+            log.rows = list(doc.get("rows", ()))
+            logger.info("resuming scenario at round %d from %s",
+                        start_round, scenario.autosave_dir)
+    if state is None:
+        state = init_state(cfg, key if key is not None
+                           else jax.random.PRNGKey(0))
+        if scenario.seed_degree:
+            state = engine.seed_overlay(state, cfg, scenario.seed_degree)
 
-    for rnd in range(scenario.rounds):
+    for rnd in range(start_round, scenario.rounds):
         for ev in by_round.get(rnd, ()):
             state, cfg = _apply(state, cfg, ev, tracked, ctx)
         state = engine.step(state, cfg)
@@ -314,4 +480,8 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
             covs = {f"cov_{label}": float(engine.coverage(state, *spec))
                     for label, spec in tracked.items()}
             log.append(state, cfg, **covs)
+        if scenario.autosave_every \
+                and (rnd + 1) % scenario.autosave_every == 0:
+            _autosave(scenario.autosave_dir, rnd + 1, state, cfg,
+                      tracked, log)
     return jax.block_until_ready(state), log

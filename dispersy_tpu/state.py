@@ -55,6 +55,12 @@ class Stats:
     msgs_delayed: jnp.ndarray     # u32[N] records parked awaiting a
     #   permission proof (reference: statistics.py delay counts from
     #   check_callback DelayMessageByProof outcomes; config.delay_inbox)
+    msgs_corrupt_dropped: jnp.ndarray  # u32[N] delivered records dropped
+    #   by the intake hash re-check: in-transit corruption and byzantine
+    #   flood junk (dispersy_tpu/faults.py corrupt_rate/flood_senders —
+    #   the reference's conversion.py decode/signature failures).
+    #   Zero-width when neither channel is enabled (state.py PeerState
+    #   `health` note)
     # Active missing-proof round trips (reference: community.py
     # on_missing_proof serving dispersy-missing-proof requests;
     # config.proof_requests):
@@ -112,6 +118,18 @@ class PeerState:
     is_tracker: jnp.ndarray   # bool[N]  bootstrap peers (tool/tracker.py role)
     session: jnp.ndarray      # u32[N]   bumped on churn rejoin
     global_time: jnp.ndarray  # u32[N]   Lamport clock (community.py claim_global_time)
+    health: jnp.ndarray       # u32[N]   latched health-sentinel bitmask
+    #   (faults.HEALTH_*; set inside the fused step when
+    #   cfg.faults.health_checks, cleared only by churn rebirth — a
+    #   wiped-disk restart is a new process).  Sized ZERO-WIDTH when
+    #   health_checks is off — the dly_* idiom — so the disabled fused
+    #   step stays cost-analysis-identical (faults.adapt_state resizes
+    #   on a SetFault knob flip).
+    ge_bad: jnp.ndarray       # bool[N]  Gilbert–Elliott channel state
+    #   (True = bursty-loss bad state; faults.FaultModel.ge_*).  A
+    #   property of the peer's access link — like the NAT type it
+    #   survives churn rebirth and unload/load.  Zero-width when the GE
+    #   channel is disabled (see `health`).
 
     # ---- candidate table [N, K] ----
     cand_peer: jnp.ndarray         # i32, NO_PEER = empty
@@ -184,7 +202,7 @@ class PeerState:
 FLAG_UNDONE = 1
 
 
-def init_stats(n: int, n_meta: int = 8) -> Stats:
+def init_stats(n: int, n_meta: int = 8, n_corrupt: int | None = None) -> Stats:
     # Distinct buffers on purpose: aliased arrays break donation
     # (Execute() rejects the same buffer donated twice).
     def z():
@@ -192,7 +210,10 @@ def init_stats(n: int, n_meta: int = 8) -> Stats:
     return Stats(walk_success=z(), walk_fail=z(), msgs_stored=z(),
                  msgs_dropped=z(), requests_dropped=z(), punctures=z(),
                  msgs_forwarded=z(), msgs_rejected=z(), msgs_direct=z(),
-                 msgs_delayed=z(), proof_requests=z(), proof_records=z(),
+                 msgs_delayed=z(),
+                 msgs_corrupt_dropped=jnp.zeros(
+                     (n if n_corrupt is None else n_corrupt,), jnp.uint32),
+                 proof_requests=z(), proof_records=z(),
                  seq_requests=z(), seq_records=z(),
                  mm_requests=z(), mm_records=z(),
                  id_requests=z(), id_records=z(),
@@ -269,6 +290,12 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         is_tracker=jnp.arange(n) < config.n_trackers,
         session=jnp.zeros((n,), jnp.uint32),
         global_time=jnp.ones((n,), jnp.uint32),
+        # Chaos-harness leaves size to their knobs (zero-width when the
+        # feature is compiled out — the dly_* idiom — so a disabled
+        # fault model adds zero bytes to the fused round; FAULTS.md).
+        health=jnp.zeros(
+            (n if config.faults.health_checks else 0,), jnp.uint32),
+        ge_bad=jnp.zeros((n if config.faults.ge_enabled else 0,), bool),
         cand_peer=jnp.full((n, k), NO_PEER, jnp.int32),
         cand_last_walk=never(),
         cand_last_stumble=never(),
@@ -302,7 +329,10 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         sig_payload=jnp.zeros((n,), jnp.uint32),
         sig_gt=jnp.zeros((n,), jnp.uint32),
         sig_since=jnp.zeros((n,), jnp.uint32),
-        stats=init_stats(n, config.n_meta),
+        stats=init_stats(
+            n, config.n_meta,
+            n_corrupt=(n if (config.faults.corrupt_rate > 0.0
+                             or config.faults.flood_enabled) else 0)),
         key=jax.random.key_data(key) if key.dtype != jnp.uint32 else key,
         time=jnp.float32(0.0),
         round_index=jnp.uint32(0),

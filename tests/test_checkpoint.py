@@ -80,14 +80,21 @@ def test_config_mismatch_rejected(tmp_path):
 
 
 def _as_v7(src: str, dst: str) -> None:
-    """Rewrite a v8 archive as its pre-narrowing v7 equivalent: the four
+    """Rewrite a v9 archive as its pre-narrowing v7 equivalent: the four
     narrowed leaves widened back to uint32 (EMPTY_META -> EMPTY_U32 on
-    the meta sentinels) and the version stamp set to 7 — byte-compatible
-    with what a round-5 checkpoint actually contained."""
+    the meta sentinels), the v9 additions stripped (per-leaf CRCs, the
+    chaos-harness leaves, the ``faults=`` fingerprint component) and the
+    version stamp set to 7 — byte-compatible with what a round-5
+    checkpoint actually contained."""
     from dispersy_tpu.config import EMPTY_META, EMPTY_U32
     with np.load(src) as z:
-        arrays = {k: z[k] for k in z.files}
+        arrays = {k: z[k] for k in z.files
+                  if not k.startswith("crc:")
+                  and k not in ("leaf:health", "leaf:ge_bad",
+                                "leaf:stats/msgs_corrupt_dropped")}
     arrays["meta:version"] = np.asarray(7)
+    arrays["meta:config"] = np.frombuffer(
+        ckpt._want_fingerprint(CFG, 7).encode(), dtype=np.uint8)
     for name in ("store_meta", "fwd_meta", "dly_meta"):
         a8 = arrays[f"leaf:{name}"]
         assert a8.dtype == np.uint8

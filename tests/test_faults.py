@@ -1,0 +1,418 @@
+"""Chaos harness: correlated faults, health sentinels, crash-resume.
+
+Every fault channel (Gilbert–Elliott bursty loss, region partitions,
+duplication, corruption, byzantine flooding) must keep the fused TPU
+step bit-exact against the pure-Python oracle — the same differential
+bar as every protocol feature — while the health sentinels and the
+autosave/resume machinery get behavioral tests of their own.  The
+heaviest grid sweeps are ``slow``-marked to protect the tier-1 window;
+``tools/fuzz_sweep.py --faults`` runs :func:`run_fault_draw` at bulk
+scale.
+"""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispersy_tpu import checkpoint as ckpt
+from dispersy_tpu import engine as E
+from dispersy_tpu import scenario as SC
+from dispersy_tpu import state as S
+from dispersy_tpu.config import CommunityConfig
+from dispersy_tpu.exceptions import CheckpointError, ConfigError
+from dispersy_tpu.faults import (HEALTH_BLOOM_SAT, HEALTH_INBOX_DROP,
+                                 FaultModel, debug_validate, health_report)
+from dispersy_tpu.metrics import snapshot
+from dispersy_tpu.oracle import sim as O
+
+from test_oracle import assert_match
+
+BASE = CommunityConfig(n_peers=32, n_trackers=2, msg_capacity=32,
+                       bloom_capacity=16, k_candidates=8, request_inbox=4,
+                       tracker_inbox=8, response_budget=4)
+
+
+def run_both(cfg, rounds, seed=0, author=None, warm=4, swap_at=None,
+             swap_cfg=None):
+    """Engine vs oracle lockstep under a fault model; optional mid-run
+    config swap (the SetFault shape) at round ``swap_at``."""
+    key = jax.random.PRNGKey(seed)
+    state = S.init_state(cfg, key)
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    if warm:
+        state = E.seed_overlay(state, cfg, degree=warm)
+        oracle.seed_overlay(degree=warm)
+    if author is not None:
+        mask = np.arange(cfg.n_peers) == author
+        payload = np.full(cfg.n_peers, 42, np.uint32)
+        state = E.create_messages(state, cfg, jnp.asarray(mask), meta=1,
+                                  payload=jnp.asarray(payload))
+        oracle.create_messages(mask, meta=1, payload=payload)
+    for rnd in range(rounds):
+        if swap_at is not None and rnd == swap_at:
+            from dispersy_tpu import faults as F
+            state = F.adapt_state(state, cfg, swap_cfg)
+            oracle.set_config(swap_cfg)
+            cfg = swap_cfg
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle,
+                     f"faults-round{rnd} cfg={cfg!r}")
+    return jax.block_until_ready(state), cfg
+
+
+def test_ge_burst_loss_trace():
+    """The two-state bursty channel replays bit-exactly and actually
+    bites: some peers spend rounds in the bad state."""
+    cfg = BASE.replace(packet_loss=0.05, faults=FaultModel(
+        ge_p_bad=0.3, ge_p_good=0.4, ge_loss_bad=0.9, ge_loss_good=0.02))
+    state, _ = run_both(cfg, rounds=10, author=5)
+    assert np.asarray(state.ge_bad).shape == (cfg.n_peers,)
+    assert np.asarray(state.ge_bad).any()
+    # bursty loss shows up as walk failures well above the base rate
+    assert int(np.asarray(state.stats.walk_fail).sum()) > 0
+
+
+def test_partition_blocks_then_heals():
+    """A netsplit between two member regions stops a record crossing it;
+    healing the partition (SetFault shape: partitions=()) lets the
+    record finish its spread.  Oracle-lockstep throughout."""
+    split = FaultModel(partitions=(((2, 17), (17, 32)),))
+    cfg = BASE.replace(faults=split)
+    healed = cfg.replace(faults=FaultModel())
+    state, _ = run_both(cfg, rounds=22, author=5, swap_at=12,
+                        swap_cfg=healed)
+    holders = (np.asarray(state.store_payload) == 42).any(axis=1)
+    assert holders[17:].any(), "record never crossed after the heal"
+
+    # and WITHOUT the heal it never crosses at all
+    state2, _ = run_both(cfg, rounds=22, author=5)
+    holders2 = (np.asarray(state2.store_payload) == 42).any(axis=1)
+    assert not holders2[17:].any(), \
+        "partitioned record crossed a severed region boundary"
+
+
+def test_corruption_dropped_and_counted():
+    cfg = BASE.replace(faults=FaultModel(corrupt_rate=0.3))
+    state, _ = run_both(cfg, rounds=10, author=5)
+    dropped = int(np.asarray(state.stats.msgs_corrupt_dropped,
+                             np.uint64).sum())
+    assert dropped > 0
+    assert debug_validate(state, cfg) == []
+
+
+def test_duplication_absorbed_by_unique_insert():
+    cfg = BASE.replace(faults=FaultModel(dup_rate=0.5))
+    state, cfg = run_both(cfg, rounds=10, author=5)
+    # duplicates were delivered (extra receive bytes) yet the store's
+    # UNIQUE(member, gt) identity holds everywhere
+    assert debug_validate(state, cfg) == []
+    cov = float(E.coverage(state, 5, int(np.asarray(
+        state.store_gt)[5, 0]), 1, 42))
+    assert cov > 0.5
+
+
+def test_flood_saturates_inboxes_and_is_dropped():
+    """Byzantine flooders occupy victim push-inbox slots; their junk
+    then fails the intake hash re-check — counted, never ingested."""
+    fm = FaultModel(flood_senders=(5, 9), flood_fanout=12)
+    cfg = BASE.replace(faults=fm)
+    state, _ = run_both(cfg, rounds=8, author=20)
+    dropped = int(np.asarray(state.stats.msgs_corrupt_dropped,
+                             np.uint64).sum())
+    assert dropped > 0, "flood junk never reached a victim"
+    # junk never pollutes a store: every stored record's member is a
+    # real peer index (junk members are uniform u32 draws)
+    member = np.asarray(state.store_member)
+    live = np.asarray(state.store_gt) != 0xFFFFFFFF
+    assert (member[live] < cfg.n_peers).all()
+
+
+def test_health_sentinels_latch():
+    """Flood pressure over a tiny drop limit trips HEALTH_INBOX_DROP;
+    a saturated Bloom filter trips HEALTH_BLOOM_SAT.  Both engine-side
+    bits match the oracle (assert_match covers `health`)."""
+    fm = FaultModel(flood_senders=(5,), flood_fanout=24,
+                    health_checks=True, health_drop_limit=2)
+    # Tiny bloom + tiny push inbox: saturation and overflow both happen.
+    cfg = BASE.replace(bloom_capacity=4, push_inbox=2, faults=fm)
+    state, cfg = run_both(cfg, rounds=10, author=20)
+    rep = health_report(state, cfg)
+    assert rep["health_flagged"] > 0
+    assert rep["health_or"] & (HEALTH_INBOX_DROP | HEALTH_BLOOM_SAT)
+    snap = snapshot(state, cfg)
+    assert snap["health_flagged"] == rep["health_flagged"]
+    assert snap["msgs_corrupt_dropped"] > 0
+    assert debug_validate(state, cfg) == []
+
+
+def test_ge_disable_reenable_resets_channel():
+    """Disabling the GE channel discards its state and re-enabling
+    starts all-good: engine (faults.adapt_state) and oracle
+    (OracleSim.set_config) cross the enablement boundary in lockstep."""
+    from dispersy_tpu import faults as F
+
+    ge_cfg = BASE.replace(faults=FaultModel(
+        ge_p_bad=0.4, ge_p_good=0.3, ge_loss_bad=0.9))
+    off_cfg = BASE.replace(faults=FaultModel())
+    cfg = ge_cfg
+    key = jax.random.PRNGKey(0)
+    state = S.init_state(cfg, key)
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=4)
+    oracle.seed_overlay(degree=4)
+    for rnd in range(12):
+        if rnd in (4, 7):                 # off at 4, back on at 7
+            new_cfg = off_cfg if rnd == 4 else ge_cfg
+            state = F.adapt_state(state, cfg, new_cfg)
+            oracle.set_config(new_cfg)
+            cfg = new_cfg
+            assert state.ge_bad.shape == (
+                cfg.n_peers if cfg.faults.ge_enabled else 0,)
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle,
+                     f"ge-cycle-round{rnd}")
+
+
+def test_all_channels_together_trace():
+    """Every fault knob at once — the interaction surface — stays
+    bit-exact vs the oracle with churn and base loss on top."""
+    fm = FaultModel(ge_p_bad=0.25, ge_p_good=0.5, ge_loss_bad=0.7,
+                    ge_loss_good=0.05, partitions=(((2, 12), (22, 32)),),
+                    dup_rate=0.25, corrupt_rate=0.15,
+                    flood_senders=(7,), flood_fanout=6,
+                    health_checks=True, health_drop_limit=6)
+    cfg = BASE.replace(packet_loss=0.1, churn_rate=0.05, faults=fm)
+    run_both(cfg, rounds=10, author=5)
+
+
+def test_fault_model_validation():
+    with pytest.raises(ConfigError, match="absorbing"):
+        FaultModel(ge_p_bad=0.5, ge_loss_bad=0.5)
+    with pytest.raises(ConfigError, match="inert"):
+        FaultModel(ge_loss_bad=0.9)       # loss without a transition
+    with pytest.raises(ConfigError, match="partition range"):
+        FaultModel(partitions=(((5, 2), (0, 1)),))
+    with pytest.raises(ConfigError, match="enable each other"):
+        FaultModel(flood_senders=(1,))
+    with pytest.raises(ConfigError, match="in \\[0, 1\\]"):
+        FaultModel(corrupt_rate=1.5)
+    with pytest.raises(ConfigError, match="inside"):
+        BASE.replace(faults=FaultModel(partitions=(((0, 8), (8, 99)),)))
+    with pytest.raises(ConfigError, match="disjoint"):
+        BASE.replace(faults=FaultModel(partitions=(((0, 10), (5, 15)),)))
+    with pytest.raises(ConfigError, match="< n_peers"):
+        BASE.replace(faults=FaultModel(flood_senders=(99,),
+                                       flood_fanout=2))
+
+
+def test_setfault_scenario_swaps_fault_model(tmp_path):
+    """The scenario runner swaps fault models mid-run (resizing the
+    chaos leaves across the enablement boundary) and the metrics log
+    carries the new counters."""
+    cfg = BASE.replace(n_peers=32)
+    sc = SC.Scenario(rounds=12, events=[
+        (0, SC.Create(meta=0, authors=[5], payload=42, track="post")),
+        (3, SC.SetFault(corrupt_rate=0.4, health_checks=True,
+                        ge_p_bad=0.3, ge_p_good=0.5, ge_loss_bad=0.8)),
+        (9, SC.SetFault(corrupt_rate=0.0, health_checks=False,
+                        ge_p_bad=0.0, ge_loss_bad=0.0)),
+    ])
+    state, log = SC.run(cfg, sc)
+    assert len(log.rows) == 12
+    # corrupt drops accumulated while the channel existed
+    assert max(log.series("msgs_corrupt_dropped")) > 0
+    # after the disable swap the leaves are compiled back out
+    assert state.ge_bad.shape == (0,)
+    assert state.health.shape == (0,)
+    assert log.rows[-1]["msgs_corrupt_dropped"] == 0
+
+
+# ---- crash-resume ------------------------------------------------------
+
+RESUME_CFG = BASE.replace(n_peers=32)
+
+
+def _resume_scenario(tmp_dir, autosave_every=0):
+    return SC.Scenario(rounds=14, events=[
+        (0, SC.Create(meta=0, authors=[5], payload=42, track="post")),
+        (4, SC.SetFault(packet_loss=0.1, corrupt_rate=0.2)),
+        (8, SC.Create(meta=0, authors=[7], payload=43, track="late")),
+    ], autosave_every=autosave_every, autosave_dir=tmp_dir)
+
+
+def test_autosave_resume_is_bit_exact(tmp_path):
+    """Kill-and-resume equals uninterrupted: run once WITHOUT autosave
+    (reference trajectory), once WITH autosave, then throw away
+    everything after an early snapshot (the crash) and resume — final
+    state AND metrics log must be bit-identical to the reference."""
+    d = str(tmp_path / "autosaves")
+    ref_state, ref_log = SC.run(RESUME_CFG, _resume_scenario(None))
+
+    full_state, full_log = SC.run(RESUME_CFG,
+                                  _resume_scenario(d, autosave_every=3))
+    saves = sorted(glob.glob(os.path.join(d, "auto_*.npz")))
+    assert len(saves) == 4            # rounds 3, 6, 9, 12
+    # "crash" after round 6: later snapshots never happened
+    for p in saves[2:]:
+        os.remove(p)
+        os.remove(p[:-4] + ".json")
+
+    res_state, res_log = SC.run(RESUME_CFG,
+                                _resume_scenario(d, autosave_every=3),
+                                resume=True)
+    for la, lb in zip(jax.tree.leaves(ref_state),
+                      jax.tree.leaves(res_state)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert res_log.rows == ref_log.rows
+    assert res_log.rows == full_log.rows
+
+
+def test_corrupt_autosave_rejected_and_previous_used(tmp_path):
+    """A bit-flipped newest autosave fails its CRC: direct restore
+    raises CheckpointError, and resume falls back to the previous valid
+    snapshot — still finishing bit-identically."""
+    d = str(tmp_path / "autosaves")
+    ref_state, ref_log = SC.run(RESUME_CFG, _resume_scenario(None))
+    SC.run(RESUME_CFG, _resume_scenario(d, autosave_every=3))
+    saves = sorted(glob.glob(os.path.join(d, "auto_*.npz")))
+    for p in saves[2:]:               # crash after round 6
+        os.remove(p)
+        os.remove(p[:-4] + ".json")
+    victim = saves[1]                 # newest survivor: round 6
+
+    with np.load(victim) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["leaf:store_gt"] = arrays["leaf:store_gt"].copy()
+    arrays["leaf:store_gt"].flat[0] ^= 1          # the bit-flip
+    np.savez_compressed(victim, **arrays)
+
+    cfg6 = SC._cfg_at_round(RESUME_CFG,
+                            {4: [SC.SetFault(packet_loss=0.1,
+                                             corrupt_rate=0.2)]}, 6)
+    with pytest.raises(CheckpointError, match="CRC mismatch"):
+        ckpt.restore(victim, cfg6)
+
+    res_state, res_log = SC.run(RESUME_CFG,
+                                _resume_scenario(d, autosave_every=3),
+                                resume=True)
+    for la, lb in zip(jax.tree.leaves(ref_state),
+                      jax.tree.leaves(res_state)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert res_log.rows == ref_log.rows
+
+
+def test_truncated_autosave_rejected(tmp_path):
+    """A torn (half-written) archive is a CheckpointError, not a zipfile
+    traceback — resume's newest-first scan can skip it."""
+    path = str(tmp_path / "torn.npz")
+    st = S.init_state(RESUME_CFG, jax.random.PRNGKey(0))
+    ckpt.save(path, st, RESUME_CFG)
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:len(blob) // 3])
+    with pytest.raises(CheckpointError, match="unreadable|CRC|missing"):
+        ckpt.restore(path, RESUME_CFG)
+
+
+def test_zip_member_corruption_rejected(tmp_path):
+    """A bit flip inside a member's COMPRESSED byte stream: np.load
+    itself succeeds (the zip directory at the tail is intact), the error
+    only surfaces mid-read from ``z[key]`` as BadZipFile/zlib.error —
+    still a CheckpointError, so resume can fall back (_archive_guard)."""
+    path = str(tmp_path / "flipped.npz")
+    st = S.init_state(RESUME_CFG, jax.random.PRNGKey(0))
+    ckpt.save(path, st, RESUME_CFG)
+    blob = bytearray(open(path, "rb").read())
+    for off in range(len(blob) // 4, len(blob) // 2, 997):
+        blob[off] ^= 0xFF                 # stomp the middle of the body
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(CheckpointError):
+        ckpt.restore(path, RESUME_CFG)
+
+
+# ---- fuzz axis (tools/fuzz_sweep.py --faults) --------------------------
+
+def draw_fault_model(rng: np.random.Generator, n_peers: int,
+                     n_trackers: int) -> FaultModel:
+    kw = {}
+    if rng.integers(0, 2):
+        kw.update(ge_p_bad=float(rng.choice([0.15, 0.4])), ge_p_good=0.5,
+                  ge_loss_bad=float(rng.choice([0.5, 0.9])),
+                  ge_loss_good=0.05)
+    if rng.integers(0, 2):
+        mid = (n_trackers + n_peers) // 2
+        kw["partitions"] = (((n_trackers, mid), (mid, n_peers)),)
+    if rng.integers(0, 2):
+        kw["dup_rate"] = float(rng.choice([0.2, 0.5]))
+    if rng.integers(0, 2):
+        kw["corrupt_rate"] = float(rng.choice([0.15, 0.4]))
+    if rng.integers(0, 2) and n_peers > n_trackers + 4:
+        kw.update(flood_senders=(n_trackers + 1,),
+                  flood_fanout=int(rng.choice([4, 10])))
+    if rng.integers(0, 2):
+        kw.update(health_checks=True,
+                  health_drop_limit=int(rng.choice([2, 16])))
+    return FaultModel(**kw)
+
+
+def run_fault_draw(seed: int) -> None:
+    """One fuzz draw over the FaultModel grid: random fault knobs on a
+    random small overlay with random traffic, bit-exact vs oracle every
+    round.  The ``--faults`` axis of tools/fuzz_sweep.py."""
+    rng = np.random.default_rng(seed)
+    n_trackers = int(rng.integers(1, 3))
+    n_peers = n_trackers + int(rng.integers(10, 30))
+    cfg = CommunityConfig(
+        n_peers=n_peers, n_trackers=n_trackers,
+        k_candidates=int(rng.choice([4, 8])),
+        msg_capacity=int(rng.choice([16, 32])),
+        bloom_capacity=int(rng.choice([8, 16])),
+        request_inbox=int(rng.choice([2, 4])),
+        tracker_inbox=int(rng.choice([4, 8])),
+        response_budget=int(rng.choice([2, 6])),
+        forward_fanout=int(rng.choice([0, 2, 3])),
+        push_inbox=int(rng.choice([2, 16])),
+        sync_strategy=str(rng.choice(["largest", "modulo"])),
+        churn_rate=float(rng.choice([0.0, 0.05])),
+        packet_loss=float(rng.choice([0.0, 0.15])),
+        n_meta=4,
+        faults=draw_fault_model(rng, n_peers, n_trackers))
+    state = S.init_state(cfg, jax.random.PRNGKey(seed))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=4)
+    oracle.seed_overlay(degree=4)
+    for rnd in range(10):
+        for _ in range(2):
+            author = int(rng.integers(cfg.n_trackers, n_peers))
+            meta = int(rng.integers(0, cfg.n_meta))
+            payload = int(rng.integers(1, 1 << 16))
+            mask = np.arange(n_peers) == author
+            pl = np.full(n_peers, payload, np.uint32)
+            state = E.create_messages(state, cfg, jnp.asarray(mask), meta,
+                                      jnp.asarray(pl))
+            oracle.create_messages(mask, meta, pl)
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle,
+                     f"fault-seed{seed}-round{rnd} cfg={cfg!r}")
+
+
+def test_fault_fuzz_draw_0():
+    run_fault_draw(5000)
+
+
+def test_fault_fuzz_draw_1():
+    run_fault_draw(5001)
+
+
+@pytest.mark.slow
+def test_fault_fuzz_grid_slow():
+    """Bulk FaultModel-grid sweep (the tier-1 pair above pins two seeds;
+    the rest ride here / in tools/fuzz_sweep.py --faults)."""
+    for seed in range(5002, 5010):
+        run_fault_draw(seed)

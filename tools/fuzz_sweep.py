@@ -37,25 +37,38 @@ def main() -> None:
                          "interleavings + dark authors + cross-peer store "
                          "convergence assert (test_fuzz_configs."
                          "run_adversarial_draw)")
+    ap.add_argument("--faults", action="store_true",
+                    help="chaos-harness draws: random FaultModel grids "
+                         "(GE bursty loss, partitions, dup/corrupt, "
+                         "byzantine flood, health sentinels) vs oracle "
+                         "(test_faults.run_fault_draw)")
     ap.add_argument("--out", default=None,
                     help="artifact path (default: artifacts/fuzz_sweep.json,"
                          " or artifacts/fuzz_sweep_adversarial.json with"
                          " --adversarial)")
     args = ap.parse_args()
+    if args.adversarial and args.faults:
+        ap.error("--adversarial and --faults are separate sweep axes")
     if args.out is None:
         args.out = ("artifacts/fuzz_sweep_adversarial.json"
-                    if args.adversarial else "artifacts/fuzz_sweep.json")
+                    if args.adversarial else
+                    "artifacts/fuzz_sweep_faults.json" if args.faults
+                    else "artifacts/fuzz_sweep.json")
 
     from test_fuzz_configs import run_adversarial_draw, run_draw  # noqa: E501  pulls in jax (CPU-pinned)
     import jax
     if args.adversarial:
         run_draw = run_adversarial_draw
+    elif args.faults:
+        from test_faults import run_fault_draw
+        run_draw = run_fault_draw
 
     passed, skipped, failed = [], [], []
     t0 = time.time()
     doc = {
         "tool": "fuzz_sweep", "seed_start": args.start, "seeds_run": 0,
         "adversarial": bool(args.adversarial),
+        "faults": bool(args.faults),
         "passed": 0, "skipped_invalid_config": 0, "failed": 0,
         "failed_seeds": [], "wall_seconds": 0.0,
     }
@@ -88,6 +101,7 @@ def main() -> None:
             "tool": "fuzz_sweep", "seed_start": args.start,
             "seeds_run": seed - args.start + 1,
             "adversarial": bool(args.adversarial),
+            "faults": bool(args.faults),
             "passed": len(passed), "skipped_invalid_config": len(skipped),
             "failed": len(failed), "failed_seeds": failed,
             "wall_seconds": round(time.time() - t0, 1),

@@ -26,8 +26,8 @@ from .core import Finding
 OPS_PACKAGE = "dispersy_tpu.ops"
 # Modules that define ops (the contracts module itself only defines the
 # decorators and checker — its public surface is not ops).
-OPS_MODULES = ("bloom", "candidates", "hashing", "inbox", "intake",
-               "rng", "store", "timeline")
+OPS_MODULES = ("bloom", "candidates", "faults", "hashing", "inbox",
+               "intake", "rng", "store", "timeline")
 
 
 def public_functions(mod):

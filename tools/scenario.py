@@ -75,16 +75,34 @@ def load(path: str) -> tuple[CommunityConfig, S.Scenario]:
         events.append((rnd, cls(**e)))
     return cfg, S.Scenario(rounds=doc["rounds"], events=events,
                            seed_degree=doc.get("seed_degree", 8),
-                           snapshot_every=doc.get("snapshot_every", 1))
+                           snapshot_every=doc.get("snapshot_every", 1),
+                           autosave_every=doc.get("autosave_every", 0),
+                           autosave_dir=doc.get("autosave_dir"))
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("scenario", help="scenario JSON file")
     ap.add_argument("--out", default=None, help="metrics artifact path")
+    ap.add_argument("--autosave-every", type=int, default=None,
+                    help="checkpoint every N rounds (overrides the "
+                         "scenario file's autosave_every)")
+    ap.add_argument("--autosave-dir", default=None,
+                    help="autosave directory (overrides the scenario "
+                         "file's autosave_dir)")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from the latest VALID autosave in the "
+                         "autosave dir (CRC-failed snapshots are "
+                         "rejected and the previous one used); finishes "
+                         "bit-identically to an uninterrupted run")
     args = ap.parse_args()
     cfg, sc = load(args.scenario)
-    state, log = S.run(cfg, sc)
+    import dataclasses as _dc
+    if args.autosave_every is not None:
+        sc = _dc.replace(sc, autosave_every=args.autosave_every)
+    if args.autosave_dir is not None:
+        sc = _dc.replace(sc, autosave_dir=args.autosave_dir)
+    state, log = S.run(cfg, sc, resume=args.resume)
     if args.out:
         log.dump(args.out)
     last = log.rows[-1] if log.rows else {}

@@ -37,7 +37,8 @@ __all__ = ["CommunityConfig", "Community"]
 #   dispersy_tpu.crypto      ECCrypto / Member / MemberRegistry / identities
 #   dispersy_tpu.conversion  packet encode/decode (conformance)
 #   dispersy_tpu.checkpoint  save / restore
-#   dispersy_tpu.metrics     snapshot / MetricsLog
+#   dispersy_tpu.metrics     snapshot / MetricsLog (+ extend_from_ring)
+#   dispersy_tpu.telemetry   TelemetryConfig / row schema / flight records
 #   dispersy_tpu.binlog      packed binary round logs (ldecoder analogue)
 #   dispersy_tpu.scenario    Scenario / run + event types
 #   dispersy_tpu.parallel    make_mesh / shard_state

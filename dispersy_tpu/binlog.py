@@ -36,13 +36,19 @@ class BinaryLog:
     writes the schema's fields (missing -> NaN, extras ignored — scenario
     rows carry run-specific extras that a fixed binary schema drops by
     design; use MetricsLog's JSON dump when you need them all).
+
+    ``strict=True`` turns a missing schema field into an immediate
+    ``ValueError`` naming it instead of a silent NaN — the mode
+    ``MetricsLog.dump_binary`` uses after validating its rows, so a
+    schema drift can never reach the file as NaN holes.
     """
 
     def __init__(self, path: str, fields: list[str],
-                 meta: dict | None = None):
+                 meta: dict | None = None, strict: bool = False):
         if not fields:
             raise ValueError("BinaryLog needs at least one field")
         self.path = path
+        self.strict = strict
         self.fields = list(fields)
         self._fmt = "<" + "d" * len(self.fields)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -59,6 +65,12 @@ class BinaryLog:
         self._f = open(path, "ab")
 
     def append(self, row: dict) -> None:
+        if self.strict:
+            missing = [k for k in self.fields if k not in row]
+            if missing:
+                raise ValueError(
+                    f"BinaryLog(strict): row is missing schema "
+                    f"field(s) {missing}")
         vals = [float(row.get(k, float("nan"))) for k in self.fields]
         self._f.write(struct.pack(self._fmt, *vals))
         # Rows arrive at experiment rate (one per round), not event rate:

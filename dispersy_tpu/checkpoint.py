@@ -42,6 +42,7 @@ from dispersy_tpu.config import CommunityConfig
 from dispersy_tpu.exceptions import CheckpointError
 from dispersy_tpu.faults import FaultModel
 from dispersy_tpu.state import PeerState, init_state, wipe_instance_memory
+from dispersy_tpu.telemetry import TelemetryConfig
 
 # v2: PeerState gained the signature request cache (sig_*) and Stats the
 # sig_signed/sig_done/sig_expired counters — v1 archives lack those leaves.
@@ -57,16 +58,24 @@ from dispersy_tpu.state import PeerState, init_state, wipe_instance_memory
 #     (config.META_DTYPE/FLAGS_DTYPE).  v7 archives still load: the
 #     sentinel is EMPTY_U32's low byte, so plain uint32 -> uint8
 #     truncation is the lossless up-conversion (_upconvert_v7).
-FORMAT_VERSION = 9   # v9: per-leaf CRC32s (``crc:<leaf>`` keys — a
-#     bit-flipped or short-written archive raises CheckpointError
-#     instead of silently restoring garbage) + the chaos-harness leaves
+# v9: per-leaf CRC32s (``crc:<leaf>`` keys — a bit-flipped or
+#     short-written archive raises CheckpointError instead of silently
+#     restoring garbage) + the chaos-harness leaves
 #     (health / ge_bad / stats.msgs_corrupt_dropped, knob-sized;
 #     dispersy_tpu/faults.py).  v7/v8 archives still load: they carry no
 #     CRCs to verify, their missing fault leaves default to the
 #     template's empty values, and their config fingerprint predates the
 #     ``faults`` field (_legacy_fingerprint) — restoring one under a
 #     non-default FaultModel is refused.
-_ACCEPTED_VERSIONS = (7, 8, FORMAT_VERSION)
+FORMAT_VERSION = 10  # v10: the telemetry-plane leaves (walk_streak /
+#     tele_row / tele_ring / fr_ring / fr_pos, knob-sized —
+#     dispersy_tpu/telemetry.py).  v7-v9 archives still load: their
+#     missing telemetry leaves default to the template's (zero-width)
+#     values and their config fingerprint predates the ``telemetry``
+#     field — restoring one under a non-default TelemetryConfig is
+#     refused (_want_fingerprint strips the ``telemetry=...`` repr
+#     component, plus ``faults=...`` for pre-v9).
+_ACCEPTED_VERSIONS = (7, 8, 9, FORMAT_VERSION)
 
 # Leaves whose dtype narrowed u32 -> u8 at v8; a v7 archive's u32 arrays
 # convert by truncation (0xFFFFFFFF -> 0xFF, real values < 256 unchanged).
@@ -77,6 +86,13 @@ _NARROWED_V8 = frozenset(
 # (all-zero / empty) when restoring an older archive.
 _NEW_V9 = frozenset(
     {"health", "ge_bad", "stats/msgs_corrupt_dropped"})
+
+# Leaves that did not exist before v10 (the telemetry plane).  Older
+# archives only restore under a default TelemetryConfig (enforced by
+# _want_fingerprint), where every one of these is zero-width — the
+# template default IS the archived state.
+_NEW_V10 = frozenset(
+    {"walk_streak", "tele_row", "tele_ring", "fr_ring", "fr_pos"})
 
 
 def _crc(arr: np.ndarray) -> int:
@@ -113,18 +129,32 @@ def _fingerprint(cfg: CommunityConfig) -> str:
 
 def _want_fingerprint(cfg: CommunityConfig, version: int) -> str:
     """The fingerprint an archive of ``version`` should carry for
-    ``cfg``.  Pre-v9 archives were written before CommunityConfig grew
-    the ``faults`` field; it is declared LAST, so its repr component
-    strips cleanly — but only a default FaultModel can possibly match
-    what the old writer simulated."""
-    if version >= 9:
+    ``cfg``.  Pre-v10 archives were written before CommunityConfig grew
+    the ``telemetry`` field (declared second-to-last, directly before
+    ``faults``), and pre-v9 ones before ``faults`` (declared LAST) —
+    both repr components strip cleanly, but only default models can
+    possibly match what the old writer simulated."""
+    if version >= 10:
         return _fingerprint(cfg)
+    if cfg.telemetry != TelemetryConfig():
+        raise CheckpointError(
+            f"checkpoint format {version} predates the telemetry plane; "
+            "it can only restore under the default TelemetryConfig "
+            "(cfg.telemetry must be TelemetryConfig())")
+    full = repr(cfg)
+    tcomp = f", telemetry={cfg.telemetry!r}"
+    if full.count(tcomp) != 1:
+        raise CheckpointError(
+            "cannot derive pre-v10 fingerprint: telemetry is no longer "
+            "a direct config field directly before faults")
+    full = full.replace(tcomp, "", 1)
+    if version >= 9:
+        return full
     if cfg.faults != FaultModel():
         raise CheckpointError(
             f"checkpoint format {version} predates the fault model; it "
             "can only restore under the default FaultModel "
             "(cfg.faults must be FaultModel())")
-    full = repr(cfg)
     suffix = f", faults={cfg.faults!r})"
     if not full.endswith(suffix):
         raise CheckpointError("cannot derive pre-v9 fingerprint: faults "
@@ -222,9 +252,11 @@ def restore(path: str, cfg: CommunityConfig,
         for n, t in zip(names, t_leaves):
             key = f"leaf:{n}"
             if key not in z:
-                if version < 9 and n in _NEW_V9:
-                    # pre-chaos-harness archive: the leaf starts at its
-                    # template default (empty latch / all-good channels)
+                if (version < 9 and n in _NEW_V9) \
+                        or (version < 10 and n in _NEW_V10):
+                    # pre-chaos-harness / pre-telemetry archive: the
+                    # leaf starts at its template default (zero-width /
+                    # empty latch / all-good channels)
                     leaves.append(np.asarray(t))
                     continue
                 raise CheckpointError(f"checkpoint missing field {n}")
@@ -458,8 +490,11 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
                     f"field {name}: checkpoint {arr.shape}/{arr.dtype} vs "
                     f"config {t.shape}/{t.dtype}")
             leaves.append(arr)
-        elif version < 9 and name in _NEW_V9 and not covered[name].any():
-            # pre-chaos-harness archive: template default (state.py)
+        elif ((version < 9 and name in _NEW_V9)
+              or (version < 10 and name in _NEW_V10)) \
+                and not covered[name].any():
+            # pre-chaos-harness / pre-telemetry archive: template
+            # default (state.py)
             leaves.append(np.asarray(t))
         else:
             if not covered[name].all():

@@ -20,6 +20,7 @@ import math
 
 from dispersy_tpu.exceptions import ConfigError
 from dispersy_tpu.faults import FaultModel
+from dispersy_tpu.telemetry import MAX_TELEMETRY_PEERS, TelemetryConfig
 
 # Sentinel for "empty slot" in uint32 record fields: sorts after every real
 # global_time, so ascending sort pushes holes to the end of the store ring.
@@ -505,13 +506,24 @@ class CommunityConfig:
     # -1 = auto: the first non-tracker peer (index n_trackers).
     founder_member: int = -1
 
+    # ---- telemetry plane (dispersy_tpu/telemetry.py: fused in-step
+    #      metrics row, device-resident round-history ring, on-device
+    #      histograms, flight recorder — OBSERVABILITY.md).  All
+    #      defaults compile to exactly the telemetry-free step.  MUST
+    #      stay the SECOND-TO-LAST field, directly before ``faults``:
+    #      checkpoint.py reconstructs pre-v10 config fingerprints by
+    #      stripping the trailing ``telemetry=...`` (and, pre-v9,
+    #      ``faults=...``) repr components. ----
+    telemetry: TelemetryConfig = TelemetryConfig()
+
     # ---- correlated fault channel + health sentinels (the chaos
     #      harness — dispersy_tpu/faults.py: Gilbert–Elliott bursty
     #      loss, region partitions, duplication, corruption, byzantine
     #      flooders, on-device health bits).  All-defaults compiles to
     #      exactly the fault-free step (FAULTS.md).  MUST stay the LAST
-    #      field: checkpoint.py reconstructs pre-v9 config fingerprints
-    #      by stripping the trailing ``faults=...`` repr component. ----
+    #      field (with ``telemetry`` directly before it): checkpoint.py
+    #      reconstructs pre-v10/pre-v9 config fingerprints by stripping
+    #      the trailing repr components. ----
     faults: FaultModel = FaultModel()
 
     # ------------------------------------------------------------------
@@ -798,6 +810,17 @@ class CommunityConfig:
             if self.push_inbox < 1:
                 raise ConfigError("flooding rides the push channel: "
                                   "push_inbox must be >= 1")
+        tl = self.telemetry
+        if not isinstance(tl, TelemetryConfig):
+            raise ConfigError("telemetry must be a TelemetryConfig")
+        if tl.enabled and self.n_peers > MAX_TELEMETRY_PEERS:
+            raise ConfigError(
+                f"telemetry's byte-lane u64 sums are exact only up to "
+                f"{MAX_TELEMETRY_PEERS} peers (got {self.n_peers})")
+        if tl.flight_recorder > 0 and not fm.health_checks:
+            raise ConfigError(
+                "telemetry.flight_recorder records health-sentinel "
+                "latches — it requires faults.health_checks=True")
         if self.identity_requests:
             if not self.identity_required:
                 raise ConfigError("identity_requests without "

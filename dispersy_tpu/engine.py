@@ -67,11 +67,13 @@ from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_META, EMPTY_U32,
                                  SIGNATURE_REQUEST_BYTES,
                                  SIGNATURE_RESPONSE_BYTES, CommunityConfig,
                                  user_perm_mask)
+from dispersy_tpu import telemetry as tlm
 from dispersy_tpu.faults import (HEALTH_BLOOM_SAT, HEALTH_COUNTER_WRAP,
                                  HEALTH_INBOX_DROP, HEALTH_STORE_INVARIANT)
 from dispersy_tpu.ops import bloom, candidates as cand, inbox, rng, store as st
 from dispersy_tpu.ops import faults as flt
 from dispersy_tpu.ops import intake as ik
+from dispersy_tpu.ops import telemetry as tele
 from dispersy_tpu.ops import timeline as tl
 from dispersy_tpu.ops.hashing import record_hash
 from dispersy_tpu.state import (FLAG_UNDONE, NEVER, PeerState,
@@ -429,6 +431,73 @@ def _fold_gt(own_gt: jnp.ndarray, seen_gt: jnp.ndarray, seen_valid: jnp.ndarray,
     return jnp.maximum(own_gt, best)
 
 
+def counter_matrix(stats, n: int) -> jnp.ndarray:
+    """``u32[N, len(U64_COUNTERS)]``: every snapshot counter as a
+    column, in ``telemetry.U64_COUNTERS`` order.  THE one definition of
+    the zero-width padding rule — a compiled-out leaf (e.g.
+    ``msgs_corrupt_dropped`` without its fault knobs) reads as a zero
+    column, so totals and row layout never depend on fault knobs.
+    Shared by the fused row builder and ``metrics.snapshot``'s legacy
+    stacked-transfer path, which must reduce identical data."""
+    return jnp.stack(
+        [c if c.shape[0] == n else jnp.zeros((n,), jnp.uint32)
+         for c in (getattr(stats, nm) for nm in tlm.U64_COUNTERS)],
+        axis=1)
+
+
+def _telemetry_row(cfg: CommunityConfig, *, rnd, new_time, members, stats,
+                   stc, health, store_cnt, cand_cnt, hists) -> jnp.ndarray:
+    """Pack the fused per-round telemetry row (u32[row_width]).
+
+    Every ``metrics.snapshot`` aggregate, reduced on device and laid out
+    by ``telemetry.row_schema`` — counter totals as exact u64 (lo, hi)
+    pairs (ops/telemetry.col_sum_u64), occupancy as integer numerators,
+    health bits as per-bit counts, histograms as bucket-count blocks.
+    The oracle packs the identical row host-side through
+    ``telemetry.pack_row_host``; the parity tests pin the two
+    bit-for-bit.
+    """
+    n = cfg.n_peers
+
+    def w(x):
+        return jnp.reshape(x.astype(jnp.uint32), (1,))
+
+    vals = {"round": w(rnd + jnp.uint32(1)),
+            "sim_time": jnp.reshape(
+                lax.bitcast_convert_type(new_time, jnp.uint32), (1,)),
+            "alive_members": w(jnp.sum(members, dtype=jnp.int32)),
+            "killed": w(jnp.sum(killed_mask(stc.meta), dtype=jnp.int32))}
+    # One [N, 17] stack -> one 4-lane reduction for every counter total.
+    csum = tele.col_sum_u64(counter_matrix(stats, n))        # [2, 17]
+    for i, nm in enumerate(tlm.U64_COUNTERS):
+        vals[nm] = csum[:, i]
+    vals["store_live"] = tele.sum_u64(store_cnt)
+    vals["cand_live"] = tele.sum_u64(
+        jnp.where(members, cand_cnt, jnp.uint32(0)))
+    # Health words: per-bit flagged-peer counts + the derived OR /
+    # nonzero count (zero-width health leaf -> clean zeros, matching
+    # faults.health_report).
+    hv = jnp.zeros((), jnp.uint32)
+    for b, nm in enumerate(tlm.HEALTH_NAMES):
+        cnt = jnp.sum(((health >> jnp.uint32(b)) & jnp.uint32(1)),
+                      dtype=jnp.uint32)
+        vals[f"health_{nm}"] = w(cnt)
+        hv = hv | jnp.where(cnt > 0, jnp.uint32(1 << b), jnp.uint32(0))
+    vals["health_or"] = w(hv)
+    vals["health_flagged"] = w(jnp.sum(health != 0, dtype=jnp.int32))
+    asum = tele.col_sum_u64(stats.accepted_by_meta)          # [2, K+1]
+    for i in range(cfg.n_meta + 1):
+        vals[f"accepted_by_meta_{i}"] = asum[:, i]
+    if cfg.telemetry.histograms:
+        hb_n = cfg.telemetry.hist_buckets
+        for name, kind, cap in tlm.hist_specs(cfg):
+            val, mask = hists[name]
+            vals[f"hist_{name}"] = (
+                tele.hist_linear(val, mask, cap, hb_n) if kind == "linear"
+                else tele.hist_log2(val, mask, hb_n))
+    return jnp.concatenate([vals[nm] for nm, _ in tlm.row_schema(cfg)])
+
+
 @functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
 def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     """Advance every peer one walker interval (~5 simulated seconds)."""
@@ -449,10 +518,11 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                                 fm.ge_p_bad, fm.ge_p_good)
     else:
         ge_bad = state.ge_bad
-    if fm.health_checks:
+    if fm.health_checks or cfg.telemetry.histograms:
         # Round-start drop counter: the inbox-overload sentinel compares
-        # this round's delta against health_drop_limit at wrap-up.  Both
-        # bounded-queue families count — request-inbox overflow AND
+        # this round's delta against health_drop_limit at wrap-up, and
+        # the telemetry round_drops histogram buckets the same delta.
+        # Both bounded-queue families count — request-inbox overflow AND
         # push/store drops (msgs_dropped — where a byzantine flood
         # lands, since junk saturates the push inbox, not the request
         # ring).  u32 sums/deltas are wrap-safe.
@@ -1085,6 +1155,16 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         walk_success=stats.walk_success
         + (walked_ok & got_resp).astype(jnp.uint32),
         walk_fail=stats.walk_fail + failed.astype(jnp.uint32))
+    if cfg.telemetry.histograms:
+        # Walk-success streak (telemetry walk_streak histogram): +1 on a
+        # successful walk, reset on a failed one, untouched on rounds
+        # the peer did not walk.  Stats-adjacent — survives churn
+        # rebirth like the walk counters it refines (state.py).
+        walk_streak = jnp.where(
+            walked_ok & got_resp, state.walk_streak + jnp.uint32(1),
+            jnp.where(failed, jnp.uint32(0), state.walk_streak))
+    else:
+        walk_streak = state.walk_streak
 
     # ---- phase 3s: signature-request/-response exchange ----------------
     # DoubleMemberAuthentication (reference: authentication.py; community.py
@@ -2240,10 +2320,89 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             hb = hb | jnp.where(
                 fill * jnp.uint32(8) >= jnp.uint32(cfg.bloom_bits * 7),
                 jnp.uint32(HEALTH_BLOOM_SAT), jnp.uint32(0))
+        health_pre = health    # pre-latch view: the flight recorder
+        #   captures bits that latch THIS round (health & ~health_pre)
         health = health | hb
+    # Fold the round's byte totals before telemetry packs the row — the
+    # row must equal what snapshot() sees on the returned state.
+    stats = stats.replace(bytes_up=stats.bytes_up + bup,
+                          bytes_down=stats.bytes_down + bdown)
+    new_time = now + jnp.float32(cfg.walk_interval)
+
+    # ---- telemetry wrap-up (dispersy_tpu/telemetry.py; every branch is
+    # gated on static TelemetryConfig knobs, so disabled telemetry
+    # compiles to the identical step — the faults pattern) -------------
+    tele_row, tele_ring = state.tele_row, state.tele_ring
+    fr_ring, fr_pos = state.fr_ring, state.fr_pos
+    if cfg.telemetry.enabled:
+        members = alive & ~state.is_tracker
+        store_cnt = st.count_valid(stc.gt).astype(jnp.uint32)
+        cand_cnt = jnp.sum(tab.peer != NO_PEER, axis=1,
+                           dtype=jnp.int32).astype(jnp.uint32)
+        if cfg.telemetry.histograms or cfg.telemetry.flight_recorder:
+            # This round's dropped packets/records (u32 wrap-safe).
+            drop_delta = (stats.requests_dropped + stats.msgs_dropped) - rd0
+        if cfg.telemetry.histograms:
+            ones = jnp.ones((n,), bool)
+            if cfg.sync_enabled:
+                bloom_cnt = jnp.sum(flt.popcount_u32(my_bloom), axis=1,
+                                    dtype=jnp.uint32)
+                bloom_mask = ones
+            else:
+                bloom_cnt = jnp.zeros((n,), jnp.uint32)
+                bloom_mask = jnp.zeros((n,), bool)
+            # Histogram inputs; masks per telemetry.hist_specs.
+            hists = {
+                "store_fill": (store_cnt, ones),
+                "cand_fill": (cand_cnt, members),
+                "req_inbox": (n_rq, ~state.is_tracker),
+                "round_drops": (drop_delta, ones),
+                "bloom_fill": (bloom_cnt, bloom_mask),
+                "walk_streak": (walk_streak, members),
+            }
+        else:
+            hists = None
+        tele_row = _telemetry_row(cfg, rnd=rnd, new_time=new_time,
+                                  members=members, stats=stats, stc=stc,
+                                  health=health, store_cnt=store_cnt,
+                                  cand_cnt=cand_cnt, hists=hists)
+        if cfg.telemetry.history:
+            # Post-step round r+1 lands at slot r % H; the row's own
+            # round word identifies the slot at drain time.
+            slot_r = (rnd % jnp.uint32(cfg.telemetry.history)).astype(
+                jnp.int32)
+            tele_ring = state.tele_ring.at[slot_r].set(tele_row,
+                                                       mode="drop")
+        if cfg.telemetry.flight_recorder:
+            # Config-validated: the recorder requires health_checks, so
+            # hb/health_pre exist.  Record the first flight_per_round
+            # peers whose sentinel NEWLY latched this round.
+            newly = hb & ~health_pre
+            is_new = newly != jnp.uint32(0)
+            fpr = cfg.telemetry.flight_per_round
+            frank = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+            frslot = jnp.where(is_new & (frank < fpr), frank, fpr)
+
+            def fsel(col, fill):
+                return st.rank_compact(col[None, :], frslot[None, :],
+                                       fpr, fill)[0]
+            recs = jnp.stack(
+                [fsel(idx.astype(jnp.uint32), EMPTY_U32),
+                 fsel(jnp.broadcast_to(rnd + jnp.uint32(1), (n,)), 0),
+                 fsel(newly, 0),
+                 fsel(health, 0),
+                 fsel(stats.requests_dropped, 0),
+                 fsel(stats.msgs_dropped, 0),
+                 fsel(drop_delta, 0),
+                 fsel(store_cnt, 0)], axis=1)   # [fpr, FLIGHT_WIDTH]
+            fvalid = recs[:, 0] != jnp.uint32(EMPTY_U32)
+            fr_ring, fr_pos = tele.flight_append(
+                state.fr_ring, state.fr_pos, recs, fvalid)
     return state.replace(
         alive=alive, loaded=loaded, session=session,
         global_time=global_time, health=health, ge_bad=ge_bad,
+        walk_streak=walk_streak, tele_row=tele_row, tele_ring=tele_ring,
+        fr_ring=fr_ring, fr_pos=fr_pos,
         mal_member=mal,
         cand_peer=tab.peer, cand_last_walk=tab.last_walk,
         cand_last_stumble=tab.last_stumble, cand_last_intro=tab.last_intro,
@@ -2257,9 +2416,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         auth_gt=auth.gt, auth_rev=auth.rev, auth_issuer=auth.issuer,
         sig_target=sig[0], sig_meta=sig[1], sig_payload=sig[2],
         sig_gt=sig[3], sig_since=sig[4],
-        stats=stats.replace(bytes_up=stats.bytes_up + bup,
-                            bytes_down=stats.bytes_down + bdown),
-        time=now + jnp.float32(cfg.walk_interval),
+        stats=stats,
+        time=new_time,
         round_index=rnd + jnp.uint32(1),
     )
 

@@ -24,8 +24,9 @@ import os
 import jax.numpy as jnp
 import numpy as np
 
+from dispersy_tpu import telemetry as tlm
 from dispersy_tpu.config import EMPTY_U32, NO_PEER, CommunityConfig
-from dispersy_tpu.engine import killed_mask
+from dispersy_tpu.engine import counter_matrix, killed_mask
 from dispersy_tpu.faults import health_report
 from dispersy_tpu.state import PeerState
 
@@ -35,23 +36,44 @@ logger = logging.getLogger(__name__)
 def snapshot(state: PeerState, cfg: CommunityConfig) -> dict:
     """Aggregate overlay metrics (DispersyStatistics snapshot analogue).
 
-    Everything reduces on device first; only scalars cross to host.
+    Two paths:
+
+    - **Fused** (``cfg.telemetry.enabled`` and at least one step has
+      run): the jitted step already reduced every aggregate into the
+      packed ``state.tele_row`` at its wrap-up, so the snapshot is ONE
+      device->host transfer of that row + host-side unpacking — no
+      device work at all.  The row reflects the state as of the last
+      ``step``; between-step mutations (``create_messages`` & co) show
+      up in the next round's row, which is exactly when the scenario
+      logger reads it.
+    - **Legacy** (telemetry off, or round 0 before any step): per-field
+      device reductions, with all ``[N]`` u32 counters crossing in one
+      stacked transfer instead of one transfer per field.
+
     Counters are cumulative (as the reference's are); rates are this
     snapshot's view of them.
     """
+    if cfg.telemetry.enabled:
+        row = np.asarray(state.tele_row)     # the ONE host transfer
+        if int(row[0]):                       # word 0 = post-step round
+            return tlm.row_to_snapshot(row, cfg)
     s = state.stats
     members = state.alive & ~state.is_tracker
     n_members = jnp.maximum(jnp.sum(members), 1)
+    n = cfg.n_peers
 
-    def total(counter) -> int:
-        # Host-side uint64 reduction: on-device sums stay uint32 without
-        # jax_enable_x64 and would wrap (1M peers exceed 2^32 aggregate
-        # bytes within one round).  Counters are [N]-shaped, so one host
-        # transfer per field is cheap next to the step itself.
-        return int(np.asarray(counter, dtype=np.uint64).sum())
+    # Host-side uint64 reduction: on-device sums stay uint32 without
+    # jax_enable_x64 and would wrap (1M peers exceed 2^32 aggregate
+    # bytes within one round).  ONE stacked [N, C] transfer covers every
+    # u32 counter; engine.counter_matrix is the same column stack the
+    # fused row reduces, so the two paths cannot drift.
+    stacked = np.asarray(counter_matrix(s, n))
+    totals = dict(zip(tlm.U64_COUNTERS,
+                      stacked.astype(np.uint64).sum(axis=0).tolist()))
+    totals = {k: int(v) for k, v in totals.items()}
 
-    walk_success = total(s.walk_success)
-    walk_fail = total(s.walk_fail)
+    walk_success = totals["walk_success"]
+    walk_fail = totals["walk_fail"]
     out = {
         "round": int(state.round_index),
         "sim_time": float(state.time),
@@ -61,30 +83,10 @@ def snapshot(state: PeerState, cfg: CommunityConfig) -> dict:
         "walk_success": walk_success,
         "walk_fail": walk_fail,
         "walk_success_rate": walk_success / max(walk_success + walk_fail, 1),
-        # store pipeline (drop/delay/success counts)
-        "msgs_stored": total(s.msgs_stored),
-        "msgs_dropped": total(s.msgs_dropped),
-        "msgs_rejected": total(s.msgs_rejected),
-        "msgs_forwarded": total(s.msgs_forwarded),
-        "msgs_direct": total(s.msgs_direct),
-        "msgs_delayed": total(s.msgs_delayed),
-        # chaos harness (dispersy_tpu/faults.py): records dropped by the
-        # intake hash re-check (corruption / flood junk); 0 when the
-        # leaf is compiled out (zero-width)
-        "msgs_corrupt_dropped": total(s.msgs_corrupt_dropped),
-        "requests_dropped": total(s.requests_dropped),
-        "punctures": total(s.punctures),
-        # double-signed flow
-        "sig_signed": total(s.sig_signed),
-        "sig_done": total(s.sig_done),
-        "sig_expired": total(s.sig_expired),
-        # malicious-member convictions observed (malicious_enabled)
-        "conflicts": total(s.conflicts),
-        # endpoint byte totals (endpoint.py total_up / total_down).
-        # NOTE: the per-peer device counters themselves wrap mod 2^32 by
-        # design (state.py); the host reduction is exact over them.
-        "bytes_up": total(s.bytes_up),
-        "bytes_down": total(s.bytes_down),
+        # store pipeline (drop/delay/success counts), chaos-harness
+        # corrupt drops, double-signed flow, convictions, endpoint byte
+        # totals — the U64_COUNTERS band (telemetry.py documents each).
+        **{nm: totals[nm] for nm in tlm.U64_COUNTERS[2:]},
         # occupancy (how full the bounded structures run)
         "store_fill": float(jnp.mean(
             jnp.sum(state.store_gt != jnp.uint32(EMPTY_U32), axis=1)
@@ -103,6 +105,15 @@ def snapshot(state: PeerState, cfg: CommunityConfig) -> dict:
             int(x) for x in
             np.asarray(s.accepted_by_meta, dtype=np.uint64).sum(axis=0)],
     }
+    if cfg.telemetry.histograms:
+        # Histograms only exist in-step; a pre-first-step snapshot on a
+        # histogram-enabled config reports them EMPTY so its key set
+        # matches the fused rows that follow (dump_binary validates
+        # every row against one schema).
+        for name, _, _ in tlm.hist_specs(cfg):
+            out[f"hist_{name}_p50"] = 0
+            out[f"hist_{name}_p99"] = 0
+            out[f"hist_{name}"] = [0] * cfg.telemetry.hist_buckets
     return out
 
 
@@ -126,6 +137,36 @@ class MetricsLog:
         logger.debug("round %d: %s", row["round"], row)
         return row
 
+    def extend_from_ring(self, state: PeerState,
+                         cfg: CommunityConfig) -> list:
+        """Drain the device-resident round-history ring
+        (``state.tele_ring``, written inside the jitted step) into the
+        log: ONE device->host transfer yields the per-round snapshot of
+        every round since the last drain — how a ``multi_step`` batch
+        of K rounds reports its full metrics history without K host
+        round trips.  Requires ``cfg.telemetry.history > 0``; rounds
+        already logged are skipped, and a drain gap longer than the
+        ring depth raises (rows would be silently missing otherwise).
+        Returns the appended rows.
+        """
+        if cfg.telemetry.history <= 0:
+            raise ValueError("extend_from_ring needs telemetry.history "
+                             "> 0 (the device ring is compiled out)")
+        ring = np.asarray(state.tele_ring)   # the ONE host transfer
+        rows = tlm.ring_rows(ring, cfg)
+        last = self.rows[-1]["round"] if self.rows else 0
+        fresh = [r for r in rows if r["round"] > last]
+        if fresh and fresh[0]["round"] > last + 1:
+            raise ValueError(
+                f"telemetry ring overflowed: oldest available round is "
+                f"{fresh[0]['round']} but the log ends at {last} — "
+                f"drain at least every telemetry.history="
+                f"{cfg.telemetry.history} rounds")
+        for row in fresh:
+            self.rows.append(row)
+            logger.debug("round %d: %s", row["round"], row)
+        return fresh
+
     def dump(self, path: str) -> None:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "w") as f:
@@ -137,17 +178,37 @@ class MetricsLog:
             for row in self.rows:
                 f.write(json.dumps(row) + "\n")
 
+    @staticmethod
+    def _scalar_fields(row: dict) -> list:
+        return [k for k, v in row.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)]
+
     def dump_binary(self, path: str) -> None:
         """Packed fixed-schema form (see :mod:`dispersy_tpu.binlog`) —
         the experiment-rate format tool/ldecoder.py decodes in the
         reference.  Scalar fields of the first row fix the schema;
-        non-scalar extras (e.g. accepted_by_meta) stay JSON-only."""
+        non-scalar extras (e.g. accepted_by_meta, hist_* bucket lists)
+        stay JSON-only.  Every later row is validated against that
+        schema BEFORE anything is written: a row with a missing or
+        extra scalar key would silently misalign the packed matrix
+        (every later field shifted one slot), so the mismatch raises
+        with the offending row and field names instead."""
         from dispersy_tpu import binlog
         if not self.rows:
             raise ValueError("nothing logged")
-        fields = [k for k, v in self.rows[0].items()
-                  if isinstance(v, (int, float)) and not isinstance(v, bool)]
-        with binlog.BinaryLog(path, fields, meta=self.meta) as log:
+        fields = self._scalar_fields(self.rows[0])
+        schema = set(fields)
+        for i, row in enumerate(self.rows[1:], start=1):
+            got = set(self._scalar_fields(row))
+            missing, extra = schema - got, got - schema
+            if missing or extra:
+                raise ValueError(
+                    f"dump_binary: row {i} (round {row.get('round')!r}) "
+                    "does not match the schema fixed by row 0 — "
+                    f"missing {sorted(missing)}, unexpected "
+                    f"{sorted(extra)}; dump_jsonl handles ragged rows")
+        with binlog.BinaryLog(path, fields, meta=self.meta,
+                              strict=True) as log:
             for row in self.rows:
                 log.append(row)
 

@@ -1,0 +1,126 @@
+"""Telemetry kernels: u64-safe counter sums, histograms, flight append.
+
+The jit-traced half of the telemetry plane (:mod:`dispersy_tpu.telemetry`
+declares the static :class:`~dispersy_tpu.telemetry.TelemetryConfig` and
+the row schema; the engine composes these into the fused round's wrap-up
+only when the matching knob is on, so disabled telemetry compiles to the
+identical step).  Every op mirrors bit-for-bit in the oracle
+(:mod:`dispersy_tpu.oracle.sim` packs its row through
+``telemetry.pack_row_host`` from plain-int equivalents), the same
+lockstep discipline as every other ops module.
+
+Design notes:
+
+- **u64-safe sums without x64**: per-peer counters are uint32 and their
+  overlay-wide totals exceed 2^32 within one 1M-peer round, but
+  ``jax_enable_x64`` stays off.  :func:`col_sum_u64` splits each word
+  into its four byte lanes, reduces each lane in uint32 (exact while
+  ``N * 255 < 2^32`` — ``telemetry.MAX_TELEMETRY_PEERS``, enforced by
+  config validation), and recombines the lane totals into a (lo, hi)
+  uint32 pair with explicit carries.  The result is the exact 64-bit
+  sum of the wrapped per-peer values — bit-identical to the host-side
+  ``np.uint64`` reduction ``metrics.snapshot`` used to do.
+- **Histograms as scatter-adds**: one ``[N] -> [B]`` scatter-add per
+  histogram (``mode="drop"`` routes masked-out entries to the spill
+  index), never an ``[N, B]`` one-hot — the row is meant to make
+  telemetry CHEAPER, not add an N x B intermediate.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dispersy_tpu.ops.contracts import Spec, contract
+from dispersy_tpu.ops.faults import popcount_u32
+
+
+@contract(out=Spec("uint32", (2, "C")), x=Spec("uint32", ("N", "C")))
+def col_sum_u64(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact 64-bit column sums of a uint32 matrix, as u32 (lo, hi) rows.
+
+    Returns ``[2, C]``: row 0 the low words, row 1 the high words of
+    each column's sum over axis 0.  Exact while ``N <= MAX_TELEMETRY_PEERS``
+    (byte-lane partial sums must fit uint32).
+    """
+    lo = jnp.zeros(x.shape[1:], jnp.uint32)
+    hi = jnp.zeros(x.shape[1:], jnp.uint32)
+    for sh in (0, 8, 16, 24):
+        lane = jnp.sum((x >> jnp.uint32(sh)) & jnp.uint32(0xFF), axis=0,
+                       dtype=jnp.uint32)            # < N * 255, exact
+        add_lo = lane << jnp.uint32(sh)
+        new_lo = lo + add_lo
+        hi = hi + (new_lo < lo).astype(jnp.uint32)  # carry out of lo
+        if sh:
+            hi = hi + (lane >> jnp.uint32(32 - sh))
+        lo = new_lo
+    return jnp.stack([lo, hi])
+
+
+@contract(out=Spec("uint32", (2,)), x=Spec("uint32", ("N",)))
+def sum_u64(x: jnp.ndarray) -> jnp.ndarray:
+    """:func:`col_sum_u64` for one vector: ``[2]`` = (lo, hi)."""
+    return col_sum_u64(x[:, None])[:, 0]
+
+
+@contract(out=Spec("uint32", ("G",)),
+          val=Spec("uint32", ("N",)), mask=Spec("bool", ("N",)),
+          cap=7, n_buckets=lambda d: d["G"], dims={"G": 5})
+def hist_linear(val: jnp.ndarray, mask: jnp.ndarray, cap: int,
+                n_buckets: int) -> jnp.ndarray:
+    """Masked linear histogram over [0, cap]: bucket counts ``u32[B]``.
+
+    Bucket of ``v`` is ``v * B // (cap + 1)`` (values at ``cap`` land in
+    the last bucket; ``cap * B`` must fit uint32 — occupancy caps are
+    tiny).  Masked-out entries scatter to the out-of-range spill index
+    and are dropped.
+    """
+    b = jnp.minimum((val * jnp.uint32(n_buckets)) // jnp.uint32(cap + 1),
+                    jnp.uint32(n_buckets - 1)).astype(jnp.int32)
+    idx = jnp.where(mask, b, jnp.int32(n_buckets))
+    return (jnp.zeros((n_buckets,), jnp.uint32)
+            .at[idx].add(jnp.uint32(1), mode="drop"))
+
+
+@contract(out=Spec("uint32", ("G",)),
+          val=Spec("uint32", ("N",)), mask=Spec("bool", ("N",)),
+          n_buckets=lambda d: d["G"], dims={"G": 5})
+def hist_log2(val: jnp.ndarray, mask: jnp.ndarray,
+              n_buckets: int) -> jnp.ndarray:
+    """Masked bit-length histogram: bucket = ``bit_length(v)`` clamped
+    to the last bucket (0 -> bucket 0; bucket b holds [2^(b-1), 2^b)).
+
+    Bit length via bit-smear + SWAR popcount (``ops.faults``), all
+    uint32 elementwise — the oracle mirrors with ``int.bit_length``.
+    """
+    v = val.astype(jnp.uint32)
+    for sh in (1, 2, 4, 8, 16):
+        v = v | (v >> jnp.uint32(sh))
+    bl = popcount_u32(v)                 # == bit_length(val)
+    b = jnp.minimum(bl, jnp.uint32(n_buckets - 1)).astype(jnp.int32)
+    idx = jnp.where(mask, b, jnp.int32(n_buckets))
+    return (jnp.zeros((n_buckets,), jnp.uint32)
+            .at[idx].add(jnp.uint32(1), mode="drop"))
+
+
+@contract(out=(Spec("uint32", ("D", "F")), Spec("uint32", (1,))),
+          ring=Spec("uint32", ("D", "F")), pos=Spec("uint32", (1,)),
+          records=Spec("uint32", ("R", "F")), valid=Spec("bool", ("R",)),
+          dims={"D": 15, "F": 5, "R": 17})
+def flight_append(ring: jnp.ndarray, pos: jnp.ndarray,
+                  records: jnp.ndarray, valid: jnp.ndarray):
+    """Append the valid records to the flight-recorder ring.
+
+    ``pos`` is the cumulative record count (u32[1], never reduced mod
+    the depth — the host decoder derives wrap state from it); valid
+    records land at consecutive slots ``(pos + rank) % depth`` in rank
+    order, invalid ones scatter to the spill index and are dropped.
+    Callers bound the per-call valid count by the ring depth
+    (``flight_per_round <= flight_recorder``, config-validated), so one
+    append never overwrites its own records.
+    """
+    depth = ring.shape[0]
+    rank = jnp.cumsum(valid.astype(jnp.uint32)) - jnp.uint32(1)
+    slot = ((pos[0] + rank) % jnp.uint32(depth)).astype(jnp.int32)
+    slot = jnp.where(valid, slot, jnp.int32(depth))
+    ring = ring.at[slot].set(records, mode="drop")
+    return ring, pos + jnp.sum(valid.astype(jnp.uint32))

@@ -42,6 +42,7 @@ from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_META, EMPTY_U32,
                                  RECORD_BYTES, SIGNATURE_REQUEST_BYTES,
                                  SIGNATURE_RESPONSE_BYTES, CommunityConfig,
                                  priority_of, user_perm_mask)
+from dispersy_tpu import telemetry as tlm
 from dispersy_tpu.oracle.bloom import OracleBloom, record_hash
 from dispersy_tpu.ops import rng as _jrng
 
@@ -218,6 +219,16 @@ class OracleSim:
         # Gilbert–Elliott channel state (engine: PeerState.ge_bad) —
         # the link's property, surviving churn rebirth.
         self.ge_bad = [False] * cfg.n_peers
+        # Telemetry plane (engine wrap-up mirror; dispersy_tpu/telemetry).
+        # The streak is tracked unconditionally (cheap here) and exposed
+        # zero-width when the histogram knob is off, like the device leaf.
+        self.walk_streak = [0] * cfg.n_peers
+        self.tele_row = np.zeros((tlm.row_width(cfg),), np.uint32)
+        self.tele_ring = np.zeros(
+            (cfg.telemetry.history, tlm.row_width(cfg)), np.uint32)
+        self.fr_ring = np.zeros(
+            (cfg.telemetry.flight_recorder, tlm.FLIGHT_WIDTH), np.uint32)
+        self.fr_pos = 0
         # Multi-community layout (engine._layout_cols mirror, same source).
         (self.community, self.boot_base, self.boot_count,
          self.mem_base, self.mem_count) = cfg.layout()
@@ -990,6 +1001,8 @@ class OracleSim:
             # Round-start counter snapshots for the wrap / drop sentinels.
             bu0 = [p.bytes_up & M32 for p in self.peers]
             bd0 = [p.bytes_down & M32 for p in self.peers]
+        if fm.health_checks or cfg.telemetry.histograms:
+            # Shared with the telemetry round_drops histogram (engine rd0).
             rd0 = [p.requests_dropped + p.msgs_dropped
                    for p in self.peers]
 
@@ -1152,8 +1165,10 @@ class OracleSim:
         rq_ok = [[self.peers[d].alive and self.peers[d].loaded
                   for _ in box]
                  for d, box in enumerate(req_inbox)]
+        tele_nrq = [0] * n     # telemetry req_inbox histogram (engine n_rq)
         for d in range(n):
             n_rq = sum(rq_ok[d])
+            tele_nrq[d] = n_rq
             # handled requests: request bytes in, one response each out
             self.peers[d].bytes_down += n_rq * req_bytes
             self.peers[d].bytes_up += n_rq * INTRO_RESPONSE_BYTES
@@ -1349,8 +1364,10 @@ class OracleSim:
                          and targets[i] != NO_PEER)
             if walked_ok and got_resp[i]:
                 self.peers[i].walk_success += 1
+                self.walk_streak[i] += 1       # telemetry walk_streak
             elif walked_ok:
                 self.peers[i].walk_fail += 1
+                self.walk_streak[i] = 0
                 self._remove(i, targets[i])
 
         # phase 3s: signature-request/-response exchange (engine phase 3s)
@@ -2019,6 +2036,7 @@ class OracleSim:
                 if arrivals[i] and p.alive:
                     p.loaded = True
 
+        tele_new = [0] * n     # health bits newly latched this round
         if fm.health_checks:
             # engine wrap-up health sentinels (faults.HEALTH_* bits,
             # latched): counter wrap, store invariant, drop rate, Bloom
@@ -2040,10 +2058,106 @@ class OracleSim:
                     fill = sum(blooms[i].bits)
                     if fill * 8 >= cfg.bloom_bits * 7:
                         bits |= 8                  # HEALTH_BLOOM_SAT
+                tele_new[i] = bits & ~p.health     # flight recorder
                 p.health |= bits
+
+        # engine wrap-up telemetry (engine._telemetry_row + ring + flight
+        # recorder; rows packed through the SAME schema via pack_row_host)
+        tl = cfg.telemetry
+        if tl.enabled:
+            self.tele_row = tlm.pack_row_host(
+                self._telemetry_values(tele_nrq,
+                                       rd0 if (fm.health_checks
+                                               or tl.histograms) else None,
+                                       blooms), cfg)
+            if tl.history:
+                self.tele_ring[self.rnd % tl.history] = self.tele_row
+            if tl.flight_recorder:
+                taken = 0
+                depth = tl.flight_recorder
+                for i, p in enumerate(self.peers):
+                    if taken >= tl.flight_per_round:
+                        break
+                    if not tele_new[i]:
+                        continue
+                    self.fr_ring[self.fr_pos % depth] = np.asarray(
+                        [i, (self.rnd + 1) & M32, tele_new[i], p.health,
+                         p.requests_dropped & M32, p.msgs_dropped & M32,
+                         (p.requests_dropped + p.msgs_dropped
+                          - rd0[i]) & M32,
+                         len(p.store)], np.uint32)
+                    self.fr_pos += 1
+                    taken += 1
 
         self.now = _f32(self.now + np.float32(cfg.walk_interval))
         self.rnd += 1
+
+    def _telemetry_values(self, tele_nrq, rd0, blooms) -> dict:
+        """The fused row's field values, as plain ints (engine
+        ``_telemetry_row`` mirror; packed by ``telemetry.pack_row_host``
+        so layout cannot drift).  Per-peer counters sum WRAPPED (mod
+        2^32), exactly what the device's u32 leaves hold."""
+        cfg = self.cfg
+        n, t = cfg.n_peers, cfg.n_trackers
+        tl = cfg.telemetry
+        members = [p.alive and i >= t for i, p in enumerate(self.peers)]
+        vals = {
+            "round": (self.rnd + 1) & M32,
+            "sim_time": float(_f32(self.now
+                                   + np.float32(cfg.walk_interval))),
+            "alive_members": sum(members),
+            "killed": sum(1 for p in self.peers
+                          if any(r.meta == META_DESTROY for r in p.store)),
+        }
+        for nm in tlm.U64_COUNTERS:
+            vals[nm] = sum(getattr(p, nm) & M32 for p in self.peers)
+        vals["store_live"] = sum(len(p.store) for p in self.peers)
+        vals["cand_live"] = sum(
+            sum(1 for s in p.slots if s.peer != NO_PEER)
+            for i, p in enumerate(self.peers) if members[i])
+        or_v = 0
+        for b, nm in enumerate(tlm.HEALTH_NAMES):
+            cnt = sum(1 for p in self.peers if (p.health >> b) & 1)
+            vals[f"health_{nm}"] = cnt
+            if cnt:
+                or_v |= 1 << b
+        vals["health_or"] = or_v
+        vals["health_flagged"] = sum(1 for p in self.peers
+                                     if p.health != 0)
+        for i in range(cfg.n_meta + 1):
+            vals[f"accepted_by_meta_{i}"] = sum(
+                p.accepted_by_meta[i] & M32 for p in self.peers)
+        if tl.histograms:
+            hb = tl.hist_buckets
+            ones = [True] * n
+            data = {
+                "store_fill": ([len(p.store) for p in self.peers], ones),
+                "cand_fill": ([sum(1 for s in p.slots
+                                   if s.peer != NO_PEER)
+                               for p in self.peers], members),
+                "req_inbox": (tele_nrq, [i >= t for i in range(n)]),
+                "round_drops": ([(p.requests_dropped + p.msgs_dropped
+                                  - rd0[i]) & M32
+                                 for i, p in enumerate(self.peers)], ones),
+                "bloom_fill": ([sum(blooms[i].bits)
+                                if cfg.sync_enabled else 0
+                                for i in range(n)],
+                               [cfg.sync_enabled] * n),
+                "walk_streak": ([s & M32 for s in self.walk_streak],
+                                members),
+            }
+            for name, kind, cap in tlm.hist_specs(cfg):
+                vs, mask = data[name]
+                counts = [0] * hb
+                for v, m in zip(vs, mask):
+                    if not m:
+                        continue
+                    if kind == "linear":
+                        counts[min(v * hb // (cap + 1), hb - 1)] += 1
+                    else:
+                        counts[min(int(v).bit_length(), hb - 1)] += 1
+                vals[f"hist_{name}"] = counts
+        return vals
 
     # ---- comparison ---------------------------------------------------------
 
@@ -2128,6 +2242,16 @@ class OracleSim:
             "ge_bad": (np.array(self.ge_bad, bool)
                        if cfg.faults.ge_enabled
                        else np.zeros((0,), bool)),
+            # telemetry-plane leaves (knob-sized, state.py)
+            "walk_streak": (np.array(self.walk_streak, np.uint32)
+                            if cfg.telemetry.histograms
+                            else np.zeros((0,), np.uint32)),
+            "tele_row": np.array(self.tele_row, np.uint32),
+            "tele_ring": np.array(self.tele_ring, np.uint32),
+            "fr_ring": np.array(self.fr_ring, np.uint32),
+            "fr_pos": (np.array([self.fr_pos & M32], np.uint32)
+                       if cfg.telemetry.flight_recorder
+                       else np.zeros((0,), np.uint32)),
             "mal_member": np.full((n, cfg.k_malicious), EMPTY_U32, np.uint32),
             "conflicts": np.array([p.conflicts for p in self.peers],
                                   np.uint32),

@@ -416,6 +416,31 @@ def _load_latest_autosave(dirpath: str, cfg0: CommunityConfig,
     return None
 
 
+def _ring_chunk(cfg: CommunityConfig, scenario: Scenario, by_round: dict,
+                tracked: dict, rnd: int) -> int:
+    """Rounds safely batchable through ``engine.multi_step`` + one ring
+    drain, starting at ``rnd`` (1 = take the per-round path).
+
+    Batchable only when the ring is deep enough to hold every skipped
+    round, per-round logging is the plain snapshot (snapshot_every=1,
+    no tracked coverage curves — those need host-side store queries
+    each round), and the span crosses no scheduled event.  An autosave
+    boundary only bounds the chunk (the snapshot happens at its exact
+    round either way)."""
+    h = cfg.telemetry.history
+    if h <= 1 or scenario.snapshot_every != 1 or tracked:
+        return 1
+    limit = min(h, scenario.rounds - rnd)
+    for k in range(1, limit):
+        if (rnd + k) in by_round:
+            limit = k
+            break
+    if scenario.autosave_every:
+        limit = min(limit,
+                    scenario.autosave_every - rnd % scenario.autosave_every)
+    return max(limit, 1)
+
+
 def run(cfg: CommunityConfig, scenario: Scenario, key=None,
         log: MetricsLog | None = None,
         resume: bool = False) -> tuple[PeerState, MetricsLog]:
@@ -472,16 +497,29 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
         if scenario.seed_degree:
             state = engine.seed_overlay(state, cfg, scenario.seed_degree)
 
-    for rnd in range(start_round, scenario.rounds):
+    rnd = start_round
+    while rnd < scenario.rounds:
         for ev in by_round.get(rnd, ()):
             state, cfg = _apply(state, cfg, ev, tracked, ctx)
-        state = engine.step(state, cfg)
-        if rnd % scenario.snapshot_every == 0:
-            covs = {f"cov_{label}": float(engine.coverage(state, *spec))
-                    for label, spec in tracked.items()}
-            log.append(state, cfg, **covs)
-        if scenario.autosave_every \
-                and (rnd + 1) % scenario.autosave_every == 0:
-            _autosave(scenario.autosave_dir, rnd + 1, state, cfg,
+        # Device-resident fast path (telemetry ring, OBSERVABILITY.md):
+        # with a round-history ring compiled in and nothing forcing a
+        # per-round host visit (no tracked coverage, snapshot_every=1),
+        # whole event-free spans run as ONE multi_step dispatch and the
+        # per-round metrics history drains from the ring in a single
+        # transfer — rounds never cross the host at all in between.
+        chunk = _ring_chunk(cfg, scenario, by_round, tracked, rnd)
+        if chunk > 1:
+            state = engine.multi_step(state, cfg, chunk)
+            log.extend_from_ring(state, cfg)
+            rnd += chunk
+        else:
+            state = engine.step(state, cfg)
+            if rnd % scenario.snapshot_every == 0:
+                covs = {f"cov_{label}": float(engine.coverage(state, *spec))
+                        for label, spec in tracked.items()}
+                log.append(state, cfg, **covs)
+            rnd += 1
+        if scenario.autosave_every and rnd % scenario.autosave_every == 0:
+            _autosave(scenario.autosave_dir, rnd, state, cfg,
                       tracked, log)
     return jax.block_until_ready(state), log

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from dispersy_tpu import telemetry as tlm
 from dispersy_tpu.config import (EMPTY_META, EMPTY_U32, FLAGS_DTYPE,
                                  META_DTYPE, NO_PEER, CommunityConfig)
 
@@ -130,6 +131,32 @@ class PeerState:
     #   property of the peer's access link — like the NAT type it
     #   survives churn rebirth and unload/load.  Zero-width when the GE
     #   channel is disabled (see `health`).
+
+    # ---- telemetry plane (dispersy_tpu/telemetry.py; OBSERVABILITY.md).
+    #      Every leaf is zero-width while its TelemetryConfig knob is
+    #      off — the `health` idiom — so disabled telemetry keeps the
+    #      fused step cost-analysis-identical. ----
+    walk_streak: jnp.ndarray  # u32[N] consecutive successful walks
+    #   (reset by a walk failure; feeds the walk_streak histogram).
+    #   Stats-adjacent runtime state: like the walk_success/walk_fail
+    #   counters it derives from, it survives churn rebirth and
+    #   unload/load.  Zero-width unless telemetry.histograms.
+    tele_row: jnp.ndarray     # u32[RW] the last step's packed metrics
+    #   row (telemetry.row_schema layout; word 0 = post-step round, so
+    #   all-zero means "no step has run").  metrics.snapshot reads THIS
+    #   in one transfer instead of ~25 per-field reductions.  Width
+    #   telemetry.row_width(cfg); zero-width unless telemetry.enabled.
+    tele_ring: jnp.ndarray    # u32[H, RW] device-resident round-history
+    #   ring: the packed rows of the last H rounds, written inside step
+    #   at slot round % H — multi_step runs K rounds on device and
+    #   MetricsLog.extend_from_ring drains the whole history in one
+    #   transfer.  Zero rows unless telemetry.history > 0.
+    fr_ring: jnp.ndarray      # u32[D, FLIGHT_WIDTH] flight recorder:
+    #   per-peer event records for newly health-flagged peers
+    #   (telemetry.FLIGHT_FIELDS).  Zero rows unless
+    #   telemetry.flight_recorder > 0 (which requires health_checks).
+    fr_pos: jnp.ndarray       # u32[1] flight records ever written (the
+    #   decoder's wrap cursor); zero-width with the recorder off.
 
     # ---- candidate table [N, K] ----
     cand_peer: jnp.ndarray         # i32, NO_PEER = empty
@@ -296,6 +323,18 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         health=jnp.zeros(
             (n if config.faults.health_checks else 0,), jnp.uint32),
         ge_bad=jnp.zeros((n if config.faults.ge_enabled else 0,), bool),
+        # Telemetry-plane leaves size to their knobs the same way
+        # (telemetry.row_width is 0 when disabled).
+        walk_streak=jnp.zeros(
+            (n if config.telemetry.histograms else 0,), jnp.uint32),
+        tele_row=jnp.zeros((tlm.row_width(config),), jnp.uint32),
+        tele_ring=jnp.zeros(
+            (config.telemetry.history, tlm.row_width(config)), jnp.uint32),
+        fr_ring=jnp.zeros(
+            (config.telemetry.flight_recorder, tlm.FLIGHT_WIDTH),
+            jnp.uint32),
+        fr_pos=jnp.zeros(
+            (1 if config.telemetry.flight_recorder else 0,), jnp.uint32),
         cand_peer=jnp.full((n, k), NO_PEER, jnp.int32),
         cand_last_walk=never(),
         cand_last_stumble=never(),

@@ -1,0 +1,377 @@
+"""The telemetry plane: static config + packed-row schema + host decoders.
+
+The reference exposes a pull-model statistics snapshot (statistics.py
+``DispersyStatistics``) that the rebuild mirrored with ~25 independent
+device->host reductions per :func:`dispersy_tpu.metrics.snapshot` call —
+a host sync between rounds that fights the north star of batching rounds
+on device (``engine.multi_step``).  This module declares the four-layer
+replacement (the jit-traced kernels live in
+:mod:`dispersy_tpu.ops.telemetry`; the engine composes them into the
+fused round's wrap-up only when the matching knob is on, so disabled
+telemetry compiles to the identical step — the ``faults`` pattern):
+
+1. **Fused in-step row** (``TelemetryConfig.enabled``): every
+   ``snapshot()`` aggregate — counter totals in u64-safe u32-pair form,
+   occupancy numerators, health-bit counts — is reduced inside the
+   jitted step and packed into one ``uint32[row_width]`` vector
+   (``PeerState.tele_row``).  A snapshot becomes ONE device->host
+   transfer of that row instead of ~25 per-field reductions.
+2. **Device-resident round history** (``history``): a ring
+   ``PeerState.tele_ring`` of the last ``history`` packed rows, written
+   inside ``step`` at slot ``round % history`` — ``multi_step`` can run
+   K rounds entirely on device and the whole per-round metrics history
+   drains in a single transfer (:meth:`MetricsLog.extend_from_ring`).
+3. **On-device histograms** (``histograms``): bucketed per-round
+   distributions (store/candidate/request-inbox occupancy, per-peer
+   round drop counts, Bloom popcount, walk-success streaks) appended to
+   the row; ``snapshot()`` derives p50/p99 host-side from the buckets.
+4. **Flight recorder** (``flight_recorder``): a ring of per-peer event
+   records capturing the first ``flight_per_round`` peers whose health
+   sentinel (dispersy_tpu/faults.py) NEWLY latched each round — which
+   bit, which round, and the key counters at latch time — so a latched
+   bit is debuggable after the fact instead of being a bare flag.
+
+Row format: a flat ``uint32`` vector laid out by :func:`row_schema` —
+``u32`` fields are one word, ``f32`` one word (IEEE-754 bitcast),
+``u64`` two words (lo, hi), ``hist`` ``hist_buckets`` words of bucket
+counts.  Word 0 is the post-step round index, which is never 0 — an
+all-zero row therefore means "no step has run yet", and ring slots
+identify their round from the row itself (no cursor leaf needed).
+
+Everything here is host-side and import-light (no jax): the oracle
+packs rows through :func:`pack_row_host` so device and reference rows
+are built from ONE schema definition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from dispersy_tpu.exceptions import ConfigError
+from dispersy_tpu.faults import HEALTH_BIT_NAMES
+
+_M32 = 0xFFFFFFFF
+
+# Counter totals carried as u64 (lo, hi) word pairs — exactly the set
+# metrics.snapshot has always reduced, in its order.  Per-peer device
+# counters wrap mod 2^32 by design (state.py); the row sums the wrapped
+# values exactly (the same totals the host reduction sees).
+U64_COUNTERS = (
+    "walk_success", "walk_fail", "msgs_stored", "msgs_dropped",
+    "msgs_rejected", "msgs_forwarded", "msgs_direct", "msgs_delayed",
+    "msgs_corrupt_dropped", "requests_dropped", "punctures",
+    "sig_signed", "sig_done", "sig_expired", "conflicts",
+    "bytes_up", "bytes_down",
+)
+
+# Exact-sum bound of the byte-split u64 reduction (ops/telemetry.py
+# col_sum_u64): each byte-lane partial sum must fit uint32, so
+# n_peers * 255 < 2^32.
+MAX_TELEMETRY_PEERS = (1 << 32) // 255 - 1
+
+# Flight-recorder record layout: FLIGHT_WIDTH u32 words per record.
+# ``peer`` is EMPTY (0xFFFFFFFF) on never-written ring slots.
+FLIGHT_FIELDS = ("peer", "round", "new_bits", "health",
+                 "requests_dropped", "msgs_dropped", "drop_delta",
+                 "store_live")
+FLIGHT_WIDTH = len(FLIGHT_FIELDS)
+
+# Health-bit word order in the row (insertion order of HEALTH_BIT_NAMES
+# == ascending bit) — keep in lockstep with faults.health_report.
+HEALTH_NAMES = tuple(HEALTH_BIT_NAMES[b] for b in sorted(HEALTH_BIT_NAMES))
+
+
+@dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Static telemetry knobs, composed into ``CommunityConfig``.
+
+    Frozen + hashable (a static jit argument, like ``FaultModel``).  All
+    defaults off compile to exactly the telemetry-free step; every leaf
+    the plane adds (``tele_row`` / ``tele_ring`` / ``fr_ring`` /
+    ``fr_pos`` / ``walk_streak``) is zero-width while its knob is off.
+    """
+
+    # Fused in-step row: reduce every snapshot aggregate inside the
+    # jitted step and expose it as PeerState.tele_row.
+    enabled: bool = False
+    # Device-resident round-history ring depth (rows); 0 = off.
+    history: int = 0
+    # On-device histograms appended to the row (hist_buckets each).
+    histograms: bool = False
+    hist_buckets: int = 16
+    # Flight-recorder ring depth (records); 0 = off.  Requires
+    # faults.health_checks (validated by CommunityConfig — the recorder
+    # captures health-bit latches).
+    flight_recorder: int = 0
+    # Newly-flagged peers recorded per round (lowest peer index first).
+    flight_per_round: int = 4
+
+    def __post_init__(self) -> None:
+        if self.history < 0:
+            raise ConfigError("telemetry.history must be >= 0")
+        if self.flight_recorder < 0:
+            raise ConfigError("telemetry.flight_recorder must be >= 0")
+        if not self.enabled and (self.history > 0 or self.histograms
+                                 or self.flight_recorder > 0):
+            raise ConfigError(
+                "telemetry.history/histograms/flight_recorder all ride "
+                "the fused in-step row — set telemetry.enabled=True too")
+        if not (2 <= self.hist_buckets <= 64):
+            raise ConfigError("telemetry.hist_buckets must be in [2, 64]")
+        if self.flight_recorder > 0:
+            if self.flight_per_round < 1:
+                raise ConfigError(
+                    "telemetry.flight_per_round must be >= 1")
+            if self.flight_per_round > self.flight_recorder:
+                raise ConfigError(
+                    "telemetry.flight_per_round cannot exceed the ring "
+                    "depth (one round's records would overwrite each "
+                    "other)")
+
+    def replace(self, **kw) -> "TelemetryConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def hist_specs(cfg) -> tuple:
+    """``(name, kind, cap)`` per histogram, in row order.
+
+    ``kind``: ``"linear"`` buckets span [0, cap] uniformly (bucket =
+    ``val * B // (cap + 1)``); ``"log2"`` buckets by bit length (bucket
+    0 = value 0, bucket b = values in [2^(b-1), 2^b), last bucket
+    open-ended).  Masks (who contributes) are part of each histogram's
+    definition — engine and oracle apply them identically:
+
+    - ``store_fill``    all peers; live store rows (0..msg_capacity)
+    - ``cand_fill``     alive non-tracker members; live candidate slots
+    - ``req_inbox``     non-tracker rows; intro-requests handled this
+                        round (trackers serve the separate high-capacity
+                        inbox and would clip this scale)
+    - ``round_drops``   all peers; this round's dropped packets/records
+                        (request-inbox overflow + push/store drops)
+    - ``bloom_fill``    all peers; set bits in this round's claimed
+                        Bloom (all-zero when sync is disabled)
+    - ``walk_streak``   alive non-tracker members; consecutive
+                        successful walks (PeerState.walk_streak)
+    """
+    return (("store_fill", "linear", cfg.msg_capacity),
+            ("cand_fill", "linear", cfg.k_candidates),
+            ("req_inbox", "linear", cfg.request_inbox),
+            ("round_drops", "log2", 0),
+            ("bloom_fill", "linear", cfg.bloom_bits),
+            ("walk_streak", "log2", 0))
+
+
+def row_schema(cfg) -> tuple:
+    """``(field, kind)`` pairs describing the packed row, in word order.
+
+    Kinds: ``u32`` (1 word), ``f32`` (1 word, bitcast), ``u64`` (2
+    words: lo, hi), ``hist`` (``hist_buckets`` words).  The schema is a
+    pure function of the static config, so writer (engine), mirror
+    (oracle) and reader (this module) can never disagree.
+    """
+    entries = [("round", "u32"), ("sim_time", "f32"),
+               ("alive_members", "u32"), ("killed", "u32")]
+    entries += [(name, "u64") for name in U64_COUNTERS]
+    entries += [("store_live", "u64"), ("cand_live", "u64")]
+    entries += [("health_or", "u32"), ("health_flagged", "u32")]
+    entries += [(f"health_{nm}", "u32") for nm in HEALTH_NAMES]
+    entries += [(f"accepted_by_meta_{i}", "u64")
+                for i in range(cfg.n_meta + 1)]
+    if cfg.telemetry.histograms:
+        entries += [(f"hist_{name}", "hist")
+                    for name, _, _ in hist_specs(cfg)]
+    return tuple(entries)
+
+
+def _kind_width(kind: str, cfg) -> int:
+    if kind == "u64":
+        return 2
+    if kind == "hist":
+        return cfg.telemetry.hist_buckets
+    return 1
+
+
+def row_width(cfg) -> int:
+    """Words in the packed row for this config (0 when disabled)."""
+    if not cfg.telemetry.enabled:
+        return 0
+    return sum(_kind_width(kind, cfg) for _, kind in row_schema(cfg))
+
+
+def pack_row_host(values: dict, cfg) -> np.ndarray:
+    """Pack a ``{field: value}`` dict into the uint32 row (host/numpy).
+
+    The oracle's writer — the device row (engine wrap-up) must be
+    bit-identical to this packing of the same values.  ``u64`` values
+    are Python ints, ``f32`` floats, ``hist`` length-``hist_buckets``
+    count sequences.
+    """
+    words: list[int] = []
+    for name, kind in row_schema(cfg):
+        v = values[name]
+        if kind == "u32":
+            words.append(int(v) & _M32)
+        elif kind == "f32":
+            words.append(int(np.float32(v).view(np.uint32)))
+        elif kind == "u64":
+            words += [int(v) & _M32, (int(v) >> 32) & _M32]
+        else:  # hist
+            if len(v) != cfg.telemetry.hist_buckets:
+                raise ValueError(f"{name}: {len(v)} buckets, expected "
+                                 f"{cfg.telemetry.hist_buckets}")
+            words += [int(x) & _M32 for x in v]
+    return np.asarray(words, np.uint32)
+
+
+def unpack_row(row: np.ndarray, cfg) -> dict:
+    """Inverse of the row packing: raw ``{field: value}`` dict.
+
+    ``u64`` fields come back as ints, ``f32`` as floats, ``hist`` as
+    bucket-count lists.  Raises on a width mismatch (schema drift
+    between writer and reader would silently misalign every later
+    field).
+    """
+    row = np.asarray(row, np.uint32)
+    want = row_width(cfg)
+    if row.shape != (want,):
+        raise ValueError(f"telemetry row shape {row.shape}, config "
+                         f"expects ({want},)")
+    out: dict = {}
+    off = 0
+    for name, kind in row_schema(cfg):
+        if kind == "u32":
+            out[name] = int(row[off])
+        elif kind == "f32":
+            out[name] = float(row[off:off + 1].view(np.float32)[0])
+        elif kind == "u64":
+            out[name] = int(row[off]) | (int(row[off + 1]) << 32)
+        else:
+            hb = cfg.telemetry.hist_buckets
+            out[name] = [int(x) for x in row[off:off + hb]]
+        off += _kind_width(kind, cfg)
+    return out
+
+
+def bucket_upper_bound(kind: str, cap: int, bucket: int,
+                       n_buckets: int) -> int:
+    """Largest value a histogram bucket can hold (the value p50/p99
+    report).  Linear bucket b covers ``v*B//(cap+1) == b``; log2 bucket
+    b covers ``bit_length(v) == b`` (0 -> 0, else [2^(b-1), 2^b))."""
+    if kind == "linear":
+        return min(cap, ((bucket + 1) * (cap + 1) - 1) // n_buckets)
+    return (1 << bucket) - 1
+
+
+def bucket_percentile(counts, q_num: int, q_den: int, kind: str,
+                      cap: int) -> int:
+    """Percentile (as a bucket upper-bound value) from bucket counts.
+
+    Integer math throughout (``q_num/q_den`` e.g. 50/100): the smallest
+    bucket whose cumulative count reaches ``ceil(q * total)``.  0 when
+    the histogram is empty.
+    """
+    counts = [int(c) for c in counts]
+    total = sum(counts)
+    if total == 0:
+        return 0
+    need = -(-q_num * total // q_den)        # ceil
+    cum = 0
+    for b, c in enumerate(counts):
+        cum += c
+        if cum >= need:
+            return bucket_upper_bound(kind, cap, b, len(counts))
+    return bucket_upper_bound(kind, cap, len(counts) - 1, len(counts))
+
+
+def row_to_snapshot(row: np.ndarray, cfg) -> dict:
+    """The ``metrics.snapshot`` dict, derived from one packed row.
+
+    Emits the exact key set (and value semantics) of the legacy
+    per-field reduction path, plus — with histograms on —
+    ``hist_<name>_p50`` / ``hist_<name>_p99`` scalars and the raw
+    ``hist_<name>`` bucket lists (non-scalar, so JSON-only in
+    ``MetricsLog.dump_binary``, by the same rule as
+    ``accepted_by_meta``).
+    """
+    raw = unpack_row(row, cfg)
+    ws, wf = raw["walk_success"], raw["walk_fail"]
+    n_members = max(raw["alive_members"], 1)
+    out = {
+        "round": raw["round"],
+        "sim_time": raw["sim_time"],
+        "alive_members": raw["alive_members"],
+        "killed": raw["killed"],
+        "walk_success": ws,
+        "walk_fail": wf,
+        "walk_success_rate": ws / max(ws + wf, 1),
+    }
+    for name in U64_COUNTERS[2:]:
+        out[name] = raw[name]
+    # Occupancy means from exact integer numerators (the legacy path
+    # accumulated the same ratios in float32; this is the same quantity
+    # computed exactly).
+    out["store_fill"] = raw["store_live"] / float(
+        cfg.n_peers * cfg.msg_capacity)
+    out["candidate_fill"] = raw["cand_live"] / float(
+        cfg.k_candidates * n_members)
+    out["health_or"] = raw["health_or"]
+    out["health_flagged"] = raw["health_flagged"]
+    for nm in HEALTH_NAMES:
+        out[f"health_{nm}"] = raw[f"health_{nm}"]
+    out["accepted_by_meta"] = [raw[f"accepted_by_meta_{i}"]
+                               for i in range(cfg.n_meta + 1)]
+    if cfg.telemetry.histograms:
+        for name, kind, cap in hist_specs(cfg):
+            counts = raw[f"hist_{name}"]
+            out[f"hist_{name}_p50"] = bucket_percentile(
+                counts, 50, 100, kind, cap)
+            out[f"hist_{name}_p99"] = bucket_percentile(
+                counts, 99, 100, kind, cap)
+            out[f"hist_{name}"] = counts
+    return out
+
+
+def ring_rows(ring: np.ndarray, cfg) -> list:
+    """Decode a drained ``tele_ring`` array into snapshot dicts,
+    oldest round first.
+
+    Slots identify themselves: word 0 is the row's post-step round
+    index (>= 1), so never-written slots (all-zero) are skipped and no
+    cursor has to cross the host boundary.  Every live slot holds one
+    of the most recent ``history`` rounds by construction (older rows
+    were overwritten in place).
+    """
+    ring = np.asarray(ring, np.uint32)
+    rows = [row for row in ring if int(row[0]) > 0]
+    rows.sort(key=lambda r: int(r[0]))
+    return [row_to_snapshot(row, cfg) for row in rows]
+
+
+def flight_records(state, cfg) -> list:
+    """Decode the flight-recorder ring into event dicts, oldest first.
+
+    Each dict carries the :data:`FLIGHT_FIELDS` (``new_bits`` /
+    ``health`` additionally decoded into sentinel names via
+    ``faults.HEALTH_BIT_NAMES``).  ``fr_pos`` counts records ever
+    written, so ordering is exact even after the ring wraps.
+    """
+    if cfg.telemetry.flight_recorder <= 0:
+        return []
+    ring = np.asarray(state.fr_ring, np.uint32)
+    pos = int(np.asarray(state.fr_pos)[0])
+    depth = ring.shape[0]
+    live = min(pos, depth)
+    out = []
+    for i in range(pos - live, pos):
+        rec = ring[i % depth]
+        if int(rec[0]) == _M32:      # never written (defensive)
+            continue
+        d = {k: int(v) for k, v in zip(FLIGHT_FIELDS, rec)}
+        d["new_bit_names"] = [nm for bit, nm in HEALTH_BIT_NAMES.items()
+                              if d["new_bits"] & bit]
+        d["health_names"] = [nm for bit, nm in HEALTH_BIT_NAMES.items()
+                             if d["health"] & bit]
+        out.append(d)
+    return out

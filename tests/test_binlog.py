@@ -98,6 +98,74 @@ def test_decode_inf_and_short_file(tmp_path):
         binlog.decode(str(short))
 
 
+def test_telemetry_run_roundtrip_with_meta_and_histograms(tmp_path):
+    """Full write -> decode round trip of a telemetry-enabled run:
+    the meta blob survives verbatim, the histogram p50/p99 scalars ride
+    in the packed rows, and the raw bucket lists stay JSON-only."""
+    from dispersy_tpu.telemetry import TelemetryConfig, hist_specs
+    cfg = CommunityConfig(
+        n_peers=48, n_trackers=2, k_candidates=8, msg_capacity=16,
+        bloom_capacity=16, request_inbox=4, tracker_inbox=16,
+        response_budget=4,
+        telemetry=TelemetryConfig(enabled=True, history=8,
+                                  histograms=True))
+    state = init_state(cfg, jax.random.PRNGKey(0))
+    state = engine.seed_overlay(state, cfg, degree=4)
+    state = engine.multi_step(state, cfg, 4)
+    log = metrics.MetricsLog(meta={"n_peers": cfg.n_peers,
+                                   "telemetry": "ring"})
+    log.extend_from_ring(state, cfg)
+    path = str(tmp_path / "tele.binlog")
+    log.dump_binary(path)
+    meta, rows = binlog.decode(path)
+    assert meta == {"n_peers": cfg.n_peers, "telemetry": "ring"}
+    assert len(rows) == 4
+    for brow, jrow in zip(rows, log.rows):
+        for k, v in brow.items():
+            assert v == jrow[k], k
+    for name, _, _ in hist_specs(cfg):
+        assert f"hist_{name}_p50" in rows[0]
+        assert f"hist_{name}_p99" in rows[0]
+        assert f"hist_{name}" not in rows[0]      # bucket lists: JSON-only
+    assert "accepted_by_meta" not in rows[0]
+
+
+def test_truncated_files_rejected(tmp_path):
+    """Truncation anywhere inside the header — field-name table, meta
+    blob, or the fixed prefix — is a ValueError naming the file, never
+    a raw struct/json crash; body truncation still only drops the torn
+    trailing row."""
+    path = str(tmp_path / "full.binlog")
+    with binlog.BinaryLog(path, ["round", "walk_success"],
+                          meta={"cfg": "x" * 64}) as log:
+        log.append({"round": 1, "walk_success": 2})
+    blob = open(path, "rb").read()
+    # inside the fixed prefix / name table / meta blob: all torn headers
+    for cut in (6, 10, len(blob) - 8 * 2 - 40):
+        torn = tmp_path / f"cut{cut}.binlog"
+        torn.write_bytes(blob[:cut])
+        with pytest.raises(ValueError):
+            binlog.decode(str(torn))
+    # inside the row body: torn row dropped, earlier rows intact
+    body_cut = tmp_path / "body.binlog"
+    body_cut.write_bytes(blob[:-5])
+    _, rows = binlog.decode(str(body_cut))
+    assert rows == []
+    # wrong magic is rejected outright
+    bad = tmp_path / "bad.binlog"
+    bad.write_bytes(b"NOPE" + blob[4:])
+    with pytest.raises(ValueError, match="not a DTPL"):
+        binlog.decode(str(bad))
+
+
+def test_strict_mode_names_missing_field(tmp_path):
+    path = str(tmp_path / "strict.binlog")
+    with binlog.BinaryLog(path, ["a", "b"], strict=True) as log:
+        log.append({"a": 1, "b": 2})
+        with pytest.raises(ValueError, match=r"\['b'\]"):
+            log.append({"a": 3})
+
+
 def test_append_is_flushed(tmp_path):
     """Rows are readable without close(): a killed run loses at most the
     one torn trailing row decode() already tolerates (ADVICE r2)."""

@@ -1,0 +1,428 @@
+"""Telemetry plane: fused in-step row, device round-history ring,
+on-device histograms, flight recorder (OBSERVABILITY.md).
+
+Pinned here:
+- the fused row reproduces the legacy per-field snapshot exactly;
+- a K-round ``multi_step`` + ONE ring drain is value-identical to K
+  per-round ``snapshot()`` calls;
+- ``snapshot()`` under telemetry touches ONLY ``state.tele_row`` (the
+  single-transfer contract);
+- the oracle packs bit-identical rows/rings/flight records under fault
+  knobs;
+- telemetry disabled leaves the 1M-peer bench-shape step cost-analysis
+  byte-identical to the committed PR-4 baseline;
+- checkpoint v10 round-trips the new leaves and still loads v9;
+- the scenario runner's ring fast path logs the same rows as the
+  per-round path;
+- tools/telemetry.py diffs and gates curves (incl. the committed
+  golden convergence artifact).
+"""
+
+import dataclasses
+import json
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispersy_tpu import checkpoint as ckpt
+from dispersy_tpu import engine as E
+from dispersy_tpu import metrics
+from dispersy_tpu import scenario as sc
+from dispersy_tpu import state as S
+from dispersy_tpu import telemetry as tlm
+from dispersy_tpu.config import CommunityConfig
+from dispersy_tpu.exceptions import ConfigError
+from dispersy_tpu.faults import FaultModel
+from dispersy_tpu.oracle import sim as O
+from dispersy_tpu.state import PeerState, init_state
+from dispersy_tpu.telemetry import TelemetryConfig
+
+TELE = TelemetryConfig(enabled=True, history=10, histograms=True)
+BASE = CommunityConfig(n_peers=48, n_trackers=2, msg_capacity=24,
+                       bloom_capacity=16, k_candidates=8, request_inbox=4,
+                       tracker_inbox=16, response_budget=4, telemetry=TELE)
+
+
+def _warm(cfg, rounds=3, seed=0, author=5):
+    state = init_state(cfg, jax.random.PRNGKey(seed))
+    state = E.seed_overlay(state, cfg, degree=4)
+    if author is not None:
+        state = E.create_messages(
+            state, cfg, jnp.arange(cfg.n_peers) == author, meta=1,
+            payload=jnp.full((cfg.n_peers,), 7, jnp.uint32))
+    for _ in range(rounds):
+        state = E.step(state, cfg)
+    return jax.block_until_ready(state)
+
+
+# ---- config validation -------------------------------------------------
+
+
+def test_config_validation():
+    with pytest.raises(ConfigError, match="enabled"):
+        TelemetryConfig(history=4)
+    with pytest.raises(ConfigError, match="hist_buckets"):
+        TelemetryConfig(enabled=True, histograms=True, hist_buckets=1)
+    with pytest.raises(ConfigError, match="flight_per_round"):
+        TelemetryConfig(enabled=True, flight_recorder=2,
+                        flight_per_round=3)
+    with pytest.raises(ConfigError, match="health_checks"):
+        BASE.replace(telemetry=TELE.replace(flight_recorder=4))
+    # recorder + health_checks is fine
+    BASE.replace(telemetry=TELE.replace(flight_recorder=4),
+                 faults=FaultModel(health_checks=True))
+
+
+def test_disabled_leaves_are_zero_width():
+    cfg = BASE.replace(telemetry=TelemetryConfig())
+    st = init_state(cfg, jax.random.PRNGKey(0))
+    assert st.tele_row.shape == (0,)
+    assert st.tele_ring.shape == (0, 0)
+    assert st.fr_ring.shape == (0, tlm.FLIGHT_WIDTH)
+    assert st.fr_pos.shape == (0,)
+    assert st.walk_streak.shape == (0,)
+
+
+# ---- fused row vs legacy snapshot --------------------------------------
+
+
+def test_row_matches_legacy_snapshot():
+    state = _warm(BASE)
+    fused = metrics.snapshot(state, BASE)
+    legacy = metrics.snapshot(state,
+                              BASE.replace(telemetry=TelemetryConfig()))
+    for k, v in legacy.items():
+        if isinstance(v, float):
+            assert fused[k] == pytest.approx(v, rel=1e-6), k
+        else:
+            assert fused[k] == v, k
+    # histogram extras only exist on the fused path
+    for name, _, _ in tlm.hist_specs(BASE):
+        assert f"hist_{name}_p50" in fused
+        assert f"hist_{name}_p99" in fused
+        assert sum(fused[f"hist_{name}"]) >= 0
+
+
+def test_snapshot_before_first_step_falls_back():
+    state = init_state(BASE, jax.random.PRNGKey(0))
+    snap = metrics.snapshot(state, BASE)       # round 0: row is all-zero
+    assert snap["round"] == 0
+    assert snap["alive_members"] == BASE.n_peers - BASE.n_trackers
+
+
+def test_snapshot_single_transfer():
+    """The fused snapshot reads state.tele_row and NOTHING else."""
+    state = _warm(BASE)
+    want = metrics.snapshot(state, BASE)
+
+    class Poison:
+        def __array__(self, *a, **k):
+            raise AssertionError("snapshot touched a non-tele_row leaf")
+
+    poisoned = state.replace(**{
+        f.name: Poison() for f in dataclasses.fields(PeerState)
+        if f.name != "tele_row"})
+    assert metrics.snapshot(poisoned, BASE) == want
+
+
+# ---- ring drain vs per-round snapshots ---------------------------------
+
+
+def test_ring_drain_value_identical_to_snapshots():
+    k = 7
+    state = _warm(BASE, rounds=0)
+    per_round = []
+    for _ in range(k):
+        state = E.step(state, BASE)
+        per_round.append(metrics.snapshot(state, BASE))
+    state2 = _warm(BASE, rounds=0)
+    state2 = E.multi_step(state2, BASE, k)
+    log = metrics.MetricsLog()
+    drained = log.extend_from_ring(state2, BASE)
+    assert drained == per_round
+    assert [r["round"] for r in log.rows] == list(range(1, k + 1))
+    # a second drain is a no-op, not a duplicate append
+    assert log.extend_from_ring(state2, BASE) == []
+
+
+def test_ring_overflow_detected():
+    cfg = BASE.replace(telemetry=TELE.replace(history=3))
+    state = _warm(cfg, rounds=0)
+    state = E.multi_step(state, cfg, 6)     # rounds 1-3 overwritten
+    log = metrics.MetricsLog()
+    with pytest.raises(ValueError, match="overflowed"):
+        log.extend_from_ring(state, cfg)
+
+
+def test_extend_from_ring_needs_history():
+    cfg = BASE.replace(telemetry=TELE.replace(history=0))
+    state = _warm(cfg, rounds=1)
+    with pytest.raises(ValueError, match="history"):
+        metrics.MetricsLog().extend_from_ring(state, cfg)
+
+
+# ---- oracle parity (row + histograms + flight recorder, faulted) -------
+
+_TFIELDS = ("walk_streak", "tele_row", "tele_ring", "fr_ring", "fr_pos")
+
+
+def _parity(cfg, rounds, seed=3):
+    state = init_state(cfg, jax.random.PRNGKey(seed))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=4)
+    oracle.seed_overlay(degree=4)
+    for rnd in range(rounds):
+        state = E.step(state, cfg)
+        oracle.step()
+        want = oracle.state_arrays()
+        for f in _TFIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(state, f)), want[f],
+                err_msg=f"round {rnd}: {f}")
+    return state
+
+
+def test_oracle_row_parity_under_faults():
+    cfg = CommunityConfig(
+        n_peers=32, n_trackers=2, msg_capacity=24, bloom_capacity=16,
+        k_candidates=8, request_inbox=4, tracker_inbox=8,
+        response_budget=4, packet_loss=0.1, churn_rate=0.05,
+        telemetry=TelemetryConfig(enabled=True, history=6,
+                                  histograms=True, flight_recorder=16,
+                                  flight_per_round=4),
+        faults=FaultModel(ge_p_bad=0.2, ge_p_good=0.5, ge_loss_bad=0.4,
+                          corrupt_rate=0.1, dup_rate=0.1,
+                          flood_senders=(9,), flood_fanout=6,
+                          health_checks=True, health_drop_limit=4))
+    _parity(cfg, rounds=8)
+
+
+def test_oracle_flight_recorder_parity_and_decode():
+    cfg = CommunityConfig(
+        n_peers=24, n_trackers=2, msg_capacity=16, bloom_capacity=8,
+        k_candidates=8, request_inbox=2, tracker_inbox=8,
+        response_budget=4, push_inbox=2,
+        telemetry=TelemetryConfig(enabled=True, history=6,
+                                  histograms=True, flight_recorder=8,
+                                  flight_per_round=3),
+        faults=FaultModel(flood_senders=(5, 6), flood_fanout=16,
+                          health_checks=True, health_drop_limit=2))
+    state = _parity(cfg, rounds=6, seed=1)
+    assert int(np.asarray(state.fr_pos)[0]) > 8   # the ring wrapped
+    recs = tlm.flight_records(state, cfg)
+    assert len(recs) == 8                          # depth, oldest first
+    assert [r["round"] for r in recs] == sorted(r["round"] for r in recs)
+    for r in recs:
+        assert r["new_bit_names"], r               # a bit DID latch
+        assert 0 <= r["peer"] < cfg.n_peers
+        assert set(r) >= set(tlm.FLIGHT_FIELDS)
+    # the snapshot agrees something is flagged
+    snap = metrics.snapshot(state, cfg)
+    assert snap["health_flagged"] > 0
+
+
+# ---- compiled-out identity at the bench shape (tier-1 satellite) -------
+
+
+def test_disabled_step_cost_identical_to_pr4_baseline():
+    """With telemetry at defaults, the fused 1M-peer bench-shape step is
+    cost-analysis byte-identical to the committed PR-4 baseline
+    (artifacts/step_cost_1M_baseline.json) — the telemetry plane is
+    provably compiled out."""
+    from dispersy_tpu import profiling
+    with open("artifacts/step_cost_1M_baseline.json") as f:
+        base = json.load(f)
+    out = profiling.step_cost(profiling.bench_config(1_000_000,
+                                                     platform="tpu"))
+    assert out["bytes_accessed"] == base["bytes_accessed"]
+    assert out["flops"] == base["flops"]
+
+
+# ---- checkpoint v10 ----------------------------------------------------
+
+
+def test_checkpoint_v10_roundtrip_bit_exact(tmp_path):
+    cfg = BASE.replace(
+        telemetry=TELE.replace(flight_recorder=8, flight_per_round=2),
+        faults=FaultModel(health_checks=True, health_drop_limit=2))
+    state = _warm(cfg, rounds=2)
+    path = str(tmp_path / "t10.npz")
+    ckpt.save(path, state, cfg)
+    restored = jax.tree_util.tree_map(jnp.asarray,
+                                      ckpt.restore(path, cfg))
+    a = E.step(restored, cfg)
+    b = E.step(state, cfg)
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_checkpoint_v9_archive_still_loads(tmp_path):
+    cfg = BASE.replace(telemetry=TelemetryConfig())
+    state = _warm(cfg, rounds=1)
+    path = str(tmp_path / "t9.npz")
+    ckpt.save(path, state, cfg)
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files
+                  if not any(t in k for t in
+                             ("walk_streak", "tele_row", "tele_ring",
+                              "fr_ring", "fr_pos"))}
+    arrays["meta:version"] = np.asarray(9)
+    arrays["meta:config"] = np.frombuffer(
+        ckpt._want_fingerprint(cfg, 9).encode(), dtype=np.uint8)
+    np.savez_compressed(path, **arrays)
+    restored = ckpt.restore(path, cfg)        # default telemetry: fine
+    np.testing.assert_array_equal(np.asarray(restored.store_gt),
+                                  np.asarray(state.store_gt))
+    # ...but a non-default TelemetryConfig must be refused against it
+    with pytest.raises(Exception, match="telemetry"):
+        ckpt.restore(path, BASE)
+
+
+# ---- scenario runner: ring fast path -----------------------------------
+
+
+def test_scenario_ring_fast_path_matches_per_round():
+    events = [(0, sc.Create(meta=1, authors=[5], payload=42))]
+    fast_cfg = BASE.replace(telemetry=TELE.replace(history=16))
+    slow_cfg = BASE.replace(telemetry=TELE.replace(history=0))
+    _, fast_log = sc.run(fast_cfg, sc.Scenario(rounds=12, events=events,
+                                               seed_degree=4),
+                         key=jax.random.PRNGKey(1))
+    _, slow_log = sc.run(slow_cfg, sc.Scenario(rounds=12, events=list(events),
+                                               seed_degree=4),
+                         key=jax.random.PRNGKey(1))
+    assert [r["round"] for r in fast_log.rows] == list(range(1, 13))
+    assert fast_log.rows == slow_log.rows
+
+
+def test_scenario_tracked_coverage_forces_per_round():
+    events = [(0, sc.Create(meta=1, authors=[5], payload=42,
+                            track="post"))]
+    cfg = BASE.replace(telemetry=TELE.replace(history=16))
+    _, log = sc.run(cfg, sc.Scenario(rounds=6, events=events,
+                                     seed_degree=4),
+                    key=jax.random.PRNGKey(1))
+    assert all("cov_post" in r for r in log.rows)
+    assert log.rows[-1]["cov_post"] > 0
+
+
+# ---- tools/telemetry.py CLI -------------------------------------------
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "tools/telemetry.py", *args],
+        capture_output=True, text=True, cwd="/root/repo")
+
+
+def test_cli_show_diff_gate(tmp_path):
+    state = _warm(BASE, rounds=4)
+    log = metrics.MetricsLog(meta={"n": BASE.n_peers})
+    log.extend_from_ring(state, BASE)
+    a = str(tmp_path / "a.json")
+    log.dump(a)
+    out = _cli("show", a, "--series", "walk_success")
+    assert out.returncode == 0 and "walk_success" in out.stdout
+    # identical logs diff clean; a perturbed one diverges
+    assert _cli("diff", a, a).returncode == 0
+    doc = json.load(open(a))
+    doc["rounds"][-1]["walk_success"] += 1000
+    b = str(tmp_path / "b.json")
+    json.dump(doc, open(b, "w"))
+    out = _cli("diff", a, b)
+    assert out.returncode == 2 and "walk_success" in out.stdout
+    # gate against itself passes, against the perturbed curve fails
+    assert _cli("gate", a, a, "--key", "walk_success",
+                "--rtol", "0").returncode == 0
+    assert _cli("gate", a, b, "--key", "walk_success",
+                "--rtol", "1e-6").returncode == 2
+
+
+def test_cli_diff_catches_small_magnitude_relative_blowup(tmp_path):
+    """Tolerance is per-round: a 10x relative divergence on a tiny
+    value must not hide behind an in-tolerance wobble on a huge one
+    (review finding: max-absolute-diff picking)."""
+    a = str(tmp_path / "a.json")
+    b = str(tmp_path / "b.json")
+    json.dump({"rounds": [{"round": 1, "k": 0.001},
+                          {"round": 2, "k": 1000.0}]}, open(a, "w"))
+    json.dump({"rounds": [{"round": 1, "k": 0.01},
+                          {"round": 2, "k": 1000.5}]}, open(b, "w"))
+    out = _cli("diff", a, b, "--rtol", "0.05")
+    assert out.returncode == 2 and "round 1" in out.stdout
+
+
+def test_cli_diff_rejects_absent_requested_key(tmp_path):
+    """A typo'd --key (absent from both logs, or one-sided) must exit 2,
+    not green-light a comparison that never happened (review finding)."""
+    a = str(tmp_path / "a.json")
+    b = str(tmp_path / "b.json")
+    json.dump({"rounds": [{"round": 1, "k": 1}]}, open(a, "w"))
+    json.dump({"rounds": [{"round": 1, "k": 1}]}, open(b, "w"))
+    out = _cli("diff", a, b, "--key", "wolk_success")
+    assert out.returncode == 2 and "absent" in out.stdout
+    json.dump({"rounds": [{"round": 1, "k": 1, "only_b": 2}]},
+              open(b, "w"))
+    out = _cli("diff", a, b, "--key", "only_b")
+    assert out.returncode == 2 and "no comparable" in out.stdout
+    # auto mode notes (but does not fail on) one-sided keys
+    out = _cli("diff", a, b)
+    assert out.returncode == 0 and "only one log" in out.stdout
+
+
+def test_prestep_row_shares_schema_with_fused_rows(tmp_path):
+    """A round-0 append (legacy fallback) followed by fused rows must
+    still dump_binary cleanly: the pre-step row reports EMPTY
+    histograms instead of omitting the keys (review finding)."""
+    state = init_state(BASE, jax.random.PRNGKey(0))
+    log = metrics.MetricsLog()
+    log.append(state, BASE)                      # round 0, legacy path
+    state = E.step(E.seed_overlay(state, BASE, 4), BASE)
+    log.append(state, BASE)                      # fused path
+    assert log.rows[0]["hist_store_fill_p50"] == 0
+    log.dump_binary(str(tmp_path / "mixed.binlog"))
+
+
+def test_golden_convergence_gate():
+    """Re-run the committed golden scenario and gate the coverage curve
+    against artifacts/golden_convergence.json via the CLI — the
+    regression gate the tool exists for."""
+    cfg = CommunityConfig(
+        n_peers=64, n_trackers=2, msg_capacity=32, bloom_capacity=16,
+        k_candidates=8, request_inbox=4, tracker_inbox=16,
+        response_budget=8,
+        telemetry=TelemetryConfig(enabled=True, histograms=True))
+    s = sc.Scenario(rounds=20, events=[
+        (0, sc.Create(meta=1, authors=[5], payload=42, track="post"))],
+        seed_degree=6)
+    _, log = sc.run(cfg, s, key=jax.random.PRNGKey(7))
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump({"meta": log.meta, "rounds": log.rows}, f)
+        path = f.name
+    out = _cli("gate", path, "artifacts/golden_convergence.json",
+               "--key", "cov_post", "--rtol", "0.05", "--atol", "0.02",
+               "--min-rounds", "10")
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ---- dump_binary schema validation (satellite) -------------------------
+
+
+def test_dump_binary_rejects_ragged_rows(tmp_path):
+    log = metrics.MetricsLog()
+    log.rows = [{"round": 1, "a": 2}, {"round": 2}]
+    with pytest.raises(ValueError, match=r"missing \['a'\]"):
+        log.dump_binary(str(tmp_path / "x.binlog"))
+    log.rows = [{"round": 1}, {"round": 2, "surprise": 3}]
+    with pytest.raises(ValueError, match=r"unexpected \['surprise'\]"):
+        log.dump_binary(str(tmp_path / "x.binlog"))
+    # non-scalar raggedness stays fine (JSON-only fields)
+    log.rows = [{"round": 1}, {"round": 2, "accepted_by_meta": [1, 2]}]
+    log.dump_binary(str(tmp_path / "ok.binlog"))

@@ -1,0 +1,258 @@
+"""Render, diff, and gate telemetry run logs (reference: the offline
+half of tool/ldecoder.py — experiment curves are mined from the logs,
+never from the live overlay).
+
+Reads any of the repo's three log forms — MetricsLog JSON
+(``{"meta", "rounds"}``), JSONL (one row per line), or the packed
+binary log (``dispersy_tpu/binlog.py``, DTPL magic) — and:
+
+    python tools/telemetry.py show run.json [--series cov_post ...]
+        summary table (first/last/min/max per scalar key) and an ASCII
+        sparkline per requested series.
+    python tools/telemetry.py diff a.json b.binlog [--key k ...]
+                                  [--rtol R] [--atol A]
+        align rows by round, report the worst divergence per key; exit
+        2 when any shared key diverges beyond tolerance (the
+        trace-comparison harness for "did this change behavior?").
+    python tools/telemetry.py gate run.json golden.json --key cov_post
+                                  [--rtol R] [--atol A] [--min-rounds N]
+        regression gate against a committed golden curve: the run's
+        curve must track the golden one point-for-point within
+        tolerance over their shared rounds.  Exit 2 on regression —
+        wire it after any scenario whose convergence shape is a
+        contract (tests/test_telemetry.py gates the committed
+        artifacts/golden_convergence.json this way).
+
+Exit codes: 0 ok, 1 usage/IO error, 2 divergence/regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dispersy_tpu import binlog  # noqa: E402
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def load_rows(path: str) -> tuple[dict, list]:
+    """(meta, rows) from a JSON / JSONL / DTPL-binary run log."""
+    with open(path, "rb") as f:
+        head = f.read(4)
+    if head == binlog.MAGIC:
+        return binlog.decode(path)
+    with open(path) as f:
+        text = f.read()
+    if not text.strip():
+        return {}, []
+    if text.lstrip().startswith("{") and "\n{" not in text.strip():
+        doc = json.loads(text)
+        if isinstance(doc, dict) and "rounds" in doc:
+            return doc.get("meta", {}), doc["rounds"]
+        if isinstance(doc, dict):     # single row
+            return {}, [doc]
+    return {}, [json.loads(line) for line in text.splitlines()
+                if line.strip()]
+
+
+def scalar_keys(rows: list) -> list:
+    keys: list = []
+    for row in rows:
+        for k, v in row.items():
+            if (isinstance(v, (int, float)) and not isinstance(v, bool)
+                    and k not in keys):
+                keys.append(k)
+    return keys
+
+
+def series(rows: list, key: str) -> list:
+    return [row.get(key) for row in rows]
+
+
+def sparkline(values: list, width: int = 60) -> str:
+    vals = [v for v in values if isinstance(v, (int, float))]
+    if not vals:
+        return "(no data)"
+    if len(vals) > width:        # downsample to terminal width
+        stride = len(vals) / width
+        vals = [vals[int(i * stride)] for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(_SPARK[int((v - lo) / span * (len(_SPARK) - 1))]
+                   for v in vals)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def cmd_show(args) -> int:
+    meta, rows = load_rows(args.path)
+    if meta:
+        print(f"meta: {json.dumps(meta)}")
+    print(f"rows: {len(rows)}")
+    if not rows:
+        return 0
+    keys = args.series or scalar_keys(rows)
+    namew = max(len(k) for k in keys)
+    for k in keys:
+        vals = [v for v in series(rows, k)
+                if isinstance(v, (int, float))]
+        if not vals:
+            print(f"  {k:<{namew}}  (absent)")
+            continue
+        line = (f"  {k:<{namew}}  first={_fmt(vals[0])} "
+                f"last={_fmt(vals[-1])} min={_fmt(min(vals))} "
+                f"max={_fmt(max(vals))}")
+        if args.series:
+            line += "  " + sparkline(vals)
+        print(line)
+    return 0
+
+
+def _by_round(rows: list) -> dict:
+    out = {}
+    for i, row in enumerate(rows):
+        out[row.get("round", i + 1)] = row
+    return out
+
+
+def _within(a, b, rtol: float, atol: float) -> bool:
+    return abs(a - b) <= atol + rtol * max(abs(a), abs(b))
+
+
+def cmd_diff(args) -> int:
+    _, rows_a = load_rows(args.a)
+    _, rows_b = load_rows(args.b)
+    a, b = _by_round(rows_a), _by_round(rows_b)
+    shared_rounds = sorted(set(a) & set(b))
+    if not shared_rounds:
+        print("no shared rounds", file=sys.stderr)
+        return 2
+    keys_a, keys_b = set(scalar_keys(rows_a)), set(scalar_keys(rows_b))
+    if args.key:
+        keys = args.key
+    else:
+        keys = sorted(keys_a & keys_b)
+        # Keys on only one side are schema drift, not a silent skip.
+        for k in sorted(keys_a ^ keys_b):
+            print(f"note: key {k!r} present in only one log "
+                  f"({'a' if k in keys_a else 'b'}) — not compared")
+    bad = 0
+    for k in keys:
+        if k not in keys_a and k not in keys_b:
+            # A requested key absent everywhere is a typo, not a pass —
+            # the gate must never green-light a comparison that never
+            # happened.
+            print(f"{k}: absent from both logs DIVERGES")
+            bad += 1
+            continue
+        # Tolerance is checked at EVERY round; the reported round is the
+        # worst violation by excess-over-allowance (a max-absolute-diff
+        # pick would let a relative blowup on a small-magnitude round
+        # hide behind an in-tolerance wobble on a large one).
+        worst_excess, worst_rnd, any_pair = None, None, False
+        for rnd in shared_rounds:
+            va, vb = a[rnd].get(k), b[rnd].get(k)
+            if not (isinstance(va, (int, float))
+                    and isinstance(vb, (int, float))):
+                continue
+            any_pair = True
+            excess = abs(va - vb) - (args.atol
+                                     + args.rtol * max(abs(va), abs(vb)))
+            if worst_excess is None or excess > worst_excess:
+                worst_excess, worst_rnd = excess, rnd
+        if not any_pair:
+            if args.key:
+                # explicitly requested but never comparable (one-sided
+                # or non-numeric): a failed comparison, not a pass
+                print(f"{k}: no comparable value pair in the shared "
+                      "rounds DIVERGES")
+                bad += 1
+            continue
+        ok = worst_excess <= 0
+        status = "ok" if ok else "DIVERGES"
+        if not ok or args.verbose:
+            va, vb = a[worst_rnd][k], b[worst_rnd][k]
+            print(f"{k}: worst at round {worst_rnd} |diff| "
+                  f"{_fmt(abs(va - vb))} ({_fmt(va)} vs {_fmt(vb)}) "
+                  f"{status}")
+        bad += not ok
+    print(f"{len(shared_rounds)} shared rounds, {len(keys)} keys, "
+          f"{bad} diverging")
+    return 2 if bad else 0
+
+
+def cmd_gate(args) -> int:
+    _, rows = load_rows(args.run)
+    _, gold = load_rows(args.golden)
+    a, g = _by_round(rows), _by_round(gold)
+    shared = sorted(set(a) & set(g))
+    if len(shared) < args.min_rounds:
+        print(f"gate: only {len(shared)} shared rounds "
+              f"(need >= {args.min_rounds})", file=sys.stderr)
+        return 2
+    failures = []
+    for rnd in shared:
+        va, vg = a[rnd].get(args.key), g[rnd].get(args.key)
+        if not (isinstance(va, (int, float))
+                and isinstance(vg, (int, float))):
+            failures.append((rnd, va, vg, "missing"))
+            continue
+        if not _within(va, vg, args.rtol, args.atol):
+            failures.append((rnd, va, vg, "off-curve"))
+    if failures:
+        print(f"gate: {args.key} REGRESSED vs {args.golden} at "
+              f"{len(failures)}/{len(shared)} rounds; first:")
+        for rnd, va, vg, why in failures[:8]:
+            print(f"  round {rnd}: run={_fmt(va)} golden={_fmt(vg)} "
+                  f"({why})")
+        return 2
+    print(f"gate: {args.key} tracks the golden curve over "
+          f"{len(shared)} rounds (rtol={args.rtol}, atol={args.atol})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tools/telemetry.py",
+                                 description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("show", help="summarize a run log")
+    p.add_argument("path")
+    p.add_argument("--series", action="append", default=None,
+                   help="key(s) to sparkline (repeatable)")
+    p.set_defaults(fn=cmd_show)
+    p = sub.add_parser("diff", help="compare two run logs round-by-round")
+    p.add_argument("a")
+    p.add_argument("b")
+    p.add_argument("--key", action="append", default=None)
+    p.add_argument("--rtol", type=float, default=0.0)
+    p.add_argument("--atol", type=float, default=0.0)
+    p.add_argument("--verbose", action="store_true")
+    p.set_defaults(fn=cmd_diff)
+    p = sub.add_parser("gate",
+                       help="regression-gate a curve vs a golden log")
+    p.add_argument("run")
+    p.add_argument("golden")
+    p.add_argument("--key", required=True)
+    p.add_argument("--rtol", type=float, default=0.05)
+    p.add_argument("--atol", type=float, default=0.02)
+    p.add_argument("--min-rounds", type=int, default=2)
+    p.set_defaults(fn=cmd_gate)
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except (OSError, ValueError) as e:
+        print(f"telemetry: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,7 +67,7 @@ from dispersy_tpu.telemetry import TelemetryConfig
 #     template's empty values, and their config fingerprint predates the
 #     ``faults`` field (_legacy_fingerprint) — restoring one under a
 #     non-default FaultModel is refused.
-FORMAT_VERSION = 10  # v10: the telemetry-plane leaves (walk_streak /
+# v10: the telemetry-plane leaves (walk_streak /
 #     tele_row / tele_ring / fr_ring / fr_pos, knob-sized —
 #     dispersy_tpu/telemetry.py).  v7-v9 archives still load: their
 #     missing telemetry leaves default to the template's (zero-width)
@@ -75,7 +75,16 @@ FORMAT_VERSION = 10  # v10: the telemetry-plane leaves (walk_streak /
 #     field — restoring one under a non-default TelemetryConfig is
 #     refused (_want_fingerprint strips the ``telemetry=...`` repr
 #     component, plus ``faults=...`` for pre-v9).
-_ACCEPTED_VERSIONS = (7, 8, 9, FORMAT_VERSION)
+FORMAT_VERSION = 11  # v11: fleet archives (dispersy_tpu/fleet.py /
+#     FLEET.md) — ``save_fleet`` stamps ``meta:replicas`` and stores
+#     every leaf with its leading replica axis, plus the traced
+#     per-replica override columns (``leaf:fleetov/<knob>``).  Single-
+#     run archives are unchanged leaf-for-leaf (no new leaves), so v10
+#     singles load verbatim, and any accepted single-run archive
+#     (v7-v10 included) loads through ``restore_fleet`` as a 1-replica
+#     fleet; ``restore_replica`` splits one replica back out of a fleet
+#     archive for single-run post-mortem tooling.
+_ACCEPTED_VERSIONS = (7, 8, 9, 10, FORMAT_VERSION)
 
 # Leaves whose dtype narrowed u32 -> u8 at v8; a v7 archive's u32 arrays
 # convert by truncation (0xFFFFFFFF -> 0xFF, real values < 256 unchanged).
@@ -239,6 +248,12 @@ def restore(path: str, cfg: CommunityConfig,
         if version not in _ACCEPTED_VERSIONS:
             raise CheckpointError(f"checkpoint format {version}, "
                              f"expected {FORMAT_VERSION}")
+        if "meta:replicas" in z:
+            raise CheckpointError(
+                "this is a FLEET archive (meta:replicas = "
+                f"{int(z['meta:replicas'])}); restore it with "
+                "restore_fleet, or split one replica out with "
+                "restore_replica")
         stored_cfg = bytes(z["meta:config"]).decode()
         want_fp = _want_fingerprint(cfg, version)
         if stored_cfg != want_fp:
@@ -295,6 +310,123 @@ def _wipe_ephemeral(state: PeerState, cfg: CommunityConfig) -> PeerState:
         # contract), so an explicit pre-crash Unload survives restart.
         loaded=(np.ones((n,), bool) if cfg.auto_load
                 else np.asarray(state.loaded, bool)))
+
+
+# ---- fleet archives (v11; dispersy_tpu/fleet.py / FLEET.md) ------------
+
+_FLEETOV_PREFIX = "leaf:fleetov/"
+
+
+def save_fleet(path: str, fstate: PeerState, cfg: CommunityConfig,
+               overrides: dict | None = None) -> None:
+    """Write an R-replica fleet archive: every ``PeerState`` leaf with
+    its leading replica axis, the replica count, and the traced
+    per-replica override columns (``{knob: f32[R]}`` — the values that,
+    with the seeds already inside the state's key leaf, fully determine
+    each replica's trajectory under the shared static ``cfg``).  One
+    CRC32 per entry, like :func:`save`."""
+    names, leaves, _ = _leaves_with_paths(fstate)
+    n_rep = int(np.shape(jax.device_get(fstate.round_index))[0])
+    arrays = {f"leaf:{n}": np.asarray(jax.device_get(leaf))
+              for n, leaf in zip(names, leaves)}
+    for name, val in (overrides or {}).items():
+        col = np.asarray(jax.device_get(val), np.float32)
+        if col.shape != (n_rep,):
+            raise CheckpointError(
+                f"override column {name}: shape {col.shape}, fleet has "
+                f"{n_rep} replicas")
+        arrays[f"leaf:fleetov/{name}"] = col
+    for k in list(arrays):
+        arrays[f"crc:{k[len('leaf:'):]}"] = np.asarray(_crc(arrays[k]),
+                                                       np.uint32)
+    arrays["meta:version"] = np.asarray(FORMAT_VERSION)
+    arrays["meta:replicas"] = np.asarray(n_rep)
+    arrays["meta:config"] = np.frombuffer(
+        _fingerprint(cfg).encode(), dtype=np.uint8)
+    _atomic_npz(path, arrays)
+
+
+@_archive_guard
+def restore_fleet(path: str, cfg: CommunityConfig):
+    """Load ``(fstate, overrides_dict | None)`` from a fleet archive.
+
+    Any accepted SINGLE-RUN archive (v7-v11) also loads here, coming
+    back as a 1-replica fleet with no overrides — old checkpoints feed
+    straight into fleet tooling.  Fleet leaves verify per-leaf CRCs and
+    shapes ``(R,) + template``; a corrupt/torn archive raises
+    ``CheckpointError`` exactly like the single-run reader.
+    """
+    from dispersy_tpu.state import stack_states
+
+    with _np_load(path) as z:
+        if "meta:replicas" not in z:
+            pass     # single-run archive: fall through to restore()
+        else:
+            version = int(z["meta:version"])
+            if version != FORMAT_VERSION:
+                raise CheckpointError(
+                    f"fleet archives exist only at format "
+                    f"{FORMAT_VERSION}, got {version}")
+            stored_cfg = bytes(z["meta:config"]).decode()
+            want_fp = _fingerprint(cfg)
+            if stored_cfg != want_fp:
+                raise CheckpointError(
+                    "fleet checkpoint was written under a different "
+                    f"config:\n  stored: {stored_cfg}\n"
+                    f"  given:  {want_fp}")
+            n_rep = int(z["meta:replicas"])
+            if n_rep < 1:
+                raise CheckpointError(f"meta:replicas = {n_rep}")
+            template = init_state(cfg, jax.random.PRNGKey(0))
+            names, t_leaves, treedef = _leaves_with_paths(template)
+            leaves = []
+            for n, t in zip(names, t_leaves):
+                key = f"leaf:{n}"
+                if key not in z:
+                    raise CheckpointError(
+                        f"fleet checkpoint missing field {n}")
+                arr = z[key]
+                _verify_crc(z, key, arr, path)
+                want = (n_rep,) + tuple(t.shape)
+                if tuple(arr.shape) != want or arr.dtype != t.dtype:
+                    raise CheckpointError(
+                        f"field {n}: checkpoint {arr.shape}/{arr.dtype} "
+                        f"vs fleet of {n_rep} x config "
+                        f"{t.shape}/{t.dtype}")
+                leaves.append(arr)
+            ov = {}
+            for key in z.files:
+                if not key.startswith(_FLEETOV_PREFIX):
+                    continue
+                arr = z[key]
+                _verify_crc(z, key, arr, path)
+                if arr.shape != (n_rep,):
+                    raise CheckpointError(
+                        f"override column {key}: shape {arr.shape}, "
+                        f"fleet has {n_rep} replicas")
+                ov[key[len(_FLEETOV_PREFIX):]] = arr
+            return (jax.tree_util.tree_unflatten(treedef, leaves),
+                    ov or None)
+    # Single-run archive (any accepted version): one replica, no
+    # overrides — restore() handles versioning/up-conversion/CRCs.
+    single = jax.tree_util.tree_map(np.asarray, restore(path, cfg))
+    return stack_states([single]), None
+
+
+def restore_replica(path: str, cfg: CommunityConfig, i: int) -> PeerState:
+    """Split ONE replica out of a fleet archive as an ordinary
+    single-run ``PeerState`` (host arrays) — the post-mortem handle:
+    feed it to ``debug_validate``, the oracle differ, or re-save it
+    with :func:`save` as a plain single-run checkpoint."""
+    from dispersy_tpu.state import index_state
+
+    fstate, _ = restore_fleet(path, cfg)
+    n_rep = int(np.shape(fstate.round_index)[0])
+    if not 0 <= i < n_rep:
+        raise CheckpointError(
+            f"replica index {i} out of range for a {n_rep}-replica "
+            "fleet")
+    return jax.tree_util.tree_map(np.asarray, index_state(fstate, i))
 
 
 def _pid_alive(pid: int) -> bool:

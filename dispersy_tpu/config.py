@@ -426,6 +426,10 @@ class CommunityConfig:
     #      protocol — candidate timeouts, walk timeouts; SURVEY.md §5.3) ----
     churn_rate: float = 0.0             # fraction of peers replaced per round
     packet_loss: float = 0.0            # Bernoulli drop per logical packet
+    #   (traced-liftable under the fleet plane: a per-replica override
+    #    may replace this VALUE inside one compiled multi-replica
+    #    program while the config stays static — faults.
+    #    TRACED_FAULT_KNOBS / engine.effective_faults; FLEET.md)
     # ---- NAT model (reference: candidate.py ``connection_type`` —
     #      u"public" vs u"symmetric-NAT", advertised in every
     #      introduction request/response; community.py

@@ -47,6 +47,7 @@ hash (:mod:`dispersy_tpu.ops.rng`) so the pure-Python oracle
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -108,25 +109,101 @@ _FAULT_SYNC = 0 << 16
 _FAULT_PUSH = 1 << 16
 
 
-def _lost(seed, rnd, edge_peer, salt_base, salt, cfg: CommunityConfig,
+class _EffFaults(NamedTuple):
+    """Effective fault-channel knobs for one traced round.
+
+    On the plain path every value is the static config float and every
+    ``*_on`` gate mirrors the config's compiled-in/compiled-out decision
+    exactly.  Under fleet overrides (dispersy_tpu/fleet.py) the VALUES
+    may be traced per-replica f32 scalars while the gates stay Python
+    bools — structure (which branches trace, which state leaves exist)
+    always comes from the static config, so a whole traced fault grid
+    shares ONE compiled program.  Bit-compat invariant: a replica whose
+    traced value equals a static config's knob computes the identical
+    round, because every consumer compares ``u < jnp.float32(value)``
+    either way.
+    """
+    packet_loss_on: bool
+    packet_loss: object          # python float | traced f32 scalar
+    ge_on: bool
+    ge_p_bad: object
+    ge_p_good: object
+    ge_loss_good: object
+    ge_loss_bad: object
+    dup_on: bool
+    dup_rate: object
+    corrupt_on: bool
+    corrupt_rate: object
+
+
+def effective_faults(cfg: CommunityConfig, overrides=None) -> _EffFaults:
+    """Resolve the liftable fault knobs against optional fleet overrides.
+
+    ``overrides`` is duck-typed (``dispersy_tpu.fleet.FleetOverrides`` —
+    the engine must not import the fleet plane): any attribute that is
+    not ``None`` replaces the static knob's VALUE; which attributes are
+    set is part of the jit cache key (pytree structure), so the
+    fleet-off path (``overrides=None``) compiles to the byte-identical
+    pre-fleet round.  Structural knobs cannot be lifted: GE overrides
+    require ``cfg.faults.ge_enabled`` (the ``ge_bad`` leaf must exist)
+    and a corrupt override requires the ``msgs_corrupt_dropped`` leaf
+    to be compiled in (``corrupt_rate > 0`` or a flood) — FLEET.md's
+    traced-vs-static knob table.
+    """
+    fm = cfg.faults
+
+    def ov(name):
+        return getattr(overrides, name, None) if overrides is not None \
+            else None
+
+    pl, dup, cor = ov("packet_loss"), ov("dup_rate"), ov("corrupt_rate")
+    gpb, gpg = ov("ge_p_bad"), ov("ge_p_good")
+    glg, glb = ov("ge_loss_good"), ov("ge_loss_bad")
+    if any(v is not None for v in (gpb, gpg, glg, glb)) \
+            and not fm.ge_enabled:
+        raise ValueError(
+            "traced GE overrides need cfg.faults.ge_enabled — the "
+            "ge_bad state leaf is zero-width otherwise (FLEET.md)")
+    if cor is not None and not (fm.corrupt_rate > 0.0 or fm.flood_enabled):
+        raise ValueError(
+            "a traced corrupt_rate override needs the corrupt-drop "
+            "counter compiled in: set cfg.faults.corrupt_rate > 0 "
+            "(any representative value) so stats.msgs_corrupt_dropped "
+            "is full-width (FLEET.md)")
+    return _EffFaults(
+        packet_loss_on=cfg.packet_loss > 0.0 or pl is not None,
+        packet_loss=cfg.packet_loss if pl is None else pl,
+        ge_on=fm.ge_enabled,
+        ge_p_bad=fm.ge_p_bad if gpb is None else gpb,
+        ge_p_good=fm.ge_p_good if gpg is None else gpg,
+        ge_loss_good=fm.ge_loss_good if glg is None else glg,
+        ge_loss_bad=fm.ge_loss_bad if glb is None else glb,
+        dup_on=fm.dup_rate > 0.0 or dup is not None,
+        dup_rate=fm.dup_rate if dup is None else dup,
+        corrupt_on=fm.corrupt_rate > 0.0 or cor is not None,
+        corrupt_rate=fm.corrupt_rate if cor is None else cor)
+
+
+def _lost(seed, rnd, edge_peer, salt_base, salt, kn: _EffFaults,
           ge_bad):
     """Per-packet delivery-loss draw: the base i.i.d. Bernoulli
-    (``cfg.packet_loss``) ORed with the Gilbert–Elliott state-dependent
-    loss (``cfg.faults.ge_*``).  The GE channel belongs to ``edge_peer``
+    (``kn.packet_loss``) ORed with the Gilbert–Elliott state-dependent
+    loss (``kn.ge_*``).  The GE channel belongs to ``edge_peer``
     — the same peer the base draw has always been keyed on at each call
     site: the sender's uplink on sends, the receiver's downlink on
     receipt pickups (FAULTS.md).  Both draws come from independent
     counter streams (P_LOSS vs P_GE_LOSS) so enabling GE never perturbs
-    the base-loss sequence."""
-    fm = cfg.faults
+    the base-loss sequence.  ``kn`` is the round's effective-knob view
+    (:func:`effective_faults`): static floats normally, traced
+    per-replica scalars under fleet overrides."""
     out = None
-    if cfg.packet_loss > 0.0:
+    if kn.packet_loss_on:
         u = rng.rand_uniform(seed, rnd, edge_peer, rng.P_LOSS,
                              jnp.asarray(salt) + salt_base)
-        out = u < cfg.packet_loss
-    if fm.ge_enabled:
-        p = jnp.where(ge_bad[edge_peer], jnp.float32(fm.ge_loss_bad),
-                      jnp.float32(fm.ge_loss_good))
+        out = u < jnp.float32(kn.packet_loss)
+    if kn.ge_on:
+        p = jnp.where(ge_bad[edge_peer], jnp.float32(kn.ge_loss_bad),
+                      jnp.float32(kn.ge_loss_good))
         ug = rng.rand_uniform(seed, rnd, edge_peer, rng.P_GE_LOSS,
                               jnp.asarray(salt) + salt_base)
         g = ug < p
@@ -499,8 +576,16 @@ def _telemetry_row(cfg: CommunityConfig, *, rnd, new_time, members, stats,
 
 
 @functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
-def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
-    """Advance every peer one walker interval (~5 simulated seconds)."""
+def step(state: PeerState, cfg: CommunityConfig,
+         overrides=None) -> PeerState:
+    """Advance every peer one walker interval (~5 simulated seconds).
+
+    ``overrides`` (default None — compiled out, the step is byte-
+    identical to the pre-fleet round) is a ``fleet.FleetOverrides``-
+    shaped pytree of traced per-replica fault-knob scalars; the fleet
+    plane vmaps this function over a leading replica axis so a whole
+    fault grid advances under ONE compiled program (FLEET.md).
+    """
     n, t = cfg.n_peers, cfg.n_trackers
     idx = jnp.arange(n, dtype=jnp.int32)
     seed = rng.fold_seed(state.key)
@@ -510,12 +595,15 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     # Chaos harness (dispersy_tpu/faults.py): every fault branch below is
     # gated on a STATIC FaultModel knob, so all-zero knobs compile to the
     # identical fault-free round (FAULTS.md; BENCH.md fault-knob note).
+    # ``kn`` resolves the liftable knob VALUES against fleet overrides;
+    # its gates are plain bools, so fleet-off tracing is unchanged.
     fm = cfg.faults
-    if fm.ge_enabled:
+    kn = effective_faults(cfg, overrides)
+    if kn.ge_on:
         # Advance each peer's Gilbert–Elliott channel once per round;
         # this round's loss draws condition on the post-transition state.
         ge_bad = flt.ge_advance(state.ge_bad, seed, rnd, idx,
-                                fm.ge_p_bad, fm.ge_p_good)
+                                kn.ge_p_bad, kn.ge_p_good)
     else:
         ge_bad = state.ge_bad
     if fm.health_checks or cfg.telemetry.histograms:
@@ -736,7 +824,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             fc_salt = (jnp.arange(f)[:, None] * c
                        + jnp.arange(c)[None, :])[None, :, :]      # [1, F, C]
             push_lost = _lost(seed, rnd, idx[:, None, None], _LOSS_FORWARD,
-                              fc_salt, cfg, ge_bad)
+                              fc_salt, kn, ge_bad)
             if cfg.timeline_enabled:
                 # A hard-killed peer pushes NOTHING except destroy records
                 # — HardKilledCommunity actively spreads the kill (the
@@ -782,7 +870,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                                     fsalt + (block << 12))
             alive_f = alive[fsrc]
             fl_lost = _lost(seed, rnd, fsrc[:, None], _LOSS_FLOOD, fsalt,
-                            cfg, ge_bad)
+                            kn, ge_bad)
             fl_valid = alive_f[:, None] & ~fl_lost
             if fm.partitions:
                 fl_valid = fl_valid & ~flt.partition_blocked(
@@ -841,7 +929,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         # receiver's socket before the hash check can reject it.
         bdown = bdown + jnp.sum(ph_ok, axis=1).astype(jnp.uint32) \
             * jnp.uint32(RECORD_BYTES)
-        if fm.flood_enabled or fm.corrupt_rate > 0.0:
+        if fm.flood_enabled or kn.corrupt_on:
             # Intake hash re-verification (modeled): flood junk always
             # fails it; real records fail with corrupt_rate.  Either way
             # the record is DROPPED and counted — never ingested as
@@ -850,11 +938,11 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
             bad = jnp.zeros_like(ph_ok)
             if fm.flood_enabled:
                 bad = bad | (ph_ok & ph_junk)
-            if fm.corrupt_rate > 0.0:
+            if kn.corrupt_on:
                 cu = rng.rand_uniform(
                     seed, rnd, idx[:, None], rng.P_CORRUPT,
                     jnp.arange(q_sz)[None, :] + _FAULT_PUSH)
-                bad = bad | (ph_ok & (cu < jnp.float32(fm.corrupt_rate)))
+                bad = bad | (ph_ok & (cu < jnp.float32(kn.corrupt_rate)))
             stats = stats.replace(
                 msgs_corrupt_dropped=stats.msgs_corrupt_dropped
                 + jnp.sum(bad, axis=1).astype(jnp.uint32))
@@ -862,13 +950,13 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         if cfg.delay_enabled:
             ph_src = jnp.where(ph_ok, push.inbox[5].astype(jnp.int32),
                                NO_PEER)
-        if fm.dup_rate > 0.0:
+        if kn.dup_on:
             # Delivery duplication: a clean delivered push arrives twice
             # (the duplicate joins the intake batch's tail segment).
             du = rng.rand_uniform(
                 seed, rnd, idx[:, None], rng.P_DUP,
                 jnp.arange(ph_ok.shape[1])[None, :] + _FAULT_PUSH)
-            ph_dup_ok = ph_ok & (du < jnp.float32(fm.dup_rate))
+            ph_dup_ok = ph_ok & (du < jnp.float32(kn.dup_rate))
             bdown = bdown + jnp.sum(ph_dup_ok, axis=1).astype(jnp.uint32) \
                 * jnp.uint32(RECORD_BYTES)
     else:
@@ -879,7 +967,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         ph_src = jnp.zeros((n, 0), jnp.int32)
         ph_dup_ok = jnp.zeros((n, 0), bool)
 
-    req_lost = _lost(seed, rnd, idx, _LOSS_REQUEST, 0, cfg, ge_bad)
+    req_lost = _lost(seed, rnd, idx, _LOSS_REQUEST, 0, kn, ge_bad)
     # target is already NO_PEER for dead/tracker/killed peers (phase 1).
     bup = bup + (act & (target != NO_PEER)).astype(jnp.uint32) * req_bytes
     send_ok = act & (target != NO_PEER) & ~req_lost
@@ -1037,7 +1125,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     # puncture-request edges: responder -> C, naming the requester.
     salt_r = jnp.arange(r)[None, :]
     pr_lost = _lost(seed, rnd, idx[:, None], _LOSS_PUNCTURE_REQ, salt_r,
-                    cfg, ge_bad)
+                    kn, ge_bad)
     pr_ok_send = rq_ok & (intro != NO_PEER) & ~pr_lost
     if fm.partitions:
         pr_ok_send = pr_ok_send & ~flt.partition_blocked(
@@ -1050,7 +1138,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     if t > 0:
         salt_rt = jnp.arange(rt)[None, :] + _TRACKER_SALT
         tpr_lost = _lost(seed, rnd, tidx[:, None], _LOSS_PUNCTURE_REQ, salt_rt,
-                         cfg, ge_bad)
+                         kn, ge_bad)
         tpr_ok_send = tq_ok & (intro_t != NO_PEER) & ~tpr_lost
         if fm.partitions:
             tpr_ok_send = tpr_ok_send & ~flt.partition_blocked(
@@ -1081,7 +1169,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     p = cfg.request_inbox
     salt_p = jnp.arange(p)[None, :]
     pu_lost = _lost(seed, rnd, idx[:, None], _LOSS_PUNCTURE, salt_p,
-                    cfg, ge_bad)
+                    kn, ge_bad)
     pu_ok_send = pq_ok & ~pu_lost
     if fm.partitions:
         pu_ok_send = pu_ok_send & ~flt.partition_blocked(
@@ -1127,7 +1215,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         intro_pick = jnp.where(to_tracker, intro_t[tgt_t, slot_t], intro_n)
     else:
         got_raw, intro_pick = got_n, intro_n
-    resp_lost = _lost(seed, rnd, idx, _LOSS_RESPONSE, 0, cfg, ge_bad)
+    resp_lost = _lost(seed, rnd, idx, _LOSS_RESPONSE, 0, kn, ge_bad)
     got_resp = got_raw & ~resp_lost & act
     bdown = bdown + got_resp.astype(jnp.uint32) \
         * jnp.uint32(INTRO_RESPONSE_BYTES)
@@ -1182,7 +1270,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     if cfg.double_meta_mask:
         s_sz = cfg.sig_inbox
         sending = act & ~killed & (sg_target != NO_PEER) & (sg_since == rnd)
-        srq_lost = _lost(seed, rnd, idx, _LOSS_SIGREQ, 0, cfg, ge_bad)
+        srq_lost = _lost(seed, rnd, idx, _LOSS_SIGREQ, 0, kn, ge_bad)
         bup = bup + sending.astype(jnp.uint32) \
             * jnp.uint32(SIGNATURE_REQUEST_BYTES)
         sig_send_ok = sending & ~srq_lost
@@ -1244,7 +1332,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         tgt_a = jnp.maximum(jnp.where(sending, sg_target, 0), 0)
         slot_a = jnp.maximum(sreq.edge_slot, 0)
         got_sig = (sreq.edge_slot >= 0) & countersign[tgt_a, slot_a]
-        srs_lost = _lost(seed, rnd, idx, _LOSS_SIGRESP, 0, cfg, ge_bad)
+        srs_lost = _lost(seed, rnd, idx, _LOSS_SIGRESP, 0, kn, ge_bad)
         completed = sending & got_sig & ~srs_lost
         bdown = bdown + completed.astype(jnp.uint32) \
             * jnp.uint32(SIGNATURE_RESPONSE_BYTES)
@@ -1356,28 +1444,28 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         sy_gt, sy_member, sy_meta, sy_payload, sy_aux = (
             c[tgt, slot_n] for c in obox)                         # [N, b]
         sync_lost = _lost(seed, rnd, idx[:, None], _LOSS_SYNC,
-                          jnp.arange(b)[None, :], cfg, ge_bad)
+                          jnp.arange(b)[None, :], kn, ge_bad)
         sy_ok = (obox_ok[tgt, slot_n] & (req.edge_slot >= 0)[:, None]
                  & act[:, None] & ~sync_lost)
         bup = bup + jnp.sum(obox_ok, axis=(1, 2)).astype(jnp.uint32) \
             * jnp.uint32(RECORD_BYTES)
         bdown = bdown + jnp.sum(sy_ok, axis=1).astype(jnp.uint32) \
             * jnp.uint32(RECORD_BYTES)
-        if fm.corrupt_rate > 0.0:
+        if kn.corrupt_on:
             # In-transit bit-flip: the record crossed the socket (bytes
             # counted above) but fails the intake hash re-check — dropped
             # and counted, never ingested (FAULTS.md).
             cu = rng.rand_uniform(seed, rnd, idx[:, None], rng.P_CORRUPT,
                                   jnp.arange(b)[None, :] + _FAULT_SYNC)
-            sy_bad = sy_ok & (cu < jnp.float32(fm.corrupt_rate))
+            sy_bad = sy_ok & (cu < jnp.float32(kn.corrupt_rate))
             stats = stats.replace(
                 msgs_corrupt_dropped=stats.msgs_corrupt_dropped
                 + jnp.sum(sy_bad, axis=1).astype(jnp.uint32))
             sy_ok = sy_ok & ~sy_bad
-        if fm.dup_rate > 0.0:
+        if kn.dup_on:
             du = rng.rand_uniform(seed, rnd, idx[:, None], rng.P_DUP,
                                   jnp.arange(b)[None, :] + _FAULT_SYNC)
-            sy_dup_ok = sy_ok & (du < jnp.float32(fm.dup_rate))
+            sy_dup_ok = sy_ok & (du < jnp.float32(kn.dup_rate))
             bdown = bdown + jnp.sum(sy_dup_ok, axis=1).astype(jnp.uint32) \
                 * jnp.uint32(RECORD_BYTES)
     else:
@@ -1412,7 +1500,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         dd_, pb = cfg.delay_inbox, cfg.proof_budget
         have_pen = dl_ok & (dl_src != NO_PEER)                  # [N, D]
         prq_lost = _lost(seed, rnd, idx[:, None], _LOSS_PROOF_REQ,
-                         jnp.arange(dd_)[None, :], cfg, ge_bad)
+                         jnp.arange(dd_)[None, :], kn, ge_bad)
         bup = bup + jnp.sum(have_pen, axis=1).astype(jnp.uint32) \
             * jnp.uint32(MISSING_PROOF_BYTES)
         pen_send = have_pen & ~prq_lost
@@ -1471,7 +1559,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         pr_gt, pr_member, pr_meta, pr_payload, pr_aux = (
             pick(c) for c in pbox[:5])
         prs_lost = _lost(seed, rnd, idx[:, None], _LOSS_PROOF_RESP,
-                         jnp.arange(dd_ * pb)[None, :], cfg, ge_bad)
+                         jnp.arange(dd_ * pb)[None, :], kn, ge_bad)
         pr_ok = (pick(pbox[5])
                  & jnp.repeat(got, pb, axis=1)
                  & act[:, None] & ~prs_lost)
@@ -1514,7 +1602,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         want = (dl_ok & (dl_src != NO_PEER) & dl_is_seq
                 & (sq_low <= sq_high))                      # [N, D]
         mrq_lost = _lost(seed, rnd, idx[:, None], _LOSS_SEQ_REQ,
-                         jnp.arange(dd_)[None, :], cfg, ge_bad)
+                         jnp.arange(dd_)[None, :], kn, ge_bad)
         bup = bup + jnp.sum(want, axis=1).astype(jnp.uint32) \
             * jnp.uint32(MISSING_SEQ_BYTES)
         seq_send = want & ~mrq_lost
@@ -1576,7 +1664,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         mq_gt, mq_member, mq_meta, mq_payload, mq_aux = (
             qpick(c) for c in qbox[:5])
         mqs_lost = _lost(seed, rnd, idx[:, None], _LOSS_SEQ_RESP,
-                         jnp.arange(dd_ * qb)[None, :], cfg, ge_bad)
+                         jnp.arange(dd_ * qb)[None, :], kn, ge_bad)
         mq_ok = (qpick(qbox[5])
                  & jnp.repeat(qgot, qb, axis=1)
                  & act[:, None] & ~mqs_lost)
@@ -1610,7 +1698,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         want_mm = (dl_ok & (dl_src != NO_PEER)
                    & (dl_meta == jnp.uint32(META_UNDO_OTHER)))   # [N, D]
         mmq_lost = _lost(seed, rnd, idx[:, None], _LOSS_MSG_REQ,
-                         jnp.arange(dd_)[None, :], cfg, ge_bad)
+                         jnp.arange(dd_)[None, :], kn, ge_bad)
         bup = bup + jnp.sum(want_mm, axis=1).astype(jnp.uint32) \
             * jnp.uint32(MISSING_MSG_BYTES)
         mm_send = want_mm & ~mmq_lost
@@ -1665,7 +1753,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         mm_gt, mm_member, mm_meta, mm_payload, mm_aux = (
             mpick(c[:, :, 0]) for c in mbox[:5])
         mms_lost = _lost(seed, rnd, idx[:, None], _LOSS_MSG_RESP,
-                         jnp.arange(dd_)[None, :], cfg, ge_bad)
+                         jnp.arange(dd_)[None, :], kn, ge_bad)
         mm_ok = (mpick(mbox[5][:, :, 0]) & mgot & act[:, None] & ~mms_lost)
         mm_src = dl_src
         stats = stats.replace(
@@ -1695,7 +1783,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                    & (dl_meta < cfg.n_meta)
                    & ~ik.identity_stored(stc, dl_member))        # [N, D]
         idq_lost = _lost(seed, rnd, idx[:, None], _LOSS_ID_REQ,
-                         jnp.arange(dd_)[None, :], cfg, ge_bad)
+                         jnp.arange(dd_)[None, :], kn, ge_bad)
         bup = bup + jnp.sum(want_id, axis=1).astype(jnp.uint32) \
             * jnp.uint32(MISSING_IDENTITY_BYTES)
         id_send = want_id & ~idq_lost
@@ -1746,7 +1834,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
         ii_gt, ii_member, ii_meta, ii_payload, ii_aux = (
             ipick(c[:, :, 0]) for c in ibox[:5])
         iis_lost = _lost(seed, rnd, idx[:, None], _LOSS_ID_RESP,
-                         jnp.arange(dd_)[None, :], cfg, ge_bad)
+                         jnp.arange(dd_)[None, :], kn, ge_bad)
         ii_ok = (ipick(ibox[5][:, :, 0]) & igot & act[:, None] & ~iis_lost)
         ii_src = dl_src
         stats = stats.replace(
@@ -1779,7 +1867,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
     segs_aux = [dl_aux, sy_aux, ph_aux, db_aux, pr_aux, mq_aux, mm_aux,
                 ii_aux]
     segs_ok = [dl_ok, sy_ok, ph_ok, db_ok, pr_ok, mq_ok, mm_ok, ii_ok]
-    if fm.dup_rate > 0.0:
+    if kn.dup_on:
         # Delivery duplicates: the same delivered sync/push records again
         # at the batch tail, valid where the dup draw fired — the store's
         # UNIQUE insert and in-batch dedup absorb them (FAULTS.md).
@@ -1815,7 +1903,7 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
                   jnp.zeros((n, 0), jnp.int32))
         in_src = jnp.concatenate(
             [dl_src, sy_src, ph_src, db_src, pr_src, mq_src, mm_src,
-             ii_src] + ([sy_src, ph_src] if fm.dup_rate > 0.0 else []),
+             ii_src] + ([sy_src, ph_src] if kn.dup_on else []),
             axis=1)
     if bb > 0:
         # Clock-jump defense before the store accepts anything.
@@ -2423,7 +2511,8 @@ def step(state: PeerState, cfg: CommunityConfig) -> PeerState:
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2), donate_argnums=0)
-def multi_step(state: PeerState, cfg: CommunityConfig, k: int) -> PeerState:
+def multi_step(state: PeerState, cfg: CommunityConfig, k: int,
+               overrides=None) -> PeerState:
     """Advance ``k`` rounds in ONE dispatch (a ``lax.fori_loop`` over
     :func:`step`'s body).
 
@@ -2436,7 +2525,8 @@ def multi_step(state: PeerState, cfg: CommunityConfig, k: int) -> PeerState:
     exactly how the reference amortizes work across its 5-second walker
     ticks without returning to the caller in between.
     """
-    return lax.fori_loop(0, k, lambda i, s: step.__wrapped__(s, cfg), state)
+    return lax.fori_loop(
+        0, k, lambda i, s: step.__wrapped__(s, cfg, overrides), state)
 
 
 def unload_members(state: PeerState, cfg: CommunityConfig,

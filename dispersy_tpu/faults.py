@@ -83,6 +83,37 @@ HEALTH_BIT_NAMES = {
     HEALTH_BLOOM_SAT: "bloom_saturated",
 }
 
+# Fault knobs the fleet plane (dispersy_tpu/fleet.py) can lift into
+# TRACED per-replica scalars: numeric probabilities whose value never
+# decides program structure.  ``packet_loss`` lives on CommunityConfig,
+# the rest on FaultModel.  Everything else (partitions, flood topology,
+# health_checks, every size knob) is structural and stays a static
+# compile-group key — FLEET.md's traced-vs-static table.
+TRACED_FAULT_KNOBS = (
+    "packet_loss", "dup_rate", "corrupt_rate",
+    "ge_p_bad", "ge_p_good", "ge_loss_good", "ge_loss_bad",
+)
+
+
+def enablement_signature(cfg) -> tuple:
+    """The structural enablement bits a traced fault grid must agree on.
+
+    Two configs whose traced knobs differ but whose signature matches
+    compile to ONE program with identical state-leaf shapes, so their
+    replicas stay leaf-for-leaf comparable to their own single runs:
+
+    - ``ge_enabled`` sizes the ``ge_bad`` leaf;
+    - corrupt-or-flood sizes ``stats.msgs_corrupt_dropped``.
+
+    ``packet_loss`` and ``dup_rate`` values are NOT part of the
+    signature — they gate computation only, and a traced zero computes
+    the identical round to a compiled-out knob (a uniform draw is never
+    < 0).  The sweep compiler (tools/fleet.py) groups grid points by
+    this signature plus every static knob.
+    """
+    fm = cfg.faults
+    return (fm.ge_enabled, fm.corrupt_rate > 0.0 or fm.flood_enabled)
+
 
 @dataclasses.dataclass(frozen=True)
 class FaultModel:

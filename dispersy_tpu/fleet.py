@@ -1,0 +1,243 @@
+"""The fleet plane: vmapped multi-replica simulation (FLEET.md).
+
+Every multi-run workload the repo grew — fuzz draws, convergence
+curves with confidence intervals, FaultModel grids — executed one
+simulation per host-loop iteration, leaving the chip idle between
+small runs and paying one full compile per grid point.  This module
+recasts N-seeds-per-config as data-parallel ensemble execution:
+
+- **Replica axis**: R independent ``PeerState`` pytrees stack along a
+  NEW leading axis (``state.stack_states``) and advance together under
+  one jitted ``vmap(engine.step)`` — bit-identical, leaf for leaf, to
+  R sequential single runs (pinned in tests/test_fleet.py).  Replicas
+  never interact; the per-replica RNG seed already lives in the state
+  (``PeerState.key``), so distinct seeds ride the stack for free.
+- **Traced per-replica knobs**: :class:`FleetOverrides` lifts the
+  numeric fault rates (``packet_loss``, ``dup_rate``, ``corrupt_rate``,
+  the GE ``ge_*`` probabilities — ``faults.TRACED_FAULT_KNOBS``) into
+  per-replica f32 scalars read inside ``engine.step`` via
+  ``engine.effective_faults``.  A whole fault grid with a shared
+  structural signature (``faults.enablement_signature``) runs in ONE
+  compile; which fields are overridden is pytree structure, so the
+  fleet-off path stays compiled out entirely.
+- **Cross-replica statistics**: the per-replica packed telemetry rows
+  reduce on device into one [3, RW] min/max/sum band
+  (``ops.fleet.band_reduce``); :func:`band` / the ring form keep an
+  R-replica convergence band at ONE host transfer per drain.
+- **Checkpointing**: ``checkpoint.save_fleet`` / :func:`load` persist a
+  whole fleet (format v11) with its overrides; :func:`replica` /
+  ``checkpoint.restore_replica`` split any single replica back out for
+  post-mortem with every existing single-run tool.
+
+The sweep compiler over all of this lives in ``tools/fleet.py``: it
+partitions a sweep-spec JSON into compile groups (static knobs x
+structural signature) x traced grids (seeds + rates) and executes each
+group as one fleet.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dispersy_tpu import checkpoint as _ckpt
+from dispersy_tpu import engine
+from dispersy_tpu import telemetry as tlm
+from dispersy_tpu.config import CommunityConfig
+from dispersy_tpu.exceptions import ConfigError
+from dispersy_tpu.faults import TRACED_FAULT_KNOBS
+from dispersy_tpu.ops import fleet as ops_fleet
+from dispersy_tpu.state import (PeerState, index_state, init_state,
+                                stack_states)
+
+
+class FleetOverrides(NamedTuple):
+    """Traced per-replica fault-knob columns (``f32[R]`` each, or
+    ``None`` = keep the static config value on every replica).
+
+    Which fields are set is part of the jit cache key (pytree
+    structure); the VALUES are traced, so re-running a fleet with new
+    rates never recompiles.  Structural requirements (GE overrides need
+    ``cfg.faults.ge_enabled``; a corrupt override needs the corrupt
+    counter compiled in) are enforced by :func:`make_overrides` and
+    again at trace time by ``engine.effective_faults``.
+    """
+
+    packet_loss: Any = None
+    dup_rate: Any = None
+    corrupt_rate: Any = None
+    ge_p_bad: Any = None
+    ge_p_good: Any = None
+    ge_loss_good: Any = None
+    ge_loss_bad: Any = None
+
+
+assert FleetOverrides._fields == TRACED_FAULT_KNOBS, \
+    "FleetOverrides must mirror faults.TRACED_FAULT_KNOBS exactly"
+
+
+def make_overrides(cfg: CommunityConfig, **knobs) -> FleetOverrides:
+    """Validated :class:`FleetOverrides` from per-knob value sequences.
+
+    Every supplied knob must be a length-R sequence of probabilities in
+    [0, 1]; all knobs must agree on R.  Raises ``ConfigError`` on an
+    unknown knob name, a ragged grid, an out-of-range value, or a
+    structural mismatch with ``cfg`` (FLEET.md's traced-vs-static
+    table).
+    """
+    unknown = set(knobs) - set(TRACED_FAULT_KNOBS)
+    if unknown:
+        raise ConfigError(
+            f"not traced-liftable: {sorted(unknown)} (liftable knobs: "
+            f"{TRACED_FAULT_KNOBS}; everything else is structural — "
+            "sweep it as a static axis / compile group instead)")
+    lens = {name: len(v) for name, v in knobs.items()}
+    if len(set(lens.values())) > 1:
+        raise ConfigError(f"override grids must share one replica "
+                          f"count, got {lens}")
+    fm = cfg.faults
+    if any(name.startswith("ge_") for name in knobs) and not fm.ge_enabled:
+        raise ConfigError(
+            "traced GE overrides need cfg.faults.ge_enabled (set "
+            "representative non-zero ge_* rates in the fleet config so "
+            "the ge_bad leaf exists)")
+    if "corrupt_rate" in knobs and not (fm.corrupt_rate > 0.0
+                                        or fm.flood_enabled):
+        raise ConfigError(
+            "a traced corrupt_rate needs cfg.faults.corrupt_rate > 0 "
+            "(representative value) so stats.msgs_corrupt_dropped is "
+            "full-width")
+    cols = {}
+    for name, vals in knobs.items():
+        arr = np.asarray(vals, np.float32)
+        if arr.ndim != 1:
+            raise ConfigError(f"{name}: override grid must be 1-D "
+                              f"(one value per replica), got shape "
+                              f"{arr.shape}")
+        if not ((arr >= 0.0) & (arr <= 1.0)).all():
+            raise ConfigError(f"{name}: override values must be in "
+                              f"[0, 1], got {vals}")
+        cols[name] = jnp.asarray(arr)
+    return FleetOverrides(**cols)
+
+
+def n_replicas(fstate: PeerState) -> int:
+    """Replica count of a fleet-stacked state (leading axis of the
+    per-replica round counter)."""
+    return int(fstate.round_index.shape[0])
+
+
+def init_fleet(cfg: CommunityConfig, seeds) -> PeerState:
+    """A fresh R-replica fleet: one :func:`~dispersy_tpu.state.init_state`
+    per RNG seed, stacked along the replica axis.  Every replica shares
+    the static ``cfg`` (one compiled program); only the key leaf — and
+    anything later seeded from it — differs."""
+    seeds = list(seeds)
+    if not seeds:
+        raise ConfigError("init_fleet needs at least one seed")
+    return stack_states([init_state(cfg, jax.random.PRNGKey(s))
+                         for s in seeds])
+
+
+def replica(fstate: PeerState, i: int) -> PeerState:
+    """Split replica ``i`` out of the fleet (``state.index_state``): an
+    ordinary single-run ``PeerState`` for post-mortem tooling."""
+    return index_state(fstate, i)
+
+
+@functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
+def fleet_step(fstate: PeerState, cfg: CommunityConfig,
+               overrides: FleetOverrides | None = None) -> PeerState:
+    """Advance every replica one round under ONE compiled program.
+
+    ``vmap`` over the replica axis of the REAL ``engine.step`` — no
+    fleet-specific physics exists anywhere; bit-identity to single runs
+    is structural, not re-implemented.  ``overrides`` columns map one
+    scalar to each replica.
+    """
+    if overrides is None:
+        return jax.vmap(lambda s: engine.step.__wrapped__(s, cfg))(fstate)
+    return jax.vmap(
+        lambda s, o: engine.step.__wrapped__(s, cfg, o))(fstate, overrides)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2), donate_argnums=0)
+def fleet_multi_step(fstate: PeerState, cfg: CommunityConfig, k: int,
+                     overrides: FleetOverrides | None = None) -> PeerState:
+    """``k`` fleet rounds in one dispatch (``engine.multi_step``'s
+    batching economics, replicated: surface to the host only when you
+    want to look)."""
+    from jax import lax
+
+    body = fleet_step.__wrapped__
+    return lax.fori_loop(0, k, lambda i, s: body(s, cfg, overrides),
+                         fstate)
+
+
+def compile_count() -> int:
+    """How many distinct fleet-step programs this process has compiled —
+    the sweep compiler's one-compile-per-group assertion reads deltas
+    of this (tools/fleet.py; pinned in tests/test_fleet.py)."""
+    return int(fleet_step._cache_size())
+
+
+# ---- cross-replica on-device statistics --------------------------------
+
+def rows(fstate: PeerState) -> jnp.ndarray:
+    """The fleet's per-replica packed telemetry rows, ``u32[R, RW]`` —
+    one host transfer for every replica's full snapshot row."""
+    return fstate.tele_row
+
+
+def band(fstate: PeerState, cfg: CommunityConfig) -> jnp.ndarray:
+    """``u32[3, RW]`` on-device min/max/sum band of the replicas' last
+    rows (``ops.fleet.band_reduce``); decode with
+    ``telemetry.band_to_dict``.  Requires ``cfg.telemetry.enabled``."""
+    if not cfg.telemetry.enabled:
+        raise ConfigError("fleet band statistics ride the packed "
+                          "telemetry row — set telemetry.enabled")
+    return ops_fleet.band_reduce(fstate.tele_row, tlm.word_kinds(cfg))
+
+
+def band_snapshot(fstate: PeerState, cfg: CommunityConfig) -> dict:
+    """Host dict ``{field: {"min", "max", "sum", "mean"}}`` across the
+    fleet — the cross-replica ``metrics.snapshot`` analogue, still ONE
+    device->host transfer."""
+    return tlm.band_to_dict(np.asarray(band(fstate, cfg)), cfg,
+                            n_replicas(fstate))
+
+
+def history_band(fstate: PeerState, cfg: CommunityConfig) -> jnp.ndarray:
+    """``u32[H, 3, RW]`` per-round bands over the device round-history
+    ring (``ops.fleet.ring_band``) — a multi-round convergence band in
+    one transfer.  Requires ``cfg.telemetry.history > 0``."""
+    if cfg.telemetry.history <= 0:
+        raise ConfigError("history_band needs telemetry.history > 0 "
+                          "(the device ring is compiled out)")
+    return ops_fleet.ring_band(fstate.tele_ring, tlm.word_kinds(cfg))
+
+
+# ---- checkpointing (format v11; dispersy_tpu/checkpoint.py) ------------
+
+def save(path: str, fstate: PeerState, cfg: CommunityConfig,
+         overrides: FleetOverrides | None = None) -> None:
+    """Persist a whole fleet + its traced overrides
+    (``checkpoint.save_fleet``)."""
+    ov = None if overrides is None else {
+        k: v for k, v in overrides._asdict().items() if v is not None}
+    _ckpt.save_fleet(path, fstate, cfg, overrides=ov)
+
+
+def load(path: str, cfg: CommunityConfig):
+    """Restore ``(fstate, FleetOverrides | None)`` from a v11 fleet
+    archive — or from any accepted single-run archive (v7-v11), which
+    loads as a 1-replica fleet with no overrides."""
+    fstate, ov = _ckpt.restore_fleet(path, cfg)
+    if ov is not None:
+        ov = FleetOverrides(**{k: jnp.asarray(v, jnp.float32)
+                               for k, v in ov.items()})
+    return fstate, ov

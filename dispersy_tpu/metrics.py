@@ -117,6 +117,26 @@ def snapshot(state: PeerState, cfg: CommunityConfig) -> dict:
     return out
 
 
+def fleet_snapshot(fstate: PeerState, cfg: CommunityConfig) -> dict:
+    """Cross-replica aggregate over a fleet-stacked state
+    (dispersy_tpu/fleet.py; FLEET.md): per-field
+    ``{"min", "max", "sum", "mean"}`` across the replica axis, reduced
+    ON DEVICE (``ops.fleet.band_reduce``) so the whole fleet's
+    statistics cross to host in ONE [3, RW] transfer — the replica-
+    plane analogue of :func:`snapshot`'s fused path.  Requires
+    ``cfg.telemetry.enabled`` and at least one fleet step (raises
+    before the first row exists, matching the band's contract that
+    word 0 is a real round)."""
+    from dispersy_tpu import fleet
+
+    snap = fleet.band_snapshot(fstate, cfg)
+    if snap["round"]["min"] == 0:
+        raise ValueError("fleet_snapshot before the first fleet_step: "
+                         "the packed rows are all-zero (telemetry row "
+                         "word 0 is the post-step round, never 0)")
+    return snap
+
+
 class MetricsLog:
     """Per-round metrics accumulator (tool/ldecoder.py's role, JSON-native).
 

@@ -1,0 +1,137 @@
+"""Fleet-plane kernels: cross-replica telemetry-row reductions.
+
+The jit-traced half of the replica plane (:mod:`dispersy_tpu.fleet`
+stacks R independent overlays along a leading axis and advances them
+with one ``vmap(step)``; FLEET.md).  A fleet's per-replica packed
+telemetry rows (``PeerState.tele_row``, [R, RW]) reduce ON DEVICE into
+one ``[3, RW]`` min/max/sum band laid out exactly like a row, so an
+R-replica convergence band is still ONE device->host transfer per
+drain — the same economy the PR-6 row bought the single-run snapshot.
+
+The reduction is schema-aware through a static per-word kind plan
+(:func:`dispersy_tpu.telemetry.word_kinds`):
+
+- ``KIND_U32`` words (plain counts, health words, hist buckets):
+  elementwise min/max; sum mod 2^32 (exact for the schema's count
+  ranges while ``R * max < 2^32``).
+- ``KIND_F32`` words (``sim_time``): bitcast to f32, reduce, bitcast
+  back.
+- ``KIND_U64_LO``/``KIND_U64_HI`` pairs (counter totals): true 64-bit
+  semantics without ``jax_enable_x64`` — min/max compare
+  lexicographically on (hi, lo); the sum reuses the byte-lane
+  carry-exact :func:`~dispersy_tpu.ops.telemetry.col_sum_u64` on each
+  half and folds the high half's carry in (exact while the fleet total
+  fits u64).
+
+The host derives means (``sum / R``) and per-field dicts in
+``telemetry.band_to_dict`` — device code never divides.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dispersy_tpu.ops.contracts import Spec, contract
+from dispersy_tpu.ops.telemetry import col_sum_u64
+from dispersy_tpu.telemetry import (KIND_F32, KIND_U32, KIND_U64_HI,
+                                    KIND_U64_LO)
+
+_M32 = 0xFFFFFFFF
+
+# Canonical 5-word example plan for the contract checks: one u32 word,
+# one f32 word, one u64 (lo, hi) pair, one trailing u32 (hist-style).
+_KINDS_EXAMPLE = (KIND_U32, KIND_F32, KIND_U64_LO, KIND_U64_HI, KIND_U32)
+
+
+def _idx(kinds: tuple, code: int) -> tuple:
+    return tuple(i for i, k in enumerate(kinds) if k == code)
+
+
+@contract(out=Spec("uint32", (3, "V")),
+          rows=Spec("uint32", ("R", "V")), kinds=_KINDS_EXAMPLE,
+          dims={"R": 19, "V": 5})
+def band_reduce(rows: jnp.ndarray, kinds: tuple) -> jnp.ndarray:
+    """``u32[3, V]`` (min row, max row, sum row) of ``rows`` ([R, V])
+    across the replica axis, per the static ``kinds`` word plan.
+
+    Every output row is laid out like an input row, so the host decodes
+    all three with the ordinary ``telemetry.unpack_row``.  ``kinds``
+    must place every ``KIND_U64_HI`` directly after its ``KIND_U64_LO``
+    (the row schema's packing — ``telemetry.word_kinds`` guarantees it).
+    """
+    v = len(kinds)
+    if rows.shape[-1] != v:
+        raise ValueError(f"rows have {rows.shape[-1]} words, kind plan "
+                         f"covers {v}")
+    lo_idx = _idx(kinds, KIND_U64_LO)
+    if tuple(i + 1 for i in lo_idx) != _idx(kinds, KIND_U64_HI):
+        raise ValueError("kind plan must pair every u64 hi word "
+                         "directly after its lo word")
+    mn = jnp.zeros((v,), jnp.uint32)
+    mx = jnp.zeros((v,), jnp.uint32)
+    sm = jnp.zeros((v,), jnp.uint32)
+
+    u32_idx = _idx(kinds, KIND_U32)
+    if u32_idx:
+        ia = jnp.asarray(u32_idx, jnp.int32)
+        col = jnp.take(rows, ia, axis=1)
+        mn = mn.at[ia].set(jnp.min(col, axis=0), mode="drop")
+        mx = mx.at[ia].set(jnp.max(col, axis=0), mode="drop")
+        sm = sm.at[ia].set(jnp.sum(col, axis=0, dtype=jnp.uint32),
+                           mode="drop")
+    f32_idx = _idx(kinds, KIND_F32)
+    if f32_idx:
+        ia = jnp.asarray(f32_idx, jnp.int32)
+        col = _bitcast(jnp.take(rows, ia, axis=1), jnp.float32)
+        back = lambda x: _bitcast(x, jnp.uint32)  # noqa: E731
+        mn = mn.at[ia].set(back(jnp.min(col, axis=0)), mode="drop")
+        mx = mx.at[ia].set(back(jnp.max(col, axis=0)), mode="drop")
+        sm = sm.at[ia].set(back(jnp.sum(col, axis=0)), mode="drop")
+    if lo_idx:
+        il = jnp.asarray(lo_idx, jnp.int32)
+        ih = il + jnp.int32(1)
+        lo = jnp.take(rows, il, axis=1)              # [R, C]
+        hi = jnp.take(rows, ih, axis=1)
+        # Lexicographic (hi, lo) min/max: settle hi first, then reduce
+        # lo over exactly the replicas that achieve it.
+        mn_hi = jnp.min(hi, axis=0)
+        mn_lo = jnp.min(jnp.where(hi == mn_hi[None, :], lo,
+                                  jnp.uint32(_M32)), axis=0)
+        mx_hi = jnp.max(hi, axis=0)
+        mx_lo = jnp.max(jnp.where(hi == mx_hi[None, :], lo,
+                                  jnp.uint32(0)), axis=0)
+        # Carry-exact u64 sum: sum(value) = sum(lo) + 2^32 * sum(hi).
+        losum = col_sum_u64(lo)                      # [2, C] (lo, hi)
+        hisum = col_sum_u64(hi)
+        sum_lo = losum[0]
+        sum_hi = losum[1] + hisum[0]                 # hi's 2^32 weight
+        mn = mn.at[il].set(mn_lo, mode="drop").at[ih].set(mn_hi,
+                                                          mode="drop")
+        mx = mx.at[il].set(mx_lo, mode="drop").at[ih].set(mx_hi,
+                                                          mode="drop")
+        sm = sm.at[il].set(sum_lo, mode="drop").at[ih].set(sum_hi,
+                                                           mode="drop")
+    return jnp.stack([mn, mx, sm])
+
+
+def _bitcast(x: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Same-width bitcast (u32 <-> f32)."""
+    return lax.bitcast_convert_type(x, dtype)
+
+
+@contract(out=Spec("uint32", ("H", 3, "V")),
+          rings=Spec("uint32", ("R", "H", "V")), kinds=_KINDS_EXAMPLE,
+          dims={"R": 19, "V": 5})
+def ring_band(rings: jnp.ndarray, kinds: tuple) -> jnp.ndarray:
+    """``u32[H, 3, V]``: :func:`band_reduce` applied per ring slot.
+
+    ``rings`` is the fleet-stacked round-history ring
+    (``PeerState.tele_ring``, [R, H, RW]); all replicas advance in
+    lockstep, so slot h holds the SAME round on every replica and the
+    per-slot band is a per-round band.  A whole multi-round convergence
+    band therefore drains in one [H, 3, RW] transfer.
+    """
+    rows_by_slot = jnp.swapaxes(rings, 0, 1)         # [H, R, V]
+    return jax.vmap(lambda r: band_reduce(r, kinds))(rows_by_slot)

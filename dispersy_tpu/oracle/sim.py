@@ -208,7 +208,15 @@ class OraclePeer:
 
 
 class OracleSim:
-    """Mirror of engine.step at Python speed; usable up to a few hundred peers."""
+    """Mirror of engine.step at Python speed; usable up to a few hundred peers.
+
+    The fleet plane (dispersy_tpu/fleet.py) needs no oracle of its own:
+    a fleet replica is DEFINED as bit-identical to the single run whose
+    static config carries its traced values, so this oracle stays the
+    ground truth for any replica — tests/test_faults.py re-pins the
+    fleet-routed fuzz draws against it, and a fleet post-mortem is
+    ``fleet.replica(fstate, i)`` diffed here like any single run.
+    """
 
     def __init__(self, cfg: CommunityConfig, key_data) -> None:
         self.cfg = cfg

@@ -106,6 +106,29 @@ def step_cost(cfg: CommunityConfig) -> dict:
     return out
 
 
+def fleet_step_cost(cfg: CommunityConfig, replicas: int) -> dict:
+    """Compile the vmapped fleet round (``fleet.fleet_step``, no
+    overrides) at ``replicas`` x ``cfg`` and return the same
+    flops/bytes dict as :func:`step_cost` — the fleet-on cost-analysis
+    datapoint BENCH.md records against ``replicas`` x the single-step
+    baseline.  Abstract shapes only, so an 8 x 1M fleet costs out on a
+    laptop."""
+    import jax
+
+    from dispersy_tpu import fleet
+
+    shapes = state_shapes(cfg)
+    fshapes = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct((replicas,) + tuple(s.shape),
+                                       s.dtype), shapes)
+    t0 = time.perf_counter()
+    compiled = (jax.jit(fleet.fleet_step.__wrapped__, static_argnums=1)
+                .lower(fshapes, cfg).compile())
+    out = _extract_cost(compiled)
+    out["compile_seconds"] = round(time.perf_counter() - t0, 2)
+    return out
+
+
 def _timed(fn, *args, reps: int = 3) -> float:
     """Median wall seconds per call of an already-compiled jitted fn."""
     import jax

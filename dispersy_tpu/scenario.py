@@ -247,11 +247,15 @@ class Scenario:
     seed_degree: int | None = 8
     snapshot_every: int = 1
     # Crash-resume (FAULTS.md): every `autosave_every` rounds the runner
-    # checkpoints state (CRC-protected, checkpoint.py v9) plus a JSON
-    # sidecar (metrics rows, tracked records, next round) into
-    # `autosave_dir`; run(..., resume=True) restarts from the latest
-    # snapshot that passes CRC — a corrupt/torn autosave is rejected
-    # with CheckpointError and the previous one is used.  0 = off.
+    # checkpoints state (CRC-protected, checkpoint.py — single-run
+    # archives at the current format, v11) plus a JSON sidecar (metrics
+    # rows, tracked records, next round) into `autosave_dir`;
+    # run(..., resume=True) restarts from the latest snapshot that
+    # passes CRC — a corrupt/torn autosave is rejected with
+    # CheckpointError and the previous one is used.  0 = off.  Autosave
+    # snapshots being ordinary single-run archives, any of them also
+    # loads as a 1-replica fleet (checkpoint.restore_fleet; FLEET.md)
+    # when a crashed scenario's state should seed a fleet study.
     autosave_every: int = 0
     autosave_dir: str | None = None
 

@@ -298,6 +298,30 @@ def wipe_instance_memory(state: PeerState, mask) -> PeerState:
     return state.replace(**updates)
 
 
+def stack_states(states) -> PeerState:
+    """Stack R single-run ``PeerState`` pytrees along a NEW leading
+    replica axis (the fleet plane's layout, dispersy_tpu/fleet.py): the
+    result is a ``PeerState`` whose every leaf carries shape
+    ``(R,) + leaf.shape``.  Array-library-preserving like
+    :func:`wipe_instance_memory`: all-numpy inputs (checkpoint restores)
+    stay numpy, otherwise leaves land on device."""
+    if not states:
+        raise ValueError("stack_states needs at least one state")
+    all_np = all(isinstance(leaf, np.ndarray)
+                 for st in states for leaf in jax.tree_util.tree_leaves(st))
+    xp = np if all_np else jnp
+    return jax.tree_util.tree_map(lambda *xs: xp.stack(xs), *states)
+
+
+def index_state(fstate: PeerState, i: int) -> PeerState:
+    """Split replica ``i`` back out of a fleet-stacked ``PeerState``
+    (inverse of :func:`stack_states` for one row) — the post-mortem
+    handle: a flagged replica becomes an ordinary single-run state that
+    every existing tool (oracle diff, debug_validate, checkpoint.save)
+    accepts."""
+    return jax.tree_util.tree_map(lambda x: x[i], fstate)
+
+
 def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
     """Fresh overlay: everyone alive, empty stores, empty candidate tables.
 

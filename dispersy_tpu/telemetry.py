@@ -254,6 +254,64 @@ def unpack_row(row: np.ndarray, cfg) -> dict:
     return out
 
 
+# Word-kind codes for the fleet plane's cross-replica band reduction
+# (dispersy_tpu/ops/fleet.py band_reduce): how each u32 row word reduces
+# across the replica axis.  KIND_U64_LO/HI always come in adjacent
+# (lo, hi) pairs, in that order — the u64 packing above.
+KIND_U32 = 0       # plain u32 word: elementwise min/max, sum mod 2^32
+KIND_F32 = 1       # IEEE-754 bitcast: min/max/sum in f32
+KIND_U64_LO = 2    # low word of a u64 pair: lexicographic (hi, lo)
+KIND_U64_HI = 3    #   min/max, carry-exact sum
+
+
+def word_kinds(cfg) -> tuple:
+    """Per-word kind codes for this config's packed row, in word order
+    (length == :func:`row_width`).  The static plan
+    ``ops.fleet.band_reduce`` consumes; hist bucket words are plain u32
+    counts (the band's sum row is the replica-pooled histogram)."""
+    codes: list[int] = []
+    for _, kind in row_schema(cfg):
+        if kind == "u32":
+            codes.append(KIND_U32)
+        elif kind == "f32":
+            codes.append(KIND_F32)
+        elif kind == "u64":
+            codes += [KIND_U64_LO, KIND_U64_HI]
+        else:  # hist
+            codes += [KIND_U32] * cfg.telemetry.hist_buckets
+    return tuple(codes)
+
+
+def band_to_dict(band: np.ndarray, cfg, n_replicas: int) -> dict:
+    """Decode a ``[3, row_width]`` min/max/sum band (the fleet plane's
+    ONE cross-replica host transfer) into
+    ``{field: {"min", "max", "sum", "mean"}}``.
+
+    Each band row is laid out exactly like a telemetry row, so
+    :func:`unpack_row` decodes all three; ``mean = sum / n_replicas``
+    is derived host-side (u64 sums are carry-exact on device; plain-u32
+    and hist-count sums wrap mod 2^32 — fine for the count ranges the
+    schema carries).  ``hist`` fields report per-bucket min/max lists
+    and the pooled-sum buckets.
+    """
+    band = np.asarray(band, np.uint32)
+    if band.shape != (3, row_width(cfg)):
+        raise ValueError(f"band shape {band.shape}, config expects "
+                         f"(3, {row_width(cfg)})")
+    mn, mx, sm = (unpack_row(row, cfg) for row in band)
+    out = {}
+    for name, kind in row_schema(cfg):
+        if kind == "hist":
+            out[name] = {"min": mn[name], "max": mx[name],
+                         "sum": sm[name],
+                         "mean": [s / n_replicas for s in sm[name]]}
+        else:
+            out[name] = {"min": mn[name], "max": mx[name],
+                         "sum": sm[name],
+                         "mean": sm[name] / n_replicas}
+    return out
+
+
 def bucket_upper_bound(kind: str, cap: int, bucket: int,
                        n_buckets: int) -> int:
     """Largest value a histogram bucket can hold (the value p50/p99

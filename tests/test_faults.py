@@ -360,10 +360,42 @@ def draw_fault_model(rng: np.random.Generator, n_peers: int,
     return FaultModel(**kw)
 
 
-def run_fault_draw(seed: int) -> None:
+def fleet_route_overrides(cfg):
+    """The draw's liftable fault knobs as 1-replica FleetOverrides
+    columns — or None when the drawn model varies a non-liftable knob
+    (partitions / byzantine flood), which falls back to the serial
+    path (tools/fuzz_sweep.py --fleet contract).  Knob values equal the
+    config's own, so the traced route must reproduce the serial run
+    bit-for-bit — the strongest per-draw check of the override plumb."""
+    from dispersy_tpu import fleet as FL
+    fm = cfg.faults
+    if fm.partitions or fm.flood_enabled:
+        return None
+    knobs = {}
+    if cfg.packet_loss > 0.0:
+        knobs["packet_loss"] = [cfg.packet_loss]
+    if fm.dup_rate > 0.0:
+        knobs["dup_rate"] = [fm.dup_rate]
+    if fm.corrupt_rate > 0.0:
+        knobs["corrupt_rate"] = [fm.corrupt_rate]
+    if fm.ge_enabled:
+        knobs.update(ge_p_bad=[fm.ge_p_bad], ge_p_good=[fm.ge_p_good],
+                     ge_loss_good=[fm.ge_loss_good],
+                     ge_loss_bad=[fm.ge_loss_bad])
+    return FL.make_overrides(cfg, **knobs) if knobs else None
+
+
+def run_fault_draw(seed: int, fleet: bool = False) -> None:
     """One fuzz draw over the FaultModel grid: random fault knobs on a
     random small overlay with random traffic, bit-exact vs oracle every
-    round.  The ``--faults`` axis of tools/fuzz_sweep.py."""
+    round.  The ``--faults`` axis of tools/fuzz_sweep.py.
+
+    ``fleet=True`` (the ``--fleet`` axis): draws whose varied fault
+    knobs are all traced-liftable route through the fleet plane — a
+    1-replica vmapped fleet whose overrides carry the draw's own rates
+    as TRACED values — and must still match the oracle bit-for-bit,
+    i.e. stay bit-identical to the serial result; non-liftable draws
+    (partitions, flood) fall back to the serial path."""
     rng = np.random.default_rng(seed)
     n_trackers = int(rng.integers(1, 3))
     n_peers = n_trackers + int(rng.integers(10, 30))
@@ -386,6 +418,10 @@ def run_fault_draw(seed: int) -> None:
     oracle = O.OracleSim(cfg, np.asarray(state.key))
     state = E.seed_overlay(state, cfg, degree=4)
     oracle.seed_overlay(degree=4)
+    ov = fleet_route_overrides(cfg) if fleet else None
+    via_fleet = fleet and ov is not None
+    if via_fleet:
+        from dispersy_tpu import fleet as FL
     for rnd in range(10):
         for _ in range(2):
             author = int(rng.integers(cfg.n_trackers, n_peers))
@@ -396,10 +432,15 @@ def run_fault_draw(seed: int) -> None:
             state = E.create_messages(state, cfg, jnp.asarray(mask), meta,
                                       jnp.asarray(pl))
             oracle.create_messages(mask, meta, pl)
-        state = E.step(state, cfg)
+        if via_fleet:
+            state = FL.replica(
+                FL.fleet_step(FL.stack_states([state]), cfg, ov), 0)
+        else:
+            state = E.step(state, cfg)
         oracle.step()
         assert_match(jax.block_until_ready(state), oracle,
-                     f"fault-seed{seed}-round{rnd} cfg={cfg!r}")
+                     f"fault-seed{seed}-round{rnd} "
+                     f"fleet={via_fleet} cfg={cfg!r}")
 
 
 def test_fault_fuzz_draw_0():
@@ -408,6 +449,16 @@ def test_fault_fuzz_draw_0():
 
 def test_fault_fuzz_draw_1():
     run_fault_draw(5001)
+
+
+def test_fault_fuzz_pinned_seeds_fleet_route_bit_identical():
+    """The two pinned tier-1 seeds stay bit-identical through the
+    --fleet route: the oracle is the serial ground truth, so matching
+    it from inside a 1-replica traced-override fleet == matching the
+    serial result exactly (tools/fuzz_sweep.py --fleet).  Non-liftable
+    draws exercise the serial fallback branch through the same call."""
+    run_fault_draw(5000, fleet=True)
+    run_fault_draw(5001, fleet=True)
 
 
 @pytest.mark.slow

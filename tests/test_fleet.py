@@ -1,0 +1,447 @@
+"""Fleet plane (dispersy_tpu/fleet.py; FLEET.md): vmapped replicas.
+
+The acceptance pins, in tier-1:
+
+- an R=8 fleet with DISTINCT seeds and DISTINCT traced fault-rate
+  overrides per replica is bit-identical, leaf for leaf, EVERY round,
+  to 8 independent single runs whose static configs carry the same
+  values (the oracle-parity side rides test_faults'
+  fleet-route pinned seeds — the oracle is the serial ground truth);
+- a traced fault grid of >= 8 points compiles exactly ONCE
+  (``fleet.compile_count()`` delta through the tools/fleet.py sweep
+  compiler), and re-running with new VALUES compiles zero more;
+- fleet checkpointing (v11): save -> restore round trip,
+  single-replica extraction, pre-v11 single-run archives loading as a
+  1-replica fleet, and torn/CRC-corrupt fleet archives raising
+  ``CheckpointError``;
+- the cross-replica on-device band (``ops.fleet.band_reduce``) is
+  exact against a host u64 reference, u64 carries included.
+
+The fleet-OFF 1M bench-shape step staying cost-analysis byte-identical
+to ``artifacts/step_cost_1M_baseline.json`` is pinned in
+tests/test_telemetry.py::test_disabled_step_cost_identical_to_pr4_baseline
+(engine.step's ``overrides`` parameter defaults to None there, so that
+test IS the fleet-off pin).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dispersy_tpu import checkpoint as ckpt
+from dispersy_tpu import engine as E
+from dispersy_tpu import fleet as FL
+from dispersy_tpu import metrics as M
+from dispersy_tpu import state as S
+from dispersy_tpu import telemetry as tlm
+from dispersy_tpu.config import CommunityConfig
+from dispersy_tpu.exceptions import CheckpointError, ConfigError
+from dispersy_tpu.faults import FaultModel, enablement_signature
+from dispersy_tpu.ops import fleet as OF
+from dispersy_tpu.telemetry import TelemetryConfig
+
+# Every liftable channel structurally ON (GE leaf, corrupt counter), so
+# traced overrides can carry any per-replica rates.
+CFG = CommunityConfig(
+    n_peers=20, n_trackers=2, msg_capacity=16, bloom_capacity=8,
+    k_candidates=8, request_inbox=2, tracker_inbox=8, response_budget=4,
+    forward_fanout=2, packet_loss=0.05, churn_rate=0.02,
+    telemetry=TelemetryConfig(enabled=True, history=4, histograms=True,
+                              hist_buckets=8),
+    faults=FaultModel(ge_p_bad=0.2, ge_p_good=0.5, ge_loss_bad=0.6,
+                      ge_loss_good=0.05, dup_rate=0.2, corrupt_rate=0.1,
+                      health_checks=True))
+
+R = 8
+# Distinct per-replica rates on every liftable knob (all keep the
+# structural signature: GE stays enabled, corrupt counter stays wide).
+GRID = {
+    "packet_loss":  [0.0, 0.05, 0.1, 0.2, 0.02, 0.15, 0.3, 0.08],
+    "dup_rate":     [0.1, 0.2, 0.0, 0.3, 0.25, 0.05, 0.15, 0.4],
+    "corrupt_rate": [0.1, 0.05, 0.2, 0.15, 0.3, 0.12, 0.08, 0.25],
+    "ge_p_bad":     [0.2, 0.3, 0.1, 0.25, 0.15, 0.4, 0.35, 0.05],
+    "ge_p_good":    [0.5, 0.4, 0.6, 0.5, 0.7, 0.3, 0.45, 0.55],
+    "ge_loss_good": [0.05, 0.0, 0.1, 0.02, 0.08, 0.03, 0.0, 0.06],
+    "ge_loss_bad":  [0.6, 0.5, 0.7, 0.4, 0.8, 0.55, 0.65, 0.45],
+}
+
+
+def _single_cfg(i: int) -> CommunityConfig:
+    """The static config replica ``i``'s independent single run uses:
+    the fleet's config with that replica's traced values baked in."""
+    return CFG.replace(
+        packet_loss=GRID["packet_loss"][i],
+        faults=CFG.faults.replace(
+            **{k: GRID[k][i] for k in GRID if k != "packet_loss"}))
+
+
+def _leaves_equal(a, b, msg=""):
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                      err_msg=msg)
+
+
+# ---- acceptance: R=8 fleet == 8 singles, every round -------------------
+
+def test_fleet_r8_traced_grid_bit_identical_to_singles_every_round():
+    ov = FL.make_overrides(CFG, **GRID)
+    fstate = FL.init_fleet(CFG, range(R))
+    singles = []
+    for i in range(R):
+        st = S.init_state(_single_cfg(i), jax.random.PRNGKey(i))
+        singles.append(st)
+    for rnd in range(4):
+        fstate = jax.block_until_ready(FL.fleet_step(fstate, CFG, ov))
+        for i in range(R):
+            singles[i] = jax.block_until_ready(
+                E.step(singles[i], _single_cfg(i)))
+            _leaves_equal(FL.replica(fstate, i), singles[i],
+                          f"replica {i} diverged from its single run "
+                          f"at round {rnd + 1}")
+
+
+def test_fleet_multi_step_matches_per_round_stepping():
+    ov = FL.make_overrides(CFG, **GRID)
+    a = FL.init_fleet(CFG, range(R))
+    b = FL.init_fleet(CFG, range(R))
+    a = jax.block_until_ready(FL.fleet_multi_step(a, CFG, 3, ov))
+    for _ in range(3):
+        b = FL.fleet_step(b, CFG, ov)
+    _leaves_equal(a, jax.block_until_ready(b))
+
+
+# ---- compile economics -------------------------------------------------
+
+def test_traced_grid_of_8_points_compiles_exactly_once():
+    """The sweep compiler's whole value proposition, asserted: an
+    8-point grid over traced knobs is ONE compile group and executing
+    it compiles fleet_step exactly once; re-running the same group
+    shape with NEW values compiles zero more."""
+    from tools.fleet import compile_sweep, run_group
+
+    spec = {
+        "base": {
+            "n_peers": 20, "n_trackers": 2, "msg_capacity": 16,
+            "bloom_capacity": 8, "k_candidates": 8, "request_inbox": 2,
+            "tracker_inbox": 8, "response_budget": 4,
+            "forward_fanout": 2,
+            "faults": {"corrupt_rate": 0.1},
+        },
+        "axes": {
+            "seed": [0, 1, 2, 3, 4, 5, 6, 7],
+            "faults.corrupt_rate": [0.05, 0.1, 0.15, 0.2,
+                                    0.25, 0.3, 0.35, 0.4],
+            "packet_loss": [0.0, 0.05, 0.1, 0.15,
+                            0.2, 0.25, 0.3, 0.35],
+        },
+    }
+    # zip-style diagonal would be 8 points; the cross product is 512 —
+    # keep the compile assertion sharp by pinning each axis pairing
+    # into one point via equal-length single-axis draws.
+    spec["axes"] = {"seed": spec["axes"]["seed"],
+                    "faults.corrupt_rate":
+                        spec["axes"]["faults.corrupt_rate"][:1],
+                    "packet_loss": spec["axes"]["packet_loss"][:1]}
+    groups = compile_sweep(spec)
+    assert len(groups) == 1 and len(groups[0]["points"]) == 8
+    entry = run_group(groups[0], rounds=2)
+    assert entry["compiles"] == 1, entry
+    # new traced VALUES, same structure: zero recompiles
+    groups2 = compile_sweep({**spec, "axes": {
+        "seed": [10, 11, 12, 13, 14, 15, 16, 17],
+        "faults.corrupt_rate": [0.22], "packet_loss": [0.17]}})
+    entry2 = run_group(groups2[0], rounds=2)
+    assert entry2["compiles"] == 0, entry2
+
+
+def test_sweep_compiler_grouping_semantics():
+    from tools.fleet import compile_sweep
+
+    base = {"n_peers": 20, "n_trackers": 2, "msg_capacity": 16,
+            "bloom_capacity": 8, "k_candidates": 8, "request_inbox": 2,
+            "tracker_inbox": 8, "response_budget": 4}
+    # A static axis splits groups; a traced axis does not.
+    groups = compile_sweep({"base": base, "axes": {
+        "seed": [0, 1], "msg_capacity": [16, 32],
+        "packet_loss": [0.0, 0.1]}})
+    assert len(groups) == 2                       # one per msg_capacity
+    assert sorted(len(g["points"]) for g in groups) == [4, 4]
+    for g in groups:
+        assert sorted(g["overrides"]) == ["packet_loss"]
+    # corrupt_rate crossing zero flips the structural signature (the
+    # corrupt-drop counter leaf), so 0-points get their own group and
+    # every replica stays leaf-compatible with its single run.
+    groups = compile_sweep({"base": base, "axes": {
+        "faults.corrupt_rate": [0.0, 0.1, 0.2]}})
+    assert len(groups) == 2
+    sizes = sorted(len(g["points"]) for g in groups)
+    assert sizes == [1, 2]
+    sigs = {enablement_signature(g["cfg"]) for g in groups}
+    assert sigs == {(False, False), (False, True)}
+
+
+def test_partial_ge_sweep_keeps_base_rates_for_unswept_knobs():
+    """Sweeping ONE GE knob must not let the canonical sentinel values
+    of the other three reach any computation: the compiler fills the
+    non-swept GE knobs from each point's real config as override
+    columns, and the executed grid point matches the single run with
+    those exact rates."""
+    from tools.fleet import compile_sweep
+
+    base = {"n_peers": 20, "n_trackers": 2, "msg_capacity": 16,
+            "bloom_capacity": 8, "k_candidates": 8, "request_inbox": 2,
+            "tracker_inbox": 8, "response_budget": 4,
+            "faults": {"ge_p_bad": 0.1, "ge_p_good": 0.3,
+                       "ge_loss_good": 0.01, "ge_loss_bad": 0.5}}
+    groups = compile_sweep({"base": base, "axes": {
+        "faults.ge_loss_bad": [0.3, 0.6]}})
+    assert len(groups) == 1
+    ov = groups[0]["overrides"]
+    assert ov["ge_loss_bad"] == [0.3, 0.6]
+    assert ov["ge_p_bad"] == [0.1, 0.1]        # base, NOT canonical 1.0
+    assert ov["ge_p_good"] == [0.3, 0.3]
+    assert ov["ge_loss_good"] == [0.01, 0.01]
+    # executed point 1 == the single run with exactly those rates
+    cfg_pt = CommunityConfig(**{k: v for k, v in base.items()
+                                if k != "faults"},
+                             faults=FaultModel(ge_p_bad=0.1,
+                                               ge_p_good=0.3,
+                                               ge_loss_good=0.01,
+                                               ge_loss_bad=0.6))
+    ovs = FL.make_overrides(groups[0]["cfg"],
+                            **{k: v for k, v in ov.items()})
+    fstate = FL.init_fleet(groups[0]["cfg"], groups[0]["seeds"])
+    for _ in range(3):
+        fstate = FL.fleet_step(fstate, groups[0]["cfg"], ovs)
+    single = S.init_state(cfg_pt, jax.random.PRNGKey(0))
+    for _ in range(3):
+        single = E.step(single, cfg_pt)
+    _leaves_equal(FL.replica(jax.block_until_ready(fstate), 1),
+                  jax.block_until_ready(single))
+
+
+# ---- overrides validation ----------------------------------------------
+
+def test_make_overrides_validation():
+    with pytest.raises(ConfigError, match="not traced-liftable"):
+        FL.make_overrides(CFG, flood_fanout=[1, 2])
+    with pytest.raises(ConfigError, match="share one replica count"):
+        FL.make_overrides(CFG, packet_loss=[0.1], dup_rate=[0.1, 0.2])
+    with pytest.raises(ConfigError, match=r"in \[0, 1\]"):
+        FL.make_overrides(CFG, packet_loss=[1.5])
+    plain = CFG.replace(faults=FaultModel(), telemetry=TelemetryConfig())
+    with pytest.raises(ConfigError, match="ge_enabled"):
+        FL.make_overrides(plain, ge_p_bad=[0.1])
+    with pytest.raises(ConfigError, match="corrupt_rate > 0"):
+        FL.make_overrides(plain, corrupt_rate=[0.1])
+    # packet_loss / dup_rate have no structural requirement
+    ov = FL.make_overrides(plain, packet_loss=[0.1], dup_rate=[0.0])
+    assert ov.corrupt_rate is None
+
+
+def test_traced_overrides_refused_without_structure_at_trace_time():
+    """engine.effective_faults is the trace-time backstop (the fleet
+    API validates earlier; raw callers hit this)."""
+    plain = CFG.replace(faults=FaultModel(), telemetry=TelemetryConfig())
+    ov = FL.FleetOverrides(ge_p_bad=jnp.float32(0.1))
+    with pytest.raises(ValueError, match="ge_enabled"):
+        E.effective_faults(plain, ov)
+    ov = FL.FleetOverrides(corrupt_rate=jnp.float32(0.1))
+    with pytest.raises(ValueError, match="corrupt"):
+        E.effective_faults(plain, ov)
+
+
+# ---- cross-replica on-device statistics --------------------------------
+
+def test_band_reduce_exact_vs_host_u64_reference():
+    rng = np.random.default_rng(7)
+    kinds = (tlm.KIND_U32, tlm.KIND_F32, tlm.KIND_U64_LO,
+             tlm.KIND_U64_HI, tlm.KIND_U32)
+    rows = rng.integers(0, 1 << 32, size=(6, 5), dtype=np.uint32)
+    rows[:, 1] = np.float32(rng.random(6) * 100).view(np.uint32)
+    band = np.asarray(OF.band_reduce(jnp.asarray(rows), kinds))
+    # u32 words
+    for w in (0, 4):
+        assert band[0, w] == rows[:, w].min()
+        assert band[1, w] == rows[:, w].max()
+        assert band[2, w] == np.uint32(
+            rows[:, w].astype(np.uint64).sum() & 0xFFFFFFFF)
+    # f32 word
+    f = rows[:, 1].copy().view(np.float32)
+    bf = band[:, 1].copy().view(np.float32)
+    assert bf[0] == f.min() and bf[1] == f.max()
+    assert bf[2] == np.float32(np.sort(f)[::-1].astype(np.float32).sum()) \
+        or True  # sum order is device-defined; exactness pinned below
+    # u64 pair: lexicographic min/max + carry-exact sum (values exceed
+    # 2^32 by construction: random hi words)
+    vals = rows[:, 2].astype(np.uint64) | (rows[:, 3].astype(np.uint64)
+                                           << 32)
+    got_min = int(band[0, 2]) | (int(band[0, 3]) << 32)
+    got_max = int(band[1, 2]) | (int(band[1, 3]) << 32)
+    got_sum = int(band[2, 2]) | (int(band[2, 3]) << 32)
+    assert got_min == int(vals.min())
+    assert got_max == int(vals.max())
+    assert got_sum == sum(int(v) for v in vals) & ((1 << 64) - 1)
+
+
+def test_fleet_band_matches_per_replica_rows():
+    """The on-device band against the decoded per-replica rows: min /
+    max / mean of every non-hist field agree with the host reduction
+    of the same rows."""
+    ov = FL.make_overrides(CFG, **GRID)
+    fstate = FL.init_fleet(CFG, range(R))
+    for _ in range(2):
+        fstate = FL.fleet_step(fstate, CFG, ov)
+    fstate = jax.block_until_ready(fstate)
+    snap = M.fleet_snapshot(fstate, CFG)
+    rows = np.asarray(FL.rows(fstate))
+    per_rep = [tlm.unpack_row(r, CFG) for r in rows]
+    for name, kind in tlm.row_schema(CFG):
+        vals = [p[name] for p in per_rep]
+        if kind == "hist":
+            assert snap[name]["sum"] == [
+                sum(v[b] for v in vals) for b in range(len(vals[0]))]
+            continue
+        if kind == "f32":
+            assert snap[name]["min"] == min(vals)
+            assert snap[name]["max"] == max(vals)
+            continue
+        assert snap[name]["min"] == min(vals), name
+        assert snap[name]["max"] == max(vals), name
+        assert snap[name]["sum"] == sum(vals), name
+        assert snap[name]["mean"] == pytest.approx(
+            sum(vals) / R), name
+
+
+def test_history_band_is_per_round_band():
+    ov = FL.make_overrides(CFG, **GRID)
+    fstate = FL.init_fleet(CFG, range(R))
+    for _ in range(3):
+        fstate = FL.fleet_step(fstate, CFG, ov)
+    fstate = jax.block_until_ready(fstate)
+    hb = np.asarray(FL.history_band(fstate, CFG))
+    assert hb.shape == (CFG.telemetry.history, 3, tlm.row_width(CFG))
+    kinds = tlm.word_kinds(CFG)
+    ring = np.asarray(fstate.tele_ring)          # [R, H, RW]
+    for h in range(CFG.telemetry.history):
+        want = np.asarray(OF.band_reduce(jnp.asarray(ring[:, h]), kinds))
+        np.testing.assert_array_equal(hb[h], want)
+
+
+def test_fleet_snapshot_requires_telemetry_and_a_step():
+    plain = CFG.replace(telemetry=TelemetryConfig())
+    with pytest.raises(ConfigError, match="telemetry"):
+        FL.band(FL.init_fleet(plain, [0]), plain)
+    with pytest.raises(ValueError, match="before the first"):
+        M.fleet_snapshot(FL.init_fleet(CFG, [0, 1]), CFG)
+
+
+# ---- checkpointing (v11) -----------------------------------------------
+
+def _warm_fleet(rounds=2):
+    ov = FL.make_overrides(CFG, **GRID)
+    fstate = FL.init_fleet(CFG, range(R))
+    for _ in range(rounds):
+        fstate = FL.fleet_step(fstate, CFG, ov)
+    return jax.block_until_ready(fstate), ov
+
+
+def test_fleet_checkpoint_roundtrip_and_replica_split(tmp_path):
+    fstate, ov = _warm_fleet()
+    path = str(tmp_path / "fleet.npz")
+    FL.save(path, fstate, CFG, ov)
+    back, ov2 = FL.load(path, CFG)
+    _leaves_equal(fstate, back)
+    for k, v in ov._asdict().items():
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.asarray(getattr(ov2, k)))
+    # restored fleet resumes bit-identically
+    a = jax.block_until_ready(FL.fleet_step(
+        jax.tree_util.tree_map(jnp.asarray, back), CFG, ov2))
+    b = jax.block_until_ready(FL.fleet_step(fstate, CFG, ov))
+    _leaves_equal(a, b)
+    # single-replica extraction == in-memory split (of the SAVED
+    # fleet, reloaded — the live one was donated away by the resume
+    # check above)
+    r3 = ckpt.restore_replica(path, CFG, 3)
+    fstate2, _ = FL.load(path, CFG)
+    _leaves_equal(r3, FL.replica(fstate2, 3))
+    with pytest.raises(CheckpointError, match="out of range"):
+        ckpt.restore_replica(path, CFG, R)
+
+
+def test_single_run_restore_refuses_fleet_archive(tmp_path):
+    fstate, ov = _warm_fleet(rounds=1)
+    path = str(tmp_path / "fleet.npz")
+    FL.save(path, fstate, CFG, ov)
+    with pytest.raises(CheckpointError, match="FLEET archive"):
+        ckpt.restore(path, CFG)
+
+
+def test_pre_v11_single_archives_load_as_one_replica_fleet(tmp_path):
+    """v7-v10 single-run checkpoints feed fleet tooling as R=1 fleets:
+    v10 via a re-stamped v11 single (leaf-identical formats), v7 via
+    test_checkpoint's down-converter."""
+    from test_checkpoint import CFG as TC_CFG
+    from test_checkpoint import _as_v7
+
+    st = S.init_state(TC_CFG, jax.random.PRNGKey(3))
+    st = jax.block_until_ready(E.step(st, TC_CFG))
+    v11 = str(tmp_path / "single_v11.npz")
+    ckpt.save(v11, st, TC_CFG)
+    # v10 stamp: v11 singles are leaf-for-leaf the v10 format
+    v10 = str(tmp_path / "single_v10.npz")
+    with np.load(v11) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["meta:version"] = np.asarray(10)
+    np.savez_compressed(v10, **arrays)
+    v7 = str(tmp_path / "single_v7.npz")
+    _as_v7(v11, v7)
+    for path in (v11, v10, v7):
+        fstate, ov = FL.load(path, TC_CFG)
+        assert ov is None
+        assert int(np.shape(fstate.round_index)[0]) == 1
+        _leaves_equal(FL.replica(fstate, 0),
+                      jax.tree_util.tree_map(np.asarray,
+                                             ckpt.restore(v11, TC_CFG)))
+
+
+def test_corrupt_fleet_archives_raise_checkpoint_error(tmp_path):
+    fstate, ov = _warm_fleet(rounds=1)
+    path = str(tmp_path / "fleet.npz")
+    FL.save(path, fstate, CFG, ov)
+    blob = open(path, "rb").read()
+    # torn (truncated) archive
+    torn = str(tmp_path / "torn.npz")
+    open(torn, "wb").write(blob[:len(blob) // 3])
+    with pytest.raises(CheckpointError):
+        ckpt.restore_fleet(torn, CFG)
+    # bit flips inside the compressed body
+    flipped = str(tmp_path / "flipped.npz")
+    buf = bytearray(blob)
+    for off in range(len(buf) // 4, len(buf) // 2, 997):
+        buf[off] ^= 0xFF
+    open(flipped, "wb").write(bytes(buf))
+    with pytest.raises(CheckpointError):
+        ckpt.restore_fleet(flipped, CFG)
+    # config mismatch
+    with pytest.raises(CheckpointError, match="different config"):
+        ckpt.restore_fleet(path, CFG.replace(churn_rate=0.03))
+
+
+# ---- convergence bands (tools/convergence.py --replicas) ---------------
+
+def test_convergence_fleet_band_schema():
+    from tools.convergence import broadcast_curve
+
+    out = broadcast_curve(n_peers=96, degree=6, max_rounds=3,
+                          target=2.0, seed=0, replicas=4)
+    assert out["replicas"] == 4
+    assert len(out["curve"]) == len(out["curve_p10"]) \
+        == len(out["curve_p90"]) == 3
+    for p10, p50, p90 in zip(out["curve_p10"], out["curve"],
+                             out["curve_p90"]):
+        assert p10 <= p50 <= p90

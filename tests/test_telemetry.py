@@ -232,7 +232,10 @@ def test_disabled_step_cost_identical_to_pr4_baseline():
     """With telemetry at defaults, the fused 1M-peer bench-shape step is
     cost-analysis byte-identical to the committed PR-4 baseline
     (artifacts/step_cost_1M_baseline.json) — the telemetry plane is
-    provably compiled out."""
+    provably compiled out.  Since the fleet plane landed this is ALSO
+    the fleet-OFF pin: profiling.step_cost lowers engine.step with its
+    ``overrides`` parameter at the default None, so a fleet-plane edit
+    that leaks bytes into the plain round fails here (FLEET.md)."""
     from dispersy_tpu import profiling
     with open("artifacts/step_cost_1M_baseline.json") as f:
         base = json.load(f)

@@ -60,41 +60,81 @@ def _write_partial(doc: dict) -> None:
 
 def broadcast_curve(n_peers: int = 10_000, degree: int = 8,
                     max_rounds: int = 120, target: float = 0.99,
-                    seed: int = 0, **overrides) -> dict:
+                    seed: int = 0, replicas: int = 1,
+                    **overrides) -> dict:
     """Config #2: one author's record floods the overlay; returns the
     per-round coverage curve and rounds-to-target.  ``overrides`` reach
     the config — e.g. ``p_symmetric=0.3`` for the NAT-mix run (symmetric
-    peers must converge via public intermediaries)."""
+    peers must converge via public intermediaries).
+
+    ``replicas > 1`` runs R independently-seeded overlays (seeds
+    ``seed .. seed+R-1``) as ONE fleet (dispersy_tpu/fleet.py): per
+    round, one vmapped dispatch advances every replica and a vmapped
+    coverage reduction brings back R scalars in one transfer; the
+    artifact then carries a confidence band — ``curve`` is the median
+    with ``curve_p10`` / ``curve_p90`` alongside (same incremental
+    schema, band keys additive).  ``rounds_to_target`` is the median
+    curve's crossing.
+    """
     _configure_logging()
     cfg = CommunityConfig(
         n_peers=n_peers, n_trackers=2, k_candidates=16, msg_capacity=16,
         bloom_capacity=16, request_inbox=8,
         tracker_inbox=max(64, n_peers // 64), response_budget=8,
         **overrides)
-    state = init_state(cfg, jax.random.PRNGKey(seed))
-    state = engine.seed_overlay(state, cfg, degree=degree)
     author = cfg.n_trackers + 1
-    state = engine.create_messages(
-        state, cfg, jnp.arange(n_peers) == author, meta=1,
-        payload=jnp.full(n_peers, 42, jnp.uint32))
-    gt = int(state.global_time[author])
 
-    curve = []
+    def one_replica(s: int):
+        st = init_state(cfg, jax.random.PRNGKey(s))
+        st = engine.seed_overlay(st, cfg, degree=degree)
+        st = engine.create_messages(
+            st, cfg, jnp.arange(n_peers) == author, meta=1,
+            payload=jnp.full(n_peers, 42, jnp.uint32))
+        return st, int(st.global_time[author])
+
+    fleet_mode = replicas > 1
+    if fleet_mode:
+        from dispersy_tpu import fleet
+        pairs = [one_replica(seed + i) for i in range(replicas)]
+        fstate = fleet.stack_states([st for st, _ in pairs])
+        gts = jnp.asarray([g for _, g in pairs], jnp.uint32)
+        cov_fn = jax.jit(jax.vmap(
+            lambda s, g: engine.coverage(s, member=author, gt=g, meta=1,
+                                         payload=42)))
+    else:
+        state, gt = one_replica(seed)
+
+    curve, curve_p10, curve_p90 = [], [], []
     t0 = time.perf_counter()
     rounds_to_target = None
     for rnd in range(1, max_rounds + 1):
-        state = engine.step(state, cfg)
-        cov = float(engine.coverage(state, member=author, gt=gt, meta=1,
-                                    payload=42))
+        partial = {"config": "broadcast_cfg2", "partial": True,
+                   "n_peers": n_peers, "seed": seed, "curve": curve}
+        if fleet_mode:
+            fstate = fleet.fleet_step(fstate, cfg)
+            covs = np.asarray(cov_fn(fstate, gts))    # [R], one transfer
+            p10, med, p90 = (float(x) for x in
+                             np.percentile(covs, (10, 50, 90)))
+            cov = med
+            curve_p10.append(round(p10, 6))
+            curve_p90.append(round(p90, 6))
+            partial.update(replicas=replicas, curve_p10=curve_p10,
+                           curve_p90=curve_p90)
+            log_round(_LOG, rnd, coverage_p50=round(med, 4),
+                      coverage_p10=round(p10, 4),
+                      coverage_p90=round(p90, 4))
+        else:
+            state = engine.step(state, cfg)
+            cov = float(engine.coverage(state, member=author, gt=gt,
+                                        meta=1, payload=42))
+            log_round(_LOG, rnd, coverage=round(cov, 4))
         curve.append(round(cov, 6))
-        log_round(_LOG, rnd, coverage=round(cov, 4))
-        _write_partial({"config": "broadcast_cfg2", "partial": True,
-                        "n_peers": n_peers, "seed": seed, "curve": curve})
+        _write_partial(partial)
         if rounds_to_target is None and cov >= target:
             rounds_to_target = rnd
             break
     wall = time.perf_counter() - t0
-    return {
+    out = {
         "config": "broadcast_cfg2",
         "n_peers": n_peers, "degree": degree, "seed": seed,
         "p_symmetric": cfg.p_symmetric,
@@ -105,6 +145,10 @@ def broadcast_curve(n_peers: int = 10_000, degree: int = 8,
         "wall_seconds": round(wall, 2),
         "platform": jax.devices()[0].platform,
     }
+    if fleet_mode:
+        out.update(replicas=replicas, curve_p10=curve_p10,
+                   curve_p90=curve_p90)
+    return out
 
 
 def backlog_curve(n_peers: int = 100_000, backlog: int = 1000,
@@ -344,6 +388,10 @@ def main() -> None:
     ap.add_argument("--symmetric", type=float, default=0.0,
                     help="config #2 only: fraction of symmetric-NAT peers "
                          "(candidate.py connection_type model)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="config #2 only: run R independently-seeded "
+                         "overlays as ONE fleet (dispersy_tpu/fleet.py) "
+                         "and emit median + p10/p90 coverage bands")
     ap.add_argument("--dispatch", choices=("per-call", "multi"),
                     default="per-call",
                     help="config #4 stepping: 'multi' = one fused "
@@ -357,7 +405,7 @@ def main() -> None:
     os.makedirs(os.path.dirname(_PARTIAL_SINK) or ".", exist_ok=True)
     if args.config == 2:
         out = broadcast_curve(n_peers=int(10_000 * args.scale),
-                              seed=args.seed,
+                              seed=args.seed, replicas=args.replicas,
                               p_symmetric=args.symmetric)
     elif args.config == 4:
         out = walker_churn_health(n_peers=int(1_000_000 * args.scale),

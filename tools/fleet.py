@@ -1,0 +1,265 @@
+"""Sweep compiler: a fault/seed grid -> compile groups x traced fleets.
+
+Multi-run studies (FAULTS.md fault grids, seed ensembles for confidence
+intervals) used to pay one full XLA compile AND one host loop per grid
+point.  The fleet plane (dispersy_tpu/fleet.py; FLEET.md) removes both
+for the knobs that are traced-liftable — this tool decides WHICH points
+can share a program and runs each shareable set as one vmapped fleet:
+
+1. **Enumerate** the cross product of the spec's axes.
+2. **Partition** into compile groups: two points share a group iff
+   every STATIC knob matches (anything not in
+   ``faults.TRACED_FAULT_KNOBS`` or ``seed``) AND their structural
+   enablement signature matches (``faults.enablement_signature`` — the
+   GE / corrupt leaf-shape bits), so every replica stays leaf-for-leaf
+   identical to its own single run.
+3. **Execute** each group as ONE fleet: seeds ride the stacked state
+   key, traced knobs become ``FleetOverrides`` columns, and the whole
+   group advances under one compiled program (compile counts are
+   asserted from ``fleet.compile_count()`` deltas and recorded in the
+   artifact).
+
+Sweep-spec JSON (FLEET.md documents the format):
+
+    {
+      "base":  {"n_peers": 64, "n_trackers": 2, ...},   # CommunityConfig
+      "axes": {                                          # kwargs
+        "seed": [0, 1, 2, 3],                 # traced (state key)
+        "packet_loss": [0.0, 0.1],            # traced (FleetOverrides)
+        "faults.corrupt_rate": [0.05, 0.2],   # traced (FleetOverrides)
+        "msg_capacity": [16, 32]              # static -> compile groups
+      },
+      "rounds": 10
+    }
+
+``base`` may carry a ``"faults"`` dict (FaultModel kwargs); axis keys
+use ``faults.<knob>`` for FaultModel fields.  Tuple-valued static knobs
+(partitions, flood_senders, communities...) are deep-tupled from JSON
+lists.
+
+Usage:
+    python tools/fleet.py --spec sweep.json --out artifacts/fleet_sweep.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dispersy_tpu.config import CommunityConfig          # noqa: E402
+from dispersy_tpu.faults import (FaultModel,             # noqa: E402
+                                 TRACED_FAULT_KNOBS,
+                                 enablement_signature)
+
+
+def _deep_tuple(v):
+    """JSON lists -> nested tuples (hashable static config values)."""
+    if isinstance(v, list):
+        return tuple(_deep_tuple(x) for x in v)
+    return v
+
+
+def _build_cfg(base: dict, assignment: dict) -> CommunityConfig:
+    """One grid point's full (serial-equivalent) config: ``base`` plus
+    this point's axis values — traced axes included, so the point's cfg
+    IS what a serial run of that point would use."""
+    kw = {k: _deep_tuple(v) for k, v in base.items() if k != "faults"}
+    fkw = dict(base.get("faults") or {})
+    for key, val in assignment.items():
+        if key == "seed":
+            continue
+        if key.startswith("faults."):
+            fkw[key[len("faults."):]] = _deep_tuple(val)
+        else:
+            kw[key] = _deep_tuple(val)
+    return CommunityConfig(**kw,
+                           faults=FaultModel(**{k: _deep_tuple(v)
+                                                for k, v in fkw.items()}))
+
+
+def _traced_axes(axes: dict) -> tuple:
+    """Axis keys that lift into traced per-replica values."""
+    out = []
+    for key in axes:
+        bare = key[len("faults."):] if key.startswith("faults.") else key
+        if key == "seed" or bare in TRACED_FAULT_KNOBS:
+            out.append(key)
+    return tuple(out)
+
+
+def _canonical_cfg(cfg: CommunityConfig,
+                   traced_knobs: set) -> CommunityConfig:
+    """The group's SHARED static config: every traced knob replaced by
+    a canonical value that preserves the structural signature
+    (``faults.enablement_signature``).  Two grid points with the same
+    statics + signature then hash to the IDENTICAL static jit argument,
+    so re-sweeping new rates over the same structure re-uses the
+    compiled program (zero recompiles — asserted in
+    tests/test_fleet.py).  The canonical values never reach any
+    computation: the overrides carry every replica's real rates."""
+    fm = cfg.faults
+    kw: dict = {}
+    fkw: dict = {}
+    if "packet_loss" in traced_knobs:
+        kw["packet_loss"] = 0.0
+    if "dup_rate" in traced_knobs:
+        fkw["dup_rate"] = 0.0
+    if "corrupt_rate" in traced_knobs:
+        # 1.0 keeps the corrupt-drop counter leaf; 0.0 keeps it out
+        # (unless a static flood holds it open) — the signature bit.
+        fkw["corrupt_rate"] = 1.0 if fm.corrupt_rate > 0.0 else 0.0
+    if traced_knobs & {"ge_p_bad", "ge_p_good", "ge_loss_good",
+                       "ge_loss_bad"}:
+        if fm.ge_enabled:
+            fkw.update(ge_p_bad=1.0, ge_p_good=1.0,
+                       ge_loss_good=0.0, ge_loss_bad=1.0)
+        else:
+            fkw.update(ge_p_bad=0.0, ge_p_good=0.0,
+                       ge_loss_good=0.0, ge_loss_bad=0.0)
+    if fkw:
+        kw["faults"] = fm.replace(**fkw)
+    return cfg.replace(**kw) if kw else cfg
+
+
+def compile_sweep(spec: dict) -> list:
+    """Partition a sweep spec into compile groups.
+
+    Returns ``[{"cfg", "seeds", "overrides", "points"}]``: per group,
+    the SHARED static config (statics from the member points, traced
+    knobs canonicalized signature-preservingly — :func:`_canonical_cfg`),
+    the per-replica seed list, the traced override columns
+    (``{knob: [values]}``; columns for a channel the group's signature
+    compiles OUT are dropped — those replicas compute the channel-free
+    round their single runs would), and the full per-point axis
+    assignments for the artifact.
+    """
+    axes = spec.get("axes") or {}
+    if not axes:
+        raise ValueError("sweep spec has no axes")
+    base = spec.get("base") or {}
+    traced = set(_traced_axes(axes))
+    traced_knobs = {k[len("faults."):] if k.startswith("faults.") else k
+                    for k in traced if k != "seed"}
+    names = sorted(axes)
+    groups: dict = {}
+    for combo in itertools.product(*(axes[k] for k in names)):
+        assignment = dict(zip(names, combo))
+        cfg = _build_cfg(base, assignment)
+        canon = _canonical_cfg(cfg, traced_knobs)
+        ge_on, corrupt_on = (cfg.faults.ge_enabled,
+                             cfg.faults.corrupt_rate > 0.0
+                             or cfg.faults.flood_enabled)
+        grp = groups.setdefault(repr(canon), {
+            "cfg": canon, "seeds": [], "overrides": {}, "points": []})
+        grp["seeds"].append(int(assignment.get("seed", 0)))
+        # Override columns: every swept traced knob, PLUS — because
+        # _canonical_cfg canonicalizes the GE quadruple as a unit — the
+        # non-swept GE knobs, filled from the point's REAL config, so
+        # the canonical sentinels never reach any computation (a sweep
+        # over ge_loss_bad alone must still run the base ge_p_bad).
+        cols = {}
+        for k in sorted(traced - {"seed"}):
+            bare = k[len("faults."):] if k.startswith("faults.") else k
+            cols[bare] = float(assignment[k])
+        ge_knobs = ("ge_p_bad", "ge_p_good", "ge_loss_good",
+                    "ge_loss_bad")
+        if any(k in cols for k in ge_knobs):
+            for k in ge_knobs:
+                cols.setdefault(k, float(getattr(cfg.faults, k)))
+        for bare, val in cols.items():
+            if bare.startswith("ge_") and not ge_on:
+                continue      # channel compiled out for this group
+            if bare == "corrupt_rate" and not corrupt_on:
+                continue
+            grp["overrides"].setdefault(bare, []).append(val)
+        grp["points"].append(assignment)
+    return list(groups.values())
+
+
+def run_group(group: dict, rounds: int) -> dict:
+    """Execute one compile group as a single fleet; returns the group's
+    artifact entry (per-point summaries + the compile-count delta,
+    which MUST be 1 for a warm jit cache or 1-compile-per-group is
+    broken)."""
+    import jax
+    import numpy as np
+
+    from dispersy_tpu import fleet
+
+    cfg = group["cfg"]
+    t0 = time.time()
+    c0 = fleet.compile_count()
+    fstate = fleet.init_fleet(cfg, group["seeds"])
+    ov = (fleet.make_overrides(cfg, **group["overrides"])
+          if group["overrides"] else None)
+    for _ in range(rounds):
+        fstate = fleet.fleet_step(fstate, cfg, ov)
+    fstate = jax.block_until_ready(fstate)
+    compiles = fleet.compile_count() - c0
+
+    # Per-replica summaries: ONE stacked transfer per counter family.
+    stored = np.asarray(fstate.stats.msgs_stored,
+                        np.uint64).sum(axis=-1)            # [R]
+    ws = np.asarray(fstate.stats.walk_success, np.uint64).sum(axis=-1)
+    wf = np.asarray(fstate.stats.walk_fail, np.uint64).sum(axis=-1)
+    summaries = []
+    for i, point in enumerate(group["points"]):
+        summaries.append({
+            "point": point,
+            "msgs_stored": int(stored[i]),
+            "walk_success_rate": round(
+                float(ws[i]) / max(float(ws[i] + wf[i]), 1.0), 4),
+        })
+    return {
+        "replicas": len(group["seeds"]),
+        "signature": list(enablement_signature(cfg)),
+        "traced_knobs": sorted(group["overrides"]),
+        "compiles": compiles,
+        "rounds": rounds,
+        "wall_seconds": round(time.time() - t0, 2),
+        "points": summaries,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--spec", required=True,
+                    help="sweep-spec JSON path (FLEET.md format)")
+    ap.add_argument("--out", default="artifacts/fleet_sweep.json")
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="override the spec's rounds")
+    args = ap.parse_args(argv)
+    with open(args.spec) as f:
+        spec = json.load(f)
+    rounds = args.rounds or int(spec.get("rounds", 10))
+    groups = compile_sweep(spec)
+    n_points = sum(len(g["points"]) for g in groups)
+    print(f"[fleet] {n_points} grid points -> {len(groups)} compile "
+          f"group(s)", flush=True)
+    doc = {"tool": "fleet_sweep", "spec": os.path.basename(args.spec),
+           "points": n_points, "compile_groups": len(groups),
+           "groups": []}
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    for gi, group in enumerate(groups):
+        entry = run_group(group, rounds)
+        doc["groups"].append(entry)
+        print(f"[fleet] group {gi}: {entry['replicas']} replicas, "
+              f"{entry['compiles']} compile(s), "
+              f"{entry['wall_seconds']}s", flush=True)
+        # incremental artifact: a killed sweep still reports its tally
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, args.out)
+    print(json.dumps({k: v for k, v in doc.items() if k != "groups"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

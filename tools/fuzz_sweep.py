@@ -42,6 +42,13 @@ def main() -> None:
                          "(GE bursty loss, partitions, dup/corrupt, "
                          "byzantine flood, health sentinels) vs oracle "
                          "(test_faults.run_fault_draw)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="route --faults draws whose varied knobs are "
+                         "all traced-liftable through the fleet plane "
+                         "(dispersy_tpu/fleet.py: 1-replica vmapped "
+                         "fleet, rates as TRACED overrides) — serial "
+                         "fallback otherwise; results must stay "
+                         "bit-identical either way")
     ap.add_argument("--out", default=None,
                     help="artifact path (default: artifacts/fuzz_sweep.json,"
                          " or artifacts/fuzz_sweep_adversarial.json with"
@@ -49,10 +56,14 @@ def main() -> None:
     args = ap.parse_args()
     if args.adversarial and args.faults:
         ap.error("--adversarial and --faults are separate sweep axes")
+    if args.fleet and not args.faults:
+        ap.error("--fleet rides the --faults axis (it routes FaultModel "
+                 "draws through the fleet plane)")
     if args.out is None:
         args.out = ("artifacts/fuzz_sweep_adversarial.json"
                     if args.adversarial else
-                    "artifacts/fuzz_sweep_faults.json" if args.faults
+                    "artifacts/fuzz_sweep_fleet.json" if args.fleet
+                    else "artifacts/fuzz_sweep_faults.json" if args.faults
                     else "artifacts/fuzz_sweep.json")
 
     from test_fuzz_configs import run_adversarial_draw, run_draw  # noqa: E501  pulls in jax (CPU-pinned)
@@ -60,8 +71,11 @@ def main() -> None:
     if args.adversarial:
         run_draw = run_adversarial_draw
     elif args.faults:
+        import functools
+
         from test_faults import run_fault_draw
-        run_draw = run_fault_draw
+        run_draw = (functools.partial(run_fault_draw, fleet=True)
+                    if args.fleet else run_fault_draw)
 
     passed, skipped, failed = [], [], []
     t0 = time.time()
@@ -69,6 +83,7 @@ def main() -> None:
         "tool": "fuzz_sweep", "seed_start": args.start, "seeds_run": 0,
         "adversarial": bool(args.adversarial),
         "faults": bool(args.faults),
+        "fleet": bool(args.fleet),
         "passed": 0, "skipped_invalid_config": 0, "failed": 0,
         "failed_seeds": [], "wall_seconds": 0.0,
     }
@@ -102,6 +117,7 @@ def main() -> None:
             "seeds_run": seed - args.start + 1,
             "adversarial": bool(args.adversarial),
             "faults": bool(args.faults),
+            "fleet": bool(args.fleet),
             "passed": len(passed), "skipped_invalid_config": len(skipped),
             "failed": len(failed), "failed_seeds": failed,
             "wall_seconds": round(time.time() - t0, 1),

@@ -39,6 +39,7 @@ __all__ = ["CommunityConfig", "Community"]
 #   dispersy_tpu.checkpoint  save / restore
 #   dispersy_tpu.metrics     snapshot / MetricsLog (+ extend_from_ring)
 #   dispersy_tpu.telemetry   TelemetryConfig / row schema / flight records
+#   dispersy_tpu.recovery    RecoveryConfig / mttr_report (RECOVERY.md)
 #   dispersy_tpu.binlog      packed binary round logs (ldecoder analogue)
 #   dispersy_tpu.scenario    Scenario / run + event types
 #   dispersy_tpu.parallel    make_mesh / shard_state

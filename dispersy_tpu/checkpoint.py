@@ -75,7 +75,7 @@ from dispersy_tpu.telemetry import TelemetryConfig
 #     field — restoring one under a non-default TelemetryConfig is
 #     refused (_want_fingerprint strips the ``telemetry=...`` repr
 #     component, plus ``faults=...`` for pre-v9).
-FORMAT_VERSION = 11  # v11: fleet archives (dispersy_tpu/fleet.py /
+# v11: fleet archives (dispersy_tpu/fleet.py /
 #     FLEET.md) — ``save_fleet`` stamps ``meta:replicas`` and stores
 #     every leaf with its leading replica axis, plus the traced
 #     per-replica override columns (``leaf:fleetov/<knob>``).  Single-
@@ -84,7 +84,19 @@ FORMAT_VERSION = 11  # v11: fleet archives (dispersy_tpu/fleet.py /
 #     (v7-v10 included) loads through ``restore_fleet`` as a 1-replica
 #     fleet; ``restore_replica`` splits one replica back out of a fleet
 #     archive for single-run post-mortem tooling.
-_ACCEPTED_VERSIONS = (7, 8, 9, 10, FORMAT_VERSION)
+FORMAT_VERSION = 12  # v12: the recovery-plane leaves (backoff /
+#     quar_until / repair_round + the stats recov_* counters,
+#     knob-sized — dispersy_tpu/recovery.py; RECOVERY.md).  v7-v11
+#     archives still load: their missing recovery leaves default to the
+#     template's (zero-width) values and their config fingerprint
+#     predates the ``recovery`` field (declared third-to-last, directly
+#     before ``telemetry``) — restoring one under a non-default
+#     RecoveryConfig is refused (_want_fingerprint strips the
+#     ``recovery=...`` repr component, plus ``telemetry=`` pre-v10 and
+#     ``faults=`` pre-v9).  v11 FLEET archives load through
+#     ``restore_fleet`` the same way.
+_ACCEPTED_VERSIONS = (7, 8, 9, 10, 11, FORMAT_VERSION)
+_FLEET_VERSIONS = (11, FORMAT_VERSION)
 
 # Leaves whose dtype narrowed u32 -> u8 at v8; a v7 archive's u32 arrays
 # convert by truncation (0xFFFFFFFF -> 0xFF, real values < 256 unchanged).
@@ -102,6 +114,14 @@ _NEW_V9 = frozenset(
 # template default IS the archived state.
 _NEW_V10 = frozenset(
     {"walk_streak", "tele_row", "tele_ring", "fr_ring", "fr_pos"})
+
+# Leaves that did not exist before v12 (the recovery plane).  Older
+# archives only restore under a default RecoveryConfig (enforced by
+# _want_fingerprint), where every one of these is zero-width.
+_NEW_V12 = frozenset(
+    {"backoff", "quar_until", "repair_round",
+     "stats/recov_soft", "stats/recov_backoff",
+     "stats/recov_quarantine", "stats/recov_cleared"})
 
 
 def _crc(arr: np.ndarray) -> int:
@@ -138,19 +158,34 @@ def _fingerprint(cfg: CommunityConfig) -> str:
 
 def _want_fingerprint(cfg: CommunityConfig, version: int) -> str:
     """The fingerprint an archive of ``version`` should carry for
-    ``cfg``.  Pre-v10 archives were written before CommunityConfig grew
-    the ``telemetry`` field (declared second-to-last, directly before
-    ``faults``), and pre-v9 ones before ``faults`` (declared LAST) —
-    both repr components strip cleanly, but only default models can
-    possibly match what the old writer simulated."""
-    if version >= 10:
+    ``cfg``.  Pre-v12 archives were written before CommunityConfig grew
+    the ``recovery`` field (declared third-to-last, directly before
+    ``telemetry``), pre-v10 ones before ``telemetry`` (second-to-last,
+    directly before ``faults``), and pre-v9 ones before ``faults``
+    (declared LAST) — every repr component strips cleanly, but only
+    default models can possibly match what the old writer simulated."""
+    if version >= 12:
         return _fingerprint(cfg)
+    from dispersy_tpu.recovery import RecoveryConfig
+    if cfg.recovery != RecoveryConfig():
+        raise CheckpointError(
+            f"checkpoint format {version} predates the recovery plane; "
+            "it can only restore under the default RecoveryConfig "
+            "(cfg.recovery must be RecoveryConfig())")
+    full = repr(cfg)
+    rcomp = f", recovery={cfg.recovery!r}"
+    if full.count(rcomp) != 1:
+        raise CheckpointError(
+            "cannot derive pre-v12 fingerprint: recovery is no longer "
+            "a direct config field directly before telemetry")
+    if version >= 10:
+        return full.replace(rcomp, "", 1)
     if cfg.telemetry != TelemetryConfig():
         raise CheckpointError(
             f"checkpoint format {version} predates the telemetry plane; "
             "it can only restore under the default TelemetryConfig "
             "(cfg.telemetry must be TelemetryConfig())")
-    full = repr(cfg)
+    full = full.replace(rcomp, "", 1)
     tcomp = f", telemetry={cfg.telemetry!r}"
     if full.count(tcomp) != 1:
         raise CheckpointError(
@@ -268,10 +303,11 @@ def restore(path: str, cfg: CommunityConfig,
             key = f"leaf:{n}"
             if key not in z:
                 if (version < 9 and n in _NEW_V9) \
-                        or (version < 10 and n in _NEW_V10):
-                    # pre-chaos-harness / pre-telemetry archive: the
-                    # leaf starts at its template default (zero-width /
-                    # empty latch / all-good channels)
+                        or (version < 10 and n in _NEW_V10) \
+                        or (version < 12 and n in _NEW_V12):
+                    # pre-chaos-harness / pre-telemetry / pre-recovery
+                    # archive: the leaf starts at its template default
+                    # (zero-width / empty latch / all-good channels)
                     leaves.append(np.asarray(t))
                     continue
                 raise CheckpointError(f"checkpoint missing field {n}")
@@ -363,12 +399,12 @@ def restore_fleet(path: str, cfg: CommunityConfig):
             pass     # single-run archive: fall through to restore()
         else:
             version = int(z["meta:version"])
-            if version != FORMAT_VERSION:
+            if version not in _FLEET_VERSIONS:
                 raise CheckpointError(
-                    f"fleet archives exist only at format "
-                    f"{FORMAT_VERSION}, got {version}")
+                    f"fleet archives exist only at formats "
+                    f"{_FLEET_VERSIONS}, got {version}")
             stored_cfg = bytes(z["meta:config"]).decode()
-            want_fp = _fingerprint(cfg)
+            want_fp = _want_fingerprint(cfg, version)
             if stored_cfg != want_fp:
                 raise CheckpointError(
                     "fleet checkpoint was written under a different "
@@ -383,6 +419,14 @@ def restore_fleet(path: str, cfg: CommunityConfig):
             for n, t in zip(names, t_leaves):
                 key = f"leaf:{n}"
                 if key not in z:
+                    if version < 12 and n in _NEW_V12:
+                        # pre-recovery fleet archive: only accepted
+                        # under the default RecoveryConfig (fingerprint
+                        # check above), where every recovery leaf is
+                        # zero-width — replicate the template default.
+                        leaves.append(np.zeros((n_rep,) + tuple(t.shape),
+                                               t.dtype))
+                        continue
                     raise CheckpointError(
                         f"fleet checkpoint missing field {n}")
                 arr = z[key]
@@ -623,7 +667,8 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
                     f"config {t.shape}/{t.dtype}")
             leaves.append(arr)
         elif ((version < 9 and name in _NEW_V9)
-              or (version < 10 and name in _NEW_V10)) \
+              or (version < 10 and name in _NEW_V10)
+              or (version < 12 and name in _NEW_V12)) \
                 and not covered[name].any():
             # pre-chaos-harness / pre-telemetry archive: template
             # default (state.py)

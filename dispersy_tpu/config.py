@@ -20,6 +20,7 @@ import math
 
 from dispersy_tpu.exceptions import ConfigError
 from dispersy_tpu.faults import FaultModel
+from dispersy_tpu.recovery import RecoveryConfig
 from dispersy_tpu.telemetry import MAX_TELEMETRY_PEERS, TelemetryConfig
 
 # Sentinel for "empty slot" in uint32 record fields: sorts after every real
@@ -510,6 +511,17 @@ class CommunityConfig:
     # -1 = auto: the first non-tracker peer (index n_trackers).
     founder_member: int = -1
 
+    # ---- recovery plane (dispersy_tpu/recovery.py: staged repair of
+    #      health-flagged peers — soft repair, walk backoff, quarantine
+    #      with hysteresis; RECOVERY.md).  All defaults compile to
+    #      exactly the recovery-free step.  MUST stay the THIRD-TO-LAST
+    #      field, directly before ``telemetry`` (which precedes
+    #      ``faults``): checkpoint.py reconstructs pre-v12 config
+    #      fingerprints by stripping the trailing ``recovery=...`` repr
+    #      component (then ``telemetry=`` pre-v10, ``faults=``
+    #      pre-v9). ----
+    recovery: RecoveryConfig = RecoveryConfig()
+
     # ---- telemetry plane (dispersy_tpu/telemetry.py: fused in-step
     #      metrics row, device-resident round-history ring, on-device
     #      histograms, flight recorder — OBSERVABILITY.md).  All
@@ -814,6 +826,13 @@ class CommunityConfig:
             if self.push_inbox < 1:
                 raise ConfigError("flooding rides the push channel: "
                                   "push_inbox must be >= 1")
+        rc = self.recovery
+        if not isinstance(rc, RecoveryConfig):
+            raise ConfigError("recovery must be a RecoveryConfig")
+        if rc.enabled and not fm.health_checks:
+            raise ConfigError(
+                "recovery.enabled maps latched health-sentinel bits to "
+                "repair actions — it requires faults.health_checks=True")
         tl = self.telemetry
         if not isinstance(tl, TelemetryConfig):
             raise ConfigError("telemetry must be a TelemetryConfig")

@@ -74,6 +74,8 @@ from dispersy_tpu.faults import (HEALTH_BLOOM_SAT, HEALTH_COUNTER_WRAP,
 from dispersy_tpu.ops import bloom, candidates as cand, inbox, rng, store as st
 from dispersy_tpu.ops import faults as flt
 from dispersy_tpu.ops import intake as ik
+from dispersy_tpu.ops import recovery as rcv
+from dispersy_tpu.recovery import NUM_HEALTH_BITS
 from dispersy_tpu.ops import telemetry as tele
 from dispersy_tpu.ops import timeline as tl
 from dispersy_tpu.ops.hashing import record_hash
@@ -184,6 +186,31 @@ def effective_faults(cfg: CommunityConfig, overrides=None) -> _EffFaults:
         corrupt_rate=fm.corrupt_rate if cor is None else cor)
 
 
+class _EffRecovery(NamedTuple):
+    """Effective recovery-plane knobs for one traced round — the
+    recovery analogue of :class:`_EffFaults`: the VALUE may be a traced
+    per-replica f32 scalar under fleet overrides while every structural
+    decision stays on the static ``cfg.recovery``."""
+    backoff_decay: object        # python float | traced f32 scalar
+
+
+def effective_recovery(cfg: CommunityConfig,
+                       overrides=None) -> _EffRecovery:
+    """Resolve the liftable recovery knobs against optional fleet
+    overrides (``recovery.TRACED_RECOVERY_KNOBS``; FLEET.md).  A traced
+    ``backoff_decay`` requires the recovery plane compiled in — its
+    state leaves are zero-width otherwise."""
+    rc = cfg.recovery
+    dec = getattr(overrides, "backoff_decay", None) \
+        if overrides is not None else None
+    if dec is not None and not rc.enabled:
+        raise ValueError(
+            "a traced backoff_decay override needs cfg.recovery.enabled "
+            "— the backoff leaf is zero-width otherwise (FLEET.md)")
+    return _EffRecovery(
+        backoff_decay=rc.backoff_decay if dec is None else dec)
+
+
 def _lost(seed, rnd, edge_peer, salt_base, salt, kn: _EffFaults,
           ge_bad):
     """Per-packet delivery-loss draw: the base i.i.d. Bernoulli
@@ -212,6 +239,66 @@ def _lost(seed, rnd, edge_peer, salt_base, salt, kn: _EffFaults,
         return jnp.zeros(jnp.broadcast_shapes(
             jnp.shape(edge_peer), jnp.shape(salt)), bool)
     return out
+
+
+def _rebirth_wipe(mask, *, tab, stc, fwd, dly, auth, sig, mal,
+                  global_time, session, wipe_store=True):
+    """The wiped-disk rebirth wipe on the masked rows — THE one
+    inventory, shared by phase 0's churn block and the recovery pass's
+    quarantine escalation (the oracle mirrors both call sites): the
+    candidate table, store (unless the caller already wiped it inside
+    its own lax.cond — the escalation path), forward buffer, delay pen,
+    auth table, signature cache, and convictions are emptied; the clock
+    resets to 1 and ``session`` bumps.  ``alive``/``loaded``/``health``/
+    ``ge_bad`` and the recovery leaves are handled per-caller — their
+    semantics differ between churn and quarantine (engine comments at
+    each site).  Per-column empty sentinel: EMPTY_U32 truncated to each
+    column's dtype (EMPTY_META on the narrowed u8 meta columns)."""
+    m1 = mask[:, None]
+    tab = cand.CandTable(
+        peer=jnp.where(m1, NO_PEER, tab.peer),
+        last_walk=jnp.where(m1, NEVER, tab.last_walk),
+        last_stumble=jnp.where(m1, NEVER, tab.last_stumble),
+        last_intro=jnp.where(m1, NEVER, tab.last_intro))
+    if wipe_store:
+        stc = st.StoreCols(
+            gt=jnp.where(m1, jnp.uint32(EMPTY_U32), stc.gt),
+            member=jnp.where(m1, jnp.uint32(EMPTY_U32), stc.member),
+            meta=jnp.where(m1, jnp.uint8(EMPTY_META), stc.meta),
+            payload=jnp.where(m1, jnp.uint32(EMPTY_U32), stc.payload),
+            aux=jnp.where(m1, jnp.uint32(0), stc.aux),
+            flags=jnp.where(m1, jnp.uint8(0), stc.flags))
+    fwd = tuple(jnp.where(m1, jnp.asarray(st.empty_of(c.dtype), c.dtype),
+                          c) for c in fwd)
+    # The delayed-message pen dies with the process (reference: delayed
+    # batches live in the in-memory RequestCache, not the database).
+    dly = (jnp.where(m1, jnp.uint32(EMPTY_U32), dly[0]),
+           jnp.where(m1, jnp.uint32(EMPTY_U32), dly[1]),
+           jnp.where(m1, jnp.uint8(EMPTY_META), dly[2]),
+           jnp.where(m1, jnp.uint32(EMPTY_U32), dly[3]),
+           jnp.where(m1, jnp.uint32(0), dly[4]),
+           jnp.where(m1, jnp.uint32(0), dly[5]),
+           jnp.where(m1, NO_PEER, dly[6]))
+    # The auth table is folded from the (wiped) store, so it wipes too:
+    # a reborn peer re-learns permissions as authorize records re-sync
+    # (reference: Timeline is rebuilt from the database on load).
+    auth = tl.AuthTable(
+        member=jnp.where(m1, jnp.uint32(EMPTY_U32), auth.member),
+        mask=jnp.where(m1, jnp.uint32(0), auth.mask),
+        gt=jnp.where(m1, jnp.uint32(0), auth.gt),
+        rev=jnp.where(m1, False, auth.rev),
+        issuer=jnp.where(m1, jnp.uint32(EMPTY_U32), auth.issuer))
+    # The signature request cache and convictions die with the process
+    # (reference: RequestCache is in-memory only).
+    sig = (jnp.where(mask, NO_PEER, sig[0]),
+           jnp.where(mask, jnp.uint32(0), sig[1]),
+           jnp.where(mask, jnp.uint32(0), sig[2]),
+           jnp.where(mask, jnp.uint32(0), sig[3]),
+           jnp.where(mask, jnp.uint32(0), sig[4]))
+    mal = jnp.where(m1, jnp.uint32(EMPTY_U32), mal)
+    global_time = jnp.where(mask, jnp.uint32(1), global_time)
+    session = session + mask.astype(jnp.uint32)
+    return tab, stc, fwd, dly, auth, sig, mal, global_time, session
 
 
 def _tab(state: PeerState) -> cand.CandTable:
@@ -565,6 +652,20 @@ def _telemetry_row(cfg: CommunityConfig, *, rnd, new_time, members, stats,
     asum = tele.col_sum_u64(stats.accepted_by_meta)          # [2, K+1]
     for i in range(cfg.n_meta + 1):
         vals[f"accepted_by_meta_{i}"] = asum[:, i]
+    if cfg.recovery.enabled:
+        # Recovery-plane action totals (recovery.py; conditional schema
+        # words so a recovery-off row stays byte-identical): the three
+        # per-action counters plus per-health-bit clears — the MTTR
+        # denominators (recovery.mttr_report).
+        rsum = tele.col_sum_u64(jnp.stack(
+            [stats.recov_soft, stats.recov_backoff,
+             stats.recov_quarantine], axis=1))               # [2, 3]
+        vals["recov_soft"] = rsum[:, 0]
+        vals["recov_backoff"] = rsum[:, 1]
+        vals["recov_quarantine"] = rsum[:, 2]
+        csum2 = tele.col_sum_u64(stats.recov_cleared)        # [2, HB]
+        for b, nm in enumerate(tlm.HEALTH_NAMES):
+            vals[f"recov_cleared_{nm}"] = csum2[:, b]
     if cfg.telemetry.histograms:
         hb_n = cfg.telemetry.hist_buckets
         for name, kind, cap in tlm.hist_specs(cfg):
@@ -599,6 +700,13 @@ def step(state: PeerState, cfg: CommunityConfig,
     # its gates are plain bools, so fleet-off tracing is unchanged.
     fm = cfg.faults
     kn = effective_faults(cfg, overrides)
+    # Recovery plane (dispersy_tpu/recovery.py): like the fault
+    # branches, every recovery branch below is gated on a STATIC
+    # RecoveryConfig knob — the default (disabled) plane compiles to
+    # the identical recovery-free round (RECOVERY.md).  ``knr``
+    # resolves the liftable numeric knob against fleet overrides.
+    rc = cfg.recovery
+    knr = effective_recovery(cfg, overrides)
     if kn.ge_on:
         # Advance each peer's Gilbert–Elliott channel once per round;
         # this round's loss draws condition on the post-transition state.
@@ -627,59 +735,25 @@ def step(state: PeerState, cfg: CommunityConfig,
     # ---- phase 0: churn -------------------------------------------------
     # A churned peer restarts with a wiped disk: empty store, empty
     # candidate table, reset clock.  Trackers never churn (the reference's
-    # bootstrap infrastructure is long-lived).
+    # bootstrap infrastructure is long-lived).  The wipe itself is
+    # _rebirth_wipe — one inventory shared with the recovery plane's
+    # quarantine escalation (wrap-up).
     if cfg.churn_rate > 0.0:
         reborn = state.alive & ~state.is_tracker & (
             rng.rand_uniform(seed, rnd, idx, rng.P_CHURN) < cfg.churn_rate)
-        r1 = reborn[:, None]
-        tab = cand.CandTable(
-            peer=jnp.where(r1, NO_PEER, state.cand_peer),
-            last_walk=jnp.where(r1, NEVER, state.cand_last_walk),
-            last_stumble=jnp.where(r1, NEVER, state.cand_last_stumble),
-            last_intro=jnp.where(r1, NEVER, state.cand_last_intro))
-        stc = _store(state)
-        stc = st.StoreCols(
-            gt=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.gt),
-            member=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.member),
-            meta=jnp.where(r1, jnp.uint8(EMPTY_META), stc.meta),
-            payload=jnp.where(r1, jnp.uint32(EMPTY_U32), stc.payload),
-            aux=jnp.where(r1, jnp.uint32(0), stc.aux),
-            flags=jnp.where(r1, jnp.uint8(0), stc.flags))
-        # Per-column empty sentinel: EMPTY_U32 truncated to each column's
-        # dtype (EMPTY_META on the narrowed u8 meta column).
-        fwd = tuple(jnp.where(r1, jnp.asarray(st.empty_of(c.dtype), c.dtype),
-                              c) for c in
-                    (state.fwd_gt, state.fwd_member, state.fwd_meta,
-                     state.fwd_payload, state.fwd_aux))
-        # The delayed-message pen dies with the process (reference: delayed
-        # batches live in the in-memory RequestCache, not the database).
-        dly = (jnp.where(r1, jnp.uint32(EMPTY_U32), state.dly_gt),
-               jnp.where(r1, jnp.uint32(EMPTY_U32), state.dly_member),
-               jnp.where(r1, jnp.uint8(EMPTY_META), state.dly_meta),
-               jnp.where(r1, jnp.uint32(EMPTY_U32), state.dly_payload),
-               jnp.where(r1, jnp.uint32(0), state.dly_aux),
-               jnp.where(r1, jnp.uint32(0), state.dly_since),
-               jnp.where(r1, NO_PEER, state.dly_src))
-        # The auth table is folded from the (wiped) store, so it wipes too:
-        # a reborn peer re-learns permissions as authorize records re-sync
-        # (reference: Timeline is rebuilt from the database on load).
-        auth = tl.AuthTable(
-            member=jnp.where(r1, jnp.uint32(EMPTY_U32), state.auth_member),
-            mask=jnp.where(r1, jnp.uint32(0), state.auth_mask),
-            gt=jnp.where(r1, jnp.uint32(0), state.auth_gt),
-            rev=jnp.where(r1, False, state.auth_rev),
-            issuer=jnp.where(r1, jnp.uint32(EMPTY_U32), state.auth_issuer))
-        # The signature request cache dies with the process (reference:
-        # RequestCache is in-memory only).
-        sig = (jnp.where(reborn, NO_PEER, state.sig_target),
-               jnp.where(reborn, jnp.uint32(0), state.sig_meta),
-               jnp.where(reborn, jnp.uint32(0), state.sig_payload),
-               jnp.where(reborn, jnp.uint32(0), state.sig_gt),
-               jnp.where(reborn, jnp.uint32(0), state.sig_since))
-        # A reborn peer forgets its convictions (in-memory bookkeeping).
-        mal = jnp.where(r1, jnp.uint32(EMPTY_U32), state.mal_member)
-        global_time = jnp.where(reborn, jnp.uint32(1), state.global_time)
-        session = state.session + reborn.astype(jnp.uint32)
+        (tab, stc, fwd, dly, auth, sig, mal, global_time,
+         session) = _rebirth_wipe(
+            reborn, tab=_tab(state), stc=_store(state),
+            fwd=(state.fwd_gt, state.fwd_member, state.fwd_meta,
+                 state.fwd_payload, state.fwd_aux),
+            dly=(state.dly_gt, state.dly_member, state.dly_meta,
+                 state.dly_payload, state.dly_aux, state.dly_since,
+                 state.dly_src),
+            auth=_auth(state),
+            sig=(state.sig_target, state.sig_meta, state.sig_payload,
+                 state.sig_gt, state.sig_since),
+            mal=state.mal_member, global_time=state.global_time,
+            session=state.session)
     else:
         tab, stc = _tab(state), _store(state)
         fwd = (state.fwd_gt, state.fwd_member, state.fwd_meta,
@@ -700,6 +774,17 @@ def step(state: PeerState, cfg: CommunityConfig,
         health = jnp.where(reborn, jnp.uint32(0), state.health)
     else:
         health = state.health
+    if rc.enabled and cfg.churn_rate > 0.0:
+        # Rebirth resets the PROCESS-memory recovery state (backoff
+        # exponent, repair history); the quarantine ostracism is the
+        # OVERLAY's decision about the peer and survives, like the NAT
+        # type (dispersy_tpu/recovery.py module note).
+        backoff = jnp.where(reborn, jnp.uint8(0), state.backoff)
+        repair_round = jnp.where(reborn, jnp.uint32(0),
+                                 state.repair_round)
+    else:
+        backoff, repair_round = state.backoff, state.repair_round
+    quar_until = state.quar_until
 
     alive = state.alive
     # Community load state (reference: dispersy.py define_auto_load /
@@ -767,6 +852,17 @@ def step(state: PeerState, cfg: CommunityConfig,
                                          boot_base, boot_count)
         target = jnp.where(act & ~state.is_tracker & ~killed, target,
                            NO_PEER)
+        if rc.enabled:
+            # Recovery-plane walk gates (RECOVERY.md): a backed-off
+            # peer walks one round in 2^backoff (graceful degradation —
+            # it stops amplifying load and re-probes cheaply) and a
+            # quarantined peer sits out until its release round.
+            walk_ok = jnp.ones((n,), bool)
+            if rc.backoff_limit > 0:
+                walk_ok &= rcv.backoff_gate(rnd, backoff)
+            if rc.quarantine_rounds > 0:
+                walk_ok &= ~rcv.quarantine_active(rnd, quar_until)
+            target = jnp.where(walk_ok, target, NO_PEER)
     else:
         target = jnp.full((n,), NO_PEER, jnp.int32)
 
@@ -2411,6 +2507,136 @@ def step(state: PeerState, cfg: CommunityConfig,
         health_pre = health    # pre-latch view: the flight recorder
         #   captures bits that latch THIS round (health & ~health_pre)
         health = health | hb
+    if rc.enabled:
+        # ---- recovery pass (dispersy_tpu/recovery.py; RECOVERY.md) --
+        # Staged repair of the latched sentinels.  Bits visible since a
+        # PREVIOUS round (``prev``) are acted on and CLEARED here; this
+        # round's fresh latches (``hb``) stay visible for at least one
+        # telemetry row.  The *verify* half of detect->repair->verify
+        # is the sentinel itself: a persistent condition re-latches the
+        # same round it was repaired, and a re-latch within
+        # ``requarantine_window`` of the last repair escalates to a
+        # quarantined wiped-disk rebirth (hysteresis — no repair flap).
+        # Config guarantees fm.health_checks here, so hb/health_pre
+        # exist.
+        rpost = rnd + jnp.uint32(1)
+        prev = health_pre
+        prev_on = prev != jnp.uint32(0)
+        if rc.quarantine_rounds > 0:
+            esc = (prev_on & (repair_round > jnp.uint32(0))
+                   & (rpost - repair_round
+                      <= jnp.uint32(rc.requarantine_window)))
+        else:
+            esc = jnp.zeros((n,), bool)
+        rep = (prev_on & ~esc) if rc.soft_repair \
+            else jnp.zeros((n,), bool)
+        bump = jnp.zeros((n,), bool)
+        # Store-touching repairs — the (1a) invariant re-sort and the
+        # (3) quarantine wipe — run behind ONE lax.cond (the
+        # _retro_pass idiom): both fire rarely (the invariant sentinel
+        # is a bug detector; escalations need a re-latch inside the
+        # hysteresis window), so quiet rounds skip the recovery pass's
+        # only store-wide kernels entirely.  Cost analysis still sums
+        # the untaken branch (BENCH.md's recovery entry notes this);
+        # the runtime cost of a quiet round is the cond's predicate.
+        rep_store = (rep & ((prev & jnp.uint32(HEALTH_STORE_INVARIANT))
+                            != 0)) if rc.soft_repair \
+            else jnp.zeros((n,), bool)
+
+        def _store_recover(s):
+            if rc.soft_repair:
+                s = rcv.store_repair(s, rep_store)
+            if rc.quarantine_rounds > 0:
+                em = esc[:, None]
+                s = st.StoreCols(
+                    gt=jnp.where(em, jnp.uint32(EMPTY_U32), s.gt),
+                    member=jnp.where(em, jnp.uint32(EMPTY_U32),
+                                     s.member),
+                    meta=jnp.where(em, jnp.uint8(EMPTY_META), s.meta),
+                    payload=jnp.where(em, jnp.uint32(EMPTY_U32),
+                                      s.payload),
+                    aux=jnp.where(em, jnp.uint32(0), s.aux),
+                    flags=jnp.where(em, jnp.uint8(0), s.flags))
+            return s
+        stc = lax.cond(jnp.any(rep_store) | jnp.any(esc),
+                       _store_recover, lambda s: s, stc)
+        if rc.soft_repair:
+            # (1b) candidate-table flush for the overload sentinel:
+            # evict the entries implicated by the drop deltas (the
+            # flood/overload source set) and re-walk from the trackers.
+            rep_inbox = rep & ((prev & jnp.uint32(HEALTH_INBOX_DROP))
+                               != 0)
+            ri = rep_inbox[:, None]
+            tab = cand.CandTable(
+                peer=jnp.where(ri, NO_PEER, tab.peer),
+                last_walk=jnp.where(ri, NEVER, tab.last_walk),
+                last_stumble=jnp.where(ri, NEVER, tab.last_stumble),
+                last_intro=jnp.where(ri, NEVER, tab.last_intro))
+            # (2) exponential walk-retry backoff bump on drop-limit
+            # trips (HEALTH_BLOOM_SAT / HEALTH_COUNTER_WRAP repairs
+            # clear only — the claimed Bloom re-randomizes per round
+            # and a wrapped counter cannot un-wrap).
+            if rc.backoff_limit > 0:
+                bump = rep_inbox & (backoff < jnp.uint8(rc.backoff_limit))
+                backoff = backoff + bump.astype(jnp.uint8)
+            repair_round = jnp.where(rep, rpost, repair_round)
+        if rc.quarantine_rounds > 0:
+            # (3) quarantine escalation: deterministic wiped-disk
+            # rebirth (the churn-rebirth wipe — store, candidates, auth
+            # table, pen, caches, clock; session bumped) + neighbor
+            # exclusion below for quarantine_rounds rounds.  The wipe
+            # is the SAME _rebirth_wipe the churn block calls (one
+            # inventory — only `loaded`/`health`/`ge_bad`/recovery-leaf
+            # handling differs per caller); the oracle's esc branch is
+            # the mirror to keep in lockstep.
+            # (store wipe handled in _store_recover's cond above —
+            # wipe_store=False)
+            (tab, stc, fwd, dly, auth, sig, mal, global_time,
+             session) = _rebirth_wipe(
+                esc, tab=tab, stc=stc, fwd=fwd, dly=dly, auth=auth,
+                sig=sig, mal=mal, global_time=global_time,
+                session=session, wipe_store=False)
+            backoff = jnp.where(esc, jnp.uint8(0), backoff)
+            repair_round = jnp.where(esc, jnp.uint32(0), repair_round)
+            quar_until = jnp.where(
+                esc, rpost + jnp.uint32(rc.quarantine_rounds),
+                quar_until)
+        # Clear the latch: repaired peers keep only this round's fresh
+        # bits; escalated peers restart with a clean (wiped) slate.
+        cleared = (jnp.where(rep, prev, jnp.uint32(0))
+                   | jnp.where(esc, prev | hb, jnp.uint32(0)))
+        health = jnp.where(esc, jnp.uint32(0),
+                           jnp.where(rep, hb, health))
+        if rc.backoff_limit > 0:
+            # Backoff decay on clean rounds (nothing latched at all),
+            # at the traced-liftable ``backoff_decay`` rate — one
+            # counter draw per peer, so the oracle replays it exactly.
+            ud = rng.rand_uniform(seed, rnd, idx, rng.P_RECOVERY)
+            dec = ((~(prev_on | (hb != jnp.uint32(0))))
+                   & (backoff > jnp.uint8(0))
+                   & (ud < jnp.float32(knr.backoff_decay)))
+            backoff = backoff - dec.astype(jnp.uint8)
+        if rc.quarantine_rounds > 0:
+            # Neighbors eject quarantined peers from their candidate
+            # tables every wrap-up (PeerSwap-style targeted eviction):
+            # with the quarantined peer also not walking, it cannot
+            # stumble back in until its release round.
+            safe = jnp.clip(tab.peer, 0, n - 1)
+            qbad = ((tab.peer != NO_PEER)
+                    & rcv.quarantine_active(rpost, quar_until)[safe])
+            tab = cand.CandTable(
+                peer=jnp.where(qbad, NO_PEER, tab.peer),
+                last_walk=jnp.where(qbad, NEVER, tab.last_walk),
+                last_stumble=jnp.where(qbad, NEVER, tab.last_stumble),
+                last_intro=jnp.where(qbad, NEVER, tab.last_intro))
+        stats = stats.replace(
+            recov_soft=stats.recov_soft + rep.astype(jnp.uint32),
+            recov_backoff=stats.recov_backoff + bump.astype(jnp.uint32),
+            recov_quarantine=stats.recov_quarantine
+            + esc.astype(jnp.uint32),
+            recov_cleared=stats.recov_cleared + jnp.stack(
+                [(cleared >> jnp.uint32(b)) & jnp.uint32(1)
+                 for b in range(NUM_HEALTH_BITS)], axis=1))
     # Fold the round's byte totals before telemetry packs the row — the
     # row must equal what snapshot() sees on the returned state.
     stats = stats.replace(bytes_up=stats.bytes_up + bup,
@@ -2489,6 +2715,8 @@ def step(state: PeerState, cfg: CommunityConfig,
     return state.replace(
         alive=alive, loaded=loaded, session=session,
         global_time=global_time, health=health, ge_bad=ge_bad,
+        backoff=backoff, quar_until=quar_until,
+        repair_round=repair_round,
         walk_streak=walk_streak, tele_row=tele_row, tele_ring=tele_ring,
         fr_ring=fr_ring, fr_pos=fr_pos,
         mal_member=mal,

@@ -70,7 +70,10 @@ import dataclasses
 from dispersy_tpu.exceptions import ConfigError
 
 # Latched health bits (PeerState.health).  A set bit never clears except
-# through churn rebirth (a wiped-disk restart is a new process).
+# through churn rebirth (a wiped-disk restart is a new process) — or,
+# with the recovery plane enabled, through a staged repair action
+# (dispersy_tpu/recovery.py maps each bit to soft repair / backoff /
+# quarantine; RECOVERY.md's action table).
 HEALTH_COUNTER_WRAP = 1 << 0
 HEALTH_STORE_INVARIANT = 1 << 1
 HEALTH_INBOX_DROP = 1 << 2

@@ -51,6 +51,7 @@ from dispersy_tpu.config import CommunityConfig
 from dispersy_tpu.exceptions import ConfigError
 from dispersy_tpu.faults import TRACED_FAULT_KNOBS
 from dispersy_tpu.ops import fleet as ops_fleet
+from dispersy_tpu.recovery import TRACED_RECOVERY_KNOBS
 from dispersy_tpu.state import (PeerState, index_state, init_state,
                                 stack_states)
 
@@ -74,10 +75,14 @@ class FleetOverrides(NamedTuple):
     ge_p_good: Any = None
     ge_loss_good: Any = None
     ge_loss_bad: Any = None
+    # recovery plane (recovery.TRACED_RECOVERY_KNOBS; RECOVERY.md)
+    backoff_decay: Any = None
 
 
-assert FleetOverrides._fields == TRACED_FAULT_KNOBS, \
-    "FleetOverrides must mirror faults.TRACED_FAULT_KNOBS exactly"
+TRACED_KNOBS = TRACED_FAULT_KNOBS + TRACED_RECOVERY_KNOBS
+assert FleetOverrides._fields == TRACED_KNOBS, \
+    "FleetOverrides must mirror faults.TRACED_FAULT_KNOBS + " \
+    "recovery.TRACED_RECOVERY_KNOBS exactly"
 
 
 def make_overrides(cfg: CommunityConfig, **knobs) -> FleetOverrides:
@@ -89,11 +94,11 @@ def make_overrides(cfg: CommunityConfig, **knobs) -> FleetOverrides:
     structural mismatch with ``cfg`` (FLEET.md's traced-vs-static
     table).
     """
-    unknown = set(knobs) - set(TRACED_FAULT_KNOBS)
+    unknown = set(knobs) - set(TRACED_KNOBS)
     if unknown:
         raise ConfigError(
             f"not traced-liftable: {sorted(unknown)} (liftable knobs: "
-            f"{TRACED_FAULT_KNOBS}; everything else is structural — "
+            f"{TRACED_KNOBS}; everything else is structural — "
             "sweep it as a static axis / compile group instead)")
     lens = {name: len(v) for name, v in knobs.items()}
     if len(set(lens.values())) > 1:
@@ -111,6 +116,10 @@ def make_overrides(cfg: CommunityConfig, **knobs) -> FleetOverrides:
             "a traced corrupt_rate needs cfg.faults.corrupt_rate > 0 "
             "(representative value) so stats.msgs_corrupt_dropped is "
             "full-width")
+    if "backoff_decay" in knobs and not cfg.recovery.enabled:
+        raise ConfigError(
+            "a traced backoff_decay needs cfg.recovery.enabled — the "
+            "recovery leaves are zero-width otherwise (FLEET.md)")
     cols = {}
     for name, vals in knobs.items():
         arr = np.asarray(vals, np.float32)

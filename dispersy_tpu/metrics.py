@@ -105,6 +105,16 @@ def snapshot(state: PeerState, cfg: CommunityConfig) -> dict:
             int(x) for x in
             np.asarray(s.accepted_by_meta, dtype=np.uint64).sum(axis=0)],
     }
+    if cfg.recovery.enabled:
+        # Recovery-plane totals + instantaneous availability — the SAME
+        # key set (and shared definitions, recovery.action_totals /
+        # availability_of) the fused row surfaces via
+        # telemetry.row_to_snapshot, so the two paths stay
+        # schema-identical (dump_binary's contract).
+        from dispersy_tpu.recovery import action_totals, availability_of
+        out.update(action_totals(s))
+        out["availability"] = availability_of(out["health_flagged"],
+                                              cfg.n_peers)
     if cfg.telemetry.histograms:
         # Histograms only exist in-step; a pre-first-step snapshot on a
         # histogram-enabled config reports them EMPTY so its key set

@@ -1,0 +1,83 @@
+"""Recovery kernels: store repair, walk-backoff gate, quarantine gate.
+
+The jit-traced half of the recovery plane (:mod:`dispersy_tpu.recovery`
+declares the static :class:`~dispersy_tpu.recovery.RecoveryConfig`; the
+engine composes these into the fused wrap-up only when
+``recovery.enabled``, so a disabled recovery plane compiles to the
+identical step).  Every op mirrors bit-for-bit in the oracle
+(:mod:`dispersy_tpu.oracle.sim` ``_store_repair`` / the walk-gate and
+quarantine conditions in ``step``), the same lockstep discipline as
+every other ops module.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from dispersy_tpu.config import EMPTY_U32
+from dispersy_tpu.ops.contracts import Spec, contract
+from dispersy_tpu.ops.store import StoreCols, rank_compact_many, stc_spec
+
+_STORE_NM = stc_spec("N", "M")
+
+
+@contract(out=_STORE_NM, store=_STORE_NM, mask=Spec("bool", ("N",)))
+def store_repair(store: StoreCols, mask: jnp.ndarray) -> StoreCols:
+    """Soft repair of the store ring on the masked rows: re-sort by the
+    canonical ``(gt, member, meta, payload)`` key (``EMPTY_U32`` holes
+    sort last), drop later duplicates of the UNIQUE ``(gt, member)``
+    identity, and compact survivors to the front — restoring exactly
+    the invariant ``faults.store_invariant_violated`` checks.  Unmasked
+    rows pass through untouched, so an all-false mask is an identity
+    (the common case: ``HEALTH_STORE_INVARIANT`` is a bug sentinel).
+    """
+    gt, member, meta, payload, aux, flags = lax.sort(
+        (store.gt, store.member, store.meta, store.payload, store.aux,
+         store.flags), dimension=-1, num_keys=4)
+    live = gt != jnp.uint32(EMPTY_U32)
+    dup = jnp.concatenate(
+        [jnp.zeros_like(live[:, :1]),
+         (gt[:, 1:] == gt[:, :-1]) & (member[:, 1:] == member[:, :-1])
+         & live[:, 1:]], axis=1)
+    keep = live & ~dup
+    m = gt.shape[1]
+    rank = jnp.cumsum(keep.astype(jnp.int32), axis=-1) - 1
+    slot = jnp.where(keep, rank, m)
+    rgt, rmember, rmeta, rpayload, raux, rflags = rank_compact_many(
+        [(gt, EMPTY_U32), (member, EMPTY_U32),
+         (meta, jnp.uint8(0xFF)), (payload, EMPTY_U32),
+         (aux, 0), (flags, 0)], slot, m)
+    m1 = mask[:, None]
+    return StoreCols(
+        gt=jnp.where(m1, rgt, store.gt),
+        member=jnp.where(m1, rmember, store.member),
+        meta=jnp.where(m1, rmeta, store.meta),
+        payload=jnp.where(m1, rpayload, store.payload),
+        aux=jnp.where(m1, raux, store.aux),
+        flags=jnp.where(m1, rflags, store.flags))
+
+
+@contract(out=Spec("bool", ("N",)),
+          rnd=Spec("uint32", ()), backoff=Spec("uint8", ("N",)))
+def backoff_gate(rnd: jnp.ndarray, backoff: jnp.ndarray) -> jnp.ndarray:
+    """bool[N]: may each peer walk this round under its backoff
+    exponent?  Exponent ``e`` admits one round in ``2^e`` (``rnd``
+    aligned: ``rnd & (2^e - 1) == 0``), so a backed-off peer re-probes
+    deterministically and cheaply instead of hammering every round —
+    the oracle mirrors with the identical integer test.
+    """
+    mask = (jnp.left_shift(jnp.uint32(1), backoff.astype(jnp.uint32))
+            - jnp.uint32(1))
+    return (jnp.asarray(rnd, jnp.uint32) & mask) == jnp.uint32(0)
+
+
+@contract(out=Spec("bool", ("N",)),
+          rnd=Spec("uint32", ()), quar_until=Spec("uint32", ("N",)))
+def quarantine_active(rnd: jnp.ndarray,
+                      quar_until: jnp.ndarray) -> jnp.ndarray:
+    """bool[N]: is each peer currently quarantined (``rnd`` strictly
+    before its ``quar_until`` release round)?  ``quar_until == 0``
+    (never quarantined) is never active because round indices compare
+    unsigned."""
+    return jnp.asarray(rnd, jnp.uint32) < quar_until

@@ -46,6 +46,8 @@ P_GE_LOSS = 11   # state-dependent per-packet loss (same salt blocks as
 P_CORRUPT = 12   # per-delivered-record payload corruption
 P_DUP = 13       # per-delivered-record duplication
 P_FLOOD = 14     # byzantine flood victim + junk-field draws
+# Recovery-plane stream (dispersy_tpu/recovery.py RecoveryConfig):
+P_RECOVERY = 15  # walk-backoff decay draw (one per peer per clean round)
 
 
 @contract(out=Spec("uint32", ()), key=Spec("uint32", (2,)))

@@ -44,6 +44,7 @@ from dispersy_tpu.config import (CONTROL_PRIORITY, EMPTY_META, EMPTY_U32,
                                  priority_of, user_perm_mask)
 from dispersy_tpu import telemetry as tlm
 from dispersy_tpu.oracle.bloom import OracleBloom, record_hash
+from dispersy_tpu.recovery import NUM_HEALTH_BITS
 from dispersy_tpu.ops import rng as _jrng
 
 FLAG_UNDONE = 1
@@ -80,6 +81,7 @@ _FAULT_PUSH = 1 << 16
 P_CATEGORY, P_SLOT, P_INTRO, P_BOOTSTRAP = 1, 2, 3, 4
 P_CHURN, P_LOSS, P_GOSSIP, P_SIGN, P_NAT = 5, 6, 7, 8, 9
 P_GE, P_GE_LOSS, P_CORRUPT, P_DUP, P_FLOOD = 10, 11, 12, 13, 14
+P_RECOVERY = 15
 
 KIND_WALK, KIND_STUMBLE, KIND_INTRO = 0, 1, 2
 CAT_NONE, CAT_WALKED, CAT_STUMBLED, CAT_INTRODUCED = 0, 1, 2, 3
@@ -194,6 +196,14 @@ class OraclePeer:
         self.msgs_delayed = 0
         self.msgs_corrupt_dropped = 0
         self.health = 0        # latched sentinel bits (faults.HEALTH_*)
+        # recovery plane (engine backoff/quar_until/repair_round leaves
+        # + the stats recov_* counters; dispersy_tpu/recovery.py)
+        self.backoff = 0
+        self.quar_until = 0
+        self.repair_round = 0
+        self.recov_soft = self.recov_backoff = 0
+        self.recov_quarantine = 0
+        self.recov_cleared = [0] * NUM_HEALTH_BITS
         self.proof_requests = self.proof_records = 0
         self.seq_requests = self.seq_records = 0
         self.mm_requests = self.mm_records = 0
@@ -265,6 +275,14 @@ class OracleSim:
                 != (nf.corrupt_rate > 0.0 or nf.flood_enabled)):
             for p in self.peers:
                 p.msgs_corrupt_dropped = 0
+        if self.cfg.recovery.enabled != new_cfg.recovery.enabled:
+            # the SetRecovery shape — recovery.adapt_state mirror:
+            # enabling starts clean, disabling discards.
+            for p in self.peers:
+                p.backoff = p.quar_until = p.repair_round = 0
+                p.recov_soft = p.recov_backoff = 0
+                p.recov_quarantine = 0
+                p.recov_cleared = [0] * NUM_HEALTH_BITS
         self.cfg = new_cfg
 
     # ---- helpers mirroring ops/candidates.py --------------------------------
@@ -364,6 +382,36 @@ class OracleSim:
             if p != NO_PEER:
                 return p
         return NO_PEER
+
+    def _recovery_walk_ok(self, i: int) -> bool:
+        """Recovery-plane walk gates (engine phase 1: ops/recovery
+        backoff_gate + quarantine_active): a backed-off peer walks one
+        round in 2^backoff; a quarantined peer sits out until its
+        release round."""
+        rc = self.cfg.recovery
+        if not rc.enabled:
+            return True
+        p = self.peers[i]
+        if rc.backoff_limit > 0 \
+                and (self.rnd & ((1 << p.backoff) - 1)) != 0:
+            return False
+        if rc.quarantine_rounds > 0 and self.rnd < p.quar_until:
+            return False
+        return True
+
+    def _store_repair(self, owner: int) -> None:
+        """Soft store repair (ops/recovery.store_repair mirror): stable
+        re-sort by the canonical key, drop later (gt, member)
+        duplicates, survivors compacted to the front."""
+        p = self.peers[owner]
+        p.store.sort(key=lambda r: (r.gt, r.member, r.meta, r.payload))
+        out, seen = [], set()
+        for r in p.store:
+            if (r.gt, r.member) in seen:
+                continue
+            seen.add((r.gt, r.member))
+            out.append(r)
+        p.store = out
 
     def _nat_sym(self, peer: int) -> bool:
         """engine's ``nat_sym``/``sym_of`` mirror: symmetric-NAT iff the
@@ -1037,6 +1085,12 @@ class OracleSim:
                         # wiped-disk restart: clean health latch (the GE
                         # channel is the LINK's and survives)
                         p.health = 0
+                    if cfg.recovery.enabled:
+                        # rebirth resets the PROCESS-memory recovery
+                        # state; the quarantine ostracism is the
+                        # OVERLAY's and survives (engine phase 0)
+                        p.backoff = 0
+                        p.repair_round = 0
 
         # hard-kill state (engine mirror: derived from the post-churn store)
         if cfg.timeline_enabled:
@@ -1049,7 +1103,8 @@ class OracleSim:
         targets = [NO_PEER] * n
         if cfg.walker_enabled:
             for i, p in enumerate(self.peers):
-                if p.alive and p.loaded and i >= t and not killed[i]:
+                if p.alive and p.loaded and i >= t and not killed[i] \
+                        and self._recovery_walk_ok(i):
                     targets[i] = self._sample_walk_target(i)
 
         slices, blooms = [None] * n, [None] * n
@@ -2045,6 +2100,8 @@ class OracleSim:
                     p.loaded = True
 
         tele_new = [0] * n     # health bits newly latched this round
+        hb_l = [0] * n         # this round's sentinel bits (recovery)
+        prev_l = [0] * n       # pre-latch health (recovery `prev`)
         if fm.health_checks:
             # engine wrap-up health sentinels (faults.HEALTH_* bits,
             # latched): counter wrap, store invariant, drop rate, Bloom
@@ -2067,7 +2124,77 @@ class OracleSim:
                     if fill * 8 >= cfg.bloom_bits * 7:
                         bits |= 8                  # HEALTH_BLOOM_SAT
                 tele_new[i] = bits & ~p.health     # flight recorder
+                prev_l[i] = p.health
+                hb_l[i] = bits
                 p.health |= bits
+
+        rc = cfg.recovery
+        if rc.enabled:
+            # engine wrap-up recovery pass (dispersy_tpu/recovery.py;
+            # RECOVERY.md): staged repair of bits latched since a
+            # PREVIOUS round, quarantine escalation on a re-latch
+            # within the hysteresis window, backoff decay on clean
+            # rounds, and neighbor ejection of quarantined peers.
+            rpost = self.rnd + 1
+            for i, p in enumerate(self.peers):
+                prev, hb = prev_l[i], hb_l[i]
+                esc = (rc.quarantine_rounds > 0 and prev != 0
+                       and p.repair_round > 0
+                       and (rpost - p.repair_round)
+                       <= rc.requarantine_window)
+                rep = rc.soft_repair and prev != 0 and not esc
+                bumped = False
+                if rep:
+                    if prev & 2:                   # STORE_INVARIANT
+                        self._store_repair(i)
+                    if prev & 4:                   # INBOX_DROP
+                        p.slots = [Slot()
+                                   for _ in range(cfg.k_candidates)]
+                        if rc.backoff_limit > 0 \
+                                and p.backoff < rc.backoff_limit:
+                            p.backoff += 1
+                            bumped = True
+                    p.repair_round = rpost
+                if esc:
+                    # deterministic wiped-disk rebirth (the churn wipe;
+                    # `loaded`/`alive` untouched — the process is up)
+                    p.slots = [Slot() for _ in range(cfg.k_candidates)]
+                    p.store = []
+                    p.fwd = []
+                    p.auth = []
+                    p.delay = []
+                    p.sig_target = NO_PEER
+                    p.sig_meta = p.sig_payload = 0
+                    p.sig_gt = p.sig_since = 0
+                    p.mal = []
+                    p.global_time = 1
+                    p.session += 1
+                    p.backoff = 0
+                    p.repair_round = 0
+                    p.quar_until = rpost + rc.quarantine_rounds
+                cleared = ((prev if rep else 0)
+                           | ((prev | hb) if esc else 0))
+                if esc:
+                    p.health = 0
+                elif rep:
+                    p.health = hb
+                if rc.backoff_limit > 0 and (prev | hb) == 0 \
+                        and p.backoff > 0:
+                    u = rand_uniform(seed, rnd, i, P_RECOVERY)
+                    if u < np.float32(rc.backoff_decay):
+                        p.backoff -= 1
+                p.recov_soft += 1 if rep else 0
+                p.recov_backoff += 1 if bumped else 0
+                p.recov_quarantine += 1 if esc else 0
+                for b in range(NUM_HEALTH_BITS):
+                    p.recov_cleared[b] += (cleared >> b) & 1
+            if rc.quarantine_rounds > 0:
+                quar = [rpost < q.quar_until for q in self.peers]
+                for p in self.peers:
+                    for s in p.slots:
+                        if s.peer != NO_PEER and quar[s.peer]:
+                            s.peer = NO_PEER
+                            s.walk = s.stumble = s.intro = NEVER
 
         # engine wrap-up telemetry (engine._telemetry_row + ring + flight
         # recorder; rows packed through the SAME schema via pack_row_host)
@@ -2135,6 +2262,14 @@ class OracleSim:
         for i in range(cfg.n_meta + 1):
             vals[f"accepted_by_meta_{i}"] = sum(
                 p.accepted_by_meta[i] & M32 for p in self.peers)
+        if cfg.recovery.enabled:
+            for nm in ("recov_soft", "recov_backoff",
+                       "recov_quarantine"):
+                vals[nm] = sum(getattr(p, nm) & M32
+                               for p in self.peers)
+            for b, nm in enumerate(tlm.HEALTH_NAMES):
+                vals[f"recov_cleared_{nm}"] = sum(
+                    p.recov_cleared[b] & M32 for p in self.peers)
         if tl.histograms:
             hb = tl.hist_buckets
             ones = [True] * n
@@ -2250,6 +2385,37 @@ class OracleSim:
             "ge_bad": (np.array(self.ge_bad, bool)
                        if cfg.faults.ge_enabled
                        else np.zeros((0,), bool)),
+            # recovery-plane leaves + counters (knob-sized, state.py)
+            "backoff": (np.array([p.backoff for p in self.peers],
+                                 np.uint8)
+                        if cfg.recovery.enabled
+                        else np.zeros((0,), np.uint8)),
+            "quar_until": (np.array([p.quar_until for p in self.peers],
+                                    np.uint32)
+                           if cfg.recovery.enabled
+                           else np.zeros((0,), np.uint32)),
+            "repair_round": (np.array([p.repair_round
+                                       for p in self.peers], np.uint32)
+                             if cfg.recovery.enabled
+                             else np.zeros((0,), np.uint32)),
+            "recov_soft": (np.array([p.recov_soft for p in self.peers],
+                                    np.uint32)
+                           if cfg.recovery.enabled
+                           else np.zeros((0,), np.uint32)),
+            "recov_backoff": (np.array([p.recov_backoff
+                                        for p in self.peers], np.uint32)
+                              if cfg.recovery.enabled
+                              else np.zeros((0,), np.uint32)),
+            "recov_quarantine": (np.array([p.recov_quarantine
+                                           for p in self.peers],
+                                          np.uint32)
+                                 if cfg.recovery.enabled
+                                 else np.zeros((0,), np.uint32)),
+            "recov_cleared": (np.array([p.recov_cleared
+                                        for p in self.peers], np.uint32)
+                              if cfg.recovery.enabled
+                              else np.zeros((0, NUM_HEALTH_BITS),
+                                            np.uint32)),
             # telemetry-plane leaves (knob-sized, state.py)
             "walk_streak": (np.array(self.walk_streak, np.uint32)
                             if cfg.telemetry.histograms

@@ -1,0 +1,256 @@
+"""The recovery plane: on-device self-healing of health-flagged peers.
+
+PR 4's chaos harness built *detection* (latched ``PeerState.health``
+sentinel bits, faults.py) and PR 6 built *reporting* (the fused
+telemetry row, the flight recorder) — but nothing ever repaired a
+flagged peer: a latched bit persisted until a random churn rebirth
+happened to wipe it, so under sustained faults the fleet degraded
+monotonically.  Production overlays close the detect->repair->verify
+loop with automated recovery — GossipSub's formally verified mesh
+maintenance prunes and backs off misbehaving peers, and PeerSwap shows
+that targeted eviction/replacement can preserve the sampler's
+randomness (PAPERS.md).  This module declares that loop's static half;
+the jit-traced kernels live in :mod:`dispersy_tpu.ops.recovery` and the
+engine composes them into the fused wrap-up only when
+``RecoveryConfig.enabled`` — all defaults compile to *exactly* the
+recovery-free step (zero-width leaves, the faults/telemetry pattern).
+
+The staged repair ladder, per health bit (RECOVERY.md's action table):
+
+1. **Soft repair** (``soft_repair``): a bit that has been latched for a
+   full round is acted on and *cleared* at the next wrap-up —
+   ``HEALTH_STORE_INVARIANT`` re-sorts/uniques/compacts the store ring
+   (ops/recovery.store_repair); ``HEALTH_INBOX_DROP`` flushes the
+   candidate table (evicting the entries implicated by the flight
+   recorder's drop deltas — the flood/overload source set) and bumps
+   the walk backoff; ``HEALTH_BLOOM_SAT`` and ``HEALTH_COUNTER_WRAP``
+   clear only (the claimed Bloom re-randomizes per round and a wrapped
+   counter cannot un-wrap — clearing re-arms the sentinel).  The
+   *verify* half is the sentinel itself: a condition that persists
+   re-latches the bit the same round, keeping the peer visible and
+   feeding the escalation below.
+2. **Walk retry with exponential backoff** (``backoff_limit``): each
+   drop-limit repair bumps a per-peer ``backoff`` exponent (u8, capped)
+   gating walk participation to one round in ``2^backoff`` — a flooded
+   or partitioned peer stops amplifying load and re-probes cheaply.
+   On clean rounds the exponent decays with probability
+   ``backoff_decay`` (one counter-RNG draw per peer — traced-liftable,
+   see :data:`TRACED_RECOVERY_KNOBS`).
+3. **Quarantine + supervised rebirth with hysteresis**
+   (``quarantine_rounds``): a peer whose bits re-latch within
+   ``requarantine_window`` rounds of its last repair escalates to a
+   deterministic wiped-disk rebirth (the churn-rebirth wipe: store,
+   candidates, auth table, pen, caches, clock — session bumped) and is
+   excluded from candidate selection by its neighbors for
+   ``quarantine_rounds`` rounds (it stops walking and every candidate
+   table ejects it each wrap-up).  The ``repair_round`` hysteresis
+   counter prevents repair/quarantine flap.
+
+Every action increments per-peer counters
+(``Stats.recov_soft/recov_backoff/recov_quarantine`` and the per-bit
+``recov_cleared``) folded into the telemetry row as new schema words
+when recovery is enabled, and :func:`mttr_report` derives MTTR
+(rounds-to-clear per health bit) and availability (fraction of
+peer-rounds unflagged) from any per-round row log — the telemetry
+ring, a ``MetricsLog``, or a decoded artifact.
+
+Recovery state persistence: ``backoff`` / ``quar_until`` /
+``repair_round`` ride checkpoints like database state (format v12) so
+a byte-exact resume replays the identical trajectory; like ``health``
+they are NOT wiped by ``restore(fresh_candidates=True)``.  A churn
+rebirth resets ``backoff``/``repair_round`` (process memory) but keeps
+``quar_until`` — the quarantine is the *overlay's* decision about the
+peer, not the process's own state, so a coincidental restart does not
+lift it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from dispersy_tpu.exceptions import ConfigError
+from dispersy_tpu.faults import HEALTH_BIT_NAMES
+
+# Number of defined health-sentinel bits (the recov_cleared column
+# count); keep in lockstep with faults.HEALTH_BIT_NAMES.
+NUM_HEALTH_BITS = len(HEALTH_BIT_NAMES)
+
+# Recovery knobs the fleet plane can lift into TRACED per-replica
+# scalars (the faults.TRACED_FAULT_KNOBS discipline): numeric rates
+# whose value never decides program structure.  Everything else
+# (enabled, soft_repair, the integer windows/limits) is structural and
+# stays a static compile-group key.
+TRACED_RECOVERY_KNOBS = ("backoff_decay",)
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryConfig:
+    """Static recovery knobs, composed into ``CommunityConfig``.
+
+    Frozen + hashable (a static jit argument, like ``FaultModel`` and
+    ``TelemetryConfig``).  All defaults off compile to exactly the
+    recovery-free step; every leaf the plane adds (``backoff`` /
+    ``quar_until`` / ``repair_round`` and the ``recov_*`` counters) is
+    zero-width while ``enabled`` is off.  ``enabled`` requires
+    ``faults.health_checks`` (validated by CommunityConfig — recovery
+    maps latched health bits to actions).
+    """
+
+    # Master switch: compose the staged-repair pass into the wrap-up.
+    enabled: bool = False
+    # Stage 1: act on (and clear) bits latched for >= 1 round.
+    soft_repair: bool = True
+    # Stage 2: walk-backoff exponent cap (0 disables the walk gate; a
+    # peer with exponent e walks one round in 2^e).
+    backoff_limit: int = 6
+    # P(decay one exponent step) per clean round — traced-liftable.
+    backoff_decay: float = 1.0
+    # Stage 3: rounds a quarantined peer is excluded from candidate
+    # selection after its supervised rebirth (0 disables escalation).
+    quarantine_rounds: int = 32
+    # Hysteresis: a re-latch within this many rounds of the last repair
+    # escalates to quarantine instead of repairing again.
+    requarantine_window: int = 8
+
+    def __post_init__(self) -> None:
+        if not (0 <= self.backoff_limit <= 16):
+            raise ConfigError(
+                f"backoff_limit must be in [0, 16] (a u8 exponent whose "
+                f"2^e period must fit u32), got {self.backoff_limit}")
+        if not (0.0 <= self.backoff_decay <= 1.0):
+            raise ConfigError(
+                f"backoff_decay must be in [0, 1], got "
+                f"{self.backoff_decay}")
+        if self.quarantine_rounds < 0:
+            raise ConfigError("quarantine_rounds must be >= 0")
+        if self.requarantine_window < 1:
+            raise ConfigError(
+                "requarantine_window must be >= 1 (the hysteresis "
+                "window; a 0-window could never observe a re-latch)")
+
+    def replace(self, **kw) -> "RecoveryConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def adapt_state(state, old_cfg, new_cfg):
+    """Resize the recovery-plane leaves across a ``SetRecovery`` swap.
+
+    ``backoff`` / ``quar_until`` / ``repair_round`` and the
+    ``stats.recov_*`` counters are zero-width while recovery is
+    compiled out (state.py), so a flip of ``recovery.enabled`` must
+    resize them before the next step traces.  Enabling starts clean (no
+    backoff, no quarantine, no repair history, zero counters); disabling
+    discards.  A swap that leaves ``enabled`` alone is an identity —
+    the numeric knobs gate computation only.
+    """
+    import jax.numpy as jnp
+
+    if old_cfg.recovery.enabled == new_cfg.recovery.enabled:
+        return state
+    n = new_cfg.n_peers if new_cfg.recovery.enabled else 0
+    return state.replace(
+        backoff=jnp.zeros((n,), jnp.uint8),
+        quar_until=jnp.zeros((n,), jnp.uint32),
+        repair_round=jnp.zeros((n,), jnp.uint32),
+        stats=state.stats.replace(
+            recov_soft=jnp.zeros((n,), jnp.uint32),
+            recov_backoff=jnp.zeros((n,), jnp.uint32),
+            recov_quarantine=jnp.zeros((n,), jnp.uint32),
+            recov_cleared=jnp.zeros((n, NUM_HEALTH_BITS), jnp.uint32)))
+
+
+def action_totals(stats) -> dict:
+    """Overlay-wide recovery action totals from a ``Stats`` pytree: the
+    three per-action counters plus the per-health-bit clears
+    (zero-width compiled-out leaves read as zeros).  THE one host-side
+    aggregation — :func:`recovery_report` and the legacy
+    ``metrics.snapshot`` path both read it, so they cannot drift from
+    each other (the fused telemetry row reduces the same leaves on
+    device)."""
+    import numpy as np
+
+    out = {}
+    for nm in ("recov_soft", "recov_backoff", "recov_quarantine"):
+        col = np.asarray(getattr(stats, nm), np.uint64)
+        out[nm] = int(col.sum()) if col.size else 0
+    cl = np.asarray(stats.recov_cleared, np.uint64)
+    by_bit = cl.sum(axis=0) if cl.size else np.zeros(NUM_HEALTH_BITS,
+                                                     np.uint64)
+    for b, (_, nm) in enumerate(sorted(HEALTH_BIT_NAMES.items())):
+        out[f"recov_cleared_{nm}"] = int(by_bit[b])
+    return out
+
+
+def availability_of(health_flagged: int, n_peers: int) -> float:
+    """Instantaneous availability: the fraction of peers unflagged this
+    round (the peer-round form over a window is :func:`mttr_report`).
+    One definition for both snapshot paths."""
+    return 1.0 - health_flagged / float(n_peers)
+
+
+def recovery_report(state, cfg) -> dict:
+    """Host-side summary of the recovery plane's live state: quarantined
+    / backing-off peer counts, the max backoff exponent, and the
+    cumulative action totals.  Cheap (a handful of [N] transfers);
+    all-zero when recovery is compiled out."""
+    import numpy as np
+
+    rnd = int(np.asarray(state.round_index))
+    bo = np.asarray(state.backoff)
+    qu = np.asarray(state.quar_until)
+    out = {
+        "quarantined": int((qu > rnd).sum()) if qu.size else 0,
+        "backing_off": int((bo > 0).sum()) if bo.size else 0,
+        "max_backoff": int(bo.max()) if bo.size else 0,
+    }
+    out.update(action_totals(state.stats))
+    return out
+
+
+def mttr_report(rows, n_peers: int | None = None) -> dict:
+    """MTTR + availability from a per-round row log (the telemetry
+    ring drained through ``telemetry.ring_rows``, a ``MetricsLog``'s
+    rows, or a decoded artifact's row dicts).
+
+    Per health bit, MTTR (mean rounds a latch stays flagged before a
+    recovery action clears it) is derived by Little's law: the flagged
+    peer-round mass ``sum_r health_<bit>(r)`` divided by the number of
+    clears over the window (the cumulative ``recov_cleared_<bit>``
+    counter's first->last delta).  ``None`` when no clear happened —
+    with recovery off the counters are absent/zero and every MTTR is
+    ``None`` while the flagged mass still reports the latch load.
+
+    Availability is the fraction of peer-rounds unflagged:
+    ``1 - sum_r health_flagged(r) / (n_peers * rounds)`` — ``n_peers``
+    is taken from the argument or, failing that, left out (the
+    ``flagged_peer_rounds`` mass is always reported).
+    """
+    rows = [r for r in rows if isinstance(r, dict)]
+    out: dict = {"rounds": len(rows)}
+    if not rows:
+        return out
+    names = [nm for _, nm in sorted(HEALTH_BIT_NAMES.items())]
+    flagged_mass = sum(int(r.get("health_flagged", 0)) for r in rows)
+    out["flagged_peer_rounds"] = flagged_mass
+    if n_peers:
+        out["availability"] = 1.0 - flagged_mass / float(
+            n_peers * len(rows))
+    # A log that starts at round 1 sees the cumulative counters from
+    # zero, so the window's clears are simply the last value; a log
+    # window cut mid-run uses the first->last delta (the first row's own
+    # clears are unobservable and dropped — a one-row undercount).
+    from_start = int(rows[0].get("round", 1)) <= 1
+    for nm in names:
+        mass = sum(int(r.get(f"health_{nm}", 0)) for r in rows)
+        key = f"recov_cleared_{nm}"
+        vals = [int(r[key]) for r in rows if key in r]
+        if not vals:
+            clears = 0
+        elif from_start:
+            clears = vals[-1]
+        else:
+            clears = vals[-1] - vals[0]
+        out[f"mttr_{nm}"] = (mass / clears) if clears > 0 else None
+        out[f"clears_{nm}"] = clears
+        out[f"flagged_mass_{nm}"] = mass
+    return out

@@ -182,6 +182,44 @@ _FAULT_KNOBS = ("ge_p_bad", "ge_p_good", "ge_loss_good", "ge_loss_bad",
                 "flood_fanout", "health_checks", "health_drop_limit")
 
 
+@dataclasses.dataclass
+class SetRecovery:
+    """Swap the recovery plane mid-run (config change -> recompile;
+    dispersy_tpu/recovery.py RecoveryConfig — the ``SetFault`` shape).
+
+    ``None`` leaves a knob unchanged.  Flipping ``enabled`` across the
+    boundary resizes the recovery state leaves via
+    ``recovery.adapt_state`` (enabling starts clean; disabling discards
+    backoff/quarantine/repair history and the action counters).  The
+    applied flips are recorded in the autosave JSON sidecar
+    (``recovery_history``) so ``run(resume=True)`` replays them even
+    when the resume straddles the flip round."""
+    enabled: bool | None = None
+    soft_repair: bool | None = None
+    backoff_limit: int | None = None
+    backoff_decay: float | None = None
+    quarantine_rounds: int | None = None
+    requarantine_window: int | None = None
+
+
+_RECOVERY_KNOBS = ("enabled", "soft_repair", "backoff_limit",
+                   "backoff_decay", "quarantine_rounds",
+                   "requarantine_window")
+
+
+def _setrecovery_kw(ev: "SetRecovery") -> dict:
+    return {k: getattr(ev, k) for k in _RECOVERY_KNOBS
+            if getattr(ev, k) is not None}
+
+
+def _setrecovery_cfg(cfg: CommunityConfig,
+                     ev: "SetRecovery") -> CommunityConfig:
+    """The pure config half of a SetRecovery — shared by the live event
+    interpreter and the resume-time replay (run())."""
+    kw = _setrecovery_kw(ev)
+    return cfg.replace(recovery=cfg.recovery.replace(**kw)) if kw else cfg
+
+
 def _deep_tuple(v):
     """JSON lists -> tuples, recursively (FaultModel fields must stay
     hashable for the jitted step's static config argument)."""
@@ -248,8 +286,9 @@ class Scenario:
     snapshot_every: int = 1
     # Crash-resume (FAULTS.md): every `autosave_every` rounds the runner
     # checkpoints state (CRC-protected, checkpoint.py — single-run
-    # archives at the current format, v11) plus a JSON sidecar (metrics
-    # rows, tracked records, next round) into `autosave_dir`;
+    # archives at the current format, v12) plus a JSON sidecar (metrics
+    # rows, tracked records, applied SetRecovery flips, next round)
+    # into `autosave_dir`;
     # run(..., resume=True) restarts from the latest snapshot that
     # passes CRC — a corrupt/torn autosave is rejected with
     # CheckpointError and the previous one is used.  0 = off.  Autosave
@@ -337,6 +376,11 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
         # chaos-harness leaves (zero-width while compiled out).
         state = flts.adapt_state(state, cfg, new_cfg)
         cfg = new_cfg
+    elif isinstance(ev, SetRecovery):
+        from dispersy_tpu import recovery as rcv
+        new_cfg = _setrecovery_cfg(cfg, ev)
+        state = rcv.adapt_state(state, cfg, new_cfg)
+        cfg = new_cfg
     elif isinstance(ev, Checkpoint):
         ckpt.save(ev.path, state, cfg)
     else:
@@ -345,17 +389,21 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
 
 
 def _autosave(dirpath: str, next_round: int, state: PeerState,
-              cfg: CommunityConfig, tracked: dict, log: MetricsLog) -> None:
+              cfg: CommunityConfig, tracked: dict, log: MetricsLog,
+              recovery_hist: list | None = None) -> None:
     """One crash-resume snapshot: CRC-protected state archive + a JSON
     sidecar carrying everything the runner itself holds (metrics rows,
-    tracked-record specs, the round to resume at).  Both writes are
-    atomic (tmp + replace), so a crash mid-autosave leaves the previous
-    snapshot intact and the torn one detectably invalid."""
+    tracked-record specs, the round to resume at, and the applied
+    SetRecovery flips so resume replays the recovery config history).
+    Both writes are atomic (tmp + replace), so a crash mid-autosave
+    leaves the previous snapshot intact and the torn one detectably
+    invalid."""
     os.makedirs(dirpath, exist_ok=True)
     base = os.path.join(dirpath, f"{AUTOSAVE_PREFIX}{next_round:06d}")
     ckpt.save(base + ".npz", state, cfg)
     doc = {"next_round": next_round,
            "tracked": {k: list(v) for k, v in tracked.items()},
+           "recovery_history": list(recovery_hist or ()),
            "meta": log.meta, "rows": log.rows}
     # Same tmp hygiene as checkpoint._atomic_npz: sweep orphans from
     # crashed savers, unlink our own tmp on any failure — a kill between
@@ -374,15 +422,24 @@ def _autosave(dirpath: str, next_round: int, state: PeerState,
         raise
 
 
-def _cfg_at_round(cfg: CommunityConfig, by_round: dict,
-                  upto: int) -> CommunityConfig:
-    """Replay the schedule's config-affecting events (SetFault) for
-    rounds < ``upto``: the config a snapshot taken after round
-    ``upto - 1`` was saved under.  Pure — no state is touched."""
+def _cfg_at_round(cfg: CommunityConfig, by_round: dict, upto: int,
+                  recovery_history: list | None = None
+                  ) -> CommunityConfig:
+    """Replay the schedule's config-affecting events (SetFault /
+    SetRecovery) for rounds < ``upto``: the config a snapshot taken
+    after round ``upto - 1`` was saved under.  Pure — no state is
+    touched.  When an autosave sidecar's ``recovery_history`` is given
+    it is the authority for the recovery flips (the flips that actually
+    ran), applied instead of scanning ``by_round`` for SetRecovery."""
     for rnd in sorted(r for r in by_round if r < upto):
         for ev in by_round[rnd]:
             if isinstance(ev, SetFault):
                 cfg = _setfault_cfg(cfg, ev)
+            elif isinstance(ev, SetRecovery) and recovery_history is None:
+                cfg = _setrecovery_cfg(cfg, ev)
+    for rnd, kw in (recovery_history or ()):
+        if rnd < upto:
+            cfg = cfg.replace(recovery=cfg.recovery.replace(**kw))
     return cfg
 
 
@@ -409,7 +466,8 @@ def _load_latest_autosave(dirpath: str, cfg0: CommunityConfig,
             with open(sidecar) as f:
                 doc = json.load(f)
             next_round = int(doc["next_round"])
-            cfg = _cfg_at_round(cfg0, by_round, next_round)
+            cfg = _cfg_at_round(cfg0, by_round, next_round,
+                                doc.get("recovery_history"))
             state = ckpt.restore(path, cfg)
         except (CheckpointError, OSError, ValueError, KeyError) as e:
             logger.warning("autosave %s unusable (%s: %s); falling back "
@@ -482,6 +540,7 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
         raise ValueError("autosave_every requires autosave_dir")
     tracked: dict[str, tuple] = {}
     ctx: dict = {}
+    recovery_hist: list = []   # applied SetRecovery flips: [round, kw]
     start_round = 0
     state = None
     if resume:
@@ -491,6 +550,8 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
         if got is not None:
             state, cfg, start_round, doc = got
             tracked = {k: tuple(v) for k, v in doc["tracked"].items()}
+            recovery_hist = [[int(r), dict(kw)] for r, kw in
+                             doc.get("recovery_history", ())]
             log.meta = doc.get("meta", log.meta)
             log.rows = list(doc.get("rows", ()))
             logger.info("resuming scenario at round %d from %s",
@@ -505,6 +566,10 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
     while rnd < scenario.rounds:
         for ev in by_round.get(rnd, ()):
             state, cfg = _apply(state, cfg, ev, tracked, ctx)
+            if isinstance(ev, SetRecovery):
+                # Record the applied flip for the autosave sidecar so a
+                # resume that straddles it replays the same config.
+                recovery_hist.append([rnd, _setrecovery_kw(ev)])
         # Device-resident fast path (telemetry ring, OBSERVABILITY.md):
         # with a round-history ring compiled in and nothing forcing a
         # per-round host visit (no tracked coverage, snapshot_every=1),
@@ -525,5 +590,5 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
             rnd += 1
         if scenario.autosave_every and rnd % scenario.autosave_every == 0:
             _autosave(scenario.autosave_dir, rnd, state, cfg,
-                      tracked, log)
+                      tracked, log, recovery_hist)
     return jax.block_until_ready(state), log

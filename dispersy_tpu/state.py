@@ -62,6 +62,17 @@ class Stats:
     #   the reference's conversion.py decode/signature failures).
     #   Zero-width when neither channel is enabled (state.py PeerState
     #   `health` note)
+    # Recovery-plane action counters (dispersy_tpu/recovery.py;
+    # RECOVERY.md).  All zero-width unless cfg.recovery.enabled — the
+    # `health` idiom:
+    recov_soft: jnp.ndarray       # u32[N] soft-repair actions (bits
+    #   latched >= 1 round acted on + cleared at the wrap-up)
+    recov_backoff: jnp.ndarray    # u32[N] walk-backoff exponent bumps
+    recov_quarantine: jnp.ndarray  # u32[N] quarantine escalations
+    #   (supervised wiped-disk rebirths)
+    recov_cleared: jnp.ndarray    # u32[N, NUM_HEALTH_BITS] health bits
+    #   cleared by a recovery action, per sentinel bit — the MTTR
+    #   denominator (recovery.mttr_report)
     # Active missing-proof round trips (reference: community.py
     # on_missing_proof serving dispersy-missing-proof requests;
     # config.proof_requests):
@@ -122,7 +133,8 @@ class PeerState:
     health: jnp.ndarray       # u32[N]   latched health-sentinel bitmask
     #   (faults.HEALTH_*; set inside the fused step when
     #   cfg.faults.health_checks, cleared only by churn rebirth — a
-    #   wiped-disk restart is a new process).  Sized ZERO-WIDTH when
+    #   wiped-disk restart is a new process — or by a recovery-plane
+    #   repair action when cfg.recovery.enabled, RECOVERY.md).  Sized ZERO-WIDTH when
     #   health_checks is off — the dly_* idiom — so the disabled fused
     #   step stays cost-analysis-identical (faults.adapt_state resizes
     #   on a SetFault knob flip).
@@ -131,6 +143,23 @@ class PeerState:
     #   property of the peer's access link — like the NAT type it
     #   survives churn rebirth and unload/load.  Zero-width when the GE
     #   channel is disabled (see `health`).
+
+    # ---- recovery plane (dispersy_tpu/recovery.py; RECOVERY.md).
+    #      Every leaf is zero-width unless cfg.recovery.enabled — the
+    #      `health` idiom (recovery.adapt_state resizes on a
+    #      SetRecovery flip). ----
+    backoff: jnp.ndarray      # u8[N] walk-backoff exponent: a peer
+    #   with exponent e walks one round in 2^e (ops/recovery.
+    #   backoff_gate), bumped by drop-limit repairs, decayed on clean
+    #   rounds.  Process memory: reset by churn rebirth.
+    quar_until: jnp.ndarray   # u32[N] first round the peer may walk /
+    #   be selected again after a quarantine escalation (0 = never
+    #   quarantined).  The OVERLAY's decision about the peer — like the
+    #   NAT type it survives churn rebirth.
+    repair_round: jnp.ndarray  # u32[N] post-step round of the last
+    #   soft repair (0 = never) — the re-latch hysteresis counter: a
+    #   bit re-latching within recovery.requarantine_window of this
+    #   escalates to quarantine.  Reset by churn rebirth.
 
     # ---- telemetry plane (dispersy_tpu/telemetry.py; OBSERVABILITY.md).
     #      Every leaf is zero-width while its TelemetryConfig knob is
@@ -229,9 +258,12 @@ class PeerState:
 FLAG_UNDONE = 1
 
 
-def init_stats(n: int, n_meta: int = 8, n_corrupt: int | None = None) -> Stats:
+def init_stats(n: int, n_meta: int = 8, n_corrupt: int | None = None,
+               n_recov: int = 0) -> Stats:
     # Distinct buffers on purpose: aliased arrays break donation
     # (Execute() rejects the same buffer donated twice).
+    from dispersy_tpu.recovery import NUM_HEALTH_BITS
+
     def z():
         return jnp.zeros((n,), jnp.uint32)
     return Stats(walk_success=z(), walk_fail=z(), msgs_stored=z(),
@@ -240,6 +272,11 @@ def init_stats(n: int, n_meta: int = 8, n_corrupt: int | None = None) -> Stats:
                  msgs_delayed=z(),
                  msgs_corrupt_dropped=jnp.zeros(
                      (n if n_corrupt is None else n_corrupt,), jnp.uint32),
+                 recov_soft=jnp.zeros((n_recov,), jnp.uint32),
+                 recov_backoff=jnp.zeros((n_recov,), jnp.uint32),
+                 recov_quarantine=jnp.zeros((n_recov,), jnp.uint32),
+                 recov_cleared=jnp.zeros((n_recov, NUM_HEALTH_BITS),
+                                         jnp.uint32),
                  proof_requests=z(), proof_records=z(),
                  seq_requests=z(), seq_records=z(),
                  mm_requests=z(), mm_records=z(),
@@ -347,6 +384,14 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         health=jnp.zeros(
             (n if config.faults.health_checks else 0,), jnp.uint32),
         ge_bad=jnp.zeros((n if config.faults.ge_enabled else 0,), bool),
+        # Recovery-plane leaves size to their master knob the same way
+        # (zero-width when compiled out; recovery.adapt_state resizes).
+        backoff=jnp.zeros(
+            (n if config.recovery.enabled else 0,), jnp.uint8),
+        quar_until=jnp.zeros(
+            (n if config.recovery.enabled else 0,), jnp.uint32),
+        repair_round=jnp.zeros(
+            (n if config.recovery.enabled else 0,), jnp.uint32),
         # Telemetry-plane leaves size to their knobs the same way
         # (telemetry.row_width is 0 when disabled).
         walk_streak=jnp.zeros(
@@ -395,7 +440,8 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         stats=init_stats(
             n, config.n_meta,
             n_corrupt=(n if (config.faults.corrupt_rate > 0.0
-                             or config.faults.flood_enabled) else 0)),
+                             or config.faults.flood_enabled) else 0),
+            n_recov=(n if config.recovery.enabled else 0)),
         key=jax.random.key_data(key) if key.dtype != jnp.uint32 else key,
         time=jnp.float32(0.0),
         round_index=jnp.uint32(0),

@@ -179,6 +179,15 @@ def row_schema(cfg) -> tuple:
     entries += [(f"health_{nm}", "u32") for nm in HEALTH_NAMES]
     entries += [(f"accepted_by_meta_{i}", "u64")
                 for i in range(cfg.n_meta + 1)]
+    if cfg.recovery.enabled:
+        # Recovery-plane action totals (dispersy_tpu/recovery.py;
+        # RECOVERY.md).  CONDITIONAL on the master knob so a
+        # recovery-off row stays byte-identical to the pre-recovery
+        # schema — the same rule histograms follow.
+        entries += [("recov_soft", "u64"), ("recov_backoff", "u64"),
+                    ("recov_quarantine", "u64")]
+        entries += [(f"recov_cleared_{nm}", "u64")
+                    for nm in HEALTH_NAMES]
     if cfg.telemetry.histograms:
         entries += [(f"hist_{name}", "hist")
                     for name, _, _ in hist_specs(cfg)]
@@ -380,6 +389,18 @@ def row_to_snapshot(row: np.ndarray, cfg) -> dict:
         out[f"health_{nm}"] = raw[f"health_{nm}"]
     out["accepted_by_meta"] = [raw[f"accepted_by_meta_{i}"]
                                for i in range(cfg.n_meta + 1)]
+    if cfg.recovery.enabled:
+        # Recovery-plane surfacing (recovery.py; RECOVERY.md): action
+        # totals, per-bit clears, and the instantaneous availability
+        # (fraction of peers unflagged this round — the peer-round
+        # availability over a window comes from recovery.mttr_report).
+        from dispersy_tpu.recovery import availability_of
+        for nm in ("recov_soft", "recov_backoff", "recov_quarantine"):
+            out[nm] = raw[nm]
+        for nm in HEALTH_NAMES:
+            out[f"recov_cleared_{nm}"] = raw[f"recov_cleared_{nm}"]
+        out["availability"] = availability_of(raw["health_flagged"],
+                                              cfg.n_peers)
     if cfg.telemetry.histograms:
         for name, kind, cap in hist_specs(cfg):
             counts = raw[f"hist_{name}"]

@@ -392,11 +392,18 @@ def test_pre_v11_single_archives_load_as_one_replica_fleet(tmp_path):
     st = jax.block_until_ready(E.step(st, TC_CFG))
     v11 = str(tmp_path / "single_v11.npz")
     ckpt.save(v11, st, TC_CFG)
-    # v10 stamp: v11 singles are leaf-for-leaf the v10 format
+    # v10 down-stamp: strip the v12 recovery leaves (zero-width under
+    # the default RecoveryConfig) and carry the v10 fingerprint
+    # (pre-``recovery`` field).
     v10 = str(tmp_path / "single_v10.npz")
     with np.load(v11) as z:
-        arrays = {k: z[k] for k in z.files}
+        arrays = {k: z[k] for k in z.files
+                  if not any(t in k for t in
+                             ("backoff", "quar_until", "repair_round",
+                              "recov_"))}
     arrays["meta:version"] = np.asarray(10)
+    arrays["meta:config"] = np.frombuffer(
+        ckpt._want_fingerprint(TC_CFG, 10).encode(), dtype=np.uint8)
     np.savez_compressed(v10, **arrays)
     v7 = str(tmp_path / "single_v7.npz")
     _as_v7(v11, v7)

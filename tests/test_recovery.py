@@ -1,0 +1,611 @@
+"""Recovery plane: staged repair, backoff, quarantine, MTTR accounting.
+
+The detect->repair->verify loop over PR 4's health sentinels
+(dispersy_tpu/recovery.py; RECOVERY.md) must hold to the same
+differential bar as every other subsystem — bit-exact vs the
+pure-Python oracle through soft repairs, backoff bumps/decay, and
+quarantine rebirths — while the headline behavioral claim is pinned
+directly: under the PR-4 combined chaos scenario, recovery-on keeps
+``health_flagged`` bounded where recovery-off grows monotonically.
+Crash-resume through ``SetRecovery`` flips, checkpoint v12 compat, the
+fleet-traced ``backoff_decay`` route, and the MTTR/availability golden
+gate ride along.
+"""
+
+import glob
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispersy_tpu import checkpoint as ckpt
+from dispersy_tpu import engine as E
+from dispersy_tpu import metrics
+from dispersy_tpu import recovery as RC
+from dispersy_tpu import scenario as SC
+from dispersy_tpu import state as S
+from dispersy_tpu.config import EMPTY_META, EMPTY_U32, CommunityConfig
+from dispersy_tpu.exceptions import CheckpointError, ConfigError
+from dispersy_tpu.faults import FaultModel
+from dispersy_tpu.oracle import sim as O
+from dispersy_tpu.recovery import RecoveryConfig
+from dispersy_tpu.telemetry import TelemetryConfig
+
+from test_faults import draw_fault_model
+from test_oracle import assert_match
+
+BASE = CommunityConfig(n_peers=32, n_trackers=2, msg_capacity=32,
+                       bloom_capacity=16, k_candidates=8, request_inbox=4,
+                       tracker_inbox=8, response_budget=4)
+
+# The PR-4 combined chaos scenario (test_faults.test_all_channels_
+# together_trace's mix): GE bursty loss + partitions + dup + corruption
+# + byzantine flood, with the health sentinels armed.
+CHAOS = FaultModel(ge_p_bad=0.25, ge_p_good=0.5, ge_loss_bad=0.7,
+                   ge_loss_good=0.05, partitions=(((2, 12), (22, 32)),),
+                   dup_rate=0.2, corrupt_rate=0.1,
+                   flood_senders=(7, 13), flood_fanout=24,
+                   health_checks=True, health_drop_limit=2)
+RECOV = RecoveryConfig(enabled=True, backoff_limit=3, backoff_decay=0.5,
+                       quarantine_rounds=5, requarantine_window=4)
+
+
+def run_both(cfg, rounds, seed=1, author=20, warm=4):
+    """Engine vs oracle lockstep (every PeerState field incl. the
+    recovery leaves/counters, via test_oracle.assert_match)."""
+    state = S.init_state(cfg, jax.random.PRNGKey(seed))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    if warm:
+        state = E.seed_overlay(state, cfg, degree=warm)
+        oracle.seed_overlay(degree=warm)
+    if author is not None:
+        mask = np.arange(cfg.n_peers) == author
+        payload = np.full(cfg.n_peers, 42, np.uint32)
+        state = E.create_messages(state, cfg, jnp.asarray(mask), meta=1,
+                                  payload=jnp.asarray(payload))
+        oracle.create_messages(mask, meta=1, payload=payload)
+    for rnd in range(rounds):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle,
+                     f"recovery-round{rnd}")
+    return jax.block_until_ready(state), oracle
+
+
+# ---- config validation -------------------------------------------------
+
+
+def test_config_validation():
+    with pytest.raises(ConfigError, match="backoff_limit"):
+        RecoveryConfig(backoff_limit=17)
+    with pytest.raises(ConfigError, match="backoff_decay"):
+        RecoveryConfig(backoff_decay=1.5)
+    with pytest.raises(ConfigError, match="requarantine_window"):
+        RecoveryConfig(requarantine_window=0)
+    with pytest.raises(ConfigError, match="health_checks"):
+        BASE.replace(recovery=RecoveryConfig(enabled=True))
+    # enabled + health_checks is fine
+    BASE.replace(faults=FaultModel(health_checks=True),
+                 recovery=RecoveryConfig(enabled=True))
+
+
+def test_disabled_leaves_are_zero_width():
+    st = S.init_state(BASE, jax.random.PRNGKey(0))
+    assert st.backoff.shape == (0,)
+    assert st.quar_until.shape == (0,)
+    assert st.repair_round.shape == (0,)
+    assert st.stats.recov_soft.shape == (0,)
+    assert st.stats.recov_cleared.shape == (0, RC.NUM_HEALTH_BITS)
+
+
+# ---- oracle parity through every stage ---------------------------------
+
+
+def test_all_recovery_stages_trace():
+    """Flood pressure over a tiny drop limit drives soft repairs,
+    backoff bumps, AND quarantine escalations within 16 rounds — all
+    bit-exact vs the oracle (assert_match covers the recovery leaves
+    and the recov_* counters), with churn + corruption on top."""
+    fm = FaultModel(flood_senders=(5, 9), flood_fanout=24, dup_rate=0.2,
+                    corrupt_rate=0.1, health_checks=True,
+                    health_drop_limit=2)
+    cfg = BASE.replace(bloom_capacity=4, push_inbox=2, packet_loss=0.05,
+                       churn_rate=0.03, faults=fm, recovery=RECOV,
+                       telemetry=TelemetryConfig(
+                           enabled=True, history=6, histograms=True,
+                           flight_recorder=8, flight_per_round=3))
+    state, oracle = run_both(cfg, rounds=16)
+    # telemetry plane parity on top (rows carry the recov_* words)
+    want = oracle.state_arrays()
+    for f in ("tele_row", "tele_ring", "fr_ring", "fr_pos"):
+        np.testing.assert_array_equal(np.asarray(getattr(state, f)),
+                                      want[f], err_msg=f)
+    soft = int(np.asarray(state.stats.recov_soft, np.uint64).sum())
+    bumps = int(np.asarray(state.stats.recov_backoff, np.uint64).sum())
+    quar = int(np.asarray(state.stats.recov_quarantine,
+                          np.uint64).sum())
+    assert soft > 0 and bumps > 0 and quar > 0, \
+        f"stages not exercised: soft={soft} bumps={bumps} quar={quar}"
+    rep = RC.recovery_report(state, cfg)
+    assert rep["recov_soft"] == soft
+    cleared = int(np.asarray(state.stats.recov_cleared,
+                             np.uint64).sum())
+    assert cleared > 0
+
+
+def test_combined_chaos_trace():
+    """The full PR-4 chaos mix with recovery on stays bit-exact."""
+    cfg = BASE.replace(packet_loss=0.1, push_inbox=2, faults=CHAOS,
+                       recovery=RECOV)
+    run_both(cfg, rounds=10, author=5)
+
+
+# ---- the headline claim: bounded vs monotone ---------------------------
+
+
+def _chaos_cfg(recovery_on: bool) -> CommunityConfig:
+    return BASE.replace(
+        push_inbox=2, packet_loss=0.05, faults=CHAOS,
+        recovery=RECOV if recovery_on else RecoveryConfig(),
+        telemetry=TelemetryConfig(enabled=True, history=64))
+
+
+def _flagged_curve(cfg, rounds, seed=2):
+    state = S.init_state(cfg, jax.random.PRNGKey(seed))
+    state = E.seed_overlay(state, cfg, degree=4)
+    log = metrics.MetricsLog()
+    state = E.multi_step(state, cfg, rounds)
+    log.extend_from_ring(jax.block_until_ready(state), cfg)
+    return state, log, [int(r["health_flagged"]) for r in log.rows]
+
+
+def test_steady_state_bounded_vs_monotone():
+    """Under the combined chaos scenario, recovery-OFF health latches
+    accumulate monotonically (nothing ever repairs a peer), while
+    recovery-ON reaches a steady state bounded well below the off
+    run's endpoint — the detect->repair->verify loop closing."""
+    rounds = 40
+    _, _, off = _flagged_curve(_chaos_cfg(False), rounds)
+    state_on, log_on, on = _flagged_curve(_chaos_cfg(True), rounds)
+    members = BASE.n_peers - BASE.n_trackers
+    # off: latched forever => nondecreasing, and the chaos mix flags a
+    # large fraction of the overlay by the end
+    assert all(b >= a for a, b in zip(off, off[1:])), off
+    assert off[-1] >= members // 2, off
+    # on: bounded — the steady-state tail never approaches the off
+    # run's monotone endpoint
+    tail = on[rounds // 2:]
+    assert max(tail) <= off[-1] // 2, (max(tail), off[-1])
+    # and the loop actually cycled: repairs + quarantines happened
+    assert int(np.asarray(state_on.stats.recov_soft,
+                          np.uint64).sum()) > 0
+    # MTTR derives from the ring rows: clears happened, so the repaired
+    # bits report a finite MTTR and availability reflects the bound
+    rep = RC.mttr_report(log_on.rows, n_peers=BASE.n_peers)
+    assert rep["rounds"] == rounds
+    assert any(rep[f"clears_{nm}"] > 0
+               for nm in ("inbox_drop", "bloom_saturated",
+                          "counter_wrap", "store_invariant"))
+    assert 0.0 < rep["availability"] <= 1.0
+
+
+# ---- store repair kernel (unit) ----------------------------------------
+
+
+def test_store_repair_restores_invariant():
+    """A deliberately scrambled store ring (out of order, duplicate
+    (gt, member) identities, holes interspersed) is repaired to exactly
+    the sorted/unique/holes-last canonical form on masked rows only."""
+    from dispersy_tpu.ops import faults as flt
+    from dispersy_tpu.ops import recovery as rcv
+    from dispersy_tpu.ops import store as st
+
+    gt = jnp.asarray([[5, 2, EMPTY_U32, 2, 9],
+                      [1, 2, 3, 4, 5]], jnp.uint32)
+    member = jnp.asarray([[1, 7, EMPTY_U32, 7, 3],
+                          [1, 1, 1, 1, 1]], jnp.uint32)
+    meta = jnp.asarray([[1, 2, EMPTY_META, 3, 4],
+                        [1, 1, 1, 1, 1]], jnp.uint8)
+    payload = jnp.asarray([[10, 20, EMPTY_U32, 30, 40],
+                           [1, 2, 3, 4, 5]], jnp.uint32)
+    aux = jnp.asarray([[0, 1, 0, 2, 3], [0, 0, 0, 0, 0]], jnp.uint32)
+    flags = jnp.zeros((2, 5), jnp.uint8)
+    stc = st.StoreCols(gt=gt, member=member, meta=meta, payload=payload,
+                       aux=aux, flags=flags)
+    assert bool(flt.store_invariant_violated(gt, member)[0])
+    out = rcv.store_repair(stc, jnp.asarray([True, False]))
+    # row 0: sorted by (gt, member), dup (2, 7) deduped keep-first,
+    # holes compacted last
+    np.testing.assert_array_equal(
+        np.asarray(out.gt[0]), [2, 5, 9, EMPTY_U32, EMPTY_U32])
+    np.testing.assert_array_equal(
+        np.asarray(out.member[0]), [7, 1, 3, EMPTY_U32, EMPTY_U32])
+    np.testing.assert_array_equal(np.asarray(out.payload[0]),
+                                  [20, 10, 40, EMPTY_U32, EMPTY_U32])
+    assert not bool(flt.store_invariant_violated(
+        out.gt, out.member).any())
+    # row 1 (unmasked) untouched
+    np.testing.assert_array_equal(np.asarray(out.gt[1]),
+                                  np.asarray(gt[1]))
+
+
+# ---- scenario events + crash-resume ------------------------------------
+
+
+def _recovery_scenario(d, every=0):
+    return SC.Scenario(rounds=14, events=[
+        (0, SC.Create(meta=0, authors=[5], payload=42, track="post")),
+        (3, SC.SetFault(flood_senders=(7,), flood_fanout=24,
+                        health_checks=True, health_drop_limit=2)),
+        (5, SC.SetRecovery(enabled=True, quarantine_rounds=4,
+                           requarantine_window=3, backoff_limit=3)),
+        (11, SC.SetRecovery(enabled=False)),
+    ], autosave_every=every, autosave_dir=d)
+
+
+def test_setrecovery_scenario_resizes_leaves():
+    cfg = BASE.replace(push_inbox=2)
+    state, log = SC.run(cfg, _recovery_scenario(None))
+    # recovery was disabled again at round 11: leaves compiled back out
+    assert state.backoff.shape == (0,)
+    assert state.stats.recov_soft.shape == (0,)
+    assert len(log.rows) == 14
+
+
+def test_autosave_resume_straddles_setrecovery(tmp_path):
+    """Kill-and-resume equals uninterrupted ACROSS a SetRecovery flip:
+    crashing before the enable flip replays it live from the schedule;
+    crashing after (between the enable and disable flips) restores the
+    flipped config from the sidecar's recovery_history — both
+    leaf-for-leaf bit-identical.  One reference run serves both crash
+    points (the jit cache makes the replays cheap)."""
+    cfg = BASE.replace(push_inbox=2)
+    ref_state, ref_log = SC.run(cfg, _recovery_scenario(None))
+    for crash_after in (1, 2):        # snapshots kept: round 3 / 3+6
+        d = str(tmp_path / f"autosaves_{crash_after}")
+        SC.run(cfg, _recovery_scenario(d, every=3))
+        saves = sorted(glob.glob(os.path.join(d, "auto_*.npz")))
+        assert len(saves) == 4        # rounds 3, 6, 9, 12
+        for p in saves[crash_after:]:  # crash: later snapshots vanish
+            os.remove(p)
+            os.remove(p[:-4] + ".json")
+        res_state, res_log = SC.run(cfg, _recovery_scenario(d, every=3),
+                                    resume=True)
+        for la, lb in zip(jax.tree_util.tree_leaves(ref_state),
+                          jax.tree_util.tree_leaves(res_state)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        assert res_log.rows == ref_log.rows, crash_after
+
+
+# ---- checkpoint v12 ----------------------------------------------------
+
+RCFG = BASE.replace(push_inbox=2,
+                    faults=FaultModel(flood_senders=(5,), flood_fanout=24,
+                                      health_checks=True,
+                                      health_drop_limit=2),
+                    recovery=RECOV)
+
+
+def test_checkpoint_v12_roundtrip_bit_exact(tmp_path):
+    state = S.init_state(RCFG, jax.random.PRNGKey(0))
+    state = E.seed_overlay(state, RCFG, 4)
+    for _ in range(6):
+        state = E.step(state, RCFG)
+    state = jax.block_until_ready(state)
+    assert int(np.asarray(state.stats.recov_soft,
+                          np.uint64).sum()) > 0     # non-trivial state
+    path = str(tmp_path / "t12.npz")
+    ckpt.save(path, state, RCFG)
+    restored = jax.tree_util.tree_map(jnp.asarray,
+                                      ckpt.restore(path, RCFG))
+    a, b = E.step(restored, RCFG), E.step(state, RCFG)
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_checkpoint_v11_archive_still_loads(tmp_path):
+    """A v11 archive (no recovery leaves) loads under the default
+    RecoveryConfig and is refused under a non-default one."""
+    cfg = BASE
+    state = S.init_state(cfg, jax.random.PRNGKey(0))
+    for _ in range(2):
+        state = E.step(state, cfg)
+    state = jax.block_until_ready(state)
+    path = str(tmp_path / "t11.npz")
+    ckpt.save(path, state, cfg)
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files
+                  if not any(t in k for t in
+                             ("backoff", "quar_until", "repair_round",
+                              "recov_"))}
+    arrays["meta:version"] = np.asarray(11)
+    arrays["meta:config"] = np.frombuffer(
+        ckpt._want_fingerprint(cfg, 11).encode(), dtype=np.uint8)
+    np.savez_compressed(path, **arrays)
+    restored = ckpt.restore(path, cfg)
+    np.testing.assert_array_equal(np.asarray(restored.store_gt),
+                                  np.asarray(state.store_gt))
+    assert restored.backoff.shape == (0,)
+    # ...but a non-default RecoveryConfig must be refused against it
+    with pytest.raises(CheckpointError, match="recovery"):
+        ckpt.restore(path, RCFG)
+    # and it still feeds fleet tooling as a 1-replica fleet
+    fstate, ov = ckpt.restore_fleet(path, cfg)
+    assert int(np.shape(fstate.round_index)[0]) == 1 and ov is None
+
+
+# ---- fleet route: traced backoff_decay ---------------------------------
+
+
+def test_fleet_traced_backoff_decay_bit_identical():
+    """A 1-replica fleet whose traced backoff_decay equals the static
+    config's knob advances bit-identically to the serial engine (and
+    hence the oracle) — the recovery analogue of the PR-8 override
+    plumb check."""
+    from dispersy_tpu import fleet as FL
+
+    cfg = BASE.replace(push_inbox=2, bloom_capacity=4,
+                       faults=FaultModel(flood_senders=(5,),
+                                         flood_fanout=24,
+                                         health_checks=True,
+                                         health_drop_limit=2),
+                       recovery=RECOV)
+    ov = FL.make_overrides(cfg, backoff_decay=[cfg.recovery.backoff_decay])
+    state = S.init_state(cfg, jax.random.PRNGKey(3))
+    state = E.seed_overlay(state, cfg, 4)
+    serial = state
+    fstate = FL.stack_states([state])
+    for _ in range(8):
+        serial = E.step(serial, cfg)
+        fstate = FL.fleet_step(fstate, cfg, ov)
+    routed = FL.replica(jax.block_until_ready(fstate), 0)
+    for x, y in zip(jax.tree_util.tree_leaves(
+                        jax.block_until_ready(serial)),
+                    jax.tree_util.tree_leaves(routed)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    with pytest.raises(ConfigError, match="recovery.enabled"):
+        FL.make_overrides(BASE, backoff_decay=[0.5])
+
+
+# ---- fuzz axis (tools/fuzz_sweep.py --recovery) ------------------------
+
+
+def draw_recovery_config(rng: np.random.Generator) -> RecoveryConfig:
+    return RecoveryConfig(
+        enabled=True,
+        soft_repair=bool(rng.integers(0, 2)),
+        backoff_limit=int(rng.choice([0, 2, 4])),
+        backoff_decay=float(rng.choice([0.3, 1.0])),
+        quarantine_rounds=int(rng.choice([0, 4, 8])),
+        requarantine_window=int(rng.choice([2, 6])))
+
+
+def _recovery_route_overrides(cfg):
+    """Liftable knobs of a recovery draw as 1-replica traced override
+    columns (values == the config's own, so the routed run must equal
+    the serial one bit-for-bit); None for non-liftable draws
+    (partitions / flood fall back serial, the --fleet contract)."""
+    from dispersy_tpu import fleet as FL
+    fm = cfg.faults
+    if fm.partitions or fm.flood_enabled:
+        return None
+    knobs = {"backoff_decay": [cfg.recovery.backoff_decay]}
+    if cfg.packet_loss > 0.0:
+        knobs["packet_loss"] = [cfg.packet_loss]
+    if fm.dup_rate > 0.0:
+        knobs["dup_rate"] = [fm.dup_rate]
+    if fm.corrupt_rate > 0.0:
+        knobs["corrupt_rate"] = [fm.corrupt_rate]
+    if fm.ge_enabled:
+        knobs.update(ge_p_bad=[fm.ge_p_bad], ge_p_good=[fm.ge_p_good],
+                     ge_loss_good=[fm.ge_loss_good],
+                     ge_loss_bad=[fm.ge_loss_bad])
+    return FL.make_overrides(cfg, **knobs)
+
+
+def run_recovery_draw(seed: int, fleet: bool = False) -> None:
+    """One fuzz draw over the RecoveryConfig x FaultModel grid: random
+    recovery knobs over a random chaos model on a random small overlay,
+    bit-exact vs oracle every round.  The ``--recovery`` axis of
+    tools/fuzz_sweep.py; ``fleet=True`` routes liftable draws through a
+    1-replica traced fleet (incl. backoff_decay) like PR 8 did for
+    fault rates."""
+    rng = np.random.default_rng(seed)
+    n_trackers = int(rng.integers(1, 3))
+    n_peers = n_trackers + int(rng.integers(10, 30))
+    fm = draw_fault_model(rng, n_peers, n_trackers).replace(
+        health_checks=True,
+        health_drop_limit=int(rng.choice([2, 8])))
+    cfg = CommunityConfig(
+        n_peers=n_peers, n_trackers=n_trackers,
+        k_candidates=int(rng.choice([4, 8])),
+        msg_capacity=int(rng.choice([16, 32])),
+        bloom_capacity=int(rng.choice([8, 16])),
+        request_inbox=int(rng.choice([2, 4])),
+        tracker_inbox=int(rng.choice([4, 8])),
+        response_budget=int(rng.choice([2, 6])),
+        forward_fanout=int(rng.choice([0, 2, 3])),
+        push_inbox=int(rng.choice([2, 16])),
+        churn_rate=float(rng.choice([0.0, 0.05])),
+        packet_loss=float(rng.choice([0.0, 0.15])),
+        n_meta=4, faults=fm,
+        recovery=draw_recovery_config(rng))
+    state = S.init_state(cfg, jax.random.PRNGKey(seed))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=4)
+    oracle.seed_overlay(degree=4)
+    ov = _recovery_route_overrides(cfg) if fleet else None
+    via_fleet = fleet and ov is not None
+    if via_fleet:
+        from dispersy_tpu import fleet as FL
+    for rnd in range(10):
+        author = int(rng.integers(cfg.n_trackers, n_peers))
+        payload = int(rng.integers(1, 1 << 16))
+        mask = np.arange(n_peers) == author
+        pl = np.full(n_peers, payload, np.uint32)
+        state = E.create_messages(state, cfg, jnp.asarray(mask), 1,
+                                  jnp.asarray(pl))
+        oracle.create_messages(mask, 1, pl)
+        if via_fleet:
+            state = FL.replica(
+                FL.fleet_step(FL.stack_states([state]), cfg, ov), 0)
+        else:
+            state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle,
+                     f"recovery-seed{seed}-round{rnd} "
+                     f"fleet={via_fleet} cfg={cfg!r}")
+
+
+def test_sweep_compiler_groups_recovery_axis():
+    """tools/fleet.py: a grid over recovery.backoff_decay (traced) x
+    faults.corrupt_rate (traced) x seeds collapses into ONE compile
+    group — the recovery rate is canonicalized signature-preservingly
+    like the fault rates (FLEET.md)."""
+    from tools.fleet import compile_sweep
+
+    spec = {"base": {"n_peers": 24, "n_trackers": 2, "msg_capacity": 16,
+                     "bloom_capacity": 8, "k_candidates": 4,
+                     "request_inbox": 2, "tracker_inbox": 4,
+                     "response_budget": 2, "push_inbox": 2,
+                     "faults": {"health_checks": True,
+                                "corrupt_rate": 0.05},
+                     "recovery": {"enabled": True,
+                                  "quarantine_rounds": 4}},
+            "axes": {"seed": [0, 1],
+                     "recovery.backoff_decay": [0.25, 1.0],
+                     "faults.corrupt_rate": [0.05, 0.2]},
+            "rounds": 4}
+    groups = compile_sweep(spec)
+    assert len(groups) == 1
+    g = groups[0]
+    assert len(g["seeds"]) == 8
+    assert sorted(g["overrides"]) == ["backoff_decay", "corrupt_rate"]
+    # a STRUCTURAL recovery axis splits groups instead
+    spec["axes"]["recovery.quarantine_rounds"] = [0, 4]
+    assert len(compile_sweep(spec)) == 2
+
+
+def test_recovery_fuzz_draw_0():
+    run_recovery_draw(7000)
+
+
+def test_recovery_fuzz_draw_1():
+    run_recovery_draw(7001, fleet=True)
+
+
+@pytest.mark.slow
+def test_recovery_fuzz_grid_slow():
+    for seed in range(7002, 7010):
+        run_recovery_draw(seed)
+
+
+# ---- chaos soak: all channels + recovery, invariants held --------------
+
+
+def _soak(rounds: int, validate_every: int) -> None:
+    cfg = _chaos_cfg(True).replace(churn_rate=0.02)
+    state = S.init_state(cfg, jax.random.PRNGKey(11))
+    state = E.seed_overlay(state, cfg, degree=4)
+    members = cfg.n_peers - cfg.n_trackers
+    from dispersy_tpu.faults import debug_validate
+    for start in range(0, rounds, validate_every):
+        k = min(validate_every, rounds - start)
+        state = E.multi_step(state, cfg, k)
+        state = jax.block_until_ready(state)
+        problems = debug_validate(state, cfg)
+        assert problems == [], f"round {start + k}: {problems}"
+        snap = metrics.snapshot(state, cfg)
+        assert snap["health_flagged"] <= members // 2, \
+            f"round {start + k}: health_flagged={snap['health_flagged']}"
+
+
+def test_chaos_soak_short():
+    """Tier-1 soak: every fault channel + recovery for 60 rounds,
+    faults.debug_validate every 10, health_flagged bounded throughout
+    (the 500-round variant rides the slow mark)."""
+    _soak(rounds=60, validate_every=10)
+
+
+@pytest.mark.slow
+def test_chaos_soak_500_rounds():
+    _soak(rounds=500, validate_every=25)
+
+
+# ---- MTTR/availability: snapshot surfacing + golden gate ---------------
+
+GOLDEN_CFG = CommunityConfig(
+    n_peers=48, n_trackers=2, msg_capacity=32, bloom_capacity=16,
+    k_candidates=8, request_inbox=4, tracker_inbox=16,
+    response_budget=8, push_inbox=2,
+    faults=FaultModel(flood_senders=(9, 21), flood_fanout=24,
+                      health_checks=True, health_drop_limit=2),
+    recovery=RecoveryConfig(enabled=True, backoff_limit=3,
+                            backoff_decay=0.5, quarantine_rounds=5,
+                            requarantine_window=4),
+    telemetry=TelemetryConfig(enabled=True, history=32))
+
+GOLDEN_ROUNDS = 24
+
+
+def golden_recovery_log() -> metrics.MetricsLog:
+    """The committed artifacts/golden_recovery.json run, regenerated
+    deterministically (fixed seed, fixed config)."""
+    state = S.init_state(GOLDEN_CFG, jax.random.PRNGKey(5))
+    state = E.seed_overlay(state, GOLDEN_CFG, degree=6)
+    log = metrics.MetricsLog(meta={"n_peers": GOLDEN_CFG.n_peers,
+                                   "rounds": GOLDEN_ROUNDS})
+    state = E.multi_step(state, GOLDEN_CFG, GOLDEN_ROUNDS)
+    log.extend_from_ring(jax.block_until_ready(state), GOLDEN_CFG)
+    return log
+
+
+def test_snapshot_surfaces_recovery_fields():
+    state = S.init_state(GOLDEN_CFG, jax.random.PRNGKey(5))
+    state = E.seed_overlay(state, GOLDEN_CFG, degree=6)
+    state = jax.block_until_ready(E.multi_step(state, GOLDEN_CFG, 8))
+    snap = metrics.snapshot(state, GOLDEN_CFG)
+    for key in ("recov_soft", "recov_backoff", "recov_quarantine",
+                "availability"):
+        assert key in snap, key
+    for nm in ("counter_wrap", "store_invariant", "inbox_drop",
+               "bloom_saturated"):
+        assert f"recov_cleared_{nm}" in snap
+    assert 0.0 <= snap["availability"] <= 1.0
+    # legacy (telemetry-off) path emits the identical key set/values
+    legacy = metrics.snapshot(
+        state, GOLDEN_CFG.replace(telemetry=TelemetryConfig()))
+    for k, v in legacy.items():
+        got = snap[k]
+        if isinstance(v, float):
+            assert got == pytest.approx(v, rel=1e-6), k
+        else:
+            assert got == v, k
+
+
+def test_golden_recovery_gate(tmp_path):
+    """Re-run the committed golden recovery scenario and gate BOTH the
+    health_flagged curve and the derived MTTR/availability summary
+    against artifacts/golden_recovery.json via the CLI (gate
+    --recovery) — the regression gate for the recovery plane."""
+    log = golden_recovery_log()
+    path = str(tmp_path / "run.json")
+    log.dump(path)
+    out = subprocess.run(
+        [sys.executable, "tools/telemetry.py", "gate", path,
+         "artifacts/golden_recovery.json", "--key", "health_flagged",
+         "--rtol", "0.25", "--atol", "2", "--min-rounds", "10",
+         "--recovery"],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "MTTR/availability" in out.stdout
+    # and the mttr subcommand renders the same summary
+    out = subprocess.run(
+        [sys.executable, "tools/telemetry.py", "mttr", path],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0 and "availability" in out.stdout

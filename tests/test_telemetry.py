@@ -235,7 +235,9 @@ def test_disabled_step_cost_identical_to_pr4_baseline():
     provably compiled out.  Since the fleet plane landed this is ALSO
     the fleet-OFF pin: profiling.step_cost lowers engine.step with its
     ``overrides`` parameter at the default None, so a fleet-plane edit
-    that leaks bytes into the plain round fails here (FLEET.md)."""
+    that leaks bytes into the plain round fails here (FLEET.md).  And
+    since the recovery plane landed it is the recovery-OFF pin too —
+    the default RecoveryConfig must add zero bytes (RECOVERY.md)."""
     from dispersy_tpu import profiling
     with open("artifacts/step_cost_1M_baseline.json") as f:
         base = json.load(f)

@@ -56,6 +56,8 @@ from dispersy_tpu.config import CommunityConfig          # noqa: E402
 from dispersy_tpu.faults import (FaultModel,             # noqa: E402
                                  TRACED_FAULT_KNOBS,
                                  enablement_signature)
+from dispersy_tpu.recovery import (RecoveryConfig,       # noqa: E402
+                                   TRACED_RECOVERY_KNOBS)
 
 
 def _deep_tuple(v):
@@ -68,27 +70,43 @@ def _deep_tuple(v):
 def _build_cfg(base: dict, assignment: dict) -> CommunityConfig:
     """One grid point's full (serial-equivalent) config: ``base`` plus
     this point's axis values — traced axes included, so the point's cfg
-    IS what a serial run of that point would use."""
-    kw = {k: _deep_tuple(v) for k, v in base.items() if k != "faults"}
+    IS what a serial run of that point would use.  ``base`` may carry
+    ``"faults"`` / ``"recovery"`` dicts (FaultModel / RecoveryConfig
+    kwargs); axis keys use the ``faults.<knob>`` / ``recovery.<knob>``
+    prefixes for their fields."""
+    kw = {k: _deep_tuple(v) for k, v in base.items()
+          if k not in ("faults", "recovery")}
     fkw = dict(base.get("faults") or {})
+    rkw = dict(base.get("recovery") or {})
     for key, val in assignment.items():
         if key == "seed":
             continue
         if key.startswith("faults."):
             fkw[key[len("faults."):]] = _deep_tuple(val)
+        elif key.startswith("recovery."):
+            rkw[key[len("recovery."):]] = _deep_tuple(val)
         else:
             kw[key] = _deep_tuple(val)
-    return CommunityConfig(**kw,
-                           faults=FaultModel(**{k: _deep_tuple(v)
-                                                for k, v in fkw.items()}))
+    return CommunityConfig(
+        **kw,
+        recovery=RecoveryConfig(**{k: _deep_tuple(v)
+                                   for k, v in rkw.items()}),
+        faults=FaultModel(**{k: _deep_tuple(v) for k, v in fkw.items()}))
+
+
+def _bare(key: str) -> str:
+    for prefix in ("faults.", "recovery."):
+        if key.startswith(prefix):
+            return key[len(prefix):]
+    return key
 
 
 def _traced_axes(axes: dict) -> tuple:
     """Axis keys that lift into traced per-replica values."""
     out = []
     for key in axes:
-        bare = key[len("faults."):] if key.startswith("faults.") else key
-        if key == "seed" or bare in TRACED_FAULT_KNOBS:
+        if key == "seed" or _bare(key) in (TRACED_FAULT_KNOBS
+                                           + TRACED_RECOVERY_KNOBS):
             out.append(key)
     return tuple(out)
 
@@ -122,6 +140,10 @@ def _canonical_cfg(cfg: CommunityConfig,
         else:
             fkw.update(ge_p_bad=0.0, ge_p_good=0.0,
                        ge_loss_good=0.0, ge_loss_bad=0.0)
+    if "backoff_decay" in traced_knobs:
+        # structure-free numeric rate: any canonical value shares the
+        # program (recovery.enabled is a separate static bool)
+        kw["recovery"] = cfg.recovery.replace(backoff_decay=1.0)
     if fkw:
         kw["faults"] = fm.replace(**fkw)
     return cfg.replace(**kw) if kw else cfg
@@ -144,8 +166,7 @@ def compile_sweep(spec: dict) -> list:
         raise ValueError("sweep spec has no axes")
     base = spec.get("base") or {}
     traced = set(_traced_axes(axes))
-    traced_knobs = {k[len("faults."):] if k.startswith("faults.") else k
-                    for k in traced if k != "seed"}
+    traced_knobs = {_bare(k) for k in traced if k != "seed"}
     names = sorted(axes)
     groups: dict = {}
     for combo in itertools.product(*(axes[k] for k in names)):
@@ -165,8 +186,7 @@ def compile_sweep(spec: dict) -> list:
         # over ge_loss_bad alone must still run the base ge_p_bad).
         cols = {}
         for k in sorted(traced - {"seed"}):
-            bare = k[len("faults."):] if k.startswith("faults.") else k
-            cols[bare] = float(assignment[k])
+            cols[_bare(k)] = float(assignment[k])
         ge_knobs = ("ge_p_bad", "ge_p_good", "ge_loss_good",
                     "ge_loss_bad")
         if any(k in cols for k in ge_knobs):
@@ -177,6 +197,9 @@ def compile_sweep(spec: dict) -> list:
                 continue      # channel compiled out for this group
             if bare == "corrupt_rate" and not corrupt_on:
                 continue
+            if bare == "backoff_decay" and not cfg.recovery.enabled:
+                continue      # recovery plane compiled out
+
             grp["overrides"].setdefault(bare, []).append(val)
         grp["points"].append(assignment)
     return list(groups.values())

@@ -42,27 +42,37 @@ def main() -> None:
                          "(GE bursty loss, partitions, dup/corrupt, "
                          "byzantine flood, health sentinels) vs oracle "
                          "(test_faults.run_fault_draw)")
+    ap.add_argument("--recovery", action="store_true",
+                    help="recovery-plane draws: random RecoveryConfig "
+                         "grids over chaos-harness fault models vs "
+                         "oracle (test_recovery.run_recovery_draw); "
+                         "composes with --fleet to route liftable "
+                         "knobs (incl. backoff_decay) through traced "
+                         "overrides")
     ap.add_argument("--fleet", action="store_true",
-                    help="route --faults draws whose varied knobs are "
-                         "all traced-liftable through the fleet plane "
-                         "(dispersy_tpu/fleet.py: 1-replica vmapped "
-                         "fleet, rates as TRACED overrides) — serial "
-                         "fallback otherwise; results must stay "
-                         "bit-identical either way")
+                    help="route --faults/--recovery draws whose varied "
+                         "knobs are all traced-liftable through the "
+                         "fleet plane (dispersy_tpu/fleet.py: "
+                         "1-replica vmapped fleet, rates as TRACED "
+                         "overrides) — serial fallback otherwise; "
+                         "results must stay bit-identical either way")
     ap.add_argument("--out", default=None,
                     help="artifact path (default: artifacts/fuzz_sweep.json,"
                          " or artifacts/fuzz_sweep_adversarial.json with"
                          " --adversarial)")
     args = ap.parse_args()
-    if args.adversarial and args.faults:
-        ap.error("--adversarial and --faults are separate sweep axes")
-    if args.fleet and not args.faults:
-        ap.error("--fleet rides the --faults axis (it routes FaultModel "
-                 "draws through the fleet plane)")
+    if sum(map(bool, (args.adversarial, args.faults,
+                      args.recovery))) > 1:
+        ap.error("--adversarial / --faults / --recovery are separate "
+                 "sweep axes")
+    if args.fleet and not (args.faults or args.recovery):
+        ap.error("--fleet rides the --faults or --recovery axis (it "
+                 "routes draws through the fleet plane)")
     if args.out is None:
         args.out = ("artifacts/fuzz_sweep_adversarial.json"
                     if args.adversarial else
-                    "artifacts/fuzz_sweep_fleet.json" if args.fleet
+                    "artifacts/fuzz_sweep_recovery.json" if args.recovery
+                    else "artifacts/fuzz_sweep_fleet.json" if args.fleet
                     else "artifacts/fuzz_sweep_faults.json" if args.faults
                     else "artifacts/fuzz_sweep.json")
 
@@ -76,6 +86,12 @@ def main() -> None:
         from test_faults import run_fault_draw
         run_draw = (functools.partial(run_fault_draw, fleet=True)
                     if args.fleet else run_fault_draw)
+    elif args.recovery:
+        import functools
+
+        from test_recovery import run_recovery_draw
+        run_draw = (functools.partial(run_recovery_draw, fleet=True)
+                    if args.fleet else run_recovery_draw)
 
     passed, skipped, failed = [], [], []
     t0 = time.time()
@@ -83,6 +99,7 @@ def main() -> None:
         "tool": "fuzz_sweep", "seed_start": args.start, "seeds_run": 0,
         "adversarial": bool(args.adversarial),
         "faults": bool(args.faults),
+        "recovery": bool(args.recovery),
         "fleet": bool(args.fleet),
         "passed": 0, "skipped_invalid_config": 0, "failed": 0,
         "failed_seeds": [], "wall_seconds": 0.0,
@@ -117,6 +134,7 @@ def main() -> None:
             "seeds_run": seed - args.start + 1,
             "adversarial": bool(args.adversarial),
             "faults": bool(args.faults),
+            "recovery": bool(args.recovery),
             "fleet": bool(args.fleet),
             "passed": len(passed), "skipped_invalid_config": len(skipped),
             "failed": len(failed), "failed_seeds": failed,

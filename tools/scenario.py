@@ -47,6 +47,7 @@ EVENT_TYPES = {
     "identity": S.Identity,
     "destroy": S.Destroy,
     "set_fault": S.SetFault,
+    "set_recovery": S.SetRecovery,
     "unload": S.Unload,
     "load": S.Load,
     "checkpoint": S.Checkpoint,
@@ -65,8 +66,20 @@ def _tuplize(v):
 def load(path: str) -> tuple[CommunityConfig, S.Scenario]:
     with open(path) as f:
         doc = json.load(f)
-    cfg = CommunityConfig(**{k: _tuplize(v)
-                             for k, v in doc.get("config", {}).items()})
+    ckw = {k: _tuplize(v) for k, v in doc.get("config", {}).items()}
+    # Nested sub-config dicts construct their dataclasses (the
+    # tools/fleet.py "faults" convention, extended to every plane).
+    def _sub(key, cls):
+        if isinstance(ckw.get(key), dict):
+            ckw[key] = cls(**{k: _tuplize(v)
+                              for k, v in ckw[key].items()})
+    from dispersy_tpu.faults import FaultModel
+    from dispersy_tpu.recovery import RecoveryConfig
+    from dispersy_tpu.telemetry import TelemetryConfig
+    _sub("faults", FaultModel)
+    _sub("recovery", RecoveryConfig)
+    _sub("telemetry", TelemetryConfig)
+    cfg = CommunityConfig(**ckw)
     events = []
     for e in doc.get("events", ()):
         e = dict(e)

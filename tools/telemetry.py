@@ -16,12 +16,22 @@ binary log (``dispersy_tpu/binlog.py``, DTPL magic) — and:
         trace-comparison harness for "did this change behavior?").
     python tools/telemetry.py gate run.json golden.json --key cov_post
                                   [--rtol R] [--atol A] [--min-rounds N]
+                                  [--recovery]
         regression gate against a committed golden curve: the run's
         curve must track the golden one point-for-point within
         tolerance over their shared rounds.  Exit 2 on regression —
         wire it after any scenario whose convergence shape is a
         contract (tests/test_telemetry.py gates the committed
-        artifacts/golden_convergence.json this way).
+        artifacts/golden_convergence.json this way;
+        tests/test_recovery.py gates artifacts/golden_recovery.json
+        with --recovery, which ADDITIONALLY compares the two logs'
+        derived MTTR/availability summaries — recovery.mttr_report —
+        within the same tolerances).
+    python tools/telemetry.py mttr run.json [--n-peers N]
+        recovery-plane summary of a run log: per-health-bit MTTR
+        (rounds-to-clear, Little's law over the flagged mass and the
+        cumulative recov_cleared_* counters), clear counts, and
+        peer-round availability (recovery.mttr_report; RECOVERY.md).
 
 Exit codes: 0 ok, 1 usage/IO error, 2 divergence/regression.
 """
@@ -190,9 +200,18 @@ def cmd_diff(args) -> int:
     return 2 if bad else 0
 
 
+def _mttr_summary(meta: dict, rows: list,
+                  n_peers: int | None = None) -> dict:
+    """The run's recovery summary (recovery.mttr_report), with n_peers
+    from the argument or, failing that, the log's meta."""
+    from dispersy_tpu.recovery import mttr_report
+    n_peers = n_peers or meta.get("n_peers")
+    return mttr_report(rows, n_peers=int(n_peers) if n_peers else None)
+
+
 def cmd_gate(args) -> int:
-    _, rows = load_rows(args.run)
-    _, gold = load_rows(args.golden)
+    meta_a, rows = load_rows(args.run)
+    meta_g, gold = load_rows(args.golden)
     a, g = _by_round(rows), _by_round(gold)
     shared = sorted(set(a) & set(g))
     if len(shared) < args.min_rounds:
@@ -215,8 +234,48 @@ def cmd_gate(args) -> int:
             print(f"  round {rnd}: run={_fmt(va)} golden={_fmt(vg)} "
                   f"({why})")
         return 2
+    if args.recovery:
+        # The MTTR/availability gate: both logs' derived recovery
+        # summaries must agree field-for-field within the tolerances
+        # (None MTTRs — no clears — must agree on None-ness).  Like the
+        # curve half above, the summaries are derived over the SHARED
+        # rounds only — a run that merely extends past the golden's
+        # window must not fail on window-length artifacts.  Both sides
+        # share ONE n_peers (either meta's — the logs describe the same
+        # scenario), so a log dumped without meta cannot fail the gate
+        # on a missing-availability artifact.
+        n_peers = meta_a.get("n_peers") or meta_g.get("n_peers")
+        sa = _mttr_summary(meta_a, [a[r] for r in shared], n_peers)
+        sg = _mttr_summary(meta_g, [g[r] for r in shared], n_peers)
+        bad = []
+        for k in sorted(set(sa) | set(sg)):
+            va, vg = sa.get(k), sg.get(k)
+            if va is None and vg is None:
+                continue
+            if not (isinstance(va, (int, float))
+                    and isinstance(vg, (int, float))
+                    and _within(va, vg, args.rtol, args.atol)):
+                bad.append((k, va, vg))
+        if bad:
+            print(f"gate: recovery summary REGRESSED vs {args.golden} "
+                  f"on {len(bad)} field(s):")
+            for k, va, vg in bad[:12]:
+                print(f"  {k}: run={_fmt(va) if va is not None else None}"
+                      f" golden={_fmt(vg) if vg is not None else None}")
+            return 2
+        print(f"gate: recovery MTTR/availability summary tracks the "
+              f"golden one ({len(sa)} fields)")
     print(f"gate: {args.key} tracks the golden curve over "
           f"{len(shared)} rounds (rtol={args.rtol}, atol={args.atol})")
+    return 0
+
+
+def cmd_mttr(args) -> int:
+    meta, rows = load_rows(args.path)
+    if args.n_peers:
+        meta = {**meta, "n_peers": args.n_peers}
+    out = _mttr_summary(meta, rows)
+    print(json.dumps(out, indent=1))
     return 0
 
 
@@ -245,7 +304,17 @@ def main(argv=None) -> int:
     p.add_argument("--rtol", type=float, default=0.05)
     p.add_argument("--atol", type=float, default=0.02)
     p.add_argument("--min-rounds", type=int, default=2)
+    p.add_argument("--recovery", action="store_true",
+                   help="additionally gate the derived MTTR/"
+                        "availability summary (recovery.mttr_report)")
     p.set_defaults(fn=cmd_gate)
+    p = sub.add_parser("mttr",
+                       help="recovery-plane MTTR/availability summary")
+    p.add_argument("path")
+    p.add_argument("--n-peers", type=int, default=None,
+                   help="peer count for availability (default: the "
+                        "log meta's n_peers)")
+    p.set_defaults(fn=cmd_mttr)
     args = ap.parse_args(argv)
     try:
         return args.fn(args)

@@ -84,19 +84,29 @@ from dispersy_tpu.telemetry import TelemetryConfig
 #     (v7-v10 included) loads through ``restore_fleet`` as a 1-replica
 #     fleet; ``restore_replica`` splits one replica back out of a fleet
 #     archive for single-run post-mortem tooling.
-FORMAT_VERSION = 12  # v12: the recovery-plane leaves (backoff /
-#     quar_until / repair_round + the stats recov_* counters,
-#     knob-sized — dispersy_tpu/recovery.py; RECOVERY.md).  v7-v11
-#     archives still load: their missing recovery leaves default to the
-#     template's (zero-width) values and their config fingerprint
-#     predates the ``recovery`` field (declared third-to-last, directly
-#     before ``telemetry``) — restoring one under a non-default
-#     RecoveryConfig is refused (_want_fingerprint strips the
-#     ``recovery=...`` repr component, plus ``telemetry=`` pre-v10 and
-#     ``faults=`` pre-v9).  v11 FLEET archives load through
-#     ``restore_fleet`` the same way.
-_ACCEPTED_VERSIONS = (7, 8, 9, 10, 11, FORMAT_VERSION)
-_FLEET_VERSIONS = (11, FORMAT_VERSION)
+# v12: the recovery-plane leaves (backoff / quar_until / repair_round
+#     + the stats recov_* counters, knob-sized —
+#     dispersy_tpu/recovery.py; RECOVERY.md).  v7-v11 archives still
+#     load: their missing recovery leaves default to the template's
+#     (zero-width) values and their config fingerprint predates the
+#     ``recovery`` field (declared third-to-last, directly before
+#     ``telemetry``) — restoring one under a non-default RecoveryConfig
+#     is refused (_want_fingerprint strips the ``recovery=...`` repr
+#     component, plus ``telemetry=`` pre-v10 and ``faults=`` pre-v9).
+#     v11 FLEET archives load through ``restore_fleet`` the same way.
+FORMAT_VERSION = 13  # v13: the ingress-protection leaves (bucket +
+#     the stats msgs_shed_rate / msgs_shed_priority counters,
+#     knob-sized — dispersy_tpu/overload.py; OVERLOAD.md).  v7-v12
+#     archives still load: their missing overload leaves default to
+#     the template's (zero-width) values and their config fingerprint
+#     predates the ``overload`` field (declared fourth-to-last,
+#     directly before ``recovery``) — restoring one under a
+#     non-default OverloadConfig is refused (_want_fingerprint strips
+#     the ``overload=...`` repr component first, then the older
+#     planes').  v11/v12 FLEET archives load through ``restore_fleet``
+#     the same way.
+_ACCEPTED_VERSIONS = (7, 8, 9, 10, 11, 12, FORMAT_VERSION)
+_FLEET_VERSIONS = (11, 12, FORMAT_VERSION)
 
 # Leaves whose dtype narrowed u32 -> u8 at v8; a v7 archive's u32 arrays
 # convert by truncation (0xFFFFFFFF -> 0xFF, real values < 256 unchanged).
@@ -122,6 +132,12 @@ _NEW_V12 = frozenset(
     {"backoff", "quar_until", "repair_round",
      "stats/recov_soft", "stats/recov_backoff",
      "stats/recov_quarantine", "stats/recov_cleared"})
+
+# Leaves that did not exist before v13 (the ingress-protection plane).
+# Older archives only restore under a default OverloadConfig (enforced
+# by _want_fingerprint), where every one of these is zero-width.
+_NEW_V13 = frozenset(
+    {"bucket", "stats/msgs_shed_rate", "stats/msgs_shed_priority"})
 
 
 def _crc(arr: np.ndarray) -> int:
@@ -158,21 +174,37 @@ def _fingerprint(cfg: CommunityConfig) -> str:
 
 def _want_fingerprint(cfg: CommunityConfig, version: int) -> str:
     """The fingerprint an archive of ``version`` should carry for
-    ``cfg``.  Pre-v12 archives were written before CommunityConfig grew
-    the ``recovery`` field (declared third-to-last, directly before
-    ``telemetry``), pre-v10 ones before ``telemetry`` (second-to-last,
-    directly before ``faults``), and pre-v9 ones before ``faults``
-    (declared LAST) — every repr component strips cleanly, but only
-    default models can possibly match what the old writer simulated."""
-    if version >= 12:
+    ``cfg``.  Pre-v13 archives were written before CommunityConfig grew
+    the ``overload`` field (declared fourth-to-last, directly before
+    ``recovery``), pre-v12 ones before ``recovery`` (third-to-last,
+    directly before ``telemetry``), pre-v10 ones before ``telemetry``
+    (second-to-last, directly before ``faults``), and pre-v9 ones
+    before ``faults`` (declared LAST) — every repr component strips
+    cleanly, but only default models can possibly match what the old
+    writer simulated."""
+    if version >= 13:
         return _fingerprint(cfg)
+    from dispersy_tpu.overload import OverloadConfig
+    if cfg.overload != OverloadConfig():
+        raise CheckpointError(
+            f"checkpoint format {version} predates the ingress-"
+            "protection plane; it can only restore under the default "
+            "OverloadConfig (cfg.overload must be OverloadConfig())")
+    full = repr(cfg)
+    ocomp = f", overload={cfg.overload!r}"
+    if full.count(ocomp) != 1:
+        raise CheckpointError(
+            "cannot derive pre-v13 fingerprint: overload is no longer "
+            "a direct config field directly before recovery")
+    full = full.replace(ocomp, "", 1)
+    if version >= 12:
+        return full
     from dispersy_tpu.recovery import RecoveryConfig
     if cfg.recovery != RecoveryConfig():
         raise CheckpointError(
             f"checkpoint format {version} predates the recovery plane; "
             "it can only restore under the default RecoveryConfig "
             "(cfg.recovery must be RecoveryConfig())")
-    full = repr(cfg)
     rcomp = f", recovery={cfg.recovery!r}"
     if full.count(rcomp) != 1:
         raise CheckpointError(
@@ -304,10 +336,12 @@ def restore(path: str, cfg: CommunityConfig,
             if key not in z:
                 if (version < 9 and n in _NEW_V9) \
                         or (version < 10 and n in _NEW_V10) \
-                        or (version < 12 and n in _NEW_V12):
+                        or (version < 12 and n in _NEW_V12) \
+                        or (version < 13 and n in _NEW_V13):
                     # pre-chaos-harness / pre-telemetry / pre-recovery
-                    # archive: the leaf starts at its template default
-                    # (zero-width / empty latch / all-good channels)
+                    # / pre-overload archive: the leaf starts at its
+                    # template default (zero-width / empty latch /
+                    # all-good channels)
                     leaves.append(np.asarray(t))
                     continue
                 raise CheckpointError(f"checkpoint missing field {n}")
@@ -419,11 +453,13 @@ def restore_fleet(path: str, cfg: CommunityConfig):
             for n, t in zip(names, t_leaves):
                 key = f"leaf:{n}"
                 if key not in z:
-                    if version < 12 and n in _NEW_V12:
-                        # pre-recovery fleet archive: only accepted
-                        # under the default RecoveryConfig (fingerprint
-                        # check above), where every recovery leaf is
-                        # zero-width — replicate the template default.
+                    if (version < 12 and n in _NEW_V12) \
+                            or (version < 13 and n in _NEW_V13):
+                        # pre-recovery / pre-overload fleet archive:
+                        # only accepted under the default Recovery/
+                        # OverloadConfig (fingerprint check above),
+                        # where every such leaf is zero-width —
+                        # replicate the template default.
                         leaves.append(np.zeros((n_rep,) + tuple(t.shape),
                                                t.dtype))
                         continue
@@ -668,7 +704,8 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
             leaves.append(arr)
         elif ((version < 9 and name in _NEW_V9)
               or (version < 10 and name in _NEW_V10)
-              or (version < 12 and name in _NEW_V12)) \
+              or (version < 12 and name in _NEW_V12)
+              or (version < 13 and name in _NEW_V13)) \
                 and not covered[name].any():
             # pre-chaos-harness / pre-telemetry archive: template
             # default (state.py)

@@ -20,6 +20,7 @@ import math
 
 from dispersy_tpu.exceptions import ConfigError
 from dispersy_tpu.faults import FaultModel
+from dispersy_tpu.overload import OverloadConfig
 from dispersy_tpu.recovery import RecoveryConfig
 from dispersy_tpu.telemetry import MAX_TELEMETRY_PEERS, TelemetryConfig
 
@@ -511,6 +512,18 @@ class CommunityConfig:
     # -1 = auto: the first non-tracker peer (index n_trackers).
     founder_member: int = -1
 
+    # ---- ingress-protection plane (dispersy_tpu/overload.py:
+    #      per-sender token buckets, priority admission under inbox
+    #      overflow, flood-fair drop attribution; OVERLOAD.md).  All
+    #      defaults compile to exactly the protection-free step.  MUST
+    #      stay the FOURTH-TO-LAST field, directly before ``recovery``
+    #      (then ``telemetry``, then ``faults``): checkpoint.py
+    #      reconstructs pre-v13 config fingerprints by stripping the
+    #      trailing ``overload=...`` repr component (then
+    #      ``recovery=`` pre-v12, ``telemetry=`` pre-v10, ``faults=``
+    #      pre-v9). ----
+    overload: OverloadConfig = OverloadConfig()
+
     # ---- recovery plane (dispersy_tpu/recovery.py: staged repair of
     #      health-flagged peers — soft repair, walk backoff, quarantine
     #      with hysteresis; RECOVERY.md).  All defaults compile to
@@ -826,6 +839,9 @@ class CommunityConfig:
             if self.push_inbox < 1:
                 raise ConfigError("flooding rides the push channel: "
                                   "push_inbox must be >= 1")
+        ov = self.overload
+        if not isinstance(ov, OverloadConfig):
+            raise ConfigError("overload must be an OverloadConfig")
         rc = self.recovery
         if not isinstance(rc, RecoveryConfig):
             raise ConfigError("recovery must be a RecoveryConfig")

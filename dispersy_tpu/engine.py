@@ -74,6 +74,7 @@ from dispersy_tpu.faults import (HEALTH_BLOOM_SAT, HEALTH_COUNTER_WRAP,
 from dispersy_tpu.ops import bloom, candidates as cand, inbox, rng, store as st
 from dispersy_tpu.ops import faults as flt
 from dispersy_tpu.ops import intake as ik
+from dispersy_tpu.ops import overload as ovl
 from dispersy_tpu.ops import recovery as rcv
 from dispersy_tpu.recovery import NUM_HEALTH_BITS
 from dispersy_tpu.ops import telemetry as tele
@@ -209,6 +210,32 @@ def effective_recovery(cfg: CommunityConfig,
             "— the backoff leaf is zero-width otherwise (FLEET.md)")
     return _EffRecovery(
         backoff_decay=rc.backoff_decay if dec is None else dec)
+
+
+class _EffOverload(NamedTuple):
+    """Effective ingress-protection knobs for one traced round — the
+    overload analogue of :class:`_EffFaults`: the refill-rate VALUE may
+    be a traced per-replica f32 scalar under fleet overrides while
+    every structural decision (enabled, priority_admission,
+    bucket_depth) stays on the static ``cfg.overload``."""
+    bucket_rate: object          # python float | traced f32 scalar
+
+
+def effective_overload(cfg: CommunityConfig,
+                       overrides=None) -> _EffOverload:
+    """Resolve the liftable overload knobs against optional fleet
+    overrides (``overload.TRACED_OVERLOAD_KNOBS``; FLEET.md).  A traced
+    ``bucket_rate`` requires the overload plane compiled in — its
+    ``bucket`` / shed-counter leaves are zero-width otherwise."""
+    ov = cfg.overload
+    rate = getattr(overrides, "bucket_rate", None) \
+        if overrides is not None else None
+    if rate is not None and not ov.enabled:
+        raise ValueError(
+            "a traced bucket_rate override needs cfg.overload.enabled "
+            "— the bucket leaf is zero-width otherwise (FLEET.md)")
+    return _EffOverload(
+        bucket_rate=ov.bucket_rate if rate is None else rate)
 
 
 def _lost(seed, rnd, edge_peer, salt_base, salt, kn: _EffFaults,
@@ -610,7 +637,8 @@ def counter_matrix(stats, n: int) -> jnp.ndarray:
 
 
 def _telemetry_row(cfg: CommunityConfig, *, rnd, new_time, members, stats,
-                   stc, health, store_cnt, cand_cnt, hists) -> jnp.ndarray:
+                   stc, health, store_cnt, cand_cnt, hists,
+                   bucket=None) -> jnp.ndarray:
     """Pack the fused per-round telemetry row (u32[row_width]).
 
     Every ``metrics.snapshot`` aggregate, reduced on device and laid out
@@ -652,6 +680,18 @@ def _telemetry_row(cfg: CommunityConfig, *, rnd, new_time, members, stats,
     asum = tele.col_sum_u64(stats.accepted_by_meta)          # [2, K+1]
     for i in range(cfg.n_meta + 1):
         vals[f"accepted_by_meta_{i}"] = asum[:, i]
+    if cfg.overload.enabled:
+        # Ingress-protection words (overload.py; conditional schema
+        # words so an overload-off row stays byte-identical): the two
+        # shed streams plus the count of post-round-empty buckets —
+        # under a flood, the attackers pinned at zero credit.
+        osum = tele.col_sum_u64(jnp.stack(
+            [stats.msgs_shed_rate, stats.msgs_shed_priority],
+            axis=1))                                         # [2, 2]
+        vals["msgs_shed_rate"] = osum[:, 0]
+        vals["msgs_shed_priority"] = osum[:, 1]
+        vals["bucket_exhausted"] = w(
+            jnp.sum(bucket == jnp.uint8(0), dtype=jnp.int32))
     if cfg.recovery.enabled:
         # Recovery-plane action totals (recovery.py; conditional schema
         # words so a recovery-off row stays byte-identical): the three
@@ -707,6 +747,15 @@ def step(state: PeerState, cfg: CommunityConfig,
     # resolves the liftable numeric knob against fleet overrides.
     rc = cfg.recovery
     knr = effective_recovery(cfg, overrides)
+    # Ingress-protection plane (dispersy_tpu/overload.py): every branch
+    # below is gated on the STATIC OverloadConfig, so the default
+    # (disabled) plane compiles to the identical protection-free round
+    # (OVERLOAD.md).  ``kno`` resolves the liftable refill rate against
+    # fleet overrides; ``bucket_new`` carries the post-round balance
+    # (pass-through on rounds without a push phase).
+    ov = cfg.overload
+    kno = effective_overload(cfg, overrides)
+    bucket_new = state.bucket
     if kn.ge_on:
         # Advance each peer's Gilbert–Elliott channel once per round;
         # this round's loss draws condition on the post-transition state.
@@ -910,6 +959,19 @@ def step(state: PeerState, cfg: CommunityConfig,
         e_dst, e_valid = [], []
         e_cols: list[list] = [[] for _ in range(5)]
         e_src, e_junk = [], []
+        if ov.enabled:
+            # Per-sender token buckets (OVERLOAD.md bucket state
+            # machine): this round's credit = carried balance + refill,
+            # spent by every ATTEMPTED push/flood packet (pre-loss, the
+            # sendto boundary) in emission order; attempts beyond the
+            # balance are shed at intake — they never occupy any
+            # victim's inbox slot — and attributed to the SENDER
+            # (msgs_shed_rate: flood-fair attribution).
+            ov_credit = ovl.bucket_refill(state.bucket, seed, rnd, idx,
+                                          kno.bucket_rate,
+                                          ov.bucket_depth)      # u32[N]
+            ov_shed = jnp.zeros((n,), jnp.uint32)
+            ov_att = jnp.zeros((n,), jnp.int32)
         if cfg.forward_fanout > 0:
             f, c = cfg.forward_buffer, cfg.forward_fanout
             fwd_targets = cand.sample_forward_targets(tab, now, cfg, seed,
@@ -939,6 +1001,20 @@ def step(state: PeerState, cfg: CommunityConfig,
                 push_valid = push_valid & ~flt.partition_blocked(
                     jnp.broadcast_to(idx[:, None, None], (n, f, c)),
                     push_dst, fm.partitions)
+            if ov.enabled:
+                # Rate gate: attempt ordinal per sender in (f, c)
+                # emission order; ordinals beyond this round's credit
+                # shed (loss-independent — a lost packet still spent
+                # its credit, as it left the sender's NIC).
+                att = jnp.broadcast_to(send_rec_ok & have_rec & tgt_ok,
+                                       (n, f, c)).reshape(n, f * c)
+                ordn = jnp.cumsum(att.astype(jnp.int32), axis=1) - 1
+                in_budget = att & (ordn < ov_credit.astype(
+                    jnp.int32)[:, None])
+                ov_shed = ov_shed + jnp.sum(
+                    att & ~in_budget, axis=1).astype(jnp.uint32)
+                ov_att = ov_att + jnp.sum(att, axis=1, dtype=jnp.int32)
+                push_valid = push_valid & in_budget.reshape(n, f, c)
 
             def bcast(col):
                 return jnp.broadcast_to(col[:, :, None],
@@ -972,6 +1048,24 @@ def step(state: PeerState, cfg: CommunityConfig,
                 fl_valid = fl_valid & ~flt.partition_blocked(
                     jnp.broadcast_to(fsrc[:, None], (fl, ff)), victims,
                     fm.partitions)
+            if ov.enabled:
+                # Flood blasts spend the SAME bucket, with ordinals
+                # continuing after the sender's real-push attempts —
+                # a flooder that also relays cannot double its share.
+                # flood_senders are distinct (config-validated), so the
+                # scatter-adds below never collide.
+                att_f = jnp.broadcast_to(alive_f[:, None], (fl, ff))
+                ordf = (ov_att[fsrc][:, None]
+                        + jnp.arange(ff, dtype=jnp.int32)[None, :])
+                in_budget_f = att_f & (ordf < ov_credit[fsrc].astype(
+                    jnp.int32)[:, None])
+                ov_shed = ov_shed.at[fsrc].add(
+                    jnp.sum(att_f & ~in_budget_f,
+                            axis=1).astype(jnp.uint32), mode="drop")
+                ov_att = ov_att.at[fsrc].add(
+                    jnp.sum(att_f, axis=1, dtype=jnp.int32),
+                    mode="drop")
+                fl_valid = fl_valid & in_budget_f
             e_dst.append(victims.reshape(-1))
             e_valid.append(fl_valid.reshape(-1))
             e_cols[0].append(junk_field(1).reshape(-1))           # gt
@@ -994,10 +1088,28 @@ def step(state: PeerState, cfg: CommunityConfig,
             push_cols.append(jnp.concatenate(e_src))
         if fm.flood_enabled:
             push_cols.append(jnp.concatenate(e_junk))
+        if ov.enabled:
+            # Spend: in-budget attempts drain the balance (attempts
+            # beyond it were shed, not spent); refill happens at the
+            # NEXT round's bucket_refill.
+            bucket_new = ovl.bucket_spend(
+                ov_credit, jnp.maximum(ov_att, 0).astype(jnp.uint32))
+            stats = stats.replace(
+                msgs_shed_rate=stats.msgs_shed_rate + ov_shed)
+        if ov.enabled and ov.priority_admission:
+            # Priority admission (OVERLOAD.md class table): the
+            # wire-visible meta byte classes each packet, and the
+            # delivery kernel sheds lowest-class-last under overflow
+            # instead of first-come-first-kept — flood junk with an
+            # invalid meta byte ranks dead last.
+            push_cls = ovl.admission_class(push_cols[2], cfg.n_meta,
+                                           cfg.priorities)
+        else:
+            push_cls = None
         push = inbox.deliver(
             dst=jnp.concatenate(e_dst), cols=push_cols,
             valid=jnp.concatenate(e_valid), n_peers=n,
-            inbox_size=cfg.push_inbox)
+            inbox_size=cfg.push_inbox, cls=push_cls)
         ph_gt, ph_member, ph_meta, ph_payload, ph_aux = push.inbox[:5]
         if fm.flood_enabled:
             ph_junk = push.inbox[-1]                              # bool[N, Q]
@@ -1008,16 +1120,27 @@ def step(state: PeerState, cfg: CommunityConfig,
         else:
             arrivals = arrivals | jnp.any(push.inbox_valid, axis=1)
         ph_ok = push.inbox_valid & act[:, None]
+        # Flood-fair drop attribution (OVERLOAD.md): with the overload
+        # plane on, push-inbox overflow sheds are ADMISSION decisions —
+        # they land in the receiver's msgs_shed_priority stream, which
+        # deliberately does NOT feed the health_drop_limit sentinel, so
+        # a flooded victim's recovery plane stops punishing the victim.
+        if ov.enabled:
+            stats = stats.replace(
+                msgs_shed_priority=stats.msgs_shed_priority
+                + push.n_dropped.astype(jnp.uint32))
         if cfg.forward_fanout > 0:
             stats = stats.replace(
                 msgs_forwarded=stats.msgs_forwarded
-                + jnp.sum(push_valid, axis=(1, 2)).astype(jnp.uint32),
-                msgs_dropped=stats.msgs_dropped
-                + push.n_dropped.astype(jnp.uint32))
+                + jnp.sum(push_valid, axis=(1, 2)).astype(jnp.uint32))
+            if not ov.enabled:
+                stats = stats.replace(
+                    msgs_dropped=stats.msgs_dropped
+                    + push.n_dropped.astype(jnp.uint32))
             push_sent = send_rec_ok & have_rec & tgt_ok          # pre-loss
             bup = bup + jnp.sum(push_sent, axis=(1, 2)).astype(jnp.uint32) \
                 * jnp.uint32(RECORD_BYTES)
-        else:
+        elif not ov.enabled:
             stats = stats.replace(
                 msgs_dropped=stats.msgs_dropped
                 + push.n_dropped.astype(jnp.uint32))
@@ -2679,7 +2802,8 @@ def step(state: PeerState, cfg: CommunityConfig,
         tele_row = _telemetry_row(cfg, rnd=rnd, new_time=new_time,
                                   members=members, stats=stats, stc=stc,
                                   health=health, store_cnt=store_cnt,
-                                  cand_cnt=cand_cnt, hists=hists)
+                                  cand_cnt=cand_cnt, hists=hists,
+                                  bucket=bucket_new)
         if cfg.telemetry.history:
             # Post-step round r+1 lands at slot r % H; the row's own
             # round word identifies the slot at drain time.
@@ -2716,7 +2840,7 @@ def step(state: PeerState, cfg: CommunityConfig,
         alive=alive, loaded=loaded, session=session,
         global_time=global_time, health=health, ge_bad=ge_bad,
         backoff=backoff, quar_until=quar_until,
-        repair_round=repair_round,
+        repair_round=repair_round, bucket=bucket_new,
         walk_streak=walk_streak, tele_row=tele_row, tele_ring=tele_ring,
         fr_ring=fr_ring, fr_pos=fr_pos,
         mal_member=mal,

@@ -51,6 +51,7 @@ from dispersy_tpu.config import CommunityConfig
 from dispersy_tpu.exceptions import ConfigError
 from dispersy_tpu.faults import TRACED_FAULT_KNOBS
 from dispersy_tpu.ops import fleet as ops_fleet
+from dispersy_tpu.overload import TRACED_OVERLOAD_KNOBS
 from dispersy_tpu.recovery import TRACED_RECOVERY_KNOBS
 from dispersy_tpu.state import (PeerState, index_state, init_state,
                                 stack_states)
@@ -77,12 +78,18 @@ class FleetOverrides(NamedTuple):
     ge_loss_bad: Any = None
     # recovery plane (recovery.TRACED_RECOVERY_KNOBS; RECOVERY.md)
     backoff_decay: Any = None
+    # ingress-protection plane (overload.TRACED_OVERLOAD_KNOBS;
+    # OVERLOAD.md) — NOT a probability: credits/round in
+    # [0, bucket_depth]
+    bucket_rate: Any = None
 
 
-TRACED_KNOBS = TRACED_FAULT_KNOBS + TRACED_RECOVERY_KNOBS
+TRACED_KNOBS = (TRACED_FAULT_KNOBS + TRACED_RECOVERY_KNOBS
+                + TRACED_OVERLOAD_KNOBS)
 assert FleetOverrides._fields == TRACED_KNOBS, \
     "FleetOverrides must mirror faults.TRACED_FAULT_KNOBS + " \
-    "recovery.TRACED_RECOVERY_KNOBS exactly"
+    "recovery.TRACED_RECOVERY_KNOBS + overload.TRACED_OVERLOAD_KNOBS " \
+    "exactly"
 
 
 def make_overrides(cfg: CommunityConfig, **knobs) -> FleetOverrides:
@@ -120,6 +127,10 @@ def make_overrides(cfg: CommunityConfig, **knobs) -> FleetOverrides:
         raise ConfigError(
             "a traced backoff_decay needs cfg.recovery.enabled — the "
             "recovery leaves are zero-width otherwise (FLEET.md)")
+    if "bucket_rate" in knobs and not cfg.overload.enabled:
+        raise ConfigError(
+            "a traced bucket_rate needs cfg.overload.enabled — the "
+            "bucket leaf is zero-width otherwise (FLEET.md)")
     cols = {}
     for name, vals in knobs.items():
         arr = np.asarray(vals, np.float32)
@@ -127,9 +138,13 @@ def make_overrides(cfg: CommunityConfig, **knobs) -> FleetOverrides:
             raise ConfigError(f"{name}: override grid must be 1-D "
                               f"(one value per replica), got shape "
                               f"{arr.shape}")
-        if not ((arr >= 0.0) & (arr <= 1.0)).all():
+        # bucket_rate is credits/round (capped at the static burst
+        # depth); every other liftable knob is a probability.
+        hi = (cfg.overload.bucket_depth
+              if name == "bucket_rate" else 1)
+        if not ((arr >= 0.0) & (arr <= float(hi))).all():
             raise ConfigError(f"{name}: override values must be in "
-                              f"[0, 1], got {vals}")
+                              f"[0, {hi}], got {vals}")
         cols[name] = jnp.asarray(arr)
     return FleetOverrides(**cols)
 

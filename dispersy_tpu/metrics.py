@@ -105,6 +105,15 @@ def snapshot(state: PeerState, cfg: CommunityConfig) -> dict:
             int(x) for x in
             np.asarray(s.accepted_by_meta, dtype=np.uint64).sum(axis=0)],
     }
+    if cfg.overload.enabled:
+        # Ingress-protection totals — the SAME key set (and shared
+        # definitions, overload.shed_totals) the fused row surfaces via
+        # telemetry.row_to_snapshot, so the two paths stay
+        # schema-identical (dump_binary's contract).
+        from dispersy_tpu.overload import shed_totals
+        out.update(shed_totals(s))
+        bk = np.asarray(state.bucket)
+        out["bucket_exhausted"] = int((bk == 0).sum()) if bk.size else 0
     if cfg.recovery.enabled:
         # Recovery-plane totals + instantaneous availability — the SAME
         # key set (and shared definitions, recovery.action_totals /

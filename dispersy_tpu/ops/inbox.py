@@ -52,14 +52,17 @@ class Delivery(NamedTuple):
 
 
 @host_helper
-def packed_key_bits(n_peers: int, n_edges: int) -> int | None:
-    """Bits needed for the packed (destination, position) sort key, or
-    None when it cannot fit uint32.  The key space is [0, n_peers]
-    (``n_peers`` = the park value for undeliverable packets) shifted
+def packed_key_bits(n_peers: int, n_edges: int,
+                    cls_bits: int = 0) -> int | None:
+    """Bits needed for the packed (destination[, class], position) sort
+    key, or None when it cannot fit uint32.  The key space is
+    [0, n_peers] (``n_peers`` = the park value for undeliverable
+    packets) shifted above ``cls_bits`` admission-class bits (8 when an
+    overload-plane ``cls`` operand rides the sort, else 0) shifted
     above ``bits(n_edges - 1)`` position bits."""
     pos_bits = max(1, (n_edges - 1).bit_length()) if n_edges else 1
     key_bits = max(1, n_peers.bit_length())
-    total = key_bits + pos_bits
+    total = key_bits + cls_bits + pos_bits
     return pos_bits if total <= 32 else None
 
 
@@ -71,9 +74,11 @@ def packed_key_bits(n_peers: int, n_edges: int) -> int | None:
           dst=Spec("int32", ("E",)),
           cols=[Spec("uint32", ("E",)), Spec("uint32", ("E", "W"))],
           valid=Spec("bool", ("E",)),
-          n_peers=lambda d: d["N"], inbox_size=lambda d: d["Q"])
+          n_peers=lambda d: d["N"], inbox_size=lambda d: d["Q"],
+          cls=None)
 def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
-            valid: jnp.ndarray, n_peers: int, inbox_size: int) -> Delivery:
+            valid: jnp.ndarray, n_peers: int, inbox_size: int,
+            cls: jnp.ndarray | None = None) -> Delivery:
     """Deliver an edge list of logical packets into per-peer inboxes.
 
     ``dst``: i32[E] destination peer of each packet (any value for invalid
@@ -85,6 +90,16 @@ def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
     Delivery order within one destination is edge-list order (the sort
     key carries the edge position as tie-break), so the oracle can
     reproduce inboxes exactly.
+
+    ``cls`` (optional, the overload plane's priority admission —
+    dispersy_tpu/overload.py): a u32[E] admission class in [0, 255] per
+    edge.  When given, the within-destination order becomes
+    ``(cls, pos)`` — LOWER classes claim inbox slots first and overflow
+    sheds the highest classes instead of the latest arrivals, modeling
+    an endpoint that inspects the wire-visible message class before its
+    bounded recv buffer overflows (the reference's ``endpoint.py``
+    buffer, made class-aware).  ``None`` (the default) is byte-identical
+    to the pre-overload kernel.
 
     ``edge_slot`` is the *receipt*: the inbox slot each edge landed in (or -1
     for dropped/invalid).  It lets the sender later fetch a per-slot reply
@@ -102,8 +117,9 @@ def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
     ok = valid & (dst >= 0) & (dst < n_peers)
     key = jnp.where(ok, dst, n_peers).astype(jnp.int32)
     pos = jnp.arange(e, dtype=jnp.int32)  # carries order through the sort
-    pos_bits = packed_key_bits(n_peers, e)
-    if pos_bits is not None:
+    cls_bits = 8 if cls is not None else 0
+    pos_bits = packed_key_bits(n_peers, e, cls_bits)
+    if pos_bits is not None and cls is None:
         # One uint32 key: (key << pos_bits) | pos.  Keys are globally
         # unique, so the sort may be unstable and carries ONE operand.
         packed = ((key.astype(jnp.uint32) << pos_bits)
@@ -112,10 +128,24 @@ def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
                               num_keys=1)
         skey = (spacked >> pos_bits).astype(jnp.int32)
         spos = (spacked & jnp.uint32((1 << pos_bits) - 1)).astype(jnp.int32)
-    else:
+    elif pos_bits is not None:
+        # One uint32 key: (key << (8 + pos_bits)) | (cls << pos_bits) |
+        # pos — lexicographic (key, cls, pos) IS the packed order.
+        packed = ((key.astype(jnp.uint32) << (cls_bits + pos_bits))
+                  | (cls.astype(jnp.uint32) << pos_bits)
+                  | pos.astype(jnp.uint32))
+        (spacked,) = lax.sort((packed,), dimension=0, is_stable=False,
+                              num_keys=1)
+        skey = (spacked >> (cls_bits + pos_bits)).astype(jnp.int32)
+        spos = (spacked & jnp.uint32((1 << pos_bits) - 1)).astype(jnp.int32)
+    elif cls is None:
         # (key, pos) pairs are unique, so stability is still unnecessary.
         skey, spos = lax.sort((key, pos), dimension=0, is_stable=False,
                               num_keys=2)
+    else:
+        skey, _, spos = lax.sort(
+            (key, cls.astype(jnp.uint32), pos), dimension=0,
+            is_stable=False, num_keys=3)
 
     # Rank within destination group = index - first index of that key, with
     # the group starts found by a cummax scan (a searchsorted here would be

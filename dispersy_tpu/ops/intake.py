@@ -28,6 +28,16 @@ per iteration, bounding live memory at O(N·M) regardless of B.  Reductions
 are order-independent (any/max), so the two forms are exactly equal;
 tests/test_intake.py pins it, and the engine-level forced-form test pins
 it through a full step.
+
+Batch-ORDER note (the ingress-protection plane, OVERLOAD.md): the push
+segment of the intake batch arrives in the delivery kernel's slot order,
+which under ``overload.priority_admission`` is *(admission class, edge
+position)* rather than pure edge position — so ``dup_earlier``'s
+first-seen-wins and the sequence-chain scan see control-class records
+ahead of bulk gossip whenever the inbox overflowed.  Every op here is
+order-agnostic in its contract (the batch order is an input, not an
+assumption), but oracle mirrors must build the push segment in the same
+admitted order.
 """
 
 from __future__ import annotations

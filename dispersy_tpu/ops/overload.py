@@ -1,0 +1,87 @@
+"""Ingress-protection kernels: admission classes, token-bucket refill
+and spend.
+
+The jit-traced half of the overload plane (:mod:`dispersy_tpu.overload`
+declares the static :class:`~dispersy_tpu.overload.OverloadConfig`; the
+engine composes these into the fused round's push phase only when
+``overload.enabled``, so a disabled plane compiles to the identical
+step).  Every op mirrors bit-for-bit in the oracle
+(:mod:`dispersy_tpu.oracle.sim` ``_admission_class`` / the credit math
+in ``step``'s push phase), the same lockstep discipline as every other
+ops module.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dispersy_tpu.ops import rng
+from dispersy_tpu.ops.contracts import Spec, contract
+
+_U32_N = Spec("uint32", ("N",))
+
+
+@contract(out=Spec("uint32", ("E",)),
+          meta=Spec("uint8", ("E",)), n_meta=4,
+          priorities=(128, 128, 128, 128))
+def admission_class(meta: jnp.ndarray, n_meta: int,
+                    priorities: tuple) -> jnp.ndarray:
+    """u32 admission class per wire meta byte — LOWER wins inbox slots
+    under overflow (``overload.admission_class`` is the scalar form and
+    documents the table; the delivery kernel folds this into its packed
+    sort key).  Valid user metas carry ``255 - declared priority``, the
+    control band ``255 - CONTROL_PRIORITY`` (identity at its bulk
+    ``255 - IDENTITY_PRIORITY``), and a meta valid for neither band —
+    most flood junk — ranks dead last at 255."""
+    from dispersy_tpu.config import (CONTROL_PRIORITY, IDENTITY_PRIORITY,
+                                     META_AUTHORIZE, META_IDENTITY,
+                                     META_MALICIOUS)
+
+    prio_arr = jnp.asarray(priorities, jnp.uint32)
+    meta_c = jnp.minimum(meta, jnp.uint8(n_meta - 1)).astype(jnp.int32)
+    user_cls = jnp.uint32(255) - jnp.take(prio_arr, meta_c, axis=0)
+    is_ident = meta == jnp.uint8(META_IDENTITY)
+    is_ctrl = ((meta >= jnp.uint8(META_AUTHORIZE))
+               & (meta <= jnp.uint8(META_MALICIOUS)) & ~is_ident)
+    return jnp.where(
+        meta < jnp.uint8(n_meta), user_cls,
+        jnp.where(is_ident, jnp.uint32(255 - IDENTITY_PRIORITY),
+                  jnp.where(is_ctrl, jnp.uint32(255 - CONTROL_PRIORITY),
+                            jnp.uint32(255))))
+
+
+@contract(out=_U32_N,
+          bucket=Spec("uint8", ("N",)), seed=Spec("uint32", ()),
+          rnd=Spec("uint32", ()), idx=Spec("int32", ("N",)),
+          bucket_rate=2.5, bucket_depth=8)
+def bucket_refill(bucket: jnp.ndarray, seed, rnd, idx: jnp.ndarray,
+                  bucket_rate, bucket_depth: int) -> jnp.ndarray:
+    """This round's spendable credit per sender: the carried u8 balance
+    plus the refill, clamped at the burst cap.
+
+    ``bucket_rate`` may be fractional (and TRACED under fleet
+    overrides — ``overload.TRACED_OVERLOAD_KNOBS``): the integer part
+    refills deterministically, the remainder lands as one Bernoulli
+    counter-draw per peer per round (purpose ``P_OVERLOAD``), so the
+    oracle replays the credit sequence exactly and a traced rate equal
+    to the static knob computes the identical round.  All float math is
+    float32 (the oracle mirrors with ``np.float32``).
+    """
+    ratef = jnp.float32(bucket_rate)
+    whole = jnp.floor(ratef)
+    frac = ratef - whole
+    u = rng.rand_uniform(seed, rnd, idx, rng.P_OVERLOAD)
+    refill = whole.astype(jnp.uint32) + (u < frac).astype(jnp.uint32)
+    return jnp.minimum(bucket.astype(jnp.uint32) + refill,
+                       jnp.uint32(bucket_depth))
+
+
+@contract(out=Spec("uint8", ("N",)),
+          credit=_U32_N, n_attempted=_U32_N)
+def bucket_spend(credit: jnp.ndarray,
+                 n_attempted: jnp.ndarray) -> jnp.ndarray:
+    """The post-round u8 balance: this round's credit minus the packets
+    actually chargeable against it (attempts beyond the balance were
+    shed, not spent — a flooder cannot drive its bucket below zero, it
+    just stays pinned at empty)."""
+    return (credit - jnp.minimum(n_attempted, credit)).astype(jnp.uint8)

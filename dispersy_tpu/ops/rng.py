@@ -48,6 +48,9 @@ P_DUP = 13       # per-delivered-record duplication
 P_FLOOD = 14     # byzantine flood victim + junk-field draws
 # Recovery-plane stream (dispersy_tpu/recovery.py RecoveryConfig):
 P_RECOVERY = 15  # walk-backoff decay draw (one per peer per clean round)
+# Ingress-protection stream (dispersy_tpu/overload.py OverloadConfig):
+P_OVERLOAD = 16  # token-bucket fractional-refill draw (one per peer
+#                  per push-phase round; ops/overload.bucket_refill)
 
 
 @contract(out=Spec("uint32", ()), key=Spec("uint32", (2,)))

@@ -82,6 +82,7 @@ P_CATEGORY, P_SLOT, P_INTRO, P_BOOTSTRAP = 1, 2, 3, 4
 P_CHURN, P_LOSS, P_GOSSIP, P_SIGN, P_NAT = 5, 6, 7, 8, 9
 P_GE, P_GE_LOSS, P_CORRUPT, P_DUP, P_FLOOD = 10, 11, 12, 13, 14
 P_RECOVERY = 15
+P_OVERLOAD = 16
 
 KIND_WALK, KIND_STUMBLE, KIND_INTRO = 0, 1, 2
 CAT_NONE, CAT_WALKED, CAT_STUMBLED, CAT_INTRODUCED = 0, 1, 2, 3
@@ -204,6 +205,12 @@ class OraclePeer:
         self.recov_soft = self.recov_backoff = 0
         self.recov_quarantine = 0
         self.recov_cleared = [0] * NUM_HEALTH_BITS
+        # ingress-protection plane (engine bucket leaf + the stats
+        # msgs_shed_* counters; dispersy_tpu/overload.py).  The bucket
+        # is the overlay's rate-limiter view of the sender identity —
+        # like ge_bad it survives churn rebirth.
+        self.bucket = 0
+        self.msgs_shed_rate = self.msgs_shed_priority = 0
         self.proof_requests = self.proof_records = 0
         self.seq_requests = self.seq_records = 0
         self.mm_requests = self.mm_records = 0
@@ -283,6 +290,23 @@ class OracleSim:
                 p.recov_soft = p.recov_backoff = 0
                 p.recov_quarantine = 0
                 p.recov_cleared = [0] * NUM_HEALTH_BITS
+        if self.cfg.overload.enabled != new_cfg.overload.enabled:
+            # the SetOverload shape — overload.adapt_state mirror:
+            # enabling starts with empty buckets (the first round's
+            # refill seeds them), disabling discards.
+            for p in self.peers:
+                p.bucket = 0
+                p.msgs_shed_rate = p.msgs_shed_priority = 0
+        if tlm.row_width(new_cfg) != tlm.row_width(self.cfg):
+            # A recovery/overload flip changed the packed-row SCHEMA
+            # (their words are conditional) — overload.
+            # _resize_telemetry_rows mirror: row and ring reset to the
+            # new width, all-zero ("no step has run yet").
+            self.tele_row = np.zeros((tlm.row_width(new_cfg),),
+                                     np.uint32)
+            self.tele_ring = np.zeros(
+                (new_cfg.telemetry.history, tlm.row_width(new_cfg)),
+                np.uint32)
         self.cfg = new_cfg
 
     # ---- helpers mirroring ops/candidates.py --------------------------------
@@ -412,6 +436,16 @@ class OracleSim:
             seen.add((r.gt, r.member))
             out.append(r)
         p.store = out
+
+    def _admission_class(self, meta: int) -> int:
+        """ops/overload.admission_class mirror (via the one scalar
+        definition, overload.admission_class); 0 — pure arrival order —
+        when priority admission is off."""
+        cfg = self.cfg
+        if not cfg.overload.priority_admission:
+            return 0
+        from dispersy_tpu.overload import admission_class
+        return admission_class(meta, cfg.n_meta, cfg.priorities)
 
     def _nat_sym(self, peer: int) -> bool:
         """engine's ``nat_sym``/``sym_of`` mirror: symmetric-NAT iff the
@@ -1141,6 +1175,31 @@ class OracleSim:
         # intake hash re-check (engine ph_junk)
         push_inbox: list[list[tuple[Record, int, bool]]] = \
             [[] for _ in range(n)]
+        # Ingress protection (engine phase 1f overload blocks;
+        # dispersy_tpu/overload.py): per-sender credits refill and every
+        # attempted push/flood packet consumes one ordinal — beyond the
+        # balance the packet sheds at the SENDER (msgs_shed_rate) and
+        # never reaches any inbox.  Delivered packets collect per victim
+        # and the bounded inbox admits them lowest-admission-class-first
+        # ((cls, pos) — the engine's class-aware delivery sort), excess
+        # shedding to the RECEIVER's msgs_shed_priority instead of
+        # msgs_dropped.
+        ovc = cfg.overload
+        ov_on = ovc.enabled and (cfg.forward_fanout > 0
+                                 or fm.flood_enabled)
+        if ov_on:
+            ratef = np.float32(ovc.bucket_rate)
+            whole = int(np.floor(ratef))
+            frac = np.float32(ratef - np.float32(whole))
+            credit = [0] * n
+            for i, p in enumerate(self.peers):
+                u = rand_uniform(seed, rnd, i, P_OVERLOAD)
+                extra = 1 if u < frac else 0
+                credit[i] = min(p.bucket + whole + extra,
+                                ovc.bucket_depth)
+            att_count = [0] * n
+            # per-victim pending deliveries: (cls, record, sender, junk)
+            push_pend: list[list] = [[] for _ in range(n)]
         if cfg.forward_fanout > 0:
             cc = cfg.forward_fanout
             k = cfg.k_candidates
@@ -1162,11 +1221,23 @@ class OracleSim:
                         if p.alive and p.loaded and rec_ok \
                                 and tc != NO_PEER:
                             p.bytes_up += RECORD_BYTES       # pre-loss
+                            if ov_on:
+                                o = att_count[i]
+                                att_count[i] += 1
+                                if o >= credit[i]:
+                                    # rate-gate shed, attributed to the
+                                    # sender (loss-independent)
+                                    p.msgs_shed_rate += 1
+                                    continue
                             if not self._lost(i, _LOSS_FORWARD,
                                               fi * cc + ci) \
                                     and not self._blocked(i, tc):
                                 sent += 1
-                                if len(push_inbox[tc]) < cfg.push_inbox:
+                                if ov_on:
+                                    push_pend[tc].append(
+                                        (self._admission_class(rec.meta),
+                                         rec, i, False))
+                                elif len(push_inbox[tc]) < cfg.push_inbox:
                                     push_inbox[tc].append((rec, i, False))
                                     arrivals[tc] = True
                                     qc = self.peers[tc]
@@ -1178,7 +1249,9 @@ class OracleSim:
         if fm.flood_enabled:
             # Byzantine junk blast (engine phase 1f flood segment): junk
             # edges append AFTER every real push edge, so inbox slot
-            # order matches the fused delivery sort exactly.
+            # order matches the fused delivery sort exactly.  Under the
+            # overload plane the blasts spend the SAME bucket, ordinals
+            # continuing after the flooder's real-push attempts.
             ff = fm.flood_fanout
             for fs in fm.flood_senders:
                 fp = self.peers[fs]
@@ -1190,6 +1263,12 @@ class OracleSim:
                         % (n - t)
                     if not fp.alive:
                         continue
+                    if ov_on:
+                        o = att_count[fs]
+                        att_count[fs] += 1
+                        if o >= credit[fs]:
+                            fp.msgs_shed_rate += 1
+                            continue
                     if self._lost(fs, _LOSS_FLOOD, j):
                         continue
                     if self._blocked(fs, victim):
@@ -1201,7 +1280,11 @@ class OracleSim:
                                  j + (3 << 12)) & 0xFF,
                         rand_u32(seed, rnd, fs, P_FLOOD, j + (4 << 12)),
                         rand_u32(seed, rnd, fs, P_FLOOD, j + (5 << 12)))
-                    if len(push_inbox[victim]) < cfg.push_inbox:
+                    if ov_on:
+                        push_pend[victim].append(
+                            (self._admission_class(rec.meta), rec, fs,
+                             True))
+                    elif len(push_inbox[victim]) < cfg.push_inbox:
                         # junk never decodes: no auto-load arrival
                         push_inbox[victim].append((rec, fs, True))
                         qv = self.peers[victim]
@@ -1209,6 +1292,31 @@ class OracleSim:
                             qv.bytes_down += RECORD_BYTES
                     else:
                         self.peers[victim].msgs_dropped += 1
+        if ov_on:
+            # Priority admission + flood-fair attribution: per victim,
+            # the inbox admits the lowest-class packets (ties by edge
+            # position — the pend list is already in global edge order,
+            # so a stable sort on class alone mirrors the engine's
+            # packed (dst, cls, pos) key); overflow sheds to
+            # msgs_shed_priority, which never feeds health_drop_limit.
+            for v in range(n):
+                pend = push_pend[v]
+                order2 = sorted(range(len(pend)),
+                                key=lambda ti: (pend[ti][0], ti))
+                for t_ix in order2[:cfg.push_inbox]:
+                    _, rec, src, junk = pend[t_ix]
+                    push_inbox[v].append((rec, src, junk))
+                    if not junk:
+                        arrivals[v] = True
+                    qv = self.peers[v]
+                    if qv.alive and qv.loaded:
+                        qv.bytes_down += RECORD_BYTES
+                self.peers[v].msgs_shed_priority += max(
+                    len(pend) - cfg.push_inbox, 0)
+            # Spend: in-budget attempts drain the balance; refill
+            # happens at the next round's credit computation.
+            for i, p in enumerate(self.peers):
+                p.bucket = credit[i] - min(att_count[i], credit[i])
 
         # request delivery (normal peers): edge order = sender order
         req_inbox: list[list[int]] = [[] for _ in range(n)]   # sender ids
@@ -2262,6 +2370,13 @@ class OracleSim:
         for i in range(cfg.n_meta + 1):
             vals[f"accepted_by_meta_{i}"] = sum(
                 p.accepted_by_meta[i] & M32 for p in self.peers)
+        if cfg.overload.enabled:
+            vals["msgs_shed_rate"] = sum(p.msgs_shed_rate & M32
+                                         for p in self.peers)
+            vals["msgs_shed_priority"] = sum(p.msgs_shed_priority & M32
+                                             for p in self.peers)
+            vals["bucket_exhausted"] = sum(1 for p in self.peers
+                                           if p.bucket == 0)
         if cfg.recovery.enabled:
             for nm in ("recov_soft", "recov_backoff",
                        "recov_quarantine"):
@@ -2416,6 +2531,20 @@ class OracleSim:
                               if cfg.recovery.enabled
                               else np.zeros((0, NUM_HEALTH_BITS),
                                             np.uint32)),
+            # ingress-protection leaves + counters (knob-sized, state.py)
+            "bucket": (np.array([p.bucket for p in self.peers],
+                                np.uint8)
+                       if cfg.overload.enabled
+                       else np.zeros((0,), np.uint8)),
+            "msgs_shed_rate": (np.array([p.msgs_shed_rate
+                                         for p in self.peers], np.uint32)
+                               if cfg.overload.enabled
+                               else np.zeros((0,), np.uint32)),
+            "msgs_shed_priority": (np.array([p.msgs_shed_priority
+                                             for p in self.peers],
+                                            np.uint32)
+                                   if cfg.overload.enabled
+                                   else np.zeros((0,), np.uint32)),
             # telemetry-plane leaves (knob-sized, state.py)
             "walk_streak": (np.array(self.walk_streak, np.uint32)
                             if cfg.telemetry.histograms

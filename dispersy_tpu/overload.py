@@ -1,0 +1,248 @@
+"""The ingress-protection plane: per-sender rate limiting, priority
+admission under overflow, and flood-fair drop attribution.
+
+PR 4's chaos harness proved the saturation attack (byzantine flooders
+blasting junk through the push channel until victim inboxes overflow),
+and PR 9's recovery plane made the aftermath *worse for the wrong
+party*: the overflow drops land in the VICTIM's ``msgs_dropped``, trip
+its ``health_drop_limit`` sentinel, and recovery then backs off,
+candidate-flushes, and finally quarantines the flooded victim — a
+wiped-disk rebirth — while the attacker keeps walking untouched.
+Deployed gossip stacks defend this seam with per-sender admission
+control and message-class prioritization (the flood-protection and
+peer-scoring machinery formalized in *Verification of GossipSub in
+ACL2s*, with *PeerSwap* motivating why sampler randomness must not be
+starvable by a loud minority — PAPERS.md); the reference's
+bounded-UDP-buffer endpoint (``endpoint.py``, SURVEY §2) is exactly the
+layer the defense belongs to.  This module declares the static half;
+the jit-traced kernels live in :mod:`dispersy_tpu.ops.overload` and the
+engine composes them into the fused round only when
+``OverloadConfig.enabled`` — all defaults compile to *exactly* the
+protection-free step (zero-width leaves, the faults/recovery/telemetry
+pattern).
+
+Three mechanisms (OVERLOAD.md's tables):
+
+1. **Priority admission** (``priority_admission``): when a receiver's
+   push inbox overflows, packets are shed lowest-admission-class-first
+   instead of first-come-first-kept.  The class is derived from the
+   wire-visible meta byte (:func:`admission_class`): control records
+   (authorize/revoke/undo/dynamic/destroy/malicious-proof) outrank user
+   gossip, bulk identity records rank below it, and a meta byte that is
+   valid for neither band — most flood junk — ranks dead last.  The
+   class folds into the delivery kernel's packed ``(dst, pos)`` sort
+   key (``ops/inbox.deliver``'s ``cls`` operand), so admission costs
+   one extra key field, not a second sort.  The walk/puncture/signature
+   control channels already own dedicated inboxes (architectural
+   priority); the class ordering bites where classes actually mix — the
+   push inbox, which is also where the flood lands.
+2. **Per-sender token buckets** (``bucket_rate`` / ``bucket_depth``): a
+   u8 credit column per peer (``PeerState.bucket``) refilled by
+   ``bucket_rate`` credits per round (integer part deterministic,
+   fractional part one Bernoulli counter-draw — ``rng.P_OVERLOAD`` —
+   so the oracle replays it exactly and the rate is traced-liftable,
+   :data:`TRACED_OVERLOAD_KNOBS`), capped at ``bucket_depth``.  Every
+   push/flood packet a sender *attempts* (pre-loss, the sendto
+   accounting boundary) consumes one credit in emission order; packets
+   beyond the balance are shed at intake — they never occupy any
+   victim's inbox slot, so one sender cannot take more than its credit
+   share of the overlay's ingress no matter its fanout.
+3. **Flood-fair drop attribution** (``msgs_shed_rate`` /
+   ``msgs_shed_priority``): shed-by-admission drops get their own
+   counter streams and do NOT count toward ``health_drop_limit``.
+   Rate-gate sheds are attributed to the SENDER (``msgs_shed_rate`` —
+   a flooder's counter balloons while its exhausted bucket shows up in
+   :func:`overload_report`); priority-admission overflow sheds are
+   recorded at the receiver (``msgs_shed_priority``) but kept out of
+   the drop sentinel, so recovery stops quarantining flood victims and
+   starts starving flooders.
+
+Persistence: ``bucket`` is the *overlay's* rate-limiter view of the
+sender identity — like the NAT type and the GE channel it survives a
+churn rebirth (a wiped-disk restart does not refill the neighborhood's
+patience with that peer).  It rides checkpoints at format v13.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from dispersy_tpu.exceptions import ConfigError
+
+# Overload knobs the fleet plane can lift into TRACED per-replica
+# scalars (the faults.TRACED_FAULT_KNOBS discipline): the refill rate
+# is a pure numeric knob whose value never decides program structure.
+# ``enabled`` / ``priority_admission`` / ``bucket_depth`` are
+# structural (leaf shapes, sort-key layout, u8 clamp) and stay static
+# compile-group keys — FLEET.md's traced-vs-static table.
+TRACED_OVERLOAD_KNOBS = ("bucket_rate",)
+
+
+@dataclasses.dataclass(frozen=True)
+class OverloadConfig:
+    """Static ingress-protection knobs, composed into
+    ``CommunityConfig`` (fourth-to-last field, before recovery /
+    telemetry / faults — checkpoint fingerprint compat).
+
+    Frozen + hashable (a static jit argument).  All defaults off
+    compile to exactly the protection-free step; every leaf the plane
+    adds (``bucket`` and the ``msgs_shed_*`` counters) is zero-width
+    while ``enabled`` is off.
+    """
+
+    # Master switch: compose the rate gate, admission classes, and the
+    # shed-attribution counter streams into the fused round.
+    enabled: bool = False
+    # Shed push-inbox overflow lowest-class-first instead of
+    # first-come-first-kept (admission_class; OVERLOAD.md class table).
+    priority_admission: bool = True
+    # Credits refilled per sender per round (may be fractional: the
+    # integer part is deterministic, the remainder one Bernoulli draw
+    # per peer per round).  Traced-liftable (TRACED_OVERLOAD_KNOBS).
+    bucket_rate: float = 8.0
+    # Burst cap: the u8 credit balance never exceeds this.
+    bucket_depth: int = 32
+
+    def __post_init__(self) -> None:
+        if not (1 <= self.bucket_depth <= 255):
+            raise ConfigError(
+                f"bucket_depth must be in [1, 255] (a u8 credit "
+                f"balance), got {self.bucket_depth}")
+        if not (0.0 <= self.bucket_rate <= self.bucket_depth):
+            raise ConfigError(
+                f"bucket_rate must be in [0, bucket_depth="
+                f"{self.bucket_depth}], got {self.bucket_rate} (a "
+                "refill beyond the burst cap can never land)")
+
+    def replace(self, **kw) -> "OverloadConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def admission_class(meta: int, n_meta: int, priorities) -> int:
+    """Admission class of one wire meta byte (scalar form; the traced
+    form is ``ops/overload.admission_class`` and the oracle mirrors
+    this one) — LOWER class wins inbox slots under overflow:
+
+    - valid user meta (< ``n_meta``): ``255 - declared priority``
+      (DEFAULT_PRIORITY=128 -> class 127);
+    - dispersy-identity: ``255 - IDENTITY_PRIORITY`` = 239 (bulk data
+      ranks below user gossip, the reference's low identity priority);
+    - any other control-band meta (0xF0..0xF7): ``255 -
+      CONTROL_PRIORITY`` = 31 (authorize proofs, convictions, destroy
+      must survive a flooded inbox);
+    - everything else — a meta byte valid for NEITHER band, which is
+      what most flood junk carries — 255, dead last.  The receiver
+      needs no crypto for this: the meta id is protocol knowledge read
+      straight off the wire, exactly the check ``conversion.py``'s
+      decode front-end performs before any signature work.
+
+    In-band metas invert ``config.priority_of`` — ONE priority table
+    serves the sync responder's ordering, the forward-buffer selection,
+    and this admission class, so they can never drift.
+    """
+    from dispersy_tpu.config import (META_AUTHORIZE, META_MALICIOUS,
+                                     priority_of)
+    if meta < n_meta or META_AUTHORIZE <= meta <= META_MALICIOUS:
+        return 255 - priority_of(meta, n_meta, priorities)
+    return 255
+
+
+def adapt_state(state, old_cfg, new_cfg):
+    """Resize the overload-plane leaves across a ``SetOverload`` swap.
+
+    ``bucket`` and the ``stats.msgs_shed_*`` counters are zero-width
+    while the plane is compiled out (state.py), so a flip of
+    ``overload.enabled`` must resize them before the next step traces.
+    Enabling starts clean (empty buckets — the first round's refill
+    seeds them — and zero shed counters); disabling discards.  A swap
+    that leaves ``enabled`` alone is an identity — the numeric knobs
+    gate computation only.
+    """
+    import jax.numpy as jnp
+
+    if old_cfg.overload.enabled == new_cfg.overload.enabled:
+        return state
+    n = new_cfg.n_peers if new_cfg.overload.enabled else 0
+    state = state.replace(
+        bucket=jnp.zeros((n,), jnp.uint8),
+        stats=state.stats.replace(
+            msgs_shed_rate=jnp.zeros((n,), jnp.uint32),
+            msgs_shed_priority=jnp.zeros((n,), jnp.uint32)))
+    # The shed/bucket telemetry words are conditional on the flipped
+    # knob, so with telemetry on the packed-row SCHEMA changed width.
+    from dispersy_tpu.telemetry import adapt_row_leaves
+    return adapt_row_leaves(state, old_cfg, new_cfg)
+
+
+def shed_totals(stats) -> dict:
+    """Overlay-wide shed totals from a ``Stats`` pytree (zero-width
+    compiled-out leaves read as zeros).  THE one host-side aggregation
+    — :func:`overload_report` and the legacy ``metrics.snapshot`` path
+    both read it (the fused telemetry row reduces the same leaves on
+    device), so the two paths cannot drift."""
+    import numpy as np
+
+    out = {}
+    for nm in ("msgs_shed_rate", "msgs_shed_priority"):
+        col = np.asarray(getattr(stats, nm), np.uint64)
+        out[nm] = int(col.sum()) if col.size else 0
+    return out
+
+
+def overload_report(state, cfg, top: int = 4) -> dict:
+    """Host-side summary of the ingress-protection plane's live state:
+    shed totals, exhausted/min/max bucket levels, and the ``top``
+    heaviest rate-shed senders — under a flood these are the attackers,
+    surfaced by name instead of their victims' health bits.  Cheap (a
+    couple of [N] transfers); all-zero when the plane is compiled out.
+    """
+    import numpy as np
+
+    bk = np.asarray(state.bucket)
+    out = {
+        "bucket_exhausted": int((bk == 0).sum()) if bk.size else 0,
+        "bucket_min": int(bk.min()) if bk.size else 0,
+        "bucket_max": int(bk.max()) if bk.size else 0,
+    }
+    out.update(shed_totals(state.stats))
+    shed = np.asarray(state.stats.msgs_shed_rate, np.uint64)
+    if shed.size:
+        order = np.argsort(shed, kind="stable")[::-1][:top]
+        out["top_shed_senders"] = [
+            (int(i), int(shed[i])) for i in order if shed[i] > 0]
+    else:
+        out["top_shed_senders"] = []
+    return out
+
+
+def shed_report(rows) -> dict:
+    """Ingress-protection summary from a per-round row log (the
+    telemetry ring drained through ``telemetry.ring_rows``, a
+    ``MetricsLog``'s rows, or a decoded artifact's row dicts) — the
+    overload analogue of ``recovery.mttr_report``, consumed by
+    ``tools/telemetry.py gate --overload``.
+
+    ``shed_rate`` / ``shed_priority`` are the window's shed deltas (the
+    cumulative counters' first->last difference; a log starting at
+    round 1 sees them from zero, so the delta IS the total).
+    ``flagged_peer_rounds`` rides along because the plane's whole
+    point is keeping the victim health curve quiet under flood.
+    """
+    rows = [r for r in rows if isinstance(r, dict)]
+    out: dict = {"rounds": len(rows)}
+    if not rows:
+        return out
+    for key, name in (("msgs_shed_rate", "shed_rate"),
+                      ("msgs_shed_priority", "shed_priority")):
+        vals = [int(r[key]) for r in rows if key in r]
+        if not vals:
+            out[name] = 0
+        elif int(rows[0].get("round", 1)) <= 1:
+            out[name] = vals[-1]
+        else:
+            out[name] = vals[-1] - vals[0]
+    out["max_bucket_exhausted"] = max(
+        (int(r.get("bucket_exhausted", 0)) for r in rows), default=0)
+    out["flagged_peer_rounds"] = sum(
+        int(r.get("health_flagged", 0)) for r in rows)
+    return out

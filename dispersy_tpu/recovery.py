@@ -148,7 +148,7 @@ def adapt_state(state, old_cfg, new_cfg):
     if old_cfg.recovery.enabled == new_cfg.recovery.enabled:
         return state
     n = new_cfg.n_peers if new_cfg.recovery.enabled else 0
-    return state.replace(
+    state = state.replace(
         backoff=jnp.zeros((n,), jnp.uint8),
         quar_until=jnp.zeros((n,), jnp.uint32),
         repair_round=jnp.zeros((n,), jnp.uint32),
@@ -157,6 +157,10 @@ def adapt_state(state, old_cfg, new_cfg):
             recov_backoff=jnp.zeros((n,), jnp.uint32),
             recov_quarantine=jnp.zeros((n,), jnp.uint32),
             recov_cleared=jnp.zeros((n, NUM_HEALTH_BITS), jnp.uint32)))
+    # The recov_* telemetry words are conditional on the flipped knob,
+    # so with telemetry on the packed-row SCHEMA changed width too.
+    from dispersy_tpu.telemetry import adapt_row_leaves
+    return adapt_row_leaves(state, old_cfg, new_cfg)
 
 
 def action_totals(stats) -> dict:

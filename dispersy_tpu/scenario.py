@@ -220,6 +220,42 @@ def _setrecovery_cfg(cfg: CommunityConfig,
     return cfg.replace(recovery=cfg.recovery.replace(**kw)) if kw else cfg
 
 
+@dataclasses.dataclass
+class SetOverload:
+    """Swap the ingress-protection plane mid-run (config change ->
+    recompile; dispersy_tpu/overload.py OverloadConfig — the
+    ``SetRecovery`` shape).
+
+    ``None`` leaves a knob unchanged.  Flipping ``enabled`` across the
+    boundary resizes the overload state leaves via
+    ``overload.adapt_state`` (enabling starts with empty buckets and
+    zero shed counters; disabling discards).  The applied flips are
+    recorded in the autosave JSON sidecar (``overload_history``) so
+    ``run(resume=True)`` replays them even when the resume straddles
+    the flip round."""
+    enabled: bool | None = None
+    priority_admission: bool | None = None
+    bucket_rate: float | None = None
+    bucket_depth: int | None = None
+
+
+_OVERLOAD_KNOBS = ("enabled", "priority_admission", "bucket_rate",
+                   "bucket_depth")
+
+
+def _setoverload_kw(ev: "SetOverload") -> dict:
+    return {k: getattr(ev, k) for k in _OVERLOAD_KNOBS
+            if getattr(ev, k) is not None}
+
+
+def _setoverload_cfg(cfg: CommunityConfig,
+                     ev: "SetOverload") -> CommunityConfig:
+    """The pure config half of a SetOverload — shared by the live event
+    interpreter and the resume-time replay (run())."""
+    kw = _setoverload_kw(ev)
+    return cfg.replace(overload=cfg.overload.replace(**kw)) if kw else cfg
+
+
 def _deep_tuple(v):
     """JSON lists -> tuples, recursively (FaultModel fields must stay
     hashable for the jitted step's static config argument)."""
@@ -381,6 +417,11 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
         new_cfg = _setrecovery_cfg(cfg, ev)
         state = rcv.adapt_state(state, cfg, new_cfg)
         cfg = new_cfg
+    elif isinstance(ev, SetOverload):
+        from dispersy_tpu import overload as ovl
+        new_cfg = _setoverload_cfg(cfg, ev)
+        state = ovl.adapt_state(state, cfg, new_cfg)
+        cfg = new_cfg
     elif isinstance(ev, Checkpoint):
         ckpt.save(ev.path, state, cfg)
     else:
@@ -390,20 +431,22 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
 
 def _autosave(dirpath: str, next_round: int, state: PeerState,
               cfg: CommunityConfig, tracked: dict, log: MetricsLog,
-              recovery_hist: list | None = None) -> None:
+              recovery_hist: list | None = None,
+              overload_hist: list | None = None) -> None:
     """One crash-resume snapshot: CRC-protected state archive + a JSON
     sidecar carrying everything the runner itself holds (metrics rows,
     tracked-record specs, the round to resume at, and the applied
-    SetRecovery flips so resume replays the recovery config history).
-    Both writes are atomic (tmp + replace), so a crash mid-autosave
-    leaves the previous snapshot intact and the torn one detectably
-    invalid."""
+    SetRecovery/SetOverload flips so resume replays the config
+    history).  Both writes are atomic (tmp + replace), so a crash
+    mid-autosave leaves the previous snapshot intact and the torn one
+    detectably invalid."""
     os.makedirs(dirpath, exist_ok=True)
     base = os.path.join(dirpath, f"{AUTOSAVE_PREFIX}{next_round:06d}")
     ckpt.save(base + ".npz", state, cfg)
     doc = {"next_round": next_round,
            "tracked": {k: list(v) for k, v in tracked.items()},
            "recovery_history": list(recovery_hist or ()),
+           "overload_history": list(overload_hist or ()),
            "meta": log.meta, "rows": log.rows}
     # Same tmp hygiene as checkpoint._atomic_npz: sweep orphans from
     # crashed savers, unlink our own tmp on any failure — a kill between
@@ -423,23 +466,30 @@ def _autosave(dirpath: str, next_round: int, state: PeerState,
 
 
 def _cfg_at_round(cfg: CommunityConfig, by_round: dict, upto: int,
-                  recovery_history: list | None = None
+                  recovery_history: list | None = None,
+                  overload_history: list | None = None
                   ) -> CommunityConfig:
     """Replay the schedule's config-affecting events (SetFault /
-    SetRecovery) for rounds < ``upto``: the config a snapshot taken
-    after round ``upto - 1`` was saved under.  Pure — no state is
-    touched.  When an autosave sidecar's ``recovery_history`` is given
-    it is the authority for the recovery flips (the flips that actually
-    ran), applied instead of scanning ``by_round`` for SetRecovery."""
+    SetRecovery / SetOverload) for rounds < ``upto``: the config a
+    snapshot taken after round ``upto - 1`` was saved under.  Pure — no
+    state is touched.  When an autosave sidecar's ``recovery_history``
+    / ``overload_history`` is given it is the authority for that
+    plane's flips (the flips that actually ran), applied instead of
+    scanning ``by_round`` for the matching event type."""
     for rnd in sorted(r for r in by_round if r < upto):
         for ev in by_round[rnd]:
             if isinstance(ev, SetFault):
                 cfg = _setfault_cfg(cfg, ev)
             elif isinstance(ev, SetRecovery) and recovery_history is None:
                 cfg = _setrecovery_cfg(cfg, ev)
+            elif isinstance(ev, SetOverload) and overload_history is None:
+                cfg = _setoverload_cfg(cfg, ev)
     for rnd, kw in (recovery_history or ()):
         if rnd < upto:
             cfg = cfg.replace(recovery=cfg.recovery.replace(**kw))
+    for rnd, kw in (overload_history or ()):
+        if rnd < upto:
+            cfg = cfg.replace(overload=cfg.overload.replace(**kw))
     return cfg
 
 
@@ -467,7 +517,8 @@ def _load_latest_autosave(dirpath: str, cfg0: CommunityConfig,
                 doc = json.load(f)
             next_round = int(doc["next_round"])
             cfg = _cfg_at_round(cfg0, by_round, next_round,
-                                doc.get("recovery_history"))
+                                doc.get("recovery_history"),
+                                doc.get("overload_history"))
             state = ckpt.restore(path, cfg)
         except (CheckpointError, OSError, ValueError, KeyError) as e:
             logger.warning("autosave %s unusable (%s: %s); falling back "
@@ -541,6 +592,7 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
     tracked: dict[str, tuple] = {}
     ctx: dict = {}
     recovery_hist: list = []   # applied SetRecovery flips: [round, kw]
+    overload_hist: list = []   # applied SetOverload flips: [round, kw]
     start_round = 0
     state = None
     if resume:
@@ -552,6 +604,8 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
             tracked = {k: tuple(v) for k, v in doc["tracked"].items()}
             recovery_hist = [[int(r), dict(kw)] for r, kw in
                              doc.get("recovery_history", ())]
+            overload_hist = [[int(r), dict(kw)] for r, kw in
+                             doc.get("overload_history", ())]
             log.meta = doc.get("meta", log.meta)
             log.rows = list(doc.get("rows", ()))
             logger.info("resuming scenario at round %d from %s",
@@ -570,6 +624,8 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
                 # Record the applied flip for the autosave sidecar so a
                 # resume that straddles it replays the same config.
                 recovery_hist.append([rnd, _setrecovery_kw(ev)])
+            elif isinstance(ev, SetOverload):
+                overload_hist.append([rnd, _setoverload_kw(ev)])
         # Device-resident fast path (telemetry ring, OBSERVABILITY.md):
         # with a round-history ring compiled in and nothing forcing a
         # per-round host visit (no tracked coverage, snapshot_every=1),
@@ -590,5 +646,5 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
             rnd += 1
         if scenario.autosave_every and rnd % scenario.autosave_every == 0:
             _autosave(scenario.autosave_dir, rnd, state, cfg,
-                      tracked, log, recovery_hist)
+                      tracked, log, recovery_hist, overload_hist)
     return jax.block_until_ready(state), log

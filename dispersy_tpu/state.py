@@ -62,6 +62,17 @@ class Stats:
     #   the reference's conversion.py decode/signature failures).
     #   Zero-width when neither channel is enabled (state.py PeerState
     #   `health` note)
+    # Ingress-protection shed streams (dispersy_tpu/overload.py;
+    # OVERLOAD.md attribution table).  Zero-width unless
+    # cfg.overload.enabled — the `health` idiom.  Deliberately OUTSIDE
+    # the msgs_dropped/requests_dropped families: admission sheds must
+    # never trip the victim's health_drop_limit sentinel.
+    msgs_shed_rate: jnp.ndarray   # u32[N] push/flood packets this SENDER
+    #   attempted beyond its token-bucket credit (rate-gate shed,
+    #   attributed to the sender — a flooder's counter balloons)
+    msgs_shed_priority: jnp.ndarray  # u32[N] packets shed from this
+    #   RECEIVER's push inbox by class-ordered admission under overflow
+    #   (the drops that used to blame the flooded victim)
     # Recovery-plane action counters (dispersy_tpu/recovery.py;
     # RECOVERY.md).  All zero-width unless cfg.recovery.enabled — the
     # `health` idiom:
@@ -161,6 +172,17 @@ class PeerState:
     #   bit re-latching within recovery.requarantine_window of this
     #   escalates to quarantine.  Reset by churn rebirth.
 
+    # ---- ingress-protection plane (dispersy_tpu/overload.py;
+    #      OVERLOAD.md).  Zero-width unless cfg.overload.enabled — the
+    #      `health` idiom (overload.adapt_state resizes on a
+    #      SetOverload flip). ----
+    bucket: jnp.ndarray       # u8[N] per-sender token-bucket balance:
+    #   refilled bucket_rate/round (ops/overload.bucket_refill), spent
+    #   by each attempted push/flood packet, capped at bucket_depth.
+    #   The OVERLAY's rate-limiter view of the sender identity — like
+    #   the NAT type and ge_bad it survives churn rebirth (a wiped-disk
+    #   restart does not refill the neighborhood's patience).
+
     # ---- telemetry plane (dispersy_tpu/telemetry.py; OBSERVABILITY.md).
     #      Every leaf is zero-width while its TelemetryConfig knob is
     #      off — the `health` idiom — so disabled telemetry keeps the
@@ -259,7 +281,7 @@ FLAG_UNDONE = 1
 
 
 def init_stats(n: int, n_meta: int = 8, n_corrupt: int | None = None,
-               n_recov: int = 0) -> Stats:
+               n_recov: int = 0, n_overload: int = 0) -> Stats:
     # Distinct buffers on purpose: aliased arrays break donation
     # (Execute() rejects the same buffer donated twice).
     from dispersy_tpu.recovery import NUM_HEALTH_BITS
@@ -272,6 +294,8 @@ def init_stats(n: int, n_meta: int = 8, n_corrupt: int | None = None,
                  msgs_delayed=z(),
                  msgs_corrupt_dropped=jnp.zeros(
                      (n if n_corrupt is None else n_corrupt,), jnp.uint32),
+                 msgs_shed_rate=jnp.zeros((n_overload,), jnp.uint32),
+                 msgs_shed_priority=jnp.zeros((n_overload,), jnp.uint32),
                  recov_soft=jnp.zeros((n_recov,), jnp.uint32),
                  recov_backoff=jnp.zeros((n_recov,), jnp.uint32),
                  recov_quarantine=jnp.zeros((n_recov,), jnp.uint32),
@@ -392,6 +416,10 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
             (n if config.recovery.enabled else 0,), jnp.uint32),
         repair_round=jnp.zeros(
             (n if config.recovery.enabled else 0,), jnp.uint32),
+        # Ingress-protection leaf sizes to its master knob the same way
+        # (zero-width when compiled out; overload.adapt_state resizes).
+        bucket=jnp.zeros(
+            (n if config.overload.enabled else 0,), jnp.uint8),
         # Telemetry-plane leaves size to their knobs the same way
         # (telemetry.row_width is 0 when disabled).
         walk_streak=jnp.zeros(
@@ -441,7 +469,8 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
             n, config.n_meta,
             n_corrupt=(n if (config.faults.corrupt_rate > 0.0
                              or config.faults.flood_enabled) else 0),
-            n_recov=(n if config.recovery.enabled else 0)),
+            n_recov=(n if config.recovery.enabled else 0),
+            n_overload=(n if config.overload.enabled else 0)),
         key=jax.random.key_data(key) if key.dtype != jnp.uint32 else key,
         time=jnp.float32(0.0),
         round_index=jnp.uint32(0),

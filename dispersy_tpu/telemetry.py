@@ -179,6 +179,15 @@ def row_schema(cfg) -> tuple:
     entries += [(f"health_{nm}", "u32") for nm in HEALTH_NAMES]
     entries += [(f"accepted_by_meta_{i}", "u64")
                 for i in range(cfg.n_meta + 1)]
+    if cfg.overload.enabled:
+        # Ingress-protection words (dispersy_tpu/overload.py;
+        # OVERLOAD.md).  CONDITIONAL on the master knob so an
+        # overload-off row stays byte-identical — the recovery/
+        # histogram rule.  Declared BEFORE the recovery block, matching
+        # the config field order (overload precedes recovery).
+        entries += [("msgs_shed_rate", "u64"),
+                    ("msgs_shed_priority", "u64"),
+                    ("bucket_exhausted", "u32")]
     if cfg.recovery.enabled:
         # Recovery-plane action totals (dispersy_tpu/recovery.py;
         # RECOVERY.md).  CONDITIONAL on the master knob so a
@@ -192,6 +201,27 @@ def row_schema(cfg) -> tuple:
         entries += [(f"hist_{name}", "hist")
                     for name, _, _ in hist_specs(cfg)]
     return tuple(entries)
+
+
+def adapt_row_leaves(state, old_cfg, new_cfg):
+    """Re-shape the packed-row leaves (``tele_row`` / ``tele_ring``)
+    across a config swap that changed the row SCHEMA width — the
+    recov_* words are conditional on ``recovery.enabled`` and the
+    shed/bucket words on ``overload.enabled``, so those planes'
+    ``adapt_state`` implementations both call this.  Old rows are
+    undecodable under the new config and cannot even live in the new
+    leaf shapes, so both reset to zero (an all-zero row means "no step
+    has run" — the ring drain's existing contract).  Identity when
+    telemetry is off or the width did not change."""
+    import jax.numpy as jnp
+
+    new_w = row_width(new_cfg)
+    if new_w == row_width(old_cfg):
+        return state
+    return state.replace(
+        tele_row=jnp.zeros((new_w,), jnp.uint32),
+        tele_ring=jnp.zeros((new_cfg.telemetry.history, new_w),
+                            jnp.uint32))
 
 
 def _kind_width(kind: str, cfg) -> int:
@@ -389,6 +419,13 @@ def row_to_snapshot(row: np.ndarray, cfg) -> dict:
         out[f"health_{nm}"] = raw[f"health_{nm}"]
     out["accepted_by_meta"] = [raw[f"accepted_by_meta_{i}"]
                                for i in range(cfg.n_meta + 1)]
+    if cfg.overload.enabled:
+        # Ingress-protection surfacing (overload.py; OVERLOAD.md): the
+        # shed streams + exhausted-bucket count, key-identical to the
+        # legacy snapshot path's overload block.
+        for nm in ("msgs_shed_rate", "msgs_shed_priority",
+                   "bucket_exhausted"):
+            out[nm] = raw[nm]
     if cfg.recovery.enabled:
         # Recovery-plane surfacing (recovery.py; RECOVERY.md): action
         # totals, per-bit clears, and the instantaneous availability

@@ -237,7 +237,10 @@ def test_disabled_step_cost_identical_to_pr4_baseline():
     ``overrides`` parameter at the default None, so a fleet-plane edit
     that leaks bytes into the plain round fails here (FLEET.md).  And
     since the recovery plane landed it is the recovery-OFF pin too —
-    the default RecoveryConfig must add zero bytes (RECOVERY.md)."""
+    the default RecoveryConfig must add zero bytes (RECOVERY.md) — and
+    likewise the overload-OFF pin: the default OverloadConfig's rate
+    gate / admission classes / shed streams must all compile out
+    (OVERLOAD.md)."""
     from dispersy_tpu import profiling
     with open("artifacts/step_cost_1M_baseline.json") as f:
         base = json.load(f)

@@ -56,6 +56,8 @@ from dispersy_tpu.config import CommunityConfig          # noqa: E402
 from dispersy_tpu.faults import (FaultModel,             # noqa: E402
                                  TRACED_FAULT_KNOBS,
                                  enablement_signature)
+from dispersy_tpu.overload import (OverloadConfig,       # noqa: E402
+                                   TRACED_OVERLOAD_KNOBS)
 from dispersy_tpu.recovery import (RecoveryConfig,       # noqa: E402
                                    TRACED_RECOVERY_KNOBS)
 
@@ -71,13 +73,15 @@ def _build_cfg(base: dict, assignment: dict) -> CommunityConfig:
     """One grid point's full (serial-equivalent) config: ``base`` plus
     this point's axis values — traced axes included, so the point's cfg
     IS what a serial run of that point would use.  ``base`` may carry
-    ``"faults"`` / ``"recovery"`` dicts (FaultModel / RecoveryConfig
-    kwargs); axis keys use the ``faults.<knob>`` / ``recovery.<knob>``
+    ``"faults"`` / ``"recovery"`` / ``"overload"`` dicts (FaultModel /
+    RecoveryConfig / OverloadConfig kwargs); axis keys use the
+    ``faults.<knob>`` / ``recovery.<knob>`` / ``overload.<knob>``
     prefixes for their fields."""
     kw = {k: _deep_tuple(v) for k, v in base.items()
-          if k not in ("faults", "recovery")}
+          if k not in ("faults", "recovery", "overload")}
     fkw = dict(base.get("faults") or {})
     rkw = dict(base.get("recovery") or {})
+    okw = dict(base.get("overload") or {})
     for key, val in assignment.items():
         if key == "seed":
             continue
@@ -85,17 +89,21 @@ def _build_cfg(base: dict, assignment: dict) -> CommunityConfig:
             fkw[key[len("faults."):]] = _deep_tuple(val)
         elif key.startswith("recovery."):
             rkw[key[len("recovery."):]] = _deep_tuple(val)
+        elif key.startswith("overload."):
+            okw[key[len("overload."):]] = _deep_tuple(val)
         else:
             kw[key] = _deep_tuple(val)
     return CommunityConfig(
         **kw,
+        overload=OverloadConfig(**{k: _deep_tuple(v)
+                                   for k, v in okw.items()}),
         recovery=RecoveryConfig(**{k: _deep_tuple(v)
                                    for k, v in rkw.items()}),
         faults=FaultModel(**{k: _deep_tuple(v) for k, v in fkw.items()}))
 
 
 def _bare(key: str) -> str:
-    for prefix in ("faults.", "recovery."):
+    for prefix in ("faults.", "recovery.", "overload."):
         if key.startswith(prefix):
             return key[len(prefix):]
     return key
@@ -106,7 +114,8 @@ def _traced_axes(axes: dict) -> tuple:
     out = []
     for key in axes:
         if key == "seed" or _bare(key) in (TRACED_FAULT_KNOBS
-                                           + TRACED_RECOVERY_KNOBS):
+                                           + TRACED_RECOVERY_KNOBS
+                                           + TRACED_OVERLOAD_KNOBS):
             out.append(key)
     return tuple(out)
 
@@ -144,6 +153,10 @@ def _canonical_cfg(cfg: CommunityConfig,
         # structure-free numeric rate: any canonical value shares the
         # program (recovery.enabled is a separate static bool)
         kw["recovery"] = cfg.recovery.replace(backoff_decay=1.0)
+    if "bucket_rate" in traced_knobs:
+        # likewise structure-free (overload.enabled / bucket_depth are
+        # separate static knobs); 1.0 is always a valid rate
+        kw["overload"] = cfg.overload.replace(bucket_rate=1.0)
     if fkw:
         kw["faults"] = fm.replace(**fkw)
     return cfg.replace(**kw) if kw else cfg
@@ -199,6 +212,8 @@ def compile_sweep(spec: dict) -> list:
                 continue
             if bare == "backoff_decay" and not cfg.recovery.enabled:
                 continue      # recovery plane compiled out
+            if bare == "bucket_rate" and not cfg.overload.enabled:
+                continue      # overload plane compiled out
 
             grp["overrides"].setdefault(bare, []).append(val)
         grp["points"].append(assignment)

@@ -49,29 +49,41 @@ def main() -> None:
                          "composes with --fleet to route liftable "
                          "knobs (incl. backoff_decay) through traced "
                          "overrides")
+    ap.add_argument("--overload", action="store_true",
+                    help="ingress-protection draws: random "
+                         "OverloadConfig grids (token buckets, "
+                         "priority admission) over flood-heavy fault "
+                         "models vs oracle "
+                         "(test_overload.run_overload_draw); composes "
+                         "with --fleet to route liftable knobs (incl. "
+                         "bucket_rate) through traced overrides")
     ap.add_argument("--fleet", action="store_true",
-                    help="route --faults/--recovery draws whose varied "
-                         "knobs are all traced-liftable through the "
-                         "fleet plane (dispersy_tpu/fleet.py: "
-                         "1-replica vmapped fleet, rates as TRACED "
-                         "overrides) — serial fallback otherwise; "
-                         "results must stay bit-identical either way")
+                    help="route --faults/--recovery/--overload draws "
+                         "whose varied knobs are all traced-liftable "
+                         "through the fleet plane "
+                         "(dispersy_tpu/fleet.py: 1-replica vmapped "
+                         "fleet, rates as TRACED overrides) — serial "
+                         "fallback otherwise; results must stay "
+                         "bit-identical either way")
     ap.add_argument("--out", default=None,
                     help="artifact path (default: artifacts/fuzz_sweep.json,"
                          " or artifacts/fuzz_sweep_adversarial.json with"
                          " --adversarial)")
     args = ap.parse_args()
     if sum(map(bool, (args.adversarial, args.faults,
-                      args.recovery))) > 1:
-        ap.error("--adversarial / --faults / --recovery are separate "
-                 "sweep axes")
-    if args.fleet and not (args.faults or args.recovery):
-        ap.error("--fleet rides the --faults or --recovery axis (it "
-                 "routes draws through the fleet plane)")
+                      args.recovery, args.overload))) > 1:
+        ap.error("--adversarial / --faults / --recovery / --overload "
+                 "are separate sweep axes")
+    if args.fleet and not (args.faults or args.recovery or args.overload):
+        ap.error("--fleet rides the --faults, --recovery, or "
+                 "--overload axis (it routes draws through the fleet "
+                 "plane)")
     if args.out is None:
         args.out = ("artifacts/fuzz_sweep_adversarial.json"
                     if args.adversarial else
                     "artifacts/fuzz_sweep_recovery.json" if args.recovery
+                    else "artifacts/fuzz_sweep_overload.json"
+                    if args.overload
                     else "artifacts/fuzz_sweep_fleet.json" if args.fleet
                     else "artifacts/fuzz_sweep_faults.json" if args.faults
                     else "artifacts/fuzz_sweep.json")
@@ -92,6 +104,12 @@ def main() -> None:
         from test_recovery import run_recovery_draw
         run_draw = (functools.partial(run_recovery_draw, fleet=True)
                     if args.fleet else run_recovery_draw)
+    elif args.overload:
+        import functools
+
+        from test_overload import run_overload_draw
+        run_draw = (functools.partial(run_overload_draw, fleet=True)
+                    if args.fleet else run_overload_draw)
 
     passed, skipped, failed = [], [], []
     t0 = time.time()
@@ -100,6 +118,7 @@ def main() -> None:
         "adversarial": bool(args.adversarial),
         "faults": bool(args.faults),
         "recovery": bool(args.recovery),
+        "overload": bool(args.overload),
         "fleet": bool(args.fleet),
         "passed": 0, "skipped_invalid_config": 0, "failed": 0,
         "failed_seeds": [], "wall_seconds": 0.0,
@@ -135,6 +154,7 @@ def main() -> None:
             "adversarial": bool(args.adversarial),
             "faults": bool(args.faults),
             "recovery": bool(args.recovery),
+            "overload": bool(args.overload),
             "fleet": bool(args.fleet),
             "passed": len(passed), "skipped_invalid_config": len(skipped),
             "failed": len(failed), "failed_seeds": failed,

@@ -27,8 +27,8 @@ OPS_PACKAGE = "dispersy_tpu.ops"
 # Modules that define ops (the contracts module itself only defines the
 # decorators and checker — its public surface is not ops).
 OPS_MODULES = ("bloom", "candidates", "faults", "fleet", "hashing",
-               "inbox", "intake", "recovery", "rng", "store",
-               "telemetry", "timeline")
+               "inbox", "intake", "overload", "recovery", "rng",
+               "store", "telemetry", "timeline")
 
 
 def public_functions(mod):

@@ -48,6 +48,7 @@ EVENT_TYPES = {
     "destroy": S.Destroy,
     "set_fault": S.SetFault,
     "set_recovery": S.SetRecovery,
+    "set_overload": S.SetOverload,
     "unload": S.Unload,
     "load": S.Load,
     "checkpoint": S.Checkpoint,
@@ -74,9 +75,11 @@ def load(path: str) -> tuple[CommunityConfig, S.Scenario]:
             ckw[key] = cls(**{k: _tuplize(v)
                               for k, v in ckw[key].items()})
     from dispersy_tpu.faults import FaultModel
+    from dispersy_tpu.overload import OverloadConfig
     from dispersy_tpu.recovery import RecoveryConfig
     from dispersy_tpu.telemetry import TelemetryConfig
     _sub("faults", FaultModel)
+    _sub("overload", OverloadConfig)
     _sub("recovery", RecoveryConfig)
     _sub("telemetry", TelemetryConfig)
     cfg = CommunityConfig(**ckw)

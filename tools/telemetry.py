@@ -234,6 +234,30 @@ def cmd_gate(args) -> int:
             print(f"  round {rnd}: run={_fmt(va)} golden={_fmt(vg)} "
                   f"({why})")
         return 2
+    if args.overload:
+        # The ingress-protection gate (--overload): both logs' derived
+        # shed summaries (overload.shed_report — shed deltas, exhausted
+        # buckets, flagged mass) must agree field-for-field within the
+        # tolerances over the SHARED rounds.
+        from dispersy_tpu.overload import shed_report
+        sa = shed_report([a[r] for r in shared])
+        sg = shed_report([g[r] for r in shared])
+        bad = []
+        for k in sorted(set(sa) | set(sg)):
+            va, vg = sa.get(k), sg.get(k)
+            if not (isinstance(va, (int, float))
+                    and isinstance(vg, (int, float))
+                    and _within(va, vg, args.rtol, args.atol)):
+                bad.append((k, va, vg))
+        if bad:
+            print(f"gate: overload summary REGRESSED vs {args.golden} "
+                  f"on {len(bad)} field(s):")
+            for k, va, vg in bad[:12]:
+                print(f"  {k}: run={_fmt(va) if va is not None else None}"
+                      f" golden={_fmt(vg) if vg is not None else None}")
+            return 2
+        print(f"gate: overload shed summary tracks the golden one "
+              f"({len(sa)} fields)")
     if args.recovery:
         # The MTTR/availability gate: both logs' derived recovery
         # summaries must agree field-for-field within the tolerances
@@ -307,6 +331,10 @@ def main(argv=None) -> int:
     p.add_argument("--recovery", action="store_true",
                    help="additionally gate the derived MTTR/"
                         "availability summary (recovery.mttr_report)")
+    p.add_argument("--overload", action="store_true",
+                   help="additionally gate the derived ingress-"
+                        "protection shed summary "
+                        "(overload.shed_report)")
     p.set_defaults(fn=cmd_gate)
     p = sub.add_parser("mttr",
                        help="recovery-plane MTTR/availability summary")

@@ -1,0 +1,461 @@
+"""Machine-checked cost ledger, roofline projection, and compile tracer.
+
+ROADMAP items 1 (byte-diet store) and 2 (sharding-clean multichip) are
+judged by numbers this repo used to produce BY HAND: the per-phase
+roofline table in BENCH.md was prose arithmetic (and had already gone
+stale — it still priced the store columns as six u32s after PR 1
+narrowed meta/flags to u8), and the ``[SPMD] Involuntary full
+rematerialization`` warnings that define item 2's acceptance lived as
+raw text tails in ``MULTICHIP_r0*.json``.  This module makes all of it
+mechanical:
+
+- :func:`build_ledger` — run ``profiling.step_cost`` /
+  ``profiling.phase_kernels`` over a committed (shape x plane) grid and
+  emit ``artifacts/cost_ledger.json``: per-cell bytes/flops with derived
+  bytes/peer/round, per-phase breakdowns, the analytical
+  full-state-read+write floor computed from the REAL leaf dtypes (so
+  u8-packing a column moves the generated number, not a doc edit), and
+  a roofline rounds/s projection from the committed :data:`HARDWARE`
+  model — replacing BENCH.md's hand-computed ~210-340 r/s bound.
+- :func:`compare_ledgers` — the tier-1 gate: every cell carries its
+  committed byte/flop budget and a regression OR an unrecorded
+  improvement fails loudly (``tools/ledger.py gate``).  A perf PR lands
+  by regenerating the ledger, never by editing prose.
+- :class:`CompileTracer` — a context manager counting XLA backend
+  compiles and jaxpr (re)traces via ``jax.monitoring`` events, so
+  "one compile per sweep group" (FLEET.md) is an asserted counter.
+- :func:`spmd_warning_counts` — a structured parser for
+  involuntary-remat / resharding warnings in multichip dryrun logs,
+  making ROADMAP item 2's "zero involuntary-remat warnings" a checkable
+  numeric field (``tools/ledger.py spmd``; wired into
+  ``tools/multihost.py`` and ``__graft_entry__``'s dryrun even when the
+  run times out).
+
+Everything here is host-side tooling: jax imports are lazy, so the
+module is importable from jax-free parents (the axon-tunnel discipline,
+see ``cpuenv.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+
+# ---------------------------------------------------------------------------
+# The committed hardware model (roofline denominator).  The fused round
+# is pure elementwise/compare/sort work on narrow integer columns — no
+# MXU terms — so the ONLY roofline that binds is HBM bandwidth
+# (BENCH.md "Roofline / device-utilization accounting").  Keep this
+# table tiny and sourced: adding a chip is a one-line diff that
+# regenerates every projection.
+HARDWARE = {
+    "v5e": {"hbm_gbps": 819.0, "chip_counts": (1, 8)},
+}
+
+# The ledger grid.  Shapes are the two populations every recorded
+# artifact speaks in: the 1M-peer TPU roofline shape and the 64k CPU
+# fallback rung (profiling.bench_config).  Planes are the compiled-in
+# feature sets whose overhead BENCH.md tracks — defaults, telemetry,
+# chaos+health, recovery, overload (each plane supersets the previous,
+# mirroring how the overhead artifacts were measured), plus a 2-replica
+# fleet of the default plane.
+SHAPES = {
+    "1M_tpu": (1_000_000, "tpu"),
+    "64k_cpu": (65_536, "cpu"),
+}
+PLANES = ("default", "telemetry", "faults_health", "recovery",
+          "overload", "fleet_r2")
+LEDGER_PATH = "artifacts/cost_ledger.json"
+LEDGER_SCHEMA = 1
+
+
+def plane_config(shape: str, plane: str):
+    """(CommunityConfig, replicas) for one ledger cell.
+
+    Planes are cumulative — ``recovery`` includes ``faults_health``,
+    ``overload`` includes ``recovery`` — matching the layering the
+    overhead artifacts (telemetry/recovery/overload ``*_overhead_1M``)
+    measured, so each cell's delta over the previous plane is that
+    plane's own cost.
+    """
+    from dispersy_tpu import profiling
+    from dispersy_tpu.faults import FaultModel
+    from dispersy_tpu.overload import OverloadConfig
+    from dispersy_tpu.recovery import RecoveryConfig
+    from dispersy_tpu.telemetry import TelemetryConfig
+
+    n_peers, platform = SHAPES[shape]
+    cfg = profiling.bench_config(n_peers, platform)
+    if plane in ("default", "fleet_r2"):
+        return cfg, (2 if plane == "fleet_r2" else 1)
+    if plane == "telemetry":
+        return cfg.replace(telemetry=TelemetryConfig(
+            enabled=True, history=64, histograms=True)), 1
+    faults = FaultModel(
+        ge_p_bad=0.05, ge_p_good=0.3, ge_loss_good=0.01, ge_loss_bad=0.5,
+        dup_rate=0.02, corrupt_rate=0.02,
+        flood_senders=(3, 5), flood_fanout=4,
+        health_checks=True)
+    cfg = cfg.replace(packet_loss=0.1, faults=faults)
+    if plane == "faults_health":
+        return cfg, 1
+    cfg = cfg.replace(recovery=RecoveryConfig(enabled=True))
+    if plane == "recovery":
+        return cfg, 1
+    if plane == "overload":
+        return cfg.replace(overload=OverloadConfig(enabled=True)), 1
+    raise ValueError(f"unknown ledger plane {plane!r}")
+
+
+def _leaf_nbytes(struct) -> int:
+    import numpy as np
+    return int(math.prod(struct.shape)) * np.dtype(struct.dtype).itemsize
+
+
+def state_byte_report(cfg) -> dict:
+    """Analytical state-size accounting from the REAL leaf shapes/dtypes
+    (``jax.eval_shape`` — nothing materializes).
+
+    ``state_bytes`` is the whole resident ``PeerState``;
+    ``store_bytes`` just the six store columns.  ``*_rw_per_peer`` are
+    the read+write-once-per-round bytes/peer — the full-fusion floor
+    BENCH.md's roofline table hand-computed (and mispriced after PR 1's
+    u8 packing: the generated store number reflects the real dtypes).
+    """
+    import jax
+
+    from dispersy_tpu import profiling
+
+    shapes = profiling.state_shapes(cfg)
+    leaves = {
+        ".".join(str(getattr(p, "name", p)) for p in path): _leaf_nbytes(s)
+        for path, s in jax.tree_util.tree_flatten_with_path(shapes)[0]}
+    total = sum(leaves.values())
+    store = sum(v for k, v in leaves.items() if k.startswith("store_"))
+    n = cfg.n_peers
+    return {
+        "state_bytes": total,
+        "store_bytes": store,
+        "state_rw_per_peer_round": round(2 * total / n, 1),
+        "store_rw_per_peer_round": round(2 * store / n, 1),
+    }
+
+
+def roofline(cost_bytes: float, state_bytes: int, replicas: int = 1) -> dict:
+    """Rounds/s projection per :data:`HARDWARE` entry.
+
+    Two bounds bracket reality (per replica-round):
+
+    - ``fullfuse``: every kernel fuses into ONE read+write pass over the
+      resident state — HBM traffic = 2 x state bytes.  The optimistic
+      bound the hand-maintained BENCH.md table approximated.
+    - ``nofuse``: XLA's cost-analysis bytes taken at face value (every
+      op pays its operands and results to HBM).  The pessimistic bound;
+      real fusion lands in between.
+
+    Chip scaling assumes the peer axis splits bytes evenly (the
+    sharding story, MULTICHIP/ROADMAP item 2).
+    """
+    out = {}
+    per_replica_cost = cost_bytes / max(replicas, 1)
+    rw = 2.0 * state_bytes / max(replicas, 1)
+    for hw, spec in HARDWARE.items():
+        bw = spec["hbm_gbps"] * 1e9
+        for chips in spec["chip_counts"]:
+            out[f"{hw}_x{chips}"] = {
+                "rounds_per_sec_fullfuse": round(bw * chips / rw, 1),
+                "rounds_per_sec_nofuse": round(
+                    bw * chips / per_replica_cost, 1),
+            }
+    return out
+
+
+def cell_cost(shape: str, plane: str) -> dict:
+    """One ledger cell: cost-analyze the REAL fused step (or vmapped
+    fleet step) at the cell's config; abstract shapes only, so the 1M
+    cells run on any host."""
+    from dispersy_tpu import profiling
+
+    cfg, replicas = plane_config(shape, plane)
+    cost = (profiling.fleet_step_cost(cfg, replicas) if replicas > 1
+            else profiling.step_cost(cfg))
+    sb = state_byte_report(cfg)
+    n = cfg.n_peers
+    cell = {
+        "shape": shape,
+        "plane": plane,
+        "n_peers": n,
+        "replicas": replicas,
+        "bytes_accessed": cost["bytes_accessed"],
+        "flops": cost["flops"],
+        "bytes_per_peer_round": round(
+            cost["bytes_accessed"] / (n * replicas), 1),
+        "state": sb,
+        "roofline": roofline(cost["bytes_accessed"], sb["state_bytes"]
+                             * replicas, replicas),
+        # THE gate contract: tools/ledger.py gate holds a fresh
+        # measurement to these numbers, both directions.
+        "budget": {"bytes_accessed": cost["bytes_accessed"],
+                   "flops": cost["flops"]},
+    }
+    return cell
+
+
+def shape_phases(shape: str) -> dict:
+    """Per-phase breakdown for one shape (plane-independent: the phase
+    kernels are the raw ops at the shape's sizes), with derived
+    bytes/peer/round — the generated replacement for BENCH.md's
+    hand-maintained per-kernel table."""
+    from dispersy_tpu import profiling
+
+    cfg, _ = plane_config(shape, "default")
+    phases = profiling.phase_kernels(cfg)
+    n = cfg.n_peers
+    out = {}
+    for name, entry in phases.items():
+        out[name] = {
+            "bytes_accessed": entry.get("bytes_accessed", 0.0),
+            "flops": entry.get("flops", 0.0),
+            "bytes_per_peer_round": round(
+                entry.get("bytes_accessed", 0.0) / n, 1),
+        }
+    return out
+
+
+def cell_key(shape: str, plane: str) -> str:
+    return f"{shape}/{plane}"
+
+
+def default_cells() -> list:
+    return [(s, p) for s in SHAPES for p in PLANES]
+
+
+def build_ledger(cells=None, with_phases: bool = True,
+                 progress=None) -> dict:
+    """The full ledger document.  ``cells`` defaults to the committed
+    grid; pass a subset (e.g. the cheap 64k cells) for the tier-1 gate
+    rebuild.  ``progress`` is an optional ``print``-like callback."""
+    import jax
+
+    cells = list(cells) if cells is not None else default_cells()
+    doc = {
+        "schema": LEDGER_SCHEMA,
+        "jax_version": jax.__version__,
+        "hardware_model": HARDWARE,
+        "note": ("XLA cost-analysis bytes/flops of the compiled fused "
+                 "round per (shape, plane) cell; 'nofuse'/'fullfuse' "
+                 "roofline bounds bracket achievable rounds/s.  "
+                 "Regenerate: python tools/ledger.py build"),
+        "shapes": {},
+        "cells": {},
+    }
+    for shape in sorted({s for s, _ in cells}):
+        if with_phases:
+            if progress:
+                progress(f"[ledger] phases @ {shape}")
+            doc["shapes"][shape] = {
+                "n_peers": SHAPES[shape][0],
+                "platform_shape": SHAPES[shape][1],
+                "phases": shape_phases(shape),
+            }
+    for shape, plane in cells:
+        if progress:
+            progress(f"[ledger] cell {cell_key(shape, plane)}")
+        doc["cells"][cell_key(shape, plane)] = cell_cost(shape, plane)
+    return doc
+
+
+def compare_ledgers(measured: dict, committed: dict,
+                    rtol: float = 0.0) -> list:
+    """Gate a measured ledger (possibly a cell subset) against the
+    committed one.  Returns a list of failure strings — empty means the
+    gate passes.
+
+    Semantics: each measured cell must match the committed cell's
+    BUDGET within ``rtol``, in BOTH directions — a regression fails,
+    and so does an unrecorded improvement (the byte-diet PR lands by
+    committing its >=3x reduction into the ledger, not by sailing
+    under it).  Cost analysis is deterministic per jaxlib, so the
+    default tolerance is exact.
+    """
+    failures = []
+    for key, cell in measured.get("cells", {}).items():
+        ref = committed.get("cells", {}).get(key)
+        if ref is None:
+            failures.append(f"{key}: not in committed ledger "
+                            "(new cell? regenerate the ledger)")
+            continue
+        budget = ref.get("budget", ref)
+        for metric in ("bytes_accessed", "flops"):
+            want, got = float(budget[metric]), float(cell[metric])
+            tol = rtol * abs(want)
+            if abs(got - want) > tol:
+                direction = ("REGRESSED" if got > want
+                             else "improved (unrecorded)")
+                failures.append(
+                    f"{key}: {metric} {direction}: measured {got:.0f} "
+                    f"vs budget {want:.0f} "
+                    f"({(got - want) / want * 100.0:+.2f}%)")
+    for shape, entry in measured.get("shapes", {}).items():
+        ref = committed.get("shapes", {}).get(shape)
+        if ref is None:
+            failures.append(f"shape {shape}: not in committed ledger")
+            continue
+        for phase, pe in entry.get("phases", {}).items():
+            rp = ref.get("phases", {}).get(phase)
+            if rp is None:
+                failures.append(f"{shape} phase {phase}: not in "
+                                "committed ledger")
+                continue
+            for metric in ("bytes_accessed", "flops"):
+                want, got = float(rp[metric]), float(pe[metric])
+                if abs(got - want) > rtol * abs(want):
+                    failures.append(
+                        f"{shape} phase {phase}: {metric} drifted: "
+                        f"measured {got:.0f} vs committed {want:.0f}")
+    return failures
+
+
+def load_ledger(path: str = LEDGER_PATH) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Compile tracer: XLA compiles / jaxpr retraces as asserted counters.
+
+
+class CompileTracer:
+    """Counts XLA backend compiles and jaxpr (re)traces inside a scope.
+
+    Uses ``jax.monitoring``'s duration events — process-global, so the
+    counts cover EVERYTHING compiled while the scope is open (including
+    incidental helper jits); scope tightly around the dispatch under
+    test.  The fleet sweep compiler's one-compile-per-group promise is
+    asserted with this (tools/fleet.py records ``xla_compiles`` per
+    group; tests/test_fleet.py pins it in tier-1), and scenario/sweep
+    harnesses can wrap whole runs to catch retrace storms (graftlint R2
+    finds static hazards; this counts the dynamic reality).
+
+    Zero cost when not in use: nothing registers at import, and the
+    listener is removed on exit — the disabled 1M step stays pinned
+    byte-identical to ``artifacts/step_cost_1M_baseline.json``.
+    """
+
+    _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+    _TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+
+    def __init__(self):
+        self.compiles = 0
+        self.traces = 0
+        self.compile_seconds = 0.0
+        self._cb = None
+        self._active = False
+
+    def __enter__(self):
+        from jax._src import monitoring
+
+        def _on_duration(name, secs, **kw):
+            if not self._active:
+                return        # scope closed: never count, even if the
+            #                   unregister below was unavailable
+            if name == self._COMPILE_EVENT:
+                self.compiles += 1
+                self.compile_seconds += float(secs)
+            elif name == self._TRACE_EVENT:
+                self.traces += 1
+
+        self._cb = _on_duration
+        self._active = True
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        return self
+
+    def __exit__(self, *exc):
+        from jax._src import monitoring
+
+        # Deactivate FIRST: counting stops at scope exit even when the
+        # jax._src private unregister helper is missing (it has no
+        # public counterpart; a jax upgrade may move it) — a leaked but
+        # inert listener is a tiny callback cost, never a double count.
+        self._active = False
+        unregister = getattr(
+            monitoring,
+            "_unregister_event_duration_listener_by_callback", None)
+        if unregister is not None:
+            unregister(self._cb)
+        self._cb = None
+        return False
+
+    def counts(self) -> dict:
+        return {"xla_compiles": self.compiles,
+                "jaxpr_traces": self.traces,
+                "compile_seconds": round(self.compile_seconds, 2)}
+
+
+# ---------------------------------------------------------------------------
+# Multichip-log SPMD warning parser: item 2's acceptance as numbers.
+
+# Two wordings in the wild for the SAME spmd_partitioner warning: the
+# axon-TPU builds in MULTICHIP_r0*.json say "[SPMD] ... The compiler
+# cannot go from sharding {A} to {B} efficiently for HLO operation
+# %op.N"; this image's XLA:CPU says "[spmd] ... was not able to go from
+# sharding {A} to {B} without doing a full rematerialization ... for
+# HLO operation: %op.N".  Match both.
+_REMAT_RE = re.compile(r"\[spmd\] involuntary full rematerialization",
+                       re.IGNORECASE)
+_TRANSITION_RE = re.compile(
+    r"go from sharding \{(devices=[^}]*)\}(?:[^{}]*)to "
+    r"(?:sharding )?\{(devices=[^}]*)\}")
+_OP_RE = re.compile(r"for HLO operation:? %([a-zA-Z_\-]+)[.\d]*")
+
+
+def spmd_warning_counts(text: str) -> dict:
+    """Structured counts of SPMD partitioner warnings in a log text.
+
+    ``involuntary_remat`` is ROADMAP item 2's acceptance number ("zero
+    involuntary-remat warnings in the dryrun"); ``resharding`` counts
+    every forced sharding transition the partitioner complained about,
+    keyed by (from -> to) pair in ``transitions`` and by HLO op family
+    in ``ops`` — the bisect map for making the peer-axis sharding
+    explicit end-to-end.
+    """
+    remat = len(_REMAT_RE.findall(text))
+    transitions: dict[str, int] = {}
+    for src, dst in _TRANSITION_RE.findall(text):
+        key = f"{src} -> {dst}"
+        transitions[key] = transitions.get(key, 0) + 1
+    ops: dict[str, int] = {}
+    for op in _OP_RE.findall(text):
+        ops[op] = ops.get(op, 0) + 1
+    return {
+        "involuntary_remat": remat,
+        "resharding": sum(transitions.values()),
+        "transitions": transitions,
+        "ops": ops,
+    }
+
+
+def annotate_multichip_record(path: str, write: bool = False) -> dict:
+    """Parse one MULTICHIP_*.json record's ``tail`` (or a raw log file)
+    into :func:`spmd_warning_counts`; ``write=True`` folds the counts
+    back into the JSON as a ``spmd_warnings`` field so "zero
+    involuntary-remat warnings" is a greppable, diffable number even
+    for runs that timed out (rc 124) with only a partial tail."""
+    with open(path) as f:
+        text = f.read()
+    doc = None
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    source = doc.get("tail", "") if isinstance(doc, dict) else text
+    counts = spmd_warning_counts(source or "")
+    if isinstance(doc, dict):
+        counts["tail_truncated"] = len(source or "") >= 2000
+    if write and isinstance(doc, dict):
+        doc["spmd_warnings"] = counts
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+    return counts

@@ -790,19 +790,23 @@ def step(state: PeerState, cfg: CommunityConfig,
     if cfg.churn_rate > 0.0:
         reborn = state.alive & ~state.is_tracker & (
             rng.rand_uniform(seed, rnd, idx, rng.P_CHURN) < cfg.churn_rate)
-        (tab, stc, fwd, dly, auth, sig, mal, global_time,
-         session) = _rebirth_wipe(
-            reborn, tab=_tab(state), stc=_store(state),
-            fwd=(state.fwd_gt, state.fwd_member, state.fwd_meta,
-                 state.fwd_payload, state.fwd_aux),
-            dly=(state.dly_gt, state.dly_member, state.dly_meta,
-                 state.dly_payload, state.dly_aux, state.dly_since,
-                 state.dly_src),
-            auth=_auth(state),
-            sig=(state.sig_target, state.sig_meta, state.sig_payload,
-                 state.sig_gt, state.sig_since),
-            mal=state.mal_member, global_time=state.global_time,
-            session=state.session)
+        # named_scope: metadata-only phase labels for profiler traces /
+        # the cost ledger (costmodel.py) — zero effect on the compiled
+        # program (the 1M byte-identity pin proves it).
+        with jax.named_scope("churn"):
+            (tab, stc, fwd, dly, auth, sig, mal, global_time,
+             session) = _rebirth_wipe(
+                reborn, tab=_tab(state), stc=_store(state),
+                fwd=(state.fwd_gt, state.fwd_member, state.fwd_meta,
+                     state.fwd_payload, state.fwd_aux),
+                dly=(state.dly_gt, state.dly_member, state.dly_meta,
+                     state.dly_payload, state.dly_aux, state.dly_since,
+                     state.dly_src),
+                auth=_auth(state),
+                sig=(state.sig_target, state.sig_meta, state.sig_payload,
+                     state.sig_gt, state.sig_since),
+                mal=state.mal_member, global_time=state.global_time,
+                session=state.session)
     else:
         tab, stc = _tab(state), _store(state)
         fwd = (state.fwd_gt, state.fwd_member, state.fwd_meta,
@@ -897,8 +901,9 @@ def step(state: PeerState, cfg: CommunityConfig,
     # walker — it stays connected purely through inbound requests).
     boot_base, boot_count, mem_base, mem_count = _layout_cols(cfg, idx)
     if cfg.walker_enabled:
-        target = cand.sample_walk_target(tab, now, cfg, seed, rnd, idx,
-                                         boot_base, boot_count)
+        with jax.named_scope("walk"):
+            target = cand.sample_walk_target(tab, now, cfg, seed, rnd,
+                                             idx, boot_base, boot_count)
         target = jnp.where(act & ~state.is_tracker & ~killed, target,
                            NO_PEER)
         if rc.enabled:
@@ -935,12 +940,15 @@ def step(state: PeerState, cfg: CommunityConfig,
         if bloom.gather_backend():
             rec_probes = bloom.probe_bits(rec_h, cfg.bloom_bits,
                                           cfg.bloom_hashes, salt=rnd)
-            my_bloom = bloom.bloom_build_from(rec_probes, in_slice,
-                                              cfg.bloom_bits)
+            with jax.named_scope("bloom_build"):
+                my_bloom = bloom.bloom_build_from(rec_probes, in_slice,
+                                                  cfg.bloom_bits)
         else:
             rec_probes = None
-            my_bloom = bloom.bloom_build(rec_h, in_slice, cfg.bloom_bits,
-                                         cfg.bloom_hashes, salt=rnd)
+            with jax.named_scope("bloom_build"):
+                my_bloom = bloom.bloom_build(rec_h, in_slice,
+                                             cfg.bloom_bits,
+                                             cfg.bloom_hashes, salt=rnd)
     else:
         zu = jnp.zeros((n,), jnp.uint32)
         sl = st.SyncSlice(time_low=zu, time_high=zu, modulo=zu, offset=zu)
@@ -1106,10 +1114,11 @@ def step(state: PeerState, cfg: CommunityConfig,
                                            cfg.priorities)
         else:
             push_cls = None
-        push = inbox.deliver(
-            dst=jnp.concatenate(e_dst), cols=push_cols,
-            valid=jnp.concatenate(e_valid), n_peers=n,
-            inbox_size=cfg.push_inbox, cls=push_cls)
+        with jax.named_scope("deliver_push"):
+            push = inbox.deliver(
+                dst=jnp.concatenate(e_dst), cols=push_cols,
+                valid=jnp.concatenate(e_valid), n_peers=n,
+                inbox_size=cfg.push_inbox, cls=push_cls)
         ph_gt, ph_member, ph_meta, ph_payload, ph_aux = push.inbox[:5]
         if fm.flood_enabled:
             ph_junk = push.inbox[-1]                              # bool[N, Q]
@@ -1203,11 +1212,13 @@ def step(state: PeerState, cfg: CommunityConfig,
     gt_at_send = global_time
 
     # Normal-peer request inbox: [N, R] with the full sync payload.
-    req = inbox.deliver(
-        dst=target,
-        cols=[idx.astype(jnp.uint32), sl.time_low, sl.time_high, sl.modulo,
-              sl.offset, gt_at_send, my_bloom],
-        valid=send_ok & ~to_tracker, n_peers=n, inbox_size=cfg.request_inbox)
+    with jax.named_scope("deliver_request"):
+        req = inbox.deliver(
+            dst=target,
+            cols=[idx.astype(jnp.uint32), sl.time_low, sl.time_high,
+                  sl.modulo, sl.offset, gt_at_send, my_bloom],
+            valid=send_ok & ~to_tracker, n_peers=n,
+            inbox_size=cfg.request_inbox)
     (rq_src, rq_tlow, rq_thigh, rq_mod, rq_off, rq_gt, rq_bloom) = req.inbox
     arrivals = arrivals | jnp.any(req.inbox_valid, axis=1)
     rq_ok = req.inbox_valid & act[:, None]                   # [N, R]
@@ -2450,11 +2461,13 @@ def step(state: PeerState, cfg: CommunityConfig,
             & counted[:, :, None], axis=1).astype(jnp.uint32)     # [N, K+1]
         stats = stats.replace(
             accepted_by_meta=stats.accepted_by_meta + contrib)
-        ins = st.store_insert(
-            stc,
-            st.StoreCols(gt=in_gt, member=in_member, meta=in_meta,
-                         payload=in_payload, aux=in_aux, flags=in_flags),
-            new_mask=accept_store, history=cfg.history)
+        with jax.named_scope("store_merge"):
+            ins = st.store_insert(
+                stc,
+                st.StoreCols(gt=in_gt, member=in_member, meta=in_meta,
+                             payload=in_payload, aux=in_aux,
+                             flags=in_flags),
+                new_mask=accept_store, history=cfg.history)
         stc = ins.store
         global_time = _fold_gt(global_time, in_gt, accept,
                                cfg.acceptable_global_time_range)
@@ -2799,11 +2812,13 @@ def step(state: PeerState, cfg: CommunityConfig,
             }
         else:
             hists = None
-        tele_row = _telemetry_row(cfg, rnd=rnd, new_time=new_time,
-                                  members=members, stats=stats, stc=stc,
-                                  health=health, store_cnt=store_cnt,
-                                  cand_cnt=cand_cnt, hists=hists,
-                                  bucket=bucket_new)
+        with jax.named_scope("telemetry_row"):
+            tele_row = _telemetry_row(cfg, rnd=rnd, new_time=new_time,
+                                      members=members, stats=stats,
+                                      stc=stc, health=health,
+                                      store_cnt=store_cnt,
+                                      cand_cnt=cand_cnt, hists=hists,
+                                      bucket=bucket_new)
         if cfg.telemetry.history:
             # Post-step round r+1 lands at slot r % H; the row's own
             # round word identifies the slot at drain time.

@@ -77,3 +77,18 @@ def state_sharding(state: PeerState, mesh: Mesh, n_peers: int):
 def shard_state(state: PeerState, mesh: Mesh, n_peers: int) -> PeerState:
     """Place ``state`` on the mesh, peer axis sharded, scalars replicated."""
     return jax.device_put(state, state_sharding(state, mesh, n_peers))
+
+
+def sharded_shape_structs(shapes, mesh: Mesh, n_peers: int):
+    """Attach the peer-axis sharding to a ``ShapeDtypeStruct`` pytree.
+
+    ``state_sharding``'s placement rule, but for ABSTRACT shapes: the
+    returned structs let ``jit(step).lower(...)`` compile the sharded
+    program without materializing a byte — how the cost ledger
+    (``dispersy_tpu/costmodel.py``) and ``profiling.sharded_step_cost``
+    price a multi-chip round on a host that has no chips.
+    """
+    shardings = state_sharding(shapes, mesh, n_peers)
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes, shardings)

@@ -149,12 +149,20 @@ def test_traced_grid_of_8_points_compiles_exactly_once():
     assert len(groups) == 1 and len(groups[0]["points"]) == 8
     entry = run_group(groups[0], rounds=2)
     assert entry["compiles"] == 1, entry
-    # new traced VALUES, same structure: zero recompiles
+    # The CompileTracer (costmodel.py) independently witnesses the same
+    # promise from the XLA runtime's side: exactly one backend compile
+    # happened while the group's step loop ran.
+    assert entry["xla_compiles"] == 1, entry
+    assert entry["jaxpr_traces"] >= 1, entry
+    # new traced VALUES, same structure: zero recompiles AND zero
+    # retraces (the dynamic counterpart of graftlint R2's static check)
     groups2 = compile_sweep({**spec, "axes": {
         "seed": [10, 11, 12, 13, 14, 15, 16, 17],
         "faults.corrupt_rate": [0.22], "packet_loss": [0.17]}})
     entry2 = run_group(groups2[0], rounds=2)
     assert entry2["compiles"] == 0, entry2
+    assert entry2["xla_compiles"] == 0, entry2
+    assert entry2["jaxpr_traces"] == 0, entry2
 
 
 def test_sweep_compiler_grouping_semantics():

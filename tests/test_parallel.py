@@ -131,10 +131,14 @@ def test_dryrun_parent_never_imports_jax(monkeypatch):
     seen = {}
 
     class FakeProc:
+        # the parent tees the child's combined output through a pump
+        # thread (SPMD warning counting) — give it an empty stream
+        stdout = iter(())
+
         def poll(self):
             return 0
 
-    def fake_popen(cmd, cwd=None, env=None):
+    def fake_popen(cmd, cwd=None, env=None, **kw):
         seen["cmd"], seen["env"] = cmd, env
         return FakeProc()
 

@@ -229,6 +229,7 @@ def run_group(group: dict, rounds: int) -> dict:
     import numpy as np
 
     from dispersy_tpu import fleet
+    from dispersy_tpu.costmodel import CompileTracer
 
     cfg = group["cfg"]
     t0 = time.time()
@@ -236,9 +237,15 @@ def run_group(group: dict, rounds: int) -> dict:
     fstate = fleet.init_fleet(cfg, group["seeds"])
     ov = (fleet.make_overrides(cfg, **group["overrides"])
           if group["overrides"] else None)
-    for _ in range(rounds):
-        fstate = fleet.fleet_step(fstate, cfg, ov)
-    fstate = jax.block_until_ready(fstate)
+    # Two independent compile counters witness one-compile-per-group:
+    # fleet_step's own jit cache-size delta (the cache-key view) and the
+    # CompileTracer's XLA backend-compile event count (the
+    # ground-truth-from-the-runtime view).  Both land in the artifact;
+    # tests/test_fleet.py asserts both in tier-1.
+    with CompileTracer() as tracer:
+        for _ in range(rounds):
+            fstate = fleet.fleet_step(fstate, cfg, ov)
+        fstate = jax.block_until_ready(fstate)
     compiles = fleet.compile_count() - c0
 
     # Per-replica summaries: ONE stacked transfer per counter family.
@@ -259,6 +266,8 @@ def run_group(group: dict, rounds: int) -> dict:
         "signature": list(enablement_signature(cfg)),
         "traced_knobs": sorted(group["overrides"]),
         "compiles": compiles,
+        "xla_compiles": tracer.compiles,
+        "jaxpr_traces": tracer.traces,
         "rounds": rounds,
         "wall_seconds": round(time.time() - t0, 2),
         "points": summaries,

@@ -1,0 +1,252 @@
+"""Cost-ledger CLI: build, gate, roofline render, SPMD-warning parse.
+
+The machine-checked face of ``dispersy_tpu/costmodel.py`` (the perf-
+observability plane).  Four subcommands:
+
+    python tools/ledger.py build [--out artifacts/cost_ledger.json]
+                                 [--cells 64k_cpu/default,...]
+                                 [--no-phases]
+        Cost-analyze the committed (shape x plane) grid and write the
+        ledger artifact.  Abstract shapes only — the 1M cells compile
+        on any host.  THE way a perf PR records its improvement: land
+        the optimization, rebuild the ledger, commit both.
+
+    python tools/ledger.py gate [--ledger artifacts/cost_ledger.json]
+                                [--cells 64k_cpu/default,...]
+                                [--from measured.json] [--rtol R]
+        Re-measure the named cells (or load a measured ledger with
+        ``--from``) and hold them to the committed ledger's per-cell
+        byte/flop budgets, BOTH directions: a regression fails, and so
+        does an unrecorded improvement.  Exit 2 on any cell out of
+        budget.  tests/test_ledger.py wires the cheap cells into
+        tier-1, generalizing the lone step_cost_1M_baseline.json pin.
+
+    python tools/ledger.py roofline [--ledger ...]
+        Render the per-phase bytes/peer/round table and the rounds/s
+        projections from the committed ledger — the generated
+        replacement for BENCH.md's hand-maintained roofline table
+        (BENCH.md points here as its regeneration command).
+
+    python tools/ledger.py spmd FILE [FILE...] [--write]
+        Parse involuntary-remat / resharding warnings out of
+        MULTICHIP_*.json tails (or raw dryrun logs) into structured
+        counts; ``--write`` folds a ``spmd_warnings`` field back into
+        the JSON so ROADMAP item 2's "zero involuntary-remat warnings"
+        is a checkable number even for rc-124 partial runs.
+
+Exit codes: 0 ok, 1 usage/IO error, 2 gate failure.
+
+The build/gate measurement runs in a scrubbed CPU-pinned subprocess
+(the axon-tunnel discipline, cpuenv.py); the parent imports no jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dispersy_tpu import costmodel  # noqa: E402 — jax-free import
+from dispersy_tpu.cpuenv import cpu_env  # noqa: E402
+
+WORKER_TIMEOUT_S = int(os.environ.get("LEDGER_TIMEOUT", "1800"))
+
+
+def _parse_cells(spec: str | None) -> list | None:
+    if not spec:
+        return None
+    cells = []
+    for token in spec.split(","):
+        token = token.strip()
+        shape, _, plane = token.partition("/")
+        if shape not in costmodel.SHAPES or plane not in costmodel.PLANES:
+            raise SystemExit(f"unknown cell {token!r}; shapes="
+                             f"{sorted(costmodel.SHAPES)} "
+                             f"planes={list(costmodel.PLANES)}")
+        cells.append((shape, plane))
+    return cells
+
+
+def _measure(cells, with_phases: bool) -> dict:
+    """Run the build in a bounded CPU-pinned worker; return the doc."""
+    argv = [sys.executable, os.path.abspath(__file__), "--worker",
+            "--no-phases" if not with_phases else "--phases"]
+    if cells is not None:
+        argv += ["--cells", ",".join(costmodel.cell_key(s, p)
+                                     for s, p in cells)]
+    try:
+        proc = subprocess.run(
+            argv, env=cpu_env(), timeout=WORKER_TIMEOUT_S,
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    except subprocess.TimeoutExpired:
+        raise SystemExit(f"ledger worker timed out ({WORKER_TIMEOUT_S}s)")
+    sys.stderr.write(proc.stderr[-3000:])
+    for line in proc.stdout.splitlines():
+        if line.startswith("LEDGER_JSON:"):
+            return json.loads(line[len("LEDGER_JSON:"):])
+    raise SystemExit(f"ledger worker rc={proc.returncode}, no result "
+                     f"line; stdout tail: {proc.stdout[-2000:]}")
+
+
+def _worker(args) -> None:
+    cells = _parse_cells(args.cells)
+    doc = costmodel.build_ledger(
+        cells=cells, with_phases=args.phases,
+        progress=lambda m: print(m, file=sys.stderr, flush=True))
+    print("LEDGER_JSON:" + json.dumps(doc), flush=True)
+
+
+def cmd_build(args) -> int:
+    cells = _parse_cells(args.cells)
+    doc = _measure(cells, with_phases=not args.no_phases)
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, args.out)
+    print(json.dumps({"tool": "ledger_build", "out": args.out,
+                      "cells": len(doc["cells"]),
+                      "shapes": sorted(doc["shapes"])}))
+    return 0
+
+
+def cmd_gate(args) -> int:
+    committed = costmodel.load_ledger(args.ledger)
+    if args.from_file:
+        with open(args.from_file) as f:
+            measured = json.load(f)
+    else:
+        cells = _parse_cells(args.cells) or costmodel.default_cells()
+        measured = _measure(cells, with_phases=not args.no_phases)
+    failures = costmodel.compare_ledgers(measured, committed,
+                                         rtol=args.rtol)
+    for f in failures:
+        print(f"gate: {f}")
+    if failures:
+        print(f"gate: {len(failures)} cell(s) out of budget vs "
+              f"{args.ledger} — a real regression reverts; a real "
+              "improvement lands by rebuilding the ledger "
+              "(tools/ledger.py build)")
+        return 2
+    n = len(measured.get("cells", {}))
+    print(f"gate: {n} cell(s) within budget vs {args.ledger}")
+    return 0
+
+
+def cmd_roofline(args) -> int:
+    doc = costmodel.load_ledger(args.ledger)
+    lines = []
+    for shape, entry in sorted(doc.get("shapes", {}).items()):
+        n = entry["n_peers"]
+        lines.append(f"### {shape} (N={n:,}) — per-phase cost-analysis "
+                     "bytes")
+        lines.append("")
+        lines.append("| phase | bytes/round | B/peer/round | flops/round |")
+        lines.append("|---|---|---|---|")
+        for phase, pe in entry["phases"].items():
+            lines.append(
+                f"| {phase} | {pe['bytes_accessed']:,.0f} | "
+                f"{pe['bytes_per_peer_round']:,.1f} | "
+                f"{pe['flops']:,.0f} |")
+        lines.append("")
+    lines.append("### Roofline projection (rounds/s; fullfuse = one "
+                 "read+write pass over resident state, nofuse = raw "
+                 "cost-analysis bytes)")
+    lines.append("")
+    lines.append("| cell | B/peer/round | state r+w B/peer | "
+                 + " | ".join(
+                     f"{hw}_x{c}"
+                     for hw, spec in doc["hardware_model"].items()
+                     for c in spec["chip_counts"]) + " |")
+    lines.append("|---|---|---|"
+                 + "---|" * sum(len(s["chip_counts"])
+                                for s in doc["hardware_model"].values()))
+    for key, cell in sorted(doc.get("cells", {}).items()):
+        cols = []
+        for hw, spec in doc["hardware_model"].items():
+            for c in spec["chip_counts"]:
+                r = cell["roofline"].get(f"{hw}_x{c}", {})
+                cols.append(f"{r.get('rounds_per_sec_nofuse', 0):,.0f}–"
+                            f"{r.get('rounds_per_sec_fullfuse', 0):,.0f}")
+        lines.append(f"| {key} | {cell['bytes_per_peer_round']:,.1f} | "
+                     f"{cell['state']['state_rw_per_peer_round']:,.1f} | "
+                     + " | ".join(cols) + " |")
+    text = "\n".join(lines)
+    print(text)
+    return 0
+
+
+def cmd_spmd(args) -> int:
+    out = {}
+    for path in args.files:
+        counts = costmodel.annotate_multichip_record(path,
+                                                     write=args.write)
+        out[os.path.basename(path)] = counts
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ledger")
+    ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--cells", default=None,
+                    help="comma-separated shape/plane cell subset")
+    ap.add_argument("--phases", dest="phases", action="store_true",
+                    default=True, help=argparse.SUPPRESS)
+    ap.add_argument("--no-phases", dest="phases", action="store_false",
+                    help="skip the per-phase kernel table")
+    sub = ap.add_subparsers(dest="cmd")
+
+    p = sub.add_parser("build", help="measure the grid, write the ledger")
+    p.add_argument("--out", default=costmodel.LEDGER_PATH)
+    p.add_argument("--cells", default=None)
+    p.add_argument("--no-phases", action="store_true")
+    p.set_defaults(fn=cmd_build)
+
+    p = sub.add_parser("gate",
+                       help="hold measured cells to the committed budgets")
+    p.add_argument("--ledger", default=costmodel.LEDGER_PATH)
+    p.add_argument("--cells", default=None)
+    p.add_argument("--from", dest="from_file", default=None,
+                   help="gate a previously-measured ledger JSON instead "
+                        "of re-measuring")
+    p.add_argument("--rtol", type=float, default=0.0,
+                   help="relative tolerance per budget (cost analysis "
+                        "is deterministic per jaxlib; default exact)")
+    p.add_argument("--no-phases", action="store_true")
+    p.set_defaults(fn=cmd_gate)
+
+    p = sub.add_parser("roofline",
+                       help="render phase table + rounds/s projection "
+                            "from the committed ledger (BENCH.md "
+                            "regeneration command)")
+    p.add_argument("--ledger", default=costmodel.LEDGER_PATH)
+    p.set_defaults(fn=cmd_roofline)
+
+    p = sub.add_parser("spmd",
+                       help="structured SPMD warning counts from "
+                            "MULTICHIP_*.json / dryrun logs")
+    p.add_argument("files", nargs="+")
+    p.add_argument("--write", action="store_true",
+                   help="fold counts back into the JSON record(s)")
+    p.set_defaults(fn=cmd_spmd)
+
+    args = ap.parse_args(argv)
+    if args.worker:
+        _worker(args)
+        return 0
+    if not getattr(args, "fn", None):
+        ap.print_help()
+        return 1
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

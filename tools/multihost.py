@@ -37,6 +37,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from dispersy_tpu.cpuenv import cpu_env  # jax-free import
+from dispersy_tpu.costmodel import spmd_warning_counts  # jax-free import
 
 WORKER_TIMEOUT_S = int(os.environ.get("MULTIHOST_TIMEOUT", "1500"))
 DEVICES_PER_PROCESS = 4
@@ -517,6 +518,11 @@ def main() -> None:
                    "cluster)" if args.mode == "broadcast" else
                    "everything-on (all policy axes, pens, faults, NAT, "
                    "identity, 2 communities)"),
+        # Structured SPMD partitioner warning counts across every
+        # worker log (dispersy_tpu/costmodel.py) — emitted EVEN when the
+        # cluster timed out or failed, so a partial run still grades
+        # ROADMAP item 2's "zero involuntary-remat warnings" criterion.
+        "spmd_warnings": spmd_warning_counts("".join(outs)),
     }
     for line in outs[0].splitlines() if outs else []:
         if line.startswith("CKPT_ROUNDTRIP "):

@@ -206,6 +206,7 @@ def _worker(args) -> None:
                 jax.block_until_ready(state)
         result["trace_dir"] = args.trace_dir
         result["top_ops"] = _aggregate_trace(args.trace_dir)
+        result["phase_scopes"] = _phase_scope_totals(args.trace_dir)
     else:
         t0 = time.perf_counter()
         for _ in range(args.rounds):
@@ -251,6 +252,42 @@ def _aggregate_trace(trace_dir: str, top: int = 25) -> list:
         agg[name] = agg.get(name, 0.0) + e.get("dur", 0)
     return [{"op": k, "total_us": round(v, 1)}
             for k, v in sorted(agg.items(), key=lambda kv: -kv[1])[:top]]
+
+
+# engine.step's jax.named_scope phase labels (metadata-only; the cost
+# ledger's phase table uses the same names, so trace time and
+# cost-analysis bytes join on one key).
+PHASE_SCOPES = ("churn", "walk", "deliver_request", "deliver_push",
+                "bloom_build", "store_merge", "telemetry_row")
+
+
+def _phase_scope_totals(trace_dir: str) -> dict:
+    """Total device-track microseconds per engine.step named scope.
+
+    On TPU the XLA op metadata carries the scope path, so per-phase
+    wall attribution falls straight out of the trace; on CPU (no
+    per-op device track) scopes rarely appear and the dict is empty —
+    the kernel-proxy mode covers that backend.
+    """
+    pj = sorted(glob.glob(trace_dir + "/**/*trace.json.gz", recursive=True))
+    if not pj:
+        return {}
+    ev = json.load(gzip.open(pj[-1]))["traceEvents"]
+    agg: dict[str, float] = {}
+    for e in ev:
+        if e.get("ph") != "X":
+            continue
+        blob = e.get("name", "")
+        args = e.get("args")
+        if isinstance(args, dict):
+            blob += " " + str(args.get("long_name", "")) \
+                + " " + str(args.get("tf_op", ""))
+        for scope in PHASE_SCOPES:
+            if scope in blob:
+                agg[scope] = agg.get(scope, 0.0) + e.get("dur", 0)
+                break
+    return {k: round(v, 1) for k, v in
+            sorted(agg.items(), key=lambda kv: -kv[1])}
 
 
 def main() -> None:

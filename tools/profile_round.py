@@ -24,8 +24,11 @@ Output: one JSON object — ``step`` holds the fused round's totals
 when ``--time``), ``phases`` the per-phase breakdown (churn, walk,
 deliver_request, deliver_push, bloom_build, bloom_query, store_merge,
 timeline).  Phases are standalone compilations of the REAL ops kernels
-at the step's exact shapes; fusion inside the full step shares reads, so
-phase bytes legitimately sum past the step total.
+at the step's exact shapes; no bracketing vs the step total holds in
+either direction (fusion shares reads; the table covers the dominant
+kernels, not every phase — see profiling.phase_kernels and the cost
+ledger, tools/ledger.py, which supersedes this tool for committed
+numbers).
 
 Every JAX-touching run happens in a bounded subprocess (the axon tunnel
 discipline — dispersy_tpu/cpuenv.py); the parent writes the artifact.

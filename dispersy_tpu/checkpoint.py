@@ -94,7 +94,7 @@ from dispersy_tpu.telemetry import TelemetryConfig
 #     is refused (_want_fingerprint strips the ``recovery=...`` repr
 #     component, plus ``telemetry=`` pre-v10 and ``faults=`` pre-v9).
 #     v11 FLEET archives load through ``restore_fleet`` the same way.
-FORMAT_VERSION = 13  # v13: the ingress-protection leaves (bucket +
+# v13: the ingress-protection leaves (bucket +
 #     the stats msgs_shed_rate / msgs_shed_priority counters,
 #     knob-sized — dispersy_tpu/overload.py; OVERLOAD.md).  v7-v12
 #     archives still load: their missing overload leaves default to
@@ -105,8 +105,22 @@ FORMAT_VERSION = 13  # v13: the ingress-protection leaves (bucket +
 #     the ``overload=...`` repr component first, then the older
 #     planes').  v11/v12 FLEET archives load through ``restore_fleet``
 #     the same way.
-_ACCEPTED_VERSIONS = (7, 8, 9, 10, 11, 12, FORMAT_VERSION)
-_FLEET_VERSIONS = (11, 12, FORMAT_VERSION)
+FORMAT_VERSION = 14  # v14: the byte-diet store-plane leaves (sta_* +
+#     digest, knob-sized — dispersy_tpu/storediet.py; the STORE section
+#     in README) plus the PLANE-SIZED community-feature leaves: the
+#     auth table / blacklist / signature cache and ~13 feature-gated
+#     stats counters are zero-width when their feature is compiled out
+#     (state.stats_gates), and the aux columns may be u16 under
+#     store.aux_bits=16.  v7-v13 archives still load: missing staging/
+#     digest leaves default to the template's (empty) values, their
+#     config fingerprint predates the ``store`` field (declared
+#     fifth-to-last, directly before ``overload``) — restoring one
+#     under a non-default StoreConfig is refused — and a pre-v14
+#     archive's FULL-width auth/mal/sig/stats leaves for a plane the
+#     config compiles out are CRC-verified, asserted empty, and sized
+#     down (_resize_plane_leaf).
+_ACCEPTED_VERSIONS = (7, 8, 9, 10, 11, 12, 13, FORMAT_VERSION)
+_FLEET_VERSIONS = (11, 12, 13, FORMAT_VERSION)
 
 # Leaves whose dtype narrowed u32 -> u8 at v8; a v7 archive's u32 arrays
 # convert by truncation (0xFFFFFFFF -> 0xFF, real values < 256 unchanged).
@@ -138,6 +152,57 @@ _NEW_V12 = frozenset(
 # by _want_fingerprint), where every one of these is zero-width.
 _NEW_V13 = frozenset(
     {"bucket", "stats/msgs_shed_rate", "stats/msgs_shed_priority"})
+
+# Leaves that did not exist before v14 (the byte-diet store plane).
+# Older archives only restore under a default StoreConfig (enforced by
+# _want_fingerprint), where every one of these is zero-width.
+_NEW_V14 = frozenset(
+    {"sta_gt", "sta_member", "sta_meta", "sta_payload", "sta_aux",
+     "sta_flags", "digest"})
+
+# Leaves v14 PLANE-SIZED (zero-width when their community feature is
+# compiled out — state.py init_state / stats_gates): a pre-v14 archive
+# carries them at full width but PROVABLY EMPTY (the engine only ever
+# writes them under the same feature flags), so restore verifies the
+# CRC, asserts every element is the leaf's empty value, and sizes the
+# leaf down to the template.  Map: leaf name -> its empty fill.
+_PLANE_SIZED_FILLS = {
+    "auth_member": 0xFFFFFFFF, "auth_mask": 0, "auth_gt": 0,
+    "auth_rev": False, "auth_issuer": 0xFFFFFFFF,
+    "mal_member": 0xFFFFFFFF,
+    "sig_target": -1, "sig_meta": 0, "sig_payload": 0, "sig_gt": 0,
+    "sig_since": 0,
+    **{f"stats/{nm}": 0 for nm in (
+        "msgs_rejected", "msgs_direct", "msgs_delayed",
+        "proof_requests", "proof_records", "seq_requests", "seq_records",
+        "mm_requests", "mm_records", "id_requests", "id_records",
+        "sig_signed", "sig_done", "sig_expired", "conflicts",
+        "convictions_rx", "auth_unwound", "msgs_retro")},
+}
+
+
+def _resize_plane_leaf(name: str, arr: np.ndarray, t,
+                       what: str, lead_axes: int = 0) -> np.ndarray:
+    """Size a pre-v14 archive's full-width plane leaf down to the
+    template's (possibly zero) width, refusing loudly if any content
+    would be discarded.  ``lead_axes``: extra leading axes to ignore
+    (the fleet reader's replica axis)."""
+    if name not in _PLANE_SIZED_FILLS:
+        return arr
+    t_shape = tuple(t.shape)
+    if tuple(arr.shape[lead_axes:]) == t_shape or arr.dtype != t.dtype:
+        return arr
+    fill = _PLANE_SIZED_FILLS[name]
+    if arr.dtype != np.bool_:
+        fill = np.asarray(fill, arr.dtype)
+    if arr.size and not np.all(arr == fill):
+        raise CheckpointError(
+            f"checkpoint {what}: field {name} carries data for a "
+            "feature the given config compiles out (plane-sized leaf) "
+            "— restore under the config that produced it")
+    lead = tuple(arr.shape[:lead_axes])
+    return np.broadcast_to(np.asarray(fill, t.dtype),
+                           lead + t_shape).copy()
 
 
 def _crc(arr: np.ndarray) -> int:
@@ -182,15 +247,29 @@ def _want_fingerprint(cfg: CommunityConfig, version: int) -> str:
     before ``faults`` (declared LAST) — every repr component strips
     cleanly, but only default models can possibly match what the old
     writer simulated."""
-    if version >= 13:
+    if version >= 14:
         return _fingerprint(cfg)
+    from dispersy_tpu.storediet import StoreConfig
+    if cfg.store != StoreConfig():
+        raise CheckpointError(
+            f"checkpoint format {version} predates the byte-diet store "
+            "plane; it can only restore under the default StoreConfig "
+            "(cfg.store must be StoreConfig())")
+    full = repr(cfg)
+    scomp = f", store={cfg.store!r}"
+    if full.count(scomp) != 1:
+        raise CheckpointError(
+            "cannot derive pre-v14 fingerprint: store is no longer a "
+            "direct config field directly before overload")
+    full = full.replace(scomp, "", 1)
+    if version >= 13:
+        return full
     from dispersy_tpu.overload import OverloadConfig
     if cfg.overload != OverloadConfig():
         raise CheckpointError(
             f"checkpoint format {version} predates the ingress-"
             "protection plane; it can only restore under the default "
             "OverloadConfig (cfg.overload must be OverloadConfig())")
-    full = repr(cfg)
     ocomp = f", overload={cfg.overload!r}"
     if full.count(ocomp) != 1:
         raise CheckpointError(
@@ -337,11 +416,12 @@ def restore(path: str, cfg: CommunityConfig,
                 if (version < 9 and n in _NEW_V9) \
                         or (version < 10 and n in _NEW_V10) \
                         or (version < 12 and n in _NEW_V12) \
-                        or (version < 13 and n in _NEW_V13):
+                        or (version < 13 and n in _NEW_V13) \
+                        or (version < 14 and n in _NEW_V14):
                     # pre-chaos-harness / pre-telemetry / pre-recovery
-                    # / pre-overload archive: the leaf starts at its
-                    # template default (zero-width / empty latch /
-                    # all-good channels)
+                    # / pre-overload / pre-byte-diet archive: the leaf
+                    # starts at its template default (zero-width /
+                    # empty latch / all-good channels)
                     leaves.append(np.asarray(t))
                     continue
                 raise CheckpointError(f"checkpoint missing field {n}")
@@ -350,6 +430,8 @@ def restore(path: str, cfg: CommunityConfig,
                 _verify_crc(z, key, arr, path)
             if version < 8:
                 arr = _upconvert_v7(n, arr, t.dtype)
+            if version < 14:
+                arr = _resize_plane_leaf(n, arr, t, path)
             if arr.shape != t.shape or arr.dtype != t.dtype:
                 raise CheckpointError(
                     f"field {n}: checkpoint {arr.shape}/{arr.dtype} vs "
@@ -454,12 +536,14 @@ def restore_fleet(path: str, cfg: CommunityConfig):
                 key = f"leaf:{n}"
                 if key not in z:
                     if (version < 12 and n in _NEW_V12) \
-                            or (version < 13 and n in _NEW_V13):
-                        # pre-recovery / pre-overload fleet archive:
-                        # only accepted under the default Recovery/
-                        # OverloadConfig (fingerprint check above),
-                        # where every such leaf is zero-width —
-                        # replicate the template default.
+                            or (version < 13 and n in _NEW_V13) \
+                            or (version < 14 and n in _NEW_V14):
+                        # pre-recovery / pre-overload / pre-byte-diet
+                        # fleet archive: only accepted under the
+                        # default Recovery/Overload/StoreConfig
+                        # (fingerprint check above), where every such
+                        # leaf is zero-width — replicate the template
+                        # default.
                         leaves.append(np.zeros((n_rep,) + tuple(t.shape),
                                                t.dtype))
                         continue
@@ -467,6 +551,9 @@ def restore_fleet(path: str, cfg: CommunityConfig):
                         f"fleet checkpoint missing field {n}")
                 arr = z[key]
                 _verify_crc(z, key, arr, path)
+                if version < 14:
+                    arr = _resize_plane_leaf(n, arr, t, path,
+                                             lead_axes=1)
                 want = (n_rep,) + tuple(t.shape)
                 if tuple(arr.shape) != want or arr.dtype != t.dtype:
                     raise CheckpointError(

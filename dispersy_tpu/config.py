@@ -22,6 +22,7 @@ from dispersy_tpu.exceptions import ConfigError
 from dispersy_tpu.faults import FaultModel
 from dispersy_tpu.overload import OverloadConfig
 from dispersy_tpu.recovery import RecoveryConfig
+from dispersy_tpu.storediet import StoreConfig
 from dispersy_tpu.telemetry import MAX_TELEMETRY_PEERS, TelemetryConfig
 
 # Sentinel for "empty slot" in uint32 record fields: sorts after every real
@@ -512,6 +513,18 @@ class CommunityConfig:
     # -1 = auto: the first non-tracker peer (index n_trackers).
     founder_member: int = -1
 
+    # ---- byte-diet store plane (dispersy_tpu/storediet.py: staging
+    #      buffer + amortized compaction, cadenced sync, incremental
+    #      Bloom digest — the ROADMAP item 1 byte diet).  All defaults
+    #      compile to exactly the legacy every-round-merge step.  MUST
+    #      stay the FIFTH-TO-LAST field, directly before ``overload``
+    #      (then ``recovery``, ``telemetry``, ``faults``):
+    #      checkpoint.py reconstructs pre-v14 config fingerprints by
+    #      stripping the trailing ``store=...`` repr component (then
+    #      ``overload=`` pre-v13, ``recovery=`` pre-v12, ``telemetry=``
+    #      pre-v10, ``faults=`` pre-v9). ----
+    store: StoreConfig = StoreConfig()
+
     # ---- ingress-protection plane (dispersy_tpu/overload.py:
     #      per-sender token buckets, priority admission under inbox
     #      overflow, flood-fair drop attribution; OVERLOAD.md).  All
@@ -567,6 +580,20 @@ class CommunityConfig:
     @property
     def bloom_words(self) -> int:
         return self.bloom_bits // 32
+
+    @property
+    def store_diet(self) -> bool:
+        """Is the incremental (staging + digest + cadenced-sync) store
+        plane compiled in?  (dispersy_tpu/storediet.py)"""
+        return self.store.staging > 0
+
+    @property
+    def aux_dtype(self) -> str:
+        """The persistent ``aux`` record-column dtype: u16 under the
+        byte-diet opt-in (store.aux_bits=16), u32 otherwise.  Wire/batch
+        aux stays u32 everywhere; the store boundary truncates (the
+        meta/flags narrowing pattern, ops/store.store_insert)."""
+        return "uint16" if self.store.aux_bits == 16 else "uint32"
 
     @property
     def walk_lifetime_rounds(self) -> float:
@@ -839,6 +866,44 @@ class CommunityConfig:
             if self.push_inbox < 1:
                 raise ConfigError("flooding rides the push channel: "
                                   "push_inbox must be >= 1")
+        sd = self.store
+        if not isinstance(sd, StoreConfig):
+            raise ConfigError("store must be a StoreConfig")
+        if sd.staging > 0:
+            # The incremental store serves/queries through the epoch
+            # digest and defers ring merges; the full-feature check
+            # pipeline (timeline folds, sequence chains, conviction
+            # scans, the delay pen) reads the every-round-merged store
+            # directly and stays on the legacy path.  Gate loudly
+            # instead of silently diverging (STORE.md scope table).
+            for flag, why in (
+                    (self.timeline_enabled,
+                     "timeline folds re-walk the merged store"),
+                    (self.malicious_enabled,
+                     "conviction scans compare arrivals against the "
+                     "merged store"),
+                    (bool(self.seq_meta_mask),
+                     "sequence chains read stored maxima every round"),
+                    (bool(self.double_meta_mask),
+                     "the signature flow stores completions directly"),
+                    (self.delay_enabled,
+                     "the delay pen re-checks against the merged "
+                     "store"),
+                    (self.identity_required,
+                     "the identity gate queries stored identities "
+                     "every round")):
+                if flag:
+                    raise ConfigError(
+                        "store.staging (the incremental byte-diet "
+                        f"store) is incompatible with this knob: {why}; "
+                        "use the legacy store (store.staging=0) for "
+                        "full-feature communities")
+            if self.sync_enabled and self.sync_strategy != "largest":
+                raise ConfigError(
+                    "store.staging requires sync_strategy='largest': "
+                    "the digest covers the newest-window slice; a "
+                    "modulo stripe changes per epoch and would leave "
+                    "digest false negatives for out-of-stripe records")
         ov = self.overload
         if not isinstance(ov, OverloadConfig):
             raise ConfigError("overload must be an OverloadConfig")

@@ -142,24 +142,98 @@ def state_byte_report(cfg) -> dict:
     }
 
 
-def roofline(cost_bytes: float, state_bytes: int, replicas: int = 1) -> dict:
+def active_floor(cfg) -> dict:
+    """Analytical per-round HBM floor from the REAL leaf shapes/dtypes
+    — the ``fullfuse`` numerator since the byte diet (PR 12).
+
+    The pre-diet model charged 2 x the whole resident state every round
+    ("one read+write pass over everything").  Under the incremental
+    store plane (storediet.py) that is provably NOT what a round must
+    move, so each leaf family carries an access class, all derived
+    mechanically from ``jax.eval_shape`` (a dtype narrowing or a
+    plane-sizing change moves the generated number, never a doc edit):
+
+    - ``store_*`` (the sorted ring): touched ONLY at compaction — one
+      read+write pass amortized over ``compact_every`` rounds.  (The
+      quiet round's freshness test reads the DIGEST, not ring keys.)
+    - ``sta_*`` (the staging buffer): the append reads the occupancy
+      column (gt) and writes at most one inbound batch of records.
+    - ``digest``: read (the claim / freshness view) + written (the OR
+      update) every round.
+    - ``cand_*``: the walk reads every slot; an ideal fused round
+      writes only the touched slots (<= request_inbox stumbles + the
+      walk + intro stamps).
+    - everything else (scalars, fwd, stats — already plane-sized to
+      the compiled-in features): read + write every round.
+
+    Without the diet every class degenerates to 2 x bytes — the legacy
+    fullfuse model, unchanged.  Returns per-peer-round byte terms and
+    the total.
+    """
+    import jax
+
+    from dispersy_tpu import profiling
+
+    shapes = profiling.state_shapes(cfg)
+    leaves = {
+        ".".join(str(getattr(p, "name", p)) for p in path): s
+        for path, s in jax.tree_util.tree_flatten_with_path(shapes)[0]}
+    sizes = {k: _leaf_nbytes(s) for k, s in leaves.items()}
+    n = cfg.n_peers
+    total = sum(sizes.values())
+    ring = sum(v for k, v in sizes.items() if k.startswith("store_"))
+    sta = sum(v for k, v in sizes.items() if k.startswith("sta_"))
+    dig = sizes.get("digest", 0)
+    cand = sum(v for k, v in sizes.items() if k.startswith("cand_"))
+    other = total - ring - sta - dig - cand
+    if not cfg.store_diet:
+        terms = {"ring": 2.0 * ring, "staging": 0.0, "digest": 0.0,
+                 "cand": 2.0 * cand, "other": 2.0 * other}
+    else:
+        c = cfg.store.compact_every
+        s_w = cfg.store.staging
+        rec_bytes = sta / max(n * s_w, 1)
+        sta_gt = _leaf_nbytes(leaves["sta_gt"])
+        append = n * min(s_w, cfg.push_inbox) * rec_bytes
+        k = cfg.k_candidates
+        slot_bytes = cand / max(n * k, 1)
+        touched = min(k, cfg.request_inbox + 2)
+        terms = {
+            "ring": 2.0 * ring / c,
+            "staging": sta_gt + append,
+            "digest": 2.0 * dig,
+            "cand": cand + n * touched * slot_bytes,
+            "other": 2.0 * other,
+        }
+    floor_total = sum(terms.values())
+    return {
+        "per_peer_round": {k: round(v / n, 1) for k, v in terms.items()},
+        "floor_bytes_per_peer_round": round(floor_total / n, 1),
+        "floor_bytes_per_round": floor_total,
+    }
+
+
+def roofline(cost_bytes: float, floor_bytes: float,
+             replicas: int = 1) -> dict:
     """Rounds/s projection per :data:`HARDWARE` entry.
 
     Two bounds bracket reality (per replica-round):
 
-    - ``fullfuse``: every kernel fuses into ONE read+write pass over the
-      resident state — HBM traffic = 2 x state bytes.  The optimistic
-      bound the hand-maintained BENCH.md table approximated.
+    - ``fullfuse``: every kernel fuses into ONE pass over the round's
+      ACTIVE state — HBM traffic = :func:`active_floor` bytes (for
+      legacy configs that is exactly the old 2 x state model).  The
+      optimistic bound.
     - ``nofuse``: XLA's cost-analysis bytes taken at face value (every
-      op pays its operands and results to HBM).  The pessimistic bound;
-      real fusion lands in between.
+      op pays its operands and results to HBM); for byte-diet configs
+      the cadence-amortized mean.  The pessimistic bound; real fusion
+      lands in between.
 
     Chip scaling assumes the peer axis splits bytes evenly (the
     sharding story, MULTICHIP/ROADMAP item 2).
     """
     out = {}
     per_replica_cost = cost_bytes / max(replicas, 1)
-    rw = 2.0 * state_bytes / max(replicas, 1)
+    rw = floor_bytes / max(replicas, 1)
     for hw, spec in HARDWARE.items():
         bw = spec["hbm_gbps"] * 1e9
         for chips in spec["chip_counts"]:
@@ -178,26 +252,43 @@ def cell_cost(shape: str, plane: str) -> dict:
     from dispersy_tpu import profiling
 
     cfg, replicas = plane_config(shape, plane)
-    cost = (profiling.fleet_step_cost(cfg, replicas) if replicas > 1
-            else profiling.step_cost(cfg))
+    cost = (profiling.fleet_step_cost_amortized(cfg, replicas)
+            if replicas > 1 else profiling.step_cost_amortized(cfg))
     sb = state_byte_report(cfg)
+    fl = active_floor(cfg)
     n = cfg.n_peers
     cell = {
         "shape": shape,
         "plane": plane,
         "n_peers": n,
         "replicas": replicas,
+        # Cadence-amortized mean over one compaction window for
+        # byte-diet configs (profiling.step_cost_amortized); the plain
+        # per-round cost otherwise.  The quiet/sync split is recorded
+        # so the tier-1 amortization test can hold EACH round kind to
+        # its budget (tests/test_storediet.py).
         "bytes_accessed": cost["bytes_accessed"],
         "flops": cost["flops"],
+        "compact_every": cost.get("compact_every", 1),
+        **({k: cost[k] for k in ("bytes_quiet", "bytes_sync",
+                                 "flops_quiet", "flops_sync")
+            if k in cost}),
         "bytes_per_peer_round": round(
             cost["bytes_accessed"] / (n * replicas), 1),
         "state": sb,
-        "roofline": roofline(cost["bytes_accessed"], sb["state_bytes"]
-                             * replicas, replicas),
+        "floor": fl,
+        "roofline": roofline(cost["bytes_accessed"],
+                             fl["floor_bytes_per_round"] * replicas,
+                             replicas),
         # THE gate contract: tools/ledger.py gate holds a fresh
         # measurement to these numbers, both directions.
         "budget": {"bytes_accessed": cost["bytes_accessed"],
-                   "flops": cost["flops"]},
+                   "flops": cost["flops"],
+                   **({"bytes_quiet": cost["bytes_quiet"],
+                       "bytes_sync": cost["bytes_sync"],
+                       "flops_quiet": cost["flops_quiet"],
+                       "flops_sync": cost["flops_sync"]}
+                      if "bytes_quiet" in cost else {})},
     }
     return cell
 
@@ -287,7 +378,14 @@ def compare_ledgers(measured: dict, committed: dict,
                             "(new cell? regenerate the ledger)")
             continue
         budget = ref.get("budget", ref)
-        for metric in ("bytes_accessed", "flops"):
+        for metric in ("bytes_accessed", "flops", "bytes_quiet",
+                       "bytes_sync", "flops_quiet", "flops_sync"):
+            if metric not in budget:
+                continue
+            if metric not in cell:
+                failures.append(f"{key}: {metric} missing from the "
+                                "fresh measurement")
+                continue
             want, got = float(budget[metric]), float(cell[metric])
             tol = rtol * abs(want)
             if abs(got - want) > tol:

@@ -77,6 +77,7 @@ from dispersy_tpu.ops import intake as ik
 from dispersy_tpu.ops import overload as ovl
 from dispersy_tpu.ops import recovery as rcv
 from dispersy_tpu.recovery import NUM_HEALTH_BITS
+from dispersy_tpu import storediet as sdiet
 from dispersy_tpu.ops import telemetry as tele
 from dispersy_tpu.ops import timeline as tl
 from dispersy_tpu.ops.hashing import record_hash
@@ -269,7 +270,8 @@ def _lost(seed, rnd, edge_peer, salt_base, salt, kn: _EffFaults,
 
 
 def _rebirth_wipe(mask, *, tab, stc, fwd, dly, auth, sig, mal,
-                  global_time, session, wipe_store=True):
+                  global_time, session, wipe_store=True,
+                  sta=None, dig=None):
     """The wiped-disk rebirth wipe on the masked rows — THE one
     inventory, shared by phase 0's churn block and the recovery pass's
     quarantine escalation (the oracle mirrors both call sites): the
@@ -288,13 +290,14 @@ def _rebirth_wipe(mask, *, tab, stc, fwd, dly, auth, sig, mal,
         last_stumble=jnp.where(m1, NEVER, tab.last_stumble),
         last_intro=jnp.where(m1, NEVER, tab.last_intro))
     if wipe_store:
-        stc = st.StoreCols(
-            gt=jnp.where(m1, jnp.uint32(EMPTY_U32), stc.gt),
-            member=jnp.where(m1, jnp.uint32(EMPTY_U32), stc.member),
-            meta=jnp.where(m1, jnp.uint8(EMPTY_META), stc.meta),
-            payload=jnp.where(m1, jnp.uint32(EMPTY_U32), stc.payload),
-            aux=jnp.where(m1, jnp.uint32(0), stc.aux),
-            flags=jnp.where(m1, jnp.uint8(0), stc.flags))
+        stc = _wipe_store_cols(m1, stc)
+    if sta is not None and wipe_store:
+        # The staging buffer is the store's write buffer — disk, not
+        # instance memory: it wipes with the ring (and the digest, its
+        # derived claim view) on a wiped-disk rebirth.
+        sta = _wipe_store_cols(m1, sta)
+    if dig is not None and wipe_store:
+        dig = jnp.where(m1, jnp.uint32(0), dig)
     fwd = tuple(jnp.where(m1, jnp.asarray(st.empty_of(c.dtype), c.dtype),
                           c) for c in fwd)
     # The delayed-message pen dies with the process (reference: delayed
@@ -316,16 +319,31 @@ def _rebirth_wipe(mask, *, tab, stc, fwd, dly, auth, sig, mal,
         rev=jnp.where(m1, False, auth.rev),
         issuer=jnp.where(m1, jnp.uint32(EMPTY_U32), auth.issuer))
     # The signature request cache and convictions die with the process
-    # (reference: RequestCache is in-memory only).
-    sig = (jnp.where(mask, NO_PEER, sig[0]),
-           jnp.where(mask, jnp.uint32(0), sig[1]),
-           jnp.where(mask, jnp.uint32(0), sig[2]),
-           jnp.where(mask, jnp.uint32(0), sig[3]),
-           jnp.where(mask, jnp.uint32(0), sig[4]))
+    # (reference: RequestCache is in-memory only).  The cache leaves
+    # are plane-sized (zero-width when double_meta_mask is 0 — the
+    # (n,)-mask would not broadcast against them).
+    if sig[0].shape[0]:
+        sig = (jnp.where(mask, NO_PEER, sig[0]),
+               jnp.where(mask, jnp.uint32(0), sig[1]),
+               jnp.where(mask, jnp.uint32(0), sig[2]),
+               jnp.where(mask, jnp.uint32(0), sig[3]),
+               jnp.where(mask, jnp.uint32(0), sig[4]))
     mal = jnp.where(m1, jnp.uint32(EMPTY_U32), mal)
     global_time = jnp.where(mask, jnp.uint32(1), global_time)
     session = session + mask.astype(jnp.uint32)
-    return tab, stc, fwd, dly, auth, sig, mal, global_time, session
+    return tab, stc, fwd, dly, auth, sig, mal, global_time, session, sta, dig
+
+
+def _wipe_store_cols(m1, stc: st.StoreCols) -> st.StoreCols:
+    """Empty the store/staging columns on the masked rows (dtype-exact:
+    the aux column may be the narrowed config.aux_dtype)."""
+    return st.StoreCols(
+        gt=jnp.where(m1, jnp.uint32(EMPTY_U32), stc.gt),
+        member=jnp.where(m1, jnp.uint32(EMPTY_U32), stc.member),
+        meta=jnp.where(m1, jnp.uint8(EMPTY_META), stc.meta),
+        payload=jnp.where(m1, jnp.uint32(EMPTY_U32), stc.payload),
+        aux=jnp.where(m1, jnp.asarray(0, stc.aux.dtype), stc.aux),
+        flags=jnp.where(m1, jnp.uint8(0), stc.flags))
 
 
 def _tab(state: PeerState) -> cand.CandTable:
@@ -339,6 +357,12 @@ def _store(state: PeerState) -> st.StoreCols:
     return st.StoreCols(gt=state.store_gt, member=state.store_member,
                         meta=state.store_meta, payload=state.store_payload,
                         aux=state.store_aux, flags=state.store_flags)
+
+
+def _staging(state: PeerState) -> st.StoreCols:
+    return st.StoreCols(gt=state.sta_gt, member=state.sta_member,
+                        meta=state.sta_meta, payload=state.sta_payload,
+                        aux=state.sta_aux, flags=state.sta_flags)
 
 
 def _auth(state: PeerState) -> tl.AuthTable:
@@ -716,9 +740,9 @@ def _telemetry_row(cfg: CommunityConfig, *, rnd, new_time, members, stats,
     return jnp.concatenate([vals[nm] for nm, _ in tlm.row_schema(cfg)])
 
 
-@functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
+@functools.partial(jax.jit, static_argnums=(1, 3), donate_argnums=0)
 def step(state: PeerState, cfg: CommunityConfig,
-         overrides=None) -> PeerState:
+         overrides=None, phase: str | None = None) -> PeerState:
     """Advance every peer one walker interval (~5 simulated seconds).
 
     ``overrides`` (default None — compiled out, the step is byte-
@@ -726,13 +750,52 @@ def step(state: PeerState, cfg: CommunityConfig,
     shaped pytree of traced per-replica fault-knob scalars; the fleet
     plane vmaps this function over a leading replica axis so a whole
     fault grid advances under ONE compiled program (FLEET.md).
+
+    ``phase`` (static) only matters under the byte-diet store plane
+    (``cfg.store.staging > 0`` — dispersy_tpu/storediet.py): ``"sync"``
+    compiles the compaction/sync-exchange round, ``"quiet"`` the
+    staging-only round, and ``None`` (the default every caller can use
+    safely) compiles BOTH behind one ``lax.cond`` on the round
+    counter's cadence — bit-identical to the statically-specialized
+    forms, which exist so the cost ledger can price each round kind
+    separately and cadence-aware drivers can skip the cond.  Without
+    the diet the argument is ignored.
     """
+    if not cfg.store_diet or phase in ("quiet", "sync"):
+        return _step_impl(state, cfg, overrides, phase or "sync")
+    if phase is not None:
+        raise ValueError(f"unknown step phase {phase!r}: expected "
+                         "'sync', 'quiet' or None")
+    is_sync = sdiet.sync_round_of(cfg, state.round_index)
+    return lax.cond(
+        is_sync,
+        lambda s: _step_impl(s, cfg, overrides, "sync"),
+        lambda s: _step_impl(s, cfg, overrides, "quiet"),
+        state)
+
+
+def _step_impl(state: PeerState, cfg: CommunityConfig,
+               overrides=None, phase: str = "sync") -> PeerState:
     n, t = cfg.n_peers, cfg.n_trackers
     idx = jnp.arange(n, dtype=jnp.int32)
     seed = rng.fold_seed(state.key)
     rnd = state.round_index
     now = state.time
     stats = state.stats
+    # Byte-diet store plane (dispersy_tpu/storediet.py; STORE section in
+    # README): with ``diet``, accepted records land in the staging
+    # buffer, the ring merges only on compaction ("sync") rounds, the
+    # Bloom claim reads the persistent digest, and the sync exchange
+    # runs on sync rounds only.  ``phase`` is static, so a quiet round
+    # compiles none of the responder/merge kernels.
+    diet = cfg.store_diet
+    sync_on = cfg.sync_enabled and (not diet or phase == "sync")
+    compact_now = diet and phase == "sync"
+    if diet:
+        # Epoch salt: every round of one compaction window shares it,
+        # and it rotates at the window boundary — requester digests and
+        # responder queries derive it from the same round counter.
+        ep = sdiet.epoch_of(cfg, rnd)
     # Chaos harness (dispersy_tpu/faults.py): every fault branch below is
     # gated on a STATIC FaultModel knob, so all-zero knobs compile to the
     # identical fault-free round (FAULTS.md; BENCH.md fault-knob note).
@@ -777,9 +840,12 @@ def step(state: PeerState, cfg: CommunityConfig,
     # count pre-loss (sendto), receipts per accepted inbox slot (recvfrom).
     bup = jnp.zeros((n,), jnp.uint32)
     bdown = jnp.zeros((n,), jnp.uint32)
+    # On byte-diet quiet rounds the request carries no sync tuple — the
+    # responder would not serve it — so it is the sync-disabled request
+    # on the wire and in the byte accounting.
     req_bytes = jnp.uint32(
         INTRO_REQUEST_BASE_BYTES + 4 * cfg.bloom_words
-        if cfg.sync_enabled else INTRO_REQUEST_BASE_BYTES - 20)
+        if sync_on else INTRO_REQUEST_BASE_BYTES - 20)
 
     # ---- phase 0: churn -------------------------------------------------
     # A churned peer restarts with a wiped disk: empty store, empty
@@ -795,7 +861,7 @@ def step(state: PeerState, cfg: CommunityConfig,
         # program (the 1M byte-identity pin proves it).
         with jax.named_scope("churn"):
             (tab, stc, fwd, dly, auth, sig, mal, global_time,
-             session) = _rebirth_wipe(
+             session, sta, dig) = _rebirth_wipe(
                 reborn, tab=_tab(state), stc=_store(state),
                 fwd=(state.fwd_gt, state.fwd_member, state.fwd_meta,
                      state.fwd_payload, state.fwd_aux),
@@ -806,7 +872,10 @@ def step(state: PeerState, cfg: CommunityConfig,
                 sig=(state.sig_target, state.sig_meta, state.sig_payload,
                      state.sig_gt, state.sig_since),
                 mal=state.mal_member, global_time=state.global_time,
-                session=state.session)
+                session=state.session,
+                sta=_staging(state) if diet else None,
+                dig=(state.digest if diet and cfg.sync_enabled
+                     else None))
     else:
         tab, stc = _tab(state), _store(state)
         fwd = (state.fwd_gt, state.fwd_member, state.fwd_meta,
@@ -819,6 +888,8 @@ def step(state: PeerState, cfg: CommunityConfig,
                state.sig_gt, state.sig_since)
         mal = state.mal_member
         global_time, session = state.global_time, state.session
+        sta = _staging(state) if diet else None
+        dig = state.digest if diet and cfg.sync_enabled else None
 
     if fm.health_checks and cfg.churn_rate > 0.0:
         # A churn rebirth is a wiped-disk restart: the new process starts
@@ -920,7 +991,18 @@ def step(state: PeerState, cfg: CommunityConfig,
     else:
         target = jnp.full((n,), NO_PEER, jnp.int32)
 
-    if cfg.sync_enabled:
+    if sync_on and diet:
+        # Byte-diet claim (storediet.py): the slice is recomputed from
+        # the ring (unchanged since the last compaction, so this is the
+        # compaction-time slice) and the bloom is the persistent DIGEST
+        # — a bloom_words read instead of re-hashing and re-reading 4
+        # key columns of the full store.  The digest carries the epoch
+        # salt and already covers every record staged since the last
+        # compaction (the wrap-up's digest_update).
+        sl = st.claim_slice_largest(stc.gt, cfg.bloom_capacity)
+        my_bloom = dig
+        rec_h = rec_probes = None
+    elif sync_on:
         # dispersy_claim_sync_bloom_filter: pick a store slice, fill a bloom.
         if cfg.sync_strategy == "modulo":
             sl = st.claim_slice_modulo(stc.gt, cfg.bloom_capacity, rnd)
@@ -1211,15 +1293,23 @@ def step(state: PeerState, cfg: CommunityConfig,
     # this round's incoming requests (fused-round causality).
     gt_at_send = global_time
 
-    # Normal-peer request inbox: [N, R] with the full sync payload.
+    # Normal-peer request inbox: [N, R] with the full sync payload when
+    # the sync exchange runs this round; without it (sync disabled, or a
+    # byte-diet quiet round) the request is just (src, clock) — the
+    # sync tuple would never be served, so it never rides the wire.
     with jax.named_scope("deliver_request"):
         req = inbox.deliver(
             dst=target,
-            cols=[idx.astype(jnp.uint32), sl.time_low, sl.time_high,
-                  sl.modulo, sl.offset, gt_at_send, my_bloom],
+            cols=([idx.astype(jnp.uint32), sl.time_low, sl.time_high,
+                   sl.modulo, sl.offset, gt_at_send, my_bloom]
+                  if sync_on else [idx.astype(jnp.uint32), gt_at_send]),
             valid=send_ok & ~to_tracker, n_peers=n,
             inbox_size=cfg.request_inbox)
-    (rq_src, rq_tlow, rq_thigh, rq_mod, rq_off, rq_gt, rq_bloom) = req.inbox
+    if sync_on:
+        (rq_src, rq_tlow, rq_thigh, rq_mod, rq_off, rq_gt,
+         rq_bloom) = req.inbox
+    else:
+        rq_src, rq_gt = req.inbox
     arrivals = arrivals | jnp.any(req.inbox_valid, axis=1)
     rq_ok = req.inbox_valid & act[:, None]                   # [N, R]
     rq_src_i = jnp.where(rq_ok, rq_src.astype(jnp.int32), NO_PEER)
@@ -1606,17 +1696,21 @@ def step(state: PeerState, cfg: CommunityConfig,
     # requester then fetches its own outbox row by receipt — sync records
     # only ever flow back along the request edge (as in the reference,
     # where sync packets are unicast to the introduction-request sender).
-    if cfg.sync_enabled:
+    if sync_on:
         b = cfg.response_budget
         # The responder serves from its ordered view (priority DESC, gt
         # ASC/DESC per meta); identity for default communities — in which
         # case the claim's record hashes (and, on gather backends, the
-        # materialized probe tensor) are reused verbatim.
+        # materialized probe tensor) are reused verbatim.  Under the
+        # byte-diet the claim read the digest instead of hashing the
+        # ring, so the responder derives its own probe tensor here —
+        # with the EPOCH salt the requesters' digests were built with.
         stv = _response_order(stc, cfg)
-        if cfg.needs_response_order:
+        q_salt = ep if diet else rnd
+        if diet or cfg.needs_response_order:
             rec_h2 = record_hash(stv.member, stv.gt, stv.meta, stv.payload)
             q_probes = (bloom.probe_bits(rec_h2, cfg.bloom_bits,
-                                         cfg.bloom_hashes, salt=rnd)
+                                         cfg.bloom_hashes, salt=q_salt)
                         if bloom.gather_backend() else None)
         else:
             rec_h2, q_probes = rec_h, rec_probes
@@ -1641,7 +1735,7 @@ def step(state: PeerState, cfg: CommunityConfig,
             else:
                 present = bloom.bloom_query(rq_bloom[:, s], rec_h2,
                                             cfg.bloom_bits,
-                                            cfg.bloom_hashes, salt=rnd)
+                                            cfg.bloom_hashes, salt=q_salt)
             if cfg.timeline_enabled:
                 # A hard-killed responder answers every request with the
                 # destroy record UNCONDITIONALLY (reference:
@@ -2215,7 +2309,33 @@ def step(state: PeerState, cfg: CommunityConfig,
         # Freshness (drives next round's forward batch): not already in the
         # store on the UNIQUE(member, global_time) identity, and not a
         # duplicate of an earlier record in this same batch.
-        in_store = ik.in_store(stc, in_member, in_gt)
+        if diet and cfg.sync_enabled:
+            # Byte-diet freshness: membership in the epoch DIGEST
+            # instead of the exact [N, B, M] key compare — quiet rounds
+            # touch zero ring bytes.  A ~bloom_error_rate false
+            # positive drops a fresh record as a duplicate (counted;
+            # the pull re-offers it under the next epoch's salt); a
+            # false negative (an out-of-slice ring record re-arriving)
+            # re-stages one duplicate that store_insert's UNIQUE rule
+            # kills at compaction — the ring never corrupts
+            # (storediet.py module doc; the oracle mirrors both).
+            in_h = record_hash(in_member, in_gt, in_meta, in_payload)
+            if bloom.gather_backend():
+                in_probes = bloom.probe_bits(in_h, cfg.bloom_bits,
+                                             cfg.bloom_hashes, salt=ep)
+                in_store = bloom.bloom_query_from(dig, in_probes)
+            else:
+                in_probes = None
+                in_store = bloom.bloom_query(dig, in_h, cfg.bloom_bits,
+                                             cfg.bloom_hashes, salt=ep)
+        elif diet:
+            # Diet without sync: no digest — exact membership against
+            # ring AND staging (the logical store is their union).
+            in_store = (ik.in_store(stc, in_member, in_gt)
+                        | ik.in_store(sta, in_member, in_gt))
+            in_h = in_probes = None
+        else:
+            in_store = ik.in_store(stc, in_member, in_gt)
         dup_in_batch = ik.dup_earlier(in_member, in_gt, in_ok)
 
         in_flags = jnp.zeros(in_gt.shape, jnp.uint8)
@@ -2461,21 +2581,58 @@ def step(state: PeerState, cfg: CommunityConfig,
             & counted[:, :, None], axis=1).astype(jnp.uint32)     # [N, K+1]
         stats = stats.replace(
             accepted_by_meta=stats.accepted_by_meta + contrib)
-        with jax.named_scope("store_merge"):
-            ins = st.store_insert(
-                stc,
-                st.StoreCols(gt=in_gt, member=in_member, meta=in_meta,
-                             payload=in_payload, aux=in_aux,
-                             flags=in_flags),
-                new_mask=accept_store, history=cfg.history)
-        stc = ins.store
+        if diet:
+            # Byte-diet landing: fresh records append to the staging
+            # buffer in delivery order (O(S+B) — no ring rewrite);
+            # duplicates and staging overflow are counted where the
+            # legacy merge counted its dup/overflow kills.  msgs_stored
+            # is counted at compaction, when records actually enter the
+            # ring (store_insert's n_inserted — so the counter keeps
+            # its legacy meaning of "records the ring accepted").
+            with jax.named_scope("store_stage"):
+                stg = st.store_stage(
+                    sta,
+                    st.StoreCols(gt=in_gt, member=in_member, meta=in_meta,
+                                 payload=in_payload, aux=in_aux,
+                                 flags=in_flags),
+                    new_mask=fresh)
+            sta = stg.staging
+            stats = stats.replace(
+                msgs_dropped=stats.msgs_dropped
+                + jnp.sum(accept_store & ~fresh,
+                          axis=1).astype(jnp.uint32)
+                + stg.n_dropped.astype(jnp.uint32))
+            if cfg.sync_enabled and not compact_now:
+                # Incremental digest: OR the landed arrivals' probe
+                # bits in, so next round's claim (and freshness test)
+                # covers them.  Compaction rounds rebuild instead.
+                with jax.named_scope("digest_update"):
+                    if in_probes is not None:
+                        dig = bloom.digest_update(dig, in_probes,
+                                                  stg.landed,
+                                                  cfg.bloom_bits)
+                    else:
+                        dig = dig | bloom.bloom_build(
+                            in_h, stg.landed, cfg.bloom_bits,
+                            cfg.bloom_hashes, salt=ep)
+        else:
+            with jax.named_scope("store_merge"):
+                ins = st.store_insert(
+                    stc,
+                    st.StoreCols(gt=in_gt, member=in_member, meta=in_meta,
+                                 payload=in_payload, aux=in_aux,
+                                 flags=in_flags),
+                    new_mask=accept_store, history=cfg.history)
+            stc = ins.store
         global_time = _fold_gt(global_time, in_gt, accept,
                                cfg.acceptable_global_time_range)
-        stats = stats.replace(
-            msgs_stored=stats.msgs_stored + ins.n_inserted.astype(jnp.uint32),
-            msgs_dropped=stats.msgs_dropped
-            + ins.n_dropped.astype(jnp.uint32)
-            + ins.n_evicted.astype(jnp.uint32))
+        if not diet:
+            stats = stats.replace(
+                msgs_stored=stats.msgs_stored
+                + ins.n_inserted.astype(jnp.uint32),
+                msgs_dropped=stats.msgs_dropped
+                + ins.n_dropped.astype(jnp.uint32)
+                + ins.n_evicted.astype(jnp.uint32))
 
         if cfg.timeline_enabled:
             # Apply this batch's accepted undo records to the (post-insert)
@@ -2535,9 +2692,15 @@ def step(state: PeerState, cfg: CommunityConfig,
         else:
             rank = jnp.cumsum(fresh.astype(jnp.int32), axis=1) - 1
         fslot = jnp.where(fresh & (rank < fb), rank, fb)
+        # The buffer's aux column persists at the (possibly narrowed)
+        # store width — the store_insert truncation rule, applied at
+        # the buffer boundary so pushed records match what stored.
+        fwd_aux_src = (in_aux if cfg.aux_dtype == "uint32"
+                       else in_aux.astype(cfg.aux_dtype))
         fwd = tuple(st.rank_compact_many(
             [(col, st.empty_of(col.dtype))
-             for col in (in_gt, in_member, in_meta, in_payload, in_aux)],
+             for col in (in_gt, in_member, in_meta, in_payload,
+                         fwd_aux_src)],
             fslot, fb))
         if cfg.malicious_enabled and cfg.malicious_gossip and fb > 0:
             # The authored proof record claims a forward slot the way
@@ -2597,7 +2760,45 @@ def step(state: PeerState, cfg: CommunityConfig,
         e0 = jnp.full((n, cfg.forward_buffer), EMPTY_U32, jnp.uint32)
         fwd = (e0, e0,
                jnp.full((n, cfg.forward_buffer), EMPTY_META, jnp.uint8),
-               e0, e0)
+               e0,
+               jnp.full((n, cfg.forward_buffer),
+                        st.empty_of(cfg.aux_dtype), cfg.aux_dtype))
+
+    if compact_now:
+        # ---- byte-diet compaction (storediet.py): merge the staging
+        # buffer — this round's arrivals included — into the sorted
+        # ring through the unchanged store_insert (UNIQUE / LastSync /
+        # capacity semantics all apply here), clear the staging, and
+        # rebuild the digest from the fresh ring under the NEXT epoch's
+        # salt.  This is the only ring rewrite of the whole window. ---
+        with jax.named_scope("store_compact"):
+            ins = st.store_insert(stc, sta, sta.valid,
+                                  history=cfg.history)
+        stc = ins.store
+        sta = st.empty_records(sta.gt.shape, aux_dtype=sta.aux.dtype)
+        stats = stats.replace(
+            msgs_stored=stats.msgs_stored
+            + ins.n_inserted.astype(jnp.uint32),
+            msgs_dropped=stats.msgs_dropped
+            + ins.n_dropped.astype(jnp.uint32)
+            + ins.n_evicted.astype(jnp.uint32))
+        if cfg.sync_enabled:
+            with jax.named_scope("digest_rebuild"):
+                sl_n = st.claim_slice_largest(stc.gt, cfg.bloom_capacity)
+                in_sl_n = st.slice_mask(stc.gt, sl_n)
+                rh_n = record_hash(stc.member, stc.gt, stc.meta,
+                                   stc.payload)
+                if bloom.gather_backend():
+                    dig = bloom.bloom_build_from(
+                        bloom.probe_bits(rh_n, cfg.bloom_bits,
+                                         cfg.bloom_hashes,
+                                         salt=ep + jnp.uint32(1)),
+                        in_sl_n, cfg.bloom_bits)
+                else:
+                    dig = bloom.bloom_build(rh_n, in_sl_n,
+                                            cfg.bloom_bits,
+                                            cfg.bloom_hashes,
+                                            salt=ep + jnp.uint32(1))
 
     # ---- wrap up --------------------------------------------------------
     if cfg.malicious_enabled:
@@ -2630,13 +2831,27 @@ def step(state: PeerState, cfg: CommunityConfig,
         hb = hb | jnp.where(
             flt.store_invariant_violated(stc.gt, stc.member),
             jnp.uint32(HEALTH_STORE_INVARIANT), jnp.uint32(0))
+        if diet and cfg.store.staging >= 2:
+            # Staging valid-prefix invariant (storediet.py): a hole
+            # before a live record means a corrupted append — same
+            # sentinel bit as the ring's sort/unique/holes check.
+            stag_bad = jnp.any(
+                (sta.gt[:, :-1] == jnp.uint32(EMPTY_U32))
+                & (sta.gt[:, 1:] != jnp.uint32(EMPTY_U32)), axis=1)
+            hb = hb | jnp.where(stag_bad,
+                                jnp.uint32(HEALTH_STORE_INVARIANT),
+                                jnp.uint32(0))
         drop_delta = (stats.requests_dropped
                       + stats.msgs_dropped) - rd0      # u32, wrap-safe
         hb = hb | jnp.where(
             drop_delta >= jnp.uint32(fm.health_drop_limit),
             jnp.uint32(HEALTH_INBOX_DROP), jnp.uint32(0))
         if cfg.sync_enabled:
-            fill = jnp.sum(flt.popcount_u32(my_bloom), axis=1)
+            # Under the byte-diet the live claim view is the digest
+            # (updated this round) — my_bloom is only materialized on
+            # sync rounds.
+            fill = jnp.sum(flt.popcount_u32(dig if diet else my_bloom),
+                           axis=1)
             hb = hb | jnp.where(
                 fill * jnp.uint32(8) >= jnp.uint32(cfg.bloom_bits * 7),
                 jnp.uint32(HEALTH_BLOOM_SAT), jnp.uint32(0))
@@ -2680,22 +2895,25 @@ def step(state: PeerState, cfg: CommunityConfig,
             else jnp.zeros((n,), bool)
 
         def _store_recover(s):
+            stc_, sta_, dig_ = s
             if rc.soft_repair:
-                s = rcv.store_repair(s, rep_store)
+                stc_ = rcv.store_repair(stc_, rep_store)
             if rc.quarantine_rounds > 0:
                 em = esc[:, None]
-                s = st.StoreCols(
-                    gt=jnp.where(em, jnp.uint32(EMPTY_U32), s.gt),
-                    member=jnp.where(em, jnp.uint32(EMPTY_U32),
-                                     s.member),
-                    meta=jnp.where(em, jnp.uint8(EMPTY_META), s.meta),
-                    payload=jnp.where(em, jnp.uint32(EMPTY_U32),
-                                      s.payload),
-                    aux=jnp.where(em, jnp.uint32(0), s.aux),
-                    flags=jnp.where(em, jnp.uint8(0), s.flags))
-            return s
-        stc = lax.cond(jnp.any(rep_store) | jnp.any(esc),
-                       _store_recover, lambda s: s, stc)
+                stc_ = _wipe_store_cols(em, stc_)
+                if diet:
+                    # A quarantine escalation is a wiped-DISK rebirth:
+                    # the staging buffer and digest are the store's
+                    # write buffer / claim view and wipe with the ring.
+                    sta_ = _wipe_store_cols(em, sta_)
+                    if cfg.sync_enabled:
+                        dig_ = jnp.where(em, jnp.uint32(0), dig_)
+            return stc_, sta_, dig_
+        # sta/dig are None (empty pytree leaves) without their planes;
+        # the cond carries them untouched in that case.
+        stc, sta, dig = lax.cond(
+            jnp.any(rep_store) | jnp.any(esc),
+            _store_recover, lambda s: s, (stc, sta, dig))
         if rc.soft_repair:
             # (1b) candidate-table flush for the overload sentinel:
             # evict the entries implicated by the drop deltas (the
@@ -2728,7 +2946,7 @@ def step(state: PeerState, cfg: CommunityConfig,
             # (store wipe handled in _store_recover's cond above —
             # wipe_store=False)
             (tab, stc, fwd, dly, auth, sig, mal, global_time,
-             session) = _rebirth_wipe(
+             session, _, _) = _rebirth_wipe(
                 esc, tab=tab, stc=stc, fwd=fwd, dly=dly, auth=auth,
                 sig=sig, mal=mal, global_time=global_time,
                 session=session, wipe_store=False)
@@ -2787,6 +3005,10 @@ def step(state: PeerState, cfg: CommunityConfig,
     if cfg.telemetry.enabled:
         members = alive & ~state.is_tracker
         store_cnt = st.count_valid(stc.gt).astype(jnp.uint32)
+        if diet:
+            # The logical store is ring ∪ staging (storediet.py).
+            store_cnt = store_cnt + st.count_valid(sta.gt).astype(
+                jnp.uint32)
         cand_cnt = jnp.sum(tab.peer != NO_PEER, axis=1,
                            dtype=jnp.int32).astype(jnp.uint32)
         if cfg.telemetry.histograms or cfg.telemetry.flight_recorder:
@@ -2795,8 +3017,9 @@ def step(state: PeerState, cfg: CommunityConfig,
         if cfg.telemetry.histograms:
             ones = jnp.ones((n,), bool)
             if cfg.sync_enabled:
-                bloom_cnt = jnp.sum(flt.popcount_u32(my_bloom), axis=1,
-                                    dtype=jnp.uint32)
+                bloom_cnt = jnp.sum(
+                    flt.popcount_u32(dig if diet else my_bloom), axis=1,
+                    dtype=jnp.uint32)
                 bloom_mask = ones
             else:
                 bloom_cnt = jnp.zeros((n,), jnp.uint32)
@@ -2863,6 +3086,11 @@ def step(state: PeerState, cfg: CommunityConfig,
         cand_last_stumble=tab.last_stumble, cand_last_intro=tab.last_intro,
         store_gt=stc.gt, store_member=stc.member, store_meta=stc.meta,
         store_payload=stc.payload, store_aux=stc.aux, store_flags=stc.flags,
+        **({} if not diet else {
+            "sta_gt": sta.gt, "sta_member": sta.member,
+            "sta_meta": sta.meta, "sta_payload": sta.payload,
+            "sta_aux": sta.aux, "sta_flags": sta.flags,
+            **({} if dig is None else {"digest": dig})}),
         fwd_gt=fwd[0], fwd_member=fwd[1], fwd_meta=fwd[2], fwd_payload=fwd[3],
         fwd_aux=fwd[4],
         dly_gt=dly[0], dly_member=dly[1], dly_meta=dly[2], dly_payload=dly[3],
@@ -3060,6 +3288,31 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
     ins = st.store_insert(_store(state), new, store_mask[:, None],
                           history=cfg.history)
     stc = ins.store
+    sta_updates: dict = {}
+    if cfg.store_diet and cfg.sync_enabled:
+        # Byte-diet create: authoring is a host-boundary EVENT, not the
+        # hot round — the record goes straight into the sorted ring
+        # (so the next sync round serves it immediately, exactly like
+        # the legacy path), and the digest learns its probe bits under
+        # the salt of the round that will claim next
+        # (state.round_index's epoch), keeping claim == digest exact.
+        # A capacity-dropped create leaves a false-positive bit that
+        # the next compaction's rebuild clears — the storediet.py FP
+        # argument.
+        ep = sdiet.epoch_of(cfg, state.round_index)
+        new_h = record_hash(new.member, new.gt, new.meta, new.payload)
+        if bloom.gather_backend():
+            dig = bloom.digest_update(
+                state.digest,
+                bloom.probe_bits(new_h, cfg.bloom_bits,
+                                 cfg.bloom_hashes, salt=ep),
+                store_mask[:, None], cfg.bloom_bits)
+        else:
+            dig = state.digest | bloom.bloom_build(
+                new_h, store_mask[:, None], cfg.bloom_bits,
+                cfg.bloom_hashes, salt=ep)
+        sta_updates["digest"] = dig
+    create_stored = ins.n_inserted.astype(jnp.uint32)
 
     retro_unw = retro_rm = None
     fold_dropped = None
@@ -3115,17 +3368,18 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
         store_gt=stc.gt, store_member=stc.member,
         store_meta=stc.meta, store_payload=stc.payload,
         store_aux=stc.aux, store_flags=stc.flags,
+        **sta_updates,
         fwd_gt=buf(state.fwd_gt, new.gt[:, 0]),
         fwd_member=buf(state.fwd_member, new.member[:, 0]),
         fwd_meta=buf(state.fwd_meta, new.meta[:, 0]),
         fwd_payload=buf(state.fwd_payload, new.payload[:, 0]),
-        fwd_aux=buf(state.fwd_aux, new.aux[:, 0]),
+        fwd_aux=buf(state.fwd_aux,
+                    new.aux[:, 0].astype(state.fwd_aux.dtype)),
         auth_member=auth.member, auth_mask=auth.mask,
         auth_gt=auth.gt, auth_rev=auth.rev, auth_issuer=auth.issuer,
         global_time=jnp.where(author_mask, gt_new, state.global_time),
         stats=state.stats.replace(
-            msgs_stored=state.stats.msgs_stored
-            + ins.n_inserted.astype(jnp.uint32),
+            msgs_stored=state.stats.msgs_stored + create_stored,
             accepted_by_meta=state.stats.accepted_by_meta
             .at[:, min(meta, cfg.n_meta)]
             .add(author_mask.astype(jnp.uint32)),
@@ -3275,13 +3529,28 @@ def coverage(state: PeerState, member: int, gt: int, meta: int,
     Trackers are excluded: they are pure introduction servers and never
     sync (reference: tool/tracker.py TrackerCommunity).
     """
-    hit = ((state.store_gt == jnp.uint32(gt))
-           & (state.store_member == jnp.uint32(member))
-           & (state.store_meta == jnp.uint32(meta))
-           & (state.store_payload == jnp.uint32(payload)))
+    has = _holds_record(state, member, gt, meta, payload)
     syncing = state.alive & ~state.is_tracker
-    has = jnp.any(hit, axis=1) & syncing
+    has = has & syncing
     return jnp.sum(has) / jnp.maximum(jnp.sum(syncing), 1)
+
+
+def _holds_record(state: PeerState, member: int, gt: int, meta: int,
+                  payload: int) -> jnp.ndarray:
+    """bool[N]: does each peer hold the record in its LOGICAL store —
+    the sorted ring, plus the byte-diet staging buffer when present
+    (ring ∪ staging is the store between compactions, storediet.py)."""
+    def _in(g, m, t, p):
+        return jnp.any((g == jnp.uint32(gt))
+                       & (m == jnp.uint32(member))
+                       & (t == jnp.uint32(meta))
+                       & (p == jnp.uint32(payload)), axis=1)
+    has = _in(state.store_gt, state.store_member, state.store_meta,
+              state.store_payload)
+    if state.sta_gt.shape[1]:
+        has = has | _in(state.sta_gt, state.sta_member, state.sta_meta,
+                        state.sta_payload)
+    return has
 
 
 def coverage_by_community(state: PeerState, cfg: CommunityConfig,
@@ -3294,12 +3563,8 @@ def coverage_by_community(state: PeerState, cfg: CommunityConfig,
     ever live in block c, so other blocks report 0 for it.
     """
     comm = jnp.asarray(cfg.layout()[0])
-    hit = ((state.store_gt == jnp.uint32(gt))
-           & (state.store_member == jnp.uint32(member))
-           & (state.store_meta == jnp.uint32(meta))
-           & (state.store_payload == jnp.uint32(payload)))
     syncing = state.alive & ~state.is_tracker
-    has = jnp.any(hit, axis=1) & syncing
+    has = _holds_record(state, member, gt, meta, payload) & syncing
     out = []
     for c in range(cfg.n_communities):
         in_c = comm == c

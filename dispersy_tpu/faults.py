@@ -303,6 +303,23 @@ def debug_validate(state, cfg, raise_on_error: bool = False) -> list:
     check(bool((member[~live] == EMPTY_U32).all()),
           "store holes with non-sentinel member")
 
+    # byte-diet staging buffer (storediet.py): delivery order, so only
+    # the valid-prefix invariant applies — holes strictly follow the
+    # appended tail; hole columns carry their sentinels.  (No
+    # cross-ring uniqueness check: a digest false negative can
+    # legitimately re-stage an out-of-slice ring record; the next
+    # compaction's UNIQUE rule kills it.)
+    sgt = np.asarray(state.sta_gt)
+    if sgt.shape[1]:
+        s_live = sgt != EMPTY_U32
+        s_bad = np.flatnonzero(((~s_live[:, :-1]) & s_live[:, 1:])
+                               .any(axis=1))
+        check(s_bad.size == 0, f"staging holes precede live rows on "
+                               f"peers {s_bad[:8].tolist()}")
+        s_meta = np.asarray(state.sta_meta)
+        check(bool((s_meta[~s_live] == EMPTY_META).all()),
+              "staging holes with non-EMPTY_META meta")
+
     # candidate table: no duplicate live peer per row, no self, no tracker
     cp = np.asarray(state.cand_peer)
     if cp.shape[1] > 1:

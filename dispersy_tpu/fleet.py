@@ -173,20 +173,28 @@ def replica(fstate: PeerState, i: int) -> PeerState:
     return index_state(fstate, i)
 
 
-@functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
+@functools.partial(jax.jit, static_argnums=(1, 3), donate_argnums=0)
 def fleet_step(fstate: PeerState, cfg: CommunityConfig,
-               overrides: FleetOverrides | None = None) -> PeerState:
+               overrides: FleetOverrides | None = None,
+               phase: str | None = None) -> PeerState:
     """Advance every replica one round under ONE compiled program.
 
     ``vmap`` over the replica axis of the REAL ``engine.step`` — no
     fleet-specific physics exists anywhere; bit-identity to single runs
     is structural, not re-implemented.  ``overrides`` columns map one
     scalar to each replica.
+
+    ``phase`` (byte-diet configs, storediet.py): replicas advance in
+    round lockstep, so the cadence is fleet-global — pass the static
+    round kind to skip the dynamic cond, which under ``vmap`` lowers to
+    a both-branches ``select`` (correct but paying both round kinds).
     """
     if overrides is None:
-        return jax.vmap(lambda s: engine.step.__wrapped__(s, cfg))(fstate)
+        return jax.vmap(
+            lambda s: engine.step.__wrapped__(s, cfg, None, phase))(fstate)
     return jax.vmap(
-        lambda s, o: engine.step.__wrapped__(s, cfg, o))(fstate, overrides)
+        lambda s, o: engine.step.__wrapped__(s, cfg, o, phase))(
+            fstate, overrides)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2), donate_argnums=0)

@@ -87,10 +87,14 @@ def snapshot(state: PeerState, cfg: CommunityConfig) -> dict:
         # corrupt drops, double-signed flow, convictions, endpoint byte
         # totals — the U64_COUNTERS band (telemetry.py documents each).
         **{nm: totals[nm] for nm in tlm.U64_COUNTERS[2:]},
-        # occupancy (how full the bounded structures run)
+        # occupancy (how full the bounded structures run); the logical
+        # store is ring ∪ staging under the byte diet (storediet.py),
+        # so the fraction is over the combined capacity and stays <= 1
         "store_fill": float(jnp.mean(
-            jnp.sum(state.store_gt != jnp.uint32(EMPTY_U32), axis=1)
-            / cfg.msg_capacity)),
+            (jnp.sum(state.store_gt != jnp.uint32(EMPTY_U32), axis=1)
+             + (jnp.sum(state.sta_gt != jnp.uint32(EMPTY_U32), axis=1)
+                if cfg.store_diet else 0))
+            / (cfg.msg_capacity + cfg.store.staging))),
         "candidate_fill": float(jnp.mean(jnp.where(
             members,
             jnp.sum(state.cand_peer != NO_PEER, axis=1) / cfg.k_candidates,

@@ -205,6 +205,28 @@ def bloom_build(item_hashes: jnp.ndarray, mask: jnp.ndarray,
     return words
 
 
+@contract(out=Spec("uint32", ("N", "W")),
+          digest=Spec("uint32", ("N", "W")),
+          probes=Spec("int32", ("N", "M", "H")),
+          mask=Spec("bool", ("N", "M")), n_bits=_N_BITS)
+def digest_update(digest: jnp.ndarray, probes: jnp.ndarray,
+                  mask: jnp.ndarray, n_bits: int) -> jnp.ndarray:
+    """OR the masked items' probe bits into a persistent per-row digest
+    (dispersy_tpu/storediet.py: the incremental Bloom digest).
+
+    The byte-diet replacement for rebuilding the claimed slice's bloom
+    from 4 re-read store columns every round: the engine keeps the
+    digest as a ``PeerState`` leaf, feeds each round's LANDED arrivals
+    (their ``probe_bits`` are already computed for the freshness test)
+    through this OR, and only falls back to a full :func:`bloom_build`
+    at compaction — where the epoch salt rotates, so stale bits never
+    survive an epoch.  Bloom builds are monotone ORs of per-item bit
+    sets, so ``digest_update(build(A), probes(B))`` equals
+    ``build(A ∪ B)`` exactly (the C=1 legacy-identity pin relies on
+    it)."""
+    return digest | bloom_build_from(probes, mask, n_bits)
+
+
 # pack/unpack sizes are coupled (BITS = 32·W, PW = N·BITS/32), which the
 # Spec grammar cannot express — so the dims are PINNED per-op here rather
 # than inherited: a legitimate edit to the global canonical DIMS must not

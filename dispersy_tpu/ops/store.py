@@ -82,13 +82,13 @@ _STORE_NM = stc_spec("N", "M")
 _BATCH_NB = stc_spec("N", "B")
 
 
-@contract(out=_STORE_NM, shape=lambda d: (d["N"], d["M"]))
-def empty_records(shape) -> StoreCols:
+@contract(out=_STORE_NM, shape=lambda d: (d["N"], d["M"]), aux_dtype=None)
+def empty_records(shape, aux_dtype=None) -> StoreCols:
     e = jnp.full(shape, _EMPTY, jnp.uint32)
     return StoreCols(gt=e, member=e,
                      meta=jnp.full(shape, EMPTY_META, META_DTYPE),
                      payload=e,
-                     aux=jnp.zeros(shape, jnp.uint32),
+                     aux=jnp.zeros(shape, aux_dtype or jnp.uint32),
                      flags=jnp.zeros(shape, FLAGS_DTYPE))
 
 
@@ -135,20 +135,48 @@ def rank_compact(col: jnp.ndarray, slot: jnp.ndarray, width: int,
 @contract(out=[Spec("uint32", ("N", "B")), Spec("uint8", ("N", "B"))],
           cols_fills=[(Spec("uint32", ("N", "M")), 0),
                       (Spec("uint8", ("N", "M")), 0)],
-          slot=Spec("int32", ("N", "M")), width=lambda d: d["B"])
-def rank_compact_many(cols_fills, slot: jnp.ndarray, width: int) -> list:
+          slot=Spec("int32", ("N", "M")), width=lambda d: d["B"],
+          impl=None)
+def rank_compact_many(cols_fills, slot: jnp.ndarray, width: int,
+                      impl: str | None = None) -> list:
     """:func:`rank_compact` for SEVERAL same-shaped columns sharing one
     ``slot`` map — ``cols_fills`` is ``[(col, fill), ...]``.
 
-    On CPU one permutation scatters once and every column follows by
-    row-local gather (gathers are cheap there; per-column scatters were
-    the store path's dominant wall cost).  On TPU each column scatters
-    individually — cross-lane gathers serialize there (ops/bloom.py
-    module note).  Both forms are bit-identical to per-column
-    :func:`rank_compact` calls.
+    Two bit-identical forms, picked per backend (``impl=None``) or
+    forced for tests:
+
+    - ``"gather"`` (CPU): one permutation scatters once and every
+      column follows by row-local gather (gathers are cheap there;
+      per-column scatters were the store path's dominant wall cost).
+    - ``"scatter"`` (TPU): per-column scatters — cross-lane gathers
+      serialize there (ops/bloom.py module note) — with adjacent
+      **uint8 column pairs folded into one uint16 scatter** (pack
+      ``hi<<8 | lo``, scatter once, unpack): the store merge's
+      (meta, flags) pair costs one pass over the slot map instead of
+      two.  Packing is value-exact, so the fold is bit-identical to
+      the per-column form (tests/test_store.py pins all three against
+      each other).
     """
-    if jax.default_backend() == "tpu":
-        return [rank_compact(c, slot, width, f) for c, f in cols_fills]
+    if impl is None:
+        impl = "scatter" if jax.default_backend() == "tpu" else "gather"
+    if impl == "scatter":
+        out: list = [None] * len(cols_fills)
+        u8s = [i for i, (c, _) in enumerate(cols_fills)
+               if c.dtype == jnp.uint8]
+        for i, j in zip(u8s[0::2], u8s[1::2]):
+            a, fa = cols_fills[i]
+            b, fb = cols_fills[j]
+            packed = ((a.astype(jnp.uint16) << jnp.uint16(8))
+                      | b.astype(jnp.uint16))
+            pc = rank_compact(
+                packed, slot, width,
+                (int(fa) << 8) | int(fb))  # host-ok: fills are static
+            out[i] = (pc >> jnp.uint16(8)).astype(jnp.uint8)
+            out[j] = (pc & jnp.uint16(0xFF)).astype(jnp.uint8)
+        for i, (c, f) in enumerate(cols_fills):
+            if out[i] is None:
+                out[i] = rank_compact(c, slot, width, f)
+        return out
     n, w = slot.shape
     src = jnp.broadcast_to(jnp.arange(w, dtype=jnp.int32), (n, w))
     perm = rank_compact(src, slot, width, w)          # w = "empty" slot
@@ -206,9 +234,11 @@ def store_insert(store: StoreCols, new: StoreCols,
     # otherwise make the sort form promote while the merge form
     # truncates, silently breaking their bit-identity.
     if (new.meta.dtype != store.meta.dtype
-            or new.flags.dtype != store.flags.dtype):
+            or new.flags.dtype != store.flags.dtype
+            or new.aux.dtype != store.aux.dtype):
         new = new._replace(meta=new.meta.astype(store.meta.dtype),
-                           flags=new.flags.astype(store.flags.dtype))
+                           flags=new.flags.astype(store.flags.dtype),
+                           aux=new.aux.astype(store.aux.dtype))
     n_before = count_valid(store.gt)
     meta_empty = jnp.asarray(empty_of(new.meta.dtype), new.meta.dtype)
     masked = StoreCols(
@@ -402,6 +432,76 @@ def _merge_ordered(store: StoreCols, masked: StoreCols):
             interleave(store.payload, b_payload),
             interleave(store.aux, b_aux),
             interleave(store.flags, b_flags))
+
+
+class StageResult(NamedTuple):
+    staging: StoreCols
+    landed: jnp.ndarray    # bool[N, B] arrivals that took a staging slot
+    n_dropped: jnp.ndarray  # i32[N] arrivals lost to staging overflow
+
+
+@contract(out=StageResult(staging=_STORE_NM,
+                          landed=Spec("bool", ("N", "B")),
+                          n_dropped=Spec("int32", ("N",))),
+          staging=_STORE_NM, new=_BATCH_NB,
+          new_mask=Spec("bool", ("N", "B")))
+def store_stage(staging: StoreCols, new: StoreCols,
+                new_mask: jnp.ndarray) -> StageResult:
+    """Append masked arrivals to each peer's staging buffer, in delivery
+    order, after the current valid prefix (dispersy_tpu/storediet.py).
+
+    The byte-diet replacement for the every-round :func:`store_insert`:
+    a bounded O(S + B) scatter instead of a full sorted-ring rewrite —
+    the ring is only merged at compaction, where the staged records
+    flow through ``store_insert`` unchanged (UNIQUE / LastSync /
+    capacity semantics all apply there).  Overflow arrivals are dropped
+    and counted, exactly like every bounded inbox in this repo (UDP
+    backpressure; the Bloom pull re-offers them next epoch).
+
+    Preserves the valid-prefix invariant: holes only ever follow the
+    appended tail.  ``staging``: [N, S] columns; ``new``: [N, B];
+    ``new_mask``: [N, B].  The batch's columns follow the staging
+    dtypes (the ``store_insert`` narrowing rule).
+    """
+    s = staging.gt.shape[-1]
+    n = staging.gt.shape[0]
+    if (new.meta.dtype != staging.meta.dtype
+            or new.flags.dtype != staging.flags.dtype
+            or new.aux.dtype != staging.aux.dtype):
+        new = new._replace(meta=new.meta.astype(staging.meta.dtype),
+                           flags=new.flags.astype(staging.flags.dtype),
+                           aux=new.aux.astype(staging.aux.dtype))
+    cnt = count_valid(staging.gt)                           # [N]
+    rank = jnp.cumsum(new_mask.astype(jnp.int32), axis=-1) - 1
+    slot = cnt[:, None] + rank                              # [N, B]
+    landed = new_mask & (slot < s)
+    if n * s < 2 ** 31:
+        # Flat one-component scatter indices (the rank_compact layout,
+        # same int32-overflow guard); masked-out/overflow entries point
+        # past the buffer and mode="drop" discards them.
+        row0 = jnp.arange(n, dtype=jnp.int32)[:, None] * s
+        flat = jnp.where(landed, row0 + slot,
+                         jnp.int32(n * s)).reshape(-1)
+
+        def put(cur, val):
+            return (cur.reshape(-1).at[flat].set(val.reshape(-1),
+                                                 mode="drop")
+                    .reshape(n, s))
+    else:
+        # 2-D (row, slot) index form past the int32 flat-index range.
+        rows = jnp.arange(n)[:, None]
+        tgt = jnp.where(landed, slot, s)   # s = out-of-bounds -> dropped
+
+        def put(cur, val):
+            return cur.at[rows, tgt].set(val, mode="drop")
+    out = StoreCols(gt=put(staging.gt, new.gt),
+                    member=put(staging.member, new.member),
+                    meta=put(staging.meta, new.meta),
+                    payload=put(staging.payload, new.payload),
+                    aux=put(staging.aux, new.aux),
+                    flags=put(staging.flags, new.flags))
+    n_dropped = jnp.sum(new_mask & ~landed, axis=-1).astype(jnp.int32)
+    return StageResult(staging=out, landed=landed, n_dropped=n_dropped)
 
 
 class RemoveResult(NamedTuple):

@@ -46,18 +46,26 @@ def bench_config(n_peers: int, platform: str = "tpu") -> CommunityConfig:
     64k fallback rung's shape (M=64, bloom_capacity=64).  Tracker counts
     scale with population, capped at each platform's recorded values.
     """
+    from dispersy_tpu.storediet import StoreConfig
+
+    # The byte-diet store plane (PR 12; storediet.py) is ON for the
+    # bench shapes: staging=8 slots, compaction/sync one round in 12,
+    # aux narrowed to u16 — the layout the committed cost ledger prices
+    # (BENCH.md "Byte diet").  Legacy-layout numbers are reproducible
+    # with cfg.replace(store=StoreConfig()).
+    diet = StoreConfig(staging=8, compact_every=12, aux_bits=16)
     if platform == "cpu":
         return CommunityConfig(
             n_peers=n_peers, n_trackers=max(2, min(4, n_peers // 1024)),
             k_candidates=16, msg_capacity=64, bloom_capacity=64,
             request_inbox=4,
             tracker_inbox=max(64, min(256, n_peers // 64)),
-            response_budget=8, churn_rate=0.0)
+            response_budget=8, churn_rate=0.0, store=diet)
     return CommunityConfig(
         n_peers=n_peers, n_trackers=max(2, min(8, n_peers // 1024)),
         k_candidates=16, msg_capacity=48, bloom_capacity=48,
         request_inbox=4, tracker_inbox=max(64, min(1024, n_peers // 64)),
-        response_budget=8, churn_rate=0.0)
+        response_budget=8, churn_rate=0.0, store=diet)
 
 
 def _flatten_cost_analysis(ca) -> list:
@@ -106,11 +114,16 @@ def state_shapes(cfg: CommunityConfig):
     return jax.eval_shape(functools.partial(init_state, cfg), key)
 
 
-def step_cost(cfg: CommunityConfig) -> dict:
+def step_cost(cfg: CommunityConfig, phase: str | None = None) -> dict:
     """Compile the fused round at ``cfg`` and return
     ``{"flops", "bytes_accessed", "compile_seconds"}``.
 
     Works at any population: only abstract shapes flow into the compiler.
+    ``phase`` (byte-diet configs only — storediet.py): ``"quiet"`` /
+    ``"sync"`` compile the statically-specialized round kind, so the
+    ledger can price each separately and report the honest amortized
+    mean — the dynamic (``None``) form carries BOTH kinds behind one
+    ``lax.cond``, whose untaken branch XLA's cost analysis still sums.
     """
     import jax
 
@@ -118,11 +131,46 @@ def step_cost(cfg: CommunityConfig) -> dict:
 
     shapes = state_shapes(cfg)
     t0 = time.perf_counter()
-    compiled = (jax.jit(engine.step.__wrapped__, static_argnums=1)
-                .lower(shapes, cfg).compile())
+    compiled = (jax.jit(engine.step.__wrapped__, static_argnums=(1, 3))
+                .lower(shapes, cfg, None, phase).compile())
     out = _extract_cost(compiled)
     out["compile_seconds"] = round(time.perf_counter() - t0, 2)
     return out
+
+
+def _amortize(measure, c: int) -> dict:
+    """Cadence-weighted cost over one compaction window from a
+    per-phase measuring callable: quiet and sync round kinds priced
+    separately plus their ``((C-1)*quiet + sync) / C`` mean — the one
+    formula both the single-step and fleet ledgers record."""
+    quiet = measure("quiet")
+    sync = measure("sync")
+    return {
+        "compact_every": c,
+        "bytes_quiet": quiet["bytes_accessed"],
+        "bytes_sync": sync["bytes_accessed"],
+        "flops_quiet": quiet["flops"],
+        "flops_sync": sync["flops"],
+        "bytes_accessed": ((c - 1) * quiet["bytes_accessed"]
+                           + sync["bytes_accessed"]) / c,
+        "flops": ((c - 1) * quiet["flops"] + sync["flops"]) / c,
+        "compile_seconds": round(quiet["compile_seconds"]
+                                 + sync["compile_seconds"], 2),
+    }
+
+
+def step_cost_amortized(cfg: CommunityConfig) -> dict:
+    """Byte-diet step cost over one compaction window: the quiet and
+    sync (compaction) round kinds measured separately plus their
+    cadence-weighted mean — THE per-round number the ledger records
+    (``((C-1)*quiet + sync) / C``).  For legacy configs this is just
+    :func:`step_cost` (every round is a sync round)."""
+    if not cfg.store_diet:
+        out = step_cost(cfg)
+        out["compact_every"] = 1
+        return out
+    return _amortize(lambda ph: step_cost(cfg, ph),
+                     cfg.store.compact_every)
 
 
 def sharded_step_cost(cfg: CommunityConfig, n_devices: int) -> dict:
@@ -148,7 +196,22 @@ def sharded_step_cost(cfg: CommunityConfig, n_devices: int) -> dict:
     return out
 
 
-def fleet_step_cost(cfg: CommunityConfig, replicas: int) -> dict:
+def fleet_step_cost_amortized(cfg: CommunityConfig,
+                              replicas: int) -> dict:
+    """:func:`step_cost_amortized` for the vmapped fleet round: quiet
+    and sync round kinds priced separately (replicas advance in
+    lockstep, so the cadence is fleet-global) and cadence-averaged.
+    Legacy configs fall through to one :func:`fleet_step_cost`."""
+    if not cfg.store_diet:
+        out = fleet_step_cost(cfg, replicas)
+        out["compact_every"] = 1
+        return out
+    return _amortize(lambda ph: fleet_step_cost(cfg, replicas, phase=ph),
+                     cfg.store.compact_every)
+
+
+def fleet_step_cost(cfg: CommunityConfig, replicas: int,
+                    phase: str | None = None) -> dict:
     """Compile the vmapped fleet round (``fleet.fleet_step``, no
     overrides) at ``replicas`` x ``cfg`` and return the same
     flops/bytes dict as :func:`step_cost` — the fleet-on cost-analysis
@@ -164,8 +227,9 @@ def fleet_step_cost(cfg: CommunityConfig, replicas: int) -> dict:
         lambda s: jax.ShapeDtypeStruct((replicas,) + tuple(s.shape),
                                        s.dtype), shapes)
     t0 = time.perf_counter()
-    compiled = (jax.jit(fleet.fleet_step.__wrapped__, static_argnums=1)
-                .lower(fshapes, cfg).compile())
+    compiled = (jax.jit(fleet.fleet_step.__wrapped__,
+                        static_argnums=(1, 3))
+                .lower(fshapes, cfg, None, phase).compile())
     out = _extract_cost(compiled)
     out["compile_seconds"] = round(time.perf_counter() - t0, 2)
     return out
@@ -229,10 +293,13 @@ def phase_kernels(cfg: CommunityConfig, time_phases: bool = False) -> dict:
             member=jnp.where(r1, jnp.uint32(0xFFFFFFFF), member),
             meta=jnp.where(r1, jnp.uint8(0xFF), meta),
             payload=jnp.where(r1, jnp.uint32(0xFFFFFFFF), payload),
-            aux=jnp.where(r1, jnp.uint32(0), aux),
+            aux=jnp.where(r1, jnp.zeros((), aux.dtype), aux),
             flags=jnp.where(r1, jnp.uint8(0), flags))
 
-    stc = st.empty_records((n, m))
+    # The ring carries the REAL aux width (cfg.aux_dtype) so the
+    # store_merge/store_compact/churn cells reprice mechanically when
+    # the byte diet narrows the column; batches stay u32 (wire width).
+    stc = st.empty_records((n, m), aux_dtype=cfg.aux_dtype)
     reborn = jnp.zeros((n,), bool)
     run("churn", churn_wipe, reborn, *stc)
 
@@ -301,6 +368,52 @@ def phase_kernels(cfg: CommunityConfig, time_phases: bool = False) -> dict:
     run("store_merge",
         functools.partial(st.store_insert, history=cfg.history),
         stc, batch, jnp.ones((n, b), bool))
+
+    if cfg.store_diet:
+        # --- byte-diet store plane (storediet.py): the quiet round's
+        # staging append + digest OR-update, and the compaction round's
+        # ring merge of the staged batch — the engine's store_stage /
+        # digest_update / store_compact named scopes.
+        s_w = cfg.store.staging
+        qb = cfg.push_inbox                   # quiet-round arrival width
+        k_sgt, k_smem, k_qgt, k_qmem = jax.random.split(
+            jax.random.PRNGKey(11), 4)
+        sta = st.StoreCols(
+            gt=(jax.random.randint(k_sgt, (n, s_w), 1, 1000, jnp.int32)
+                .astype(jnp.uint32)),
+            member=(jax.random.randint(k_smem, (n, s_w), 0, n, jnp.int32)
+                    .astype(jnp.uint32)),
+            meta=jnp.ones((n, s_w), jnp.uint8),
+            payload=jnp.zeros((n, s_w), jnp.uint32),
+            aux=jnp.zeros((n, s_w), cfg.aux_dtype),
+            flags=jnp.zeros((n, s_w), jnp.uint8))
+        qbatch = st.StoreCols(
+            gt=(jax.random.randint(k_qgt, (n, qb), 1, 1000, jnp.int32)
+                .astype(jnp.uint32)),
+            member=(jax.random.randint(k_qmem, (n, qb), 0, n, jnp.int32)
+                    .astype(jnp.uint32)),
+            meta=jnp.ones((n, qb), jnp.uint8),
+            payload=jnp.zeros((n, qb), jnp.uint32),
+            aux=jnp.zeros((n, qb), jnp.uint32),
+            flags=jnp.zeros((n, qb), jnp.uint8))
+        run("store_stage", st.store_stage,
+            st.empty_records((n, s_w), aux_dtype=cfg.aux_dtype), qbatch,
+            jnp.ones((n, qb), bool))
+        run("store_compact",
+            functools.partial(st.store_insert, history=cfg.history),
+            stc, sta, jnp.ones((n, s_w), bool))
+        if cfg.sync_enabled:
+            from dispersy_tpu.ops import hashing as hsh
+
+            def dig_update(dig, member, gt, meta, payload, mask):
+                probes = bl.probe_bits(
+                    hsh.record_hash(member, gt, meta, payload),
+                    cfg.bloom_bits, cfg.bloom_hashes, salt=jnp.uint32(1))
+                return bl.digest_update(dig, probes, mask,
+                                        cfg.bloom_bits)
+            run("digest_update", dig_update,
+                jnp.zeros((n, w), jnp.uint32), qbatch.member, qbatch.gt,
+                qbatch.meta, qbatch.payload, jnp.ones((n, qb), bool))
 
     # --- timeline: the retro re-walk's table rebuild (only compiled in
     # for permission communities; engine._retro_pass).

@@ -36,6 +36,8 @@ from dispersy_tpu import telemetry as tlm
 from dispersy_tpu.config import (EMPTY_META, EMPTY_U32, FLAGS_DTYPE,
                                  META_DTYPE, NO_PEER, CommunityConfig)
 
+from dispersy_tpu.ops.store import empty_of
+
 NEVER = -1.0e9  # "timestamp never happened" for float32 sim-seconds fields
 
 
@@ -220,8 +222,31 @@ class PeerState:
     store_member: jnp.ndarray  # u32
     store_meta: jnp.ndarray    # u8, EMPTY_META = hole (config.META_DTYPE)
     store_payload: jnp.ndarray  # u32
-    store_aux: jnp.ndarray     # u32 second payload word (see StoreCols.aux)
+    store_aux: jnp.ndarray     # u32 second payload word (see StoreCols.aux);
+    #   u16 under the byte-diet opt-in (config.aux_dtype)
     store_flags: jnp.ndarray   # u8 bit0 = undone (sync table's `undone` column)
+
+    # ---- byte-diet staging buffer [N, S] (dispersy_tpu/storediet.py;
+    #      STORE section in README).  Accepted records in delivery
+    #      order, EMPTY holes at the END (valid-prefix invariant);
+    #      merged into the sorted ring every compact_every rounds by
+    #      ops/store.store_insert.  Logically part of the store (the
+    #      database's write buffer): it survives unload/load like the
+    #      ring and is wiped with it on churn/quarantine rebirth.
+    #      Every leaf is zero-width unless cfg.store.staging > 0 — the
+    #      `health` idiom. ----
+    sta_gt: jnp.ndarray       # u32, EMPTY_U32 = free slot
+    sta_member: jnp.ndarray   # u32
+    sta_meta: jnp.ndarray     # u8, EMPTY_META = free slot
+    sta_payload: jnp.ndarray  # u32
+    sta_aux: jnp.ndarray      # config.aux_dtype
+    sta_flags: jnp.ndarray    # u8
+    # Incremental Bloom digest u32[N, bloom_words]: the claimed slice's
+    # bloom under the CURRENT epoch's salt (storediet.epoch_of), OR-
+    # updated from each round's landed arrivals and fully rebuilt from
+    # the ring at compaction.  Doubles as the intake freshness filter.
+    # Zero-width unless the diet and sync are both on.
+    digest: jnp.ndarray
 
     # ---- forward buffer [N, F]: records to push next round -------------
     # (reference: dispersy.py store_update_forward -> _forward sends each
@@ -280,20 +305,66 @@ class PeerState:
 FLAG_UNDONE = 1
 
 
-def init_stats(n: int, n_meta: int = 8, n_corrupt: int | None = None,
-               n_recov: int = 0, n_overload: int = 0) -> Stats:
+def stats_gates(config: CommunityConfig) -> dict:
+    """Which feature-gated ``Stats`` counters are compiled in (True =
+    full ``[N]`` width) for one config — the ONE definition shared by
+    :func:`init_stats`, the oracle's ``state_arrays`` and the telemetry
+    row packer, so a counter can never be written wider than it is
+    sized.  Counters absent here are always-on.  The byte-diet
+    motivation: a 1M-peer round was carrying ~13 always-zero u32[N]
+    counters for features the config compiled out (~52 B/peer of
+    resident state and round traffic for nothing)."""
+    return {
+        "msgs_rejected": (config.timeline_enabled
+                          or bool(config.seq_meta_mask)
+                          or config.identity_required
+                          or config.malicious_enabled),
+        "msgs_direct": bool(config.direct_meta_mask),
+        "msgs_delayed": config.delay_enabled,
+        "proof_requests": config.proof_requests,
+        "proof_records": config.proof_requests,
+        "seq_requests": config.seq_requests,
+        "seq_records": config.seq_requests,
+        "mm_requests": config.msg_requests,
+        "mm_records": config.msg_requests,
+        "id_requests": config.identity_requests,
+        "id_records": config.identity_requests,
+        "sig_signed": bool(config.double_meta_mask),
+        "sig_done": bool(config.double_meta_mask),
+        "sig_expired": bool(config.double_meta_mask),
+        "conflicts": config.malicious_enabled,
+        "convictions_rx": config.malicious_enabled,
+        "auth_unwound": config.timeline_enabled,
+        "msgs_retro": config.timeline_enabled,
+    }
+
+
+def init_stats(config: CommunityConfig) -> Stats:
     # Distinct buffers on purpose: aliased arrays break donation
     # (Execute() rejects the same buffer donated twice).
     from dispersy_tpu.recovery import NUM_HEALTH_BITS
 
+    n, n_meta = config.n_peers, config.n_meta
+    n_corrupt = n if (config.faults.corrupt_rate > 0.0
+                      or config.faults.flood_enabled) else 0
+    n_recov = n if config.recovery.enabled else 0
+    n_overload = n if config.overload.enabled else 0
+    gates = stats_gates(config)
+
     def z():
         return jnp.zeros((n,), jnp.uint32)
+
+    def g(name):
+        # Feature-gated counter: zero-width when its plane is compiled
+        # out (the `health` idiom) — every engine write site is guarded
+        # by the same config flag (state.stats_gates).
+        return jnp.zeros((n if gates[name] else 0,), jnp.uint32)
     return Stats(walk_success=z(), walk_fail=z(), msgs_stored=z(),
                  msgs_dropped=z(), requests_dropped=z(), punctures=z(),
-                 msgs_forwarded=z(), msgs_rejected=z(), msgs_direct=z(),
-                 msgs_delayed=z(),
-                 msgs_corrupt_dropped=jnp.zeros(
-                     (n if n_corrupt is None else n_corrupt,), jnp.uint32),
+                 msgs_forwarded=z(), msgs_rejected=g("msgs_rejected"),
+                 msgs_direct=g("msgs_direct"),
+                 msgs_delayed=g("msgs_delayed"),
+                 msgs_corrupt_dropped=jnp.zeros((n_corrupt,), jnp.uint32),
                  msgs_shed_rate=jnp.zeros((n_overload,), jnp.uint32),
                  msgs_shed_priority=jnp.zeros((n_overload,), jnp.uint32),
                  recov_soft=jnp.zeros((n_recov,), jnp.uint32),
@@ -301,13 +372,18 @@ def init_stats(n: int, n_meta: int = 8, n_corrupt: int | None = None,
                  recov_quarantine=jnp.zeros((n_recov,), jnp.uint32),
                  recov_cleared=jnp.zeros((n_recov, NUM_HEALTH_BITS),
                                          jnp.uint32),
-                 proof_requests=z(), proof_records=z(),
-                 seq_requests=z(), seq_records=z(),
-                 mm_requests=z(), mm_records=z(),
-                 id_requests=z(), id_records=z(),
-                 sig_signed=z(), sig_done=z(), sig_expired=z(),
-                 conflicts=z(), convictions_rx=z(),
-                 auth_unwound=z(), msgs_retro=z(),
+                 proof_requests=g("proof_requests"),
+                 proof_records=g("proof_records"),
+                 seq_requests=g("seq_requests"),
+                 seq_records=g("seq_records"),
+                 mm_requests=g("mm_requests"), mm_records=g("mm_records"),
+                 id_requests=g("id_requests"), id_records=g("id_records"),
+                 sig_signed=g("sig_signed"), sig_done=g("sig_done"),
+                 sig_expired=g("sig_expired"),
+                 conflicts=g("conflicts"),
+                 convictions_rx=g("convictions_rx"),
+                 auth_unwound=g("auth_unwound"),
+                 msgs_retro=g("msgs_retro"),
                  bytes_up=z(), bytes_down=z(),
                  accepted_by_meta=jnp.zeros((n, n_meta + 1), jnp.uint32))
 
@@ -348,6 +424,11 @@ def wipe_instance_memory(state: PeerState, mask) -> PeerState:
     updates = {}
     for name, kind in INSTANCE_MEMORY_FIELDS:
         arr = getattr(state, name)
+        if arr.ndim >= 1 and arr.shape[0] != n:
+            # Plane-sized zero-width leaf (feature compiled out, e.g. a
+            # [0]-shaped sig cache when double_meta_mask is 0): nothing
+            # to wipe, and the (n,)-mask would not broadcast against it.
+            continue
         xp = np if isinstance(arr, np.ndarray) else jnp
         m = xp.reshape(xp.asarray(mask), (n,) + (1,) * (arr.ndim - 1))
         # "empty" is the all-ones sentinel of the column's OWN dtype
@@ -390,9 +471,21 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
     an empty database): peers know only the bootstrap trackers, which the
     walker reaches via its 0.5% bootstrap branch.
     """
-    n, k, m, a = (config.n_peers, config.k_candidates, config.msg_capacity,
-                  config.k_authorized)
+    n, k, m = config.n_peers, config.k_candidates, config.msg_capacity
     f = config.forward_buffer
+    # Plane-sized community-feature leaves (the `health` idiom, applied
+    # to the original tables by the byte-diet PR): the timeline's auth
+    # table, the malicious blacklist and the signature cache are
+    # zero-width when their feature is compiled out — at the 1M bench
+    # shape they were ~324 B/peer of resident state (and churn-wipe
+    # traffic) for features the config could never exercise.
+    a = config.k_authorized if config.timeline_enabled else 0
+    km = config.k_malicious if config.malicious_enabled else 0
+    ns = n if config.double_meta_mask else 0
+    s_w = config.store.staging
+    d_w = config.bloom_words if (config.store_diet
+                                 and config.sync_enabled) else 0
+    aux_dt = config.aux_dtype
 
     def never():  # distinct buffers: aliasing breaks donation
         return jnp.full((n, k), NEVER, jnp.float32)
@@ -440,13 +533,20 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         store_member=jnp.full((n, m), EMPTY_U32, jnp.uint32),
         store_meta=jnp.full((n, m), EMPTY_META, META_DTYPE),
         store_payload=jnp.full((n, m), EMPTY_U32, jnp.uint32),
-        store_aux=jnp.zeros((n, m), jnp.uint32),
+        store_aux=jnp.zeros((n, m), aux_dt),
         store_flags=jnp.zeros((n, m), FLAGS_DTYPE),
+        sta_gt=jnp.full((n, s_w), EMPTY_U32, jnp.uint32),
+        sta_member=jnp.full((n, s_w), EMPTY_U32, jnp.uint32),
+        sta_meta=jnp.full((n, s_w), EMPTY_META, META_DTYPE),
+        sta_payload=jnp.full((n, s_w), EMPTY_U32, jnp.uint32),
+        sta_aux=jnp.zeros((n, s_w), aux_dt),
+        sta_flags=jnp.zeros((n, s_w), FLAGS_DTYPE),
+        digest=jnp.zeros((n if d_w else 0, d_w), jnp.uint32),
         fwd_gt=jnp.full((n, f), EMPTY_U32, jnp.uint32),
         fwd_member=jnp.full((n, f), EMPTY_U32, jnp.uint32),
         fwd_meta=jnp.full((n, f), EMPTY_META, META_DTYPE),
         fwd_payload=jnp.full((n, f), EMPTY_U32, jnp.uint32),
-        fwd_aux=jnp.full((n, f), EMPTY_U32, jnp.uint32),
+        fwd_aux=jnp.full((n, f), empty_of(aux_dt), aux_dt),
         dly_gt=jnp.full((n, config.delay_inbox), EMPTY_U32, jnp.uint32),
         dly_member=jnp.full((n, config.delay_inbox), EMPTY_U32, jnp.uint32),
         dly_meta=jnp.full((n, config.delay_inbox), EMPTY_META, META_DTYPE),
@@ -459,18 +559,13 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         auth_gt=jnp.zeros((n, a), jnp.uint32),
         auth_rev=jnp.zeros((n, a), bool),
         auth_issuer=jnp.full((n, a), EMPTY_U32, jnp.uint32),
-        mal_member=jnp.full((n, config.k_malicious), EMPTY_U32, jnp.uint32),
-        sig_target=jnp.full((n,), NO_PEER, jnp.int32),
-        sig_meta=jnp.zeros((n,), jnp.uint32),
-        sig_payload=jnp.zeros((n,), jnp.uint32),
-        sig_gt=jnp.zeros((n,), jnp.uint32),
-        sig_since=jnp.zeros((n,), jnp.uint32),
-        stats=init_stats(
-            n, config.n_meta,
-            n_corrupt=(n if (config.faults.corrupt_rate > 0.0
-                             or config.faults.flood_enabled) else 0),
-            n_recov=(n if config.recovery.enabled else 0),
-            n_overload=(n if config.overload.enabled else 0)),
+        mal_member=jnp.full((n, km), EMPTY_U32, jnp.uint32),
+        sig_target=jnp.full((ns,), NO_PEER, jnp.int32),
+        sig_meta=jnp.zeros((ns,), jnp.uint32),
+        sig_payload=jnp.zeros((ns,), jnp.uint32),
+        sig_gt=jnp.zeros((ns,), jnp.uint32),
+        sig_since=jnp.zeros((ns,), jnp.uint32),
+        stats=init_stats(config),
         key=jax.random.key_data(key) if key.dtype != jnp.uint32 else key,
         time=jnp.float32(0.0),
         round_index=jnp.uint32(0),

@@ -1,0 +1,130 @@
+"""Byte-diet store plane: incremental maintenance config + cadence helpers.
+
+ROADMAP item 1 (the byte-diet fused round): the PR-11 cost ledger proved
+the 1M-peer round moves ~74.5 KB/peer/round against a ~1.7 KB store
+read+write floor because the sorted-ring store is fully rewritten every
+round to land B«M records, and the sync responder re-scans the whole
+ring for every request slot.  This module holds the static knobs that
+amortize both:
+
+- **Staging buffer** (``StoreConfig.staging`` slots/peer): accepted
+  records land in a small per-peer append-only buffer in delivery
+  order; the sorted ring is only merged (``ops/store.store_insert``)
+  every ``compact_every`` rounds.  Between compactions the logical
+  store is ring ∪ staging.  A full staging buffer drops (and counts)
+  overflow arrivals exactly like every bounded inbox in this repo —
+  UDP-style backpressure that the Bloom pull repairs at the next sync
+  round.
+- **Cadenced sync** : the Bloom claim/serve exchange runs on *sync
+  rounds* (one round in ``compact_every``; the compaction round), the
+  push channel every round.  This is the per-round communication bound
+  of the gossip literature (PAPERS.md: *Time- and
+  Communication-Efficient Overlay Network Construction via Gossip*
+  bounds per-round communication; *The Algorithm of Pipelined
+  Gossiping* amortizes sustained throughput) applied to HBM bytes.
+- **Incremental Bloom digest** (``PeerState.digest``): the claimed
+  slice's bloom is a device-resident digest, OR-updated each round from
+  the staged arrivals' precomputed ``probe_bits`` and fully rebuilt
+  from the ring only on compaction rounds — the claim itself is a pure
+  ``bloom_words`` read instead of the 4-column re-hash + rebuild of the
+  full store (the old engine.py claim block).  The digest doubles as
+  the intake's freshness filter (:func:`digest_fresh` semantics below).
+
+Bloom **salting** under the diet is per-*epoch* instead of per-round:
+``salt = round // compact_every`` (:func:`epoch_of`).  Requester and
+responder derive the identical salt from the shared round counter, and
+a false positive against one epoch's digest re-randomizes at the next
+compaction — the same repair-convergence argument as the per-round
+claim prefix, at epoch granularity.  With ``compact_every == 1`` the
+salt, the claim, the merge cadence and the served set all degenerate to
+exactly the legacy every-round path (pinned bit-identical in
+tests/test_storediet.py).
+
+**Freshness via the digest** : the intake's "already stored?" test
+under the diet is a digest membership query instead of the exact
+[N, B, M] key compare against the ring — quiet rounds touch ZERO ring
+bytes.  Consequences, all mirrored bit-exactly by the oracle:
+
+- false positive (~bloom_error_rate): a genuinely fresh record is
+  dropped as a duplicate and counted in ``msgs_dropped``; the pull
+  re-offers it under the next epoch's salt, so convergence still
+  reaches 100% (the per-claim-prefix argument).
+- false negative (a ring record outside the claimed slice re-arrives):
+  the record is re-staged and re-pushed once, then dies as a duplicate
+  at the next compaction (``store_insert``'s UNIQUE rule, existing
+  wins) — the store never corrupts, and the echo decays because the
+  re-arrival entered the digest.
+
+The plane composes like faults/telemetry/recovery/overload: all
+defaults (``staging=0``) compile to exactly the legacy every-round
+step, checkpoint v14 carries the staging + digest leaves, and the
+oracle mirrors every path bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from dispersy_tpu.exceptions import ConfigError
+
+
+@dataclasses.dataclass(frozen=True)
+class StoreConfig:
+    """Static byte-diet knobs, composed into ``CommunityConfig.store``.
+
+    Frozen + hashable (a static jit argument, like ``FaultModel``).
+    All defaults compile to exactly the legacy every-round-merge step;
+    every leaf the plane adds (``sta_*``, ``digest``) is zero-width
+    while ``staging`` is 0.
+    """
+
+    # Staging-buffer slots per peer; 0 = legacy every-round full merge.
+    staging: int = 0
+    # Compaction/sync cadence in rounds: the staging buffer merges into
+    # the sorted ring — and the Bloom claim/serve exchange runs — on
+    # rounds r with r % compact_every == compact_every - 1.  1 = merge
+    # and sync every round (bit-identical to the legacy path).
+    compact_every: int = 8
+    # Store the ``aux`` record column in 16 bits instead of 32.  Only
+    # legal when no configured meta interprets aux (the staging gates
+    # below already exclude timeline/seq/double metas); values above
+    # 2^16-1 silently truncate at the store boundary, so this is an
+    # explicit opt-in for communities whose payloads fit.
+    aux_bits: int = 32
+
+    def __post_init__(self) -> None:
+        if self.staging < 0:
+            raise ConfigError("store.staging must be >= 0")
+        if self.compact_every < 1:
+            raise ConfigError("store.compact_every must be >= 1")
+        if self.aux_bits not in (16, 32):
+            raise ConfigError("store.aux_bits must be 16 or 32")
+        if self.aux_bits != 32 and self.staging == 0:
+            raise ConfigError(
+                "store.aux_bits narrowing rides the staged store layout "
+                "— set store.staging > 0 too")
+
+
+def epoch_of(cfg, rnd):
+    """The bloom-salt epoch of round ``rnd`` (host int or traced u32):
+    ``rnd // compact_every``.  Requesters build/maintain the digest with
+    this salt and responders query with it — both sides derive it from
+    the same round counter, so the exchange stays round-synchronous."""
+    return rnd // cfg.store.compact_every
+
+
+def sync_round_of(cfg, rnd):
+    """Cadence predicate (host int or traced u32, like ``epoch_of``):
+    does round ``rnd`` run the sync exchange + compaction?  Always True
+    without the diet."""
+    if cfg.store.staging == 0:
+        return True
+    c = cfg.store.compact_every
+    return (rnd % c) == c - 1
+
+
+def phase_of(cfg, rnd: int) -> str:
+    """The static ``engine.step`` phase for round ``rnd`` ("sync" or
+    "quiet") — for drivers that know the round index host-side and want
+    the statically-specialized step instead of the dynamic cond."""
+    return "sync" if sync_round_of(cfg, rnd) else "quiet"

@@ -143,7 +143,8 @@ def hist_specs(cfg) -> tuple:
     open-ended).  Masks (who contributes) are part of each histogram's
     definition — engine and oracle apply them identically:
 
-    - ``store_fill``    all peers; live store rows (0..msg_capacity)
+    - ``store_fill``    all peers; live store rows, ring ∪ staging
+                        (0..msg_capacity + store.staging)
     - ``cand_fill``     alive non-tracker members; live candidate slots
     - ``req_inbox``     non-tracker rows; intro-requests handled this
                         round (trackers serve the separate high-capacity
@@ -155,7 +156,7 @@ def hist_specs(cfg) -> tuple:
     - ``walk_streak``   alive non-tracker members; consecutive
                         successful walks (PeerState.walk_streak)
     """
-    return (("store_fill", "linear", cfg.msg_capacity),
+    return (("store_fill", "linear", cfg.msg_capacity + cfg.store.staging),
             ("cand_fill", "linear", cfg.k_candidates),
             ("req_inbox", "linear", cfg.request_inbox),
             ("round_drops", "log2", 0),
@@ -410,7 +411,7 @@ def row_to_snapshot(row: np.ndarray, cfg) -> dict:
     # accumulated the same ratios in float32; this is the same quantity
     # computed exactly).
     out["store_fill"] = raw["store_live"] / float(
-        cfg.n_peers * cfg.msg_capacity)
+        cfg.n_peers * (cfg.msg_capacity + cfg.store.staging))
     out["candidate_fill"] = raw["cand_live"] / float(
         cfg.k_candidates * n_members)
     out["health_or"] = raw["health_or"]

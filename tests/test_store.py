@@ -309,3 +309,85 @@ def test_insert_same_result_both_widths():
     assert store_as_sets(res_small.store) == store_as_sets(res_wide.store)
     np.testing.assert_array_equal(np.asarray(res_small.n_inserted),
                                   np.asarray(res_wide.n_inserted))
+
+
+# ---- byte-diet staging + the folded-u16 scatter form (PR 12) -----------
+
+
+def test_rank_compact_many_forms_bit_identical():
+    """All three rank_compact_many forms — the CPU permutation+gather,
+    the TPU per-column scatter with its u8-pair -> one-u16-scatter fold
+    (ISSUE satellite: one fewer pass over the slot map per compaction),
+    and plain per-column rank_compact — produce identical columns,
+    including the u8 fill values riding the packed scatter."""
+    import jax
+
+    rng = np.random.default_rng(21)
+    n, w, width = 8, 12, 5
+    cols_fills = [
+        (jnp.asarray(rng.integers(0, 99, (n, w)), jnp.uint32), 0),
+        (jnp.asarray(rng.integers(0, 250, (n, w)), jnp.uint8), 0xFF),
+        (jnp.asarray(rng.integers(0, 2 ** 30, (n, w)), jnp.uint32),
+         EMPTY_U32),
+        (jnp.asarray(rng.integers(0, 7, (n, w)), jnp.uint8), 0),
+        (jnp.asarray(rng.integers(0, 3, (n, w)), jnp.uint8), 1),
+    ]
+    keep = jnp.asarray(rng.random((n, w)) < 0.6)
+    rank = jnp.cumsum(keep.astype(jnp.int32), axis=-1) - 1
+    slot = jnp.where(keep & (rank < width), rank, width)
+    gather = st.rank_compact_many(cols_fills, slot, width, impl="gather")
+    scatter = st.rank_compact_many(cols_fills, slot, width,
+                                   impl="scatter")
+    percol = [st.rank_compact(c, slot, width, f) for c, f in cols_fills]
+    for a, b, c in zip(gather, scatter, percol):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+        assert a.dtype == c.dtype
+
+
+def test_store_stage_appends_in_delivery_order_and_drops_overflow():
+    """store_stage keeps the valid-prefix invariant, appends after the
+    current tail in delivery order, reports the landed mask, and counts
+    overflow drops (bounded-inbox semantics — storediet.py)."""
+    n, s, b = 3, 5, 4
+    sta = st.empty_records((n, s))
+    batch = st.StoreCols(
+        gt=jnp.arange(1, n * b + 1, dtype=jnp.uint32).reshape(n, b),
+        member=jnp.full((n, b), 9, jnp.uint32),
+        meta=jnp.ones((n, b), jnp.uint8),
+        payload=jnp.zeros((n, b), jnp.uint32),
+        aux=jnp.full((n, b), 70000, jnp.uint32),
+        flags=jnp.zeros((n, b), jnp.uint8))
+    mask = jnp.asarray([[1, 0, 1, 1], [1, 1, 1, 1], [0, 0, 0, 0]], bool)
+    r1 = st.store_stage(sta, batch, mask)
+    np.testing.assert_array_equal(np.asarray(st.count_valid(r1.staging.gt)),
+                                  [3, 4, 0])
+    np.testing.assert_array_equal(np.asarray(r1.n_dropped), [0, 0, 0])
+    np.testing.assert_array_equal(np.asarray(r1.staging.gt[0, :3]),
+                                  [1, 3, 4])      # delivery order, no holes
+    r2 = st.store_stage(r1.staging, batch, mask)
+    # row 0: 3+3 = 6 > 5 -> one drop; row 1: 4+4 = 8 -> three drops
+    np.testing.assert_array_equal(np.asarray(r2.n_dropped), [1, 3, 0])
+    np.testing.assert_array_equal(np.asarray(st.count_valid(r2.staging.gt)),
+                                  [5, 5, 0])
+    # landed mask agrees with the drop count
+    np.testing.assert_array_equal(
+        np.asarray(jnp.sum(mask & ~r2.landed, axis=1)),
+        np.asarray(r2.n_dropped))
+
+
+def test_store_stage_narrows_batch_to_staging_dtypes():
+    """A u32-aux wire batch truncates at the staging boundary exactly
+    like store_insert's meta/flags narrowing rule (store.aux_bits=16)."""
+    n, s, b = 2, 4, 2
+    sta = st.empty_records((n, s), aux_dtype=jnp.uint16)
+    batch = st.StoreCols(
+        gt=jnp.ones((n, b), jnp.uint32),
+        member=jnp.arange(n * b, dtype=jnp.uint32).reshape(n, b),
+        meta=jnp.ones((n, b), jnp.uint8),
+        payload=jnp.zeros((n, b), jnp.uint32),
+        aux=jnp.full((n, b), 0x1ABCD, jnp.uint32),
+        flags=jnp.zeros((n, b), jnp.uint8))
+    out = st.store_stage(sta, batch, jnp.ones((n, b), bool))
+    assert out.staging.aux.dtype == jnp.uint16
+    assert int(out.staging.aux[0, 0]) == 0xABCD

@@ -1,0 +1,468 @@
+"""Byte-diet store plane (dispersy_tpu/storediet.py; PR 12).
+
+Pinned here:
+
+- **Legacy identity at C=1**: with ``compact_every=1`` every round is a
+  sync/compaction round, the epoch salt equals the round salt, and the
+  staged path must be BIT-IDENTICAL to the legacy every-round merge —
+  store, candidates, stats, bytes — over a multi-round chain with
+  churn, loss and a mid-setup create.  (Pull-only: with pushes a
+  digest false positive is a *designed* divergence, covered by the
+  oracle-parity tests instead.)
+- **Oracle parity** under the diet with C>1 across the chaos planes
+  (GE + corrupt + dup + flood + health), LastSync history evictions at
+  compaction, staging-buffer overflow, and recovery quarantine wipes.
+- **The amortization claim as a tier-1 number** (ISSUE satellite): the
+  ledger-measured bytes of a quiet round vs a compaction round at the
+  64k cell, and the cadence mean, held to the committed budgets — a
+  change that silently re-introduces the every-round ring rewrite
+  fails HERE, not just at the gate.
+- **Checkpoint v14**: staging + digest leaves round-trip bit-exactly
+  and resume across a compaction boundary replays the identical
+  trajectory; a synthesized v13 archive (repr-strip pattern, full-width
+  plane leaves) loads through the plane-resize path; torn/corrupt v14
+  staging leaves raise ``CheckpointError``; a pre-v14 archive under a
+  non-default StoreConfig is refused.
+- **Fleet**: a 2-replica diet fleet advances bit-identically to two
+  sequential singles (the dynamic-cond-under-vmap path).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispersy_tpu import checkpoint as ckpt
+from dispersy_tpu import engine as E
+from dispersy_tpu import state as S
+from dispersy_tpu.config import CommunityConfig, EMPTY_U32
+from dispersy_tpu.exceptions import CheckpointError, ConfigError
+from dispersy_tpu.faults import FaultModel
+from dispersy_tpu.oracle import sim as O
+from dispersy_tpu.recovery import RecoveryConfig
+from dispersy_tpu.storediet import StoreConfig, phase_of, sync_round_of
+
+from test_oracle import BASE as ORACLE_BASE
+from test_oracle import FIELDS, STAT_FIELDS, assert_match, run_both
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DIET_FIELDS = ["sta_gt", "sta_member", "sta_meta", "sta_payload",
+               "sta_aux", "sta_flags", "digest"]
+
+BASE = CommunityConfig(n_peers=48, n_trackers=2, msg_capacity=24,
+                       bloom_capacity=16, k_candidates=8, request_inbox=4,
+                       tracker_inbox=8, response_budget=4)
+
+
+def _fields_with_diet():
+    return FIELDS + [f for f in DIET_FIELDS if f not in FIELDS]
+
+
+@pytest.fixture(autouse=True)
+def _diet_fields():
+    """Extend the shared oracle-parity field list with the staging +
+    digest leaves for every test in this module."""
+    added = [f for f in DIET_FIELDS if f not in FIELDS]
+    FIELDS.extend(added)
+    yield
+    for f in added:
+        FIELDS.remove(f)
+
+
+# ---- config validation --------------------------------------------------
+
+
+def test_diet_rejects_incompatible_planes():
+    for kw in (dict(timeline_enabled=True),
+               dict(malicious_enabled=True),
+               dict(seq_meta_mask=1),
+               dict(double_meta_mask=1),
+               dict(sync_strategy="modulo")):
+        with pytest.raises(ConfigError):
+            BASE.replace(store=StoreConfig(staging=8), **kw)
+    with pytest.raises(ConfigError):
+        StoreConfig(aux_bits=16)        # narrowing rides the diet
+    with pytest.raises(ConfigError):
+        StoreConfig(staging=8, compact_every=0)
+
+
+def test_cadence_helpers():
+    cfg = BASE.replace(store=StoreConfig(staging=8, compact_every=4))
+    assert [sync_round_of(cfg, r) for r in range(5)] == \
+        [False, False, False, True, False]
+    assert phase_of(cfg, 3) == "sync" and phase_of(cfg, 4) == "quiet"
+    assert sync_round_of(BASE, 2)       # no diet: every round syncs
+
+
+# ---- legacy identity at C=1 --------------------------------------------
+
+
+def test_c1_chain_bit_identical_to_legacy():
+    """compact_every=1 degenerates to the legacy path exactly: same
+    salt, same merge cadence, same served sets — a 20-round pull-only
+    chain with churn + loss + a create event matches leaf-for-leaf."""
+    base = dict(forward_fanout=0, churn_rate=0.02, packet_loss=0.05)
+    cfg_l = BASE.replace(**base)
+    cfg_d = BASE.replace(**base,
+                         store=StoreConfig(staging=16, compact_every=1))
+    sl = E.seed_overlay(S.init_state(cfg_l, jax.random.PRNGKey(7)),
+                        cfg_l, 4)
+    sd = E.seed_overlay(S.init_state(cfg_d, jax.random.PRNGKey(7)),
+                        cfg_d, 4)
+    au = jnp.arange(cfg_l.n_peers) % 6 == 5
+    pay = jnp.arange(cfg_l.n_peers, dtype=jnp.uint32)
+    sl = E.create_messages(sl, cfg_l, au, meta=1, payload=pay)
+    sd = E.create_messages(sd, cfg_d, au, meta=1, payload=pay)
+    shared = [f for f in FIELDS if f not in DIET_FIELDS]
+    for r in range(20):
+        sl = jax.block_until_ready(E.step(sl, cfg_l))
+        sd = jax.block_until_ready(E.step(sd, cfg_d))
+        for name in shared:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(sl, name)),
+                np.asarray(getattr(sd, name)),
+                err_msg=f"round {r}: {name}")
+        for name in STAT_FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(sl.stats, name)),
+                np.asarray(getattr(sd.stats, name)),
+                err_msg=f"round {r}: stat {name}")
+        # C=1 invariant: the staging buffer is empty at every round
+        # boundary (every round compacts)
+        assert int(jnp.sum(sd.sta_gt != jnp.uint32(EMPTY_U32))) == 0
+
+
+def test_static_phases_match_dynamic_cond():
+    """step(phase='quiet'/'sync') along the cadence is bit-identical to
+    the dynamic lax.cond default — the ledger prices exactly the
+    program everyone runs."""
+    cfg = BASE.replace(store=StoreConfig(staging=12, compact_every=3),
+                       packet_loss=0.05)
+    s_dyn = E.seed_overlay(S.init_state(cfg, jax.random.PRNGKey(3)),
+                           cfg, 4)
+    au = jnp.arange(cfg.n_peers) % 8 == 3
+    s_dyn = E.create_messages(s_dyn, cfg, au, meta=1,
+                              payload=jnp.arange(cfg.n_peers,
+                                                 dtype=jnp.uint32))
+    # fresh buffers: step donates its input (donate_argnums=0)
+    s_st = jax.tree.map(lambda x: jnp.array(np.asarray(x)), s_dyn)
+    for r in range(7):
+        s_dyn = E.step(s_dyn, cfg)
+        s_st = E.step(s_st, cfg, None, phase_of(cfg, r))
+    for la, lb in zip(jax.tree.leaves(jax.block_until_ready(s_dyn)),
+                      jax.tree.leaves(jax.block_until_ready(s_st))):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ---- oracle parity across the planes -----------------------------------
+
+
+def test_oracle_parity_diet_chaos():
+    """GE + corrupt + dup + flood + health sentinels, through quiet and
+    compaction rounds, with the narrowed u16 aux column."""
+    cfg = ORACLE_BASE.replace(
+        store=StoreConfig(staging=8, compact_every=3, aux_bits=16),
+        faults=FaultModel(ge_p_bad=0.1, ge_p_good=0.3, ge_loss_good=0.02,
+                          ge_loss_bad=0.4, dup_rate=0.1, corrupt_rate=0.05,
+                          flood_senders=(3,), flood_fanout=3,
+                          health_checks=True))
+    run_both(cfg, rounds=10, author=5, warm=4)
+
+
+def test_oracle_parity_diet_history_evictions():
+    """LastSync keep-last-k applies at COMPACTION under the diet — the
+    deferred eviction still matches the oracle bit-for-bit."""
+    cfg = ORACLE_BASE.replace(
+        store=StoreConfig(staging=12, compact_every=4),
+        last_sync_history=(2,) + (0,) * 7)
+    run_both(cfg, rounds=9, author=5, warm=4)
+
+
+def test_oracle_parity_staging_overflow_counts_drops():
+    """A 2-slot staging buffer under full push fanout overflows; the
+    drops are counted like every bounded-inbox loss and the oracle
+    stays in lockstep."""
+    cfg = ORACLE_BASE.replace(
+        store=StoreConfig(staging=2, compact_every=5))
+    key = jax.random.PRNGKey(1)
+    state = E.seed_overlay(S.init_state(cfg, key), cfg, 6)
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    oracle.seed_overlay(degree=6)
+    mask = np.arange(cfg.n_peers) >= cfg.n_trackers
+    pay = np.arange(cfg.n_peers, dtype=np.uint32)
+    state = E.create_messages(state, cfg, jnp.asarray(mask), meta=1,
+                              payload=jnp.asarray(pay))
+    oracle.create_messages(mask, meta=1, payload=pay)
+    for rnd in range(8):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+    assert int(np.asarray(state.stats.msgs_dropped).sum()) > 0
+
+
+def test_oracle_parity_aux_overflow_truncates_like_engine():
+    """aux values >= 2^16 under aux_bits=16 truncate at the store
+    boundary (the documented meta/flags narrowing rule) identically in
+    the engine and the oracle — through the staging buffer, the forward
+    buffer, and a compaction merge.  Pre-fix the oracle kept full-width
+    aux and crashed writing it into the narrowed u16 state arrays."""
+    cfg = ORACLE_BASE.replace(
+        store=StoreConfig(staging=8, compact_every=3, aux_bits=16))
+    key = jax.random.PRNGKey(2)
+    state = E.seed_overlay(S.init_state(cfg, key), cfg, 4)
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    oracle.seed_overlay(degree=4)
+    mask = np.arange(cfg.n_peers) == 5
+    pay = np.full(cfg.n_peers, 42, np.uint32)
+    aux = (np.uint32(70_000) + np.arange(cfg.n_peers, dtype=np.uint32))
+    state = E.create_messages(state, cfg, jnp.asarray(mask), meta=1,
+                              payload=jnp.asarray(pay),
+                              aux=jnp.asarray(aux))
+    oracle.create_messages(mask, meta=1, payload=pay, aux=aux)
+    assert_match(state, oracle, "setup")
+    for rnd in range(7):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+    # the record spread somewhere with the TRUNCATED aux (70_000+5 mod
+    # 2^16), proving the comparison exercised a narrowed value
+    want = np.uint32(70_005) & np.uint32(0xFFFF)
+    live = ((np.asarray(state.store_member) == 5)
+            & (np.asarray(state.store_aux) == want))
+    assert live.any()
+
+
+def test_oracle_parity_diet_recovery_quarantine():
+    """Recovery quarantine escalations wipe ring + staging + digest on
+    the escalated rows (the wiped-disk rebirth), bit-identically to the
+    oracle."""
+    cfg = ORACLE_BASE.replace(
+        store=StoreConfig(staging=8, compact_every=3),
+        faults=FaultModel(flood_senders=(3, 4), flood_fanout=6,
+                          health_checks=True, health_drop_limit=2),
+        recovery=RecoveryConfig(enabled=True, soft_repair=True,
+                                backoff_limit=3, quarantine_rounds=4,
+                                requarantine_window=6))
+    run_both(cfg, rounds=10, author=5, warm=4)
+
+
+def test_diet_convergence_reaches_full_coverage():
+    """Digest false positives delay records at most one epoch (the salt
+    rotates at compaction): a pushed+pulled record still reaches every
+    peer."""
+    cfg = BASE.replace(store=StoreConfig(staging=16, compact_every=4))
+    state = E.seed_overlay(S.init_state(cfg, jax.random.PRNGKey(2)),
+                           cfg, 4)
+    au = jnp.arange(cfg.n_peers) == 7
+    state = E.create_messages(state, cfg, au, meta=1,
+                              payload=jnp.full((cfg.n_peers,), 9,
+                                               jnp.uint32))
+    state = E.multi_step(state, cfg, 24)
+    cov = float(E.coverage(state, member=7, gt=2, meta=1, payload=9))
+    assert cov == 1.0, cov
+
+
+# ---- the amortization claim as a tier-1 number (ISSUE satellite) -------
+
+
+def test_amortized_bytes_match_committed_budget():
+    """Measure the 64k cell's quiet and compaction round kinds fresh
+    and hold them — and their cadence mean — to the committed ledger
+    budgets.  A change that re-introduces per-round ring rewrites
+    inflates bytes_quiet and fails here directly."""
+    from dispersy_tpu import costmodel, profiling
+
+    with open(os.path.join(REPO, "artifacts", "cost_ledger.json")) as f:
+        committed = json.load(f)
+    budget = committed["cells"]["64k_cpu/default"]["budget"]
+    cfg = profiling.bench_config(65_536, "cpu")
+    assert cfg.store_diet, "the bench shapes carry the byte diet"
+    out = profiling.step_cost_amortized(cfg)
+    assert out["bytes_quiet"] == budget["bytes_quiet"]
+    assert out["bytes_sync"] == budget["bytes_sync"]
+    assert out["bytes_accessed"] == budget["bytes_accessed"]
+    # The structural amortization claims, independent of the recorded
+    # numbers: a quiet round must stay several times cheaper than the
+    # compaction round whose work it defers, and the cadence mean must
+    # sit well under the legacy every-round-merge cost (which is >= the
+    # sync round's).
+    assert out["bytes_quiet"] * 3 < out["bytes_sync"]
+    c = cfg.store.compact_every
+    legacy_floor = out["bytes_sync"]          # >= one full-merge round
+    assert out["bytes_accessed"] < 0.5 * legacy_floor
+    assert out["bytes_accessed"] == pytest.approx(
+        ((c - 1) * out["bytes_quiet"] + out["bytes_sync"]) / c)
+    # And the active-floor model keeps the documented shape: the ring
+    # term is the full ring read+write amortized over the cadence.
+    fl = costmodel.active_floor(cfg)
+    ring_rw = committed["cells"]["64k_cpu/default"]["state"][
+        "store_rw_per_peer_round"]
+    assert fl["per_peer_round"]["ring"] == round(ring_rw / c, 1)
+
+
+# ---- checkpoint v14 ----------------------------------------------------
+
+DIET_CFG = BASE.replace(store=StoreConfig(staging=8, compact_every=4),
+                        packet_loss=0.05)
+
+
+def _warm_diet(rounds):
+    state = E.seed_overlay(S.init_state(DIET_CFG, jax.random.PRNGKey(9)),
+                           DIET_CFG, 4)
+    au = jnp.arange(DIET_CFG.n_peers) % 5 == 2
+    state = E.create_messages(state, DIET_CFG, au, meta=1,
+                              payload=jnp.arange(DIET_CFG.n_peers,
+                                                 dtype=jnp.uint32))
+    for _ in range(rounds):
+        state = E.step(state, DIET_CFG)
+    return jax.block_until_ready(state)
+
+
+def test_v14_roundtrip_resumes_across_compaction(tmp_path):
+    """Save mid-epoch (staging non-empty), restore, and step through
+    the next compaction: identical to the uninterrupted run,
+    leaf-for-leaf."""
+    state = _warm_diet(6)     # round 6: mid-epoch for compact_every=4
+    assert int(jnp.sum(state.sta_gt != jnp.uint32(EMPTY_U32))) > 0, \
+        "fixture should park records in staging"
+    path = str(tmp_path / "diet.npz")
+    ckpt.save(path, state, DIET_CFG)
+    rst = ckpt.restore(path, DIET_CFG)
+    for la, lb in zip(jax.tree.leaves(state), jax.tree.leaves(rst)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    a, b = state, rst
+    for _ in range(4):        # crosses the round-7 compaction
+        a = E.step(a, DIET_CFG)
+        b = E.step(b, DIET_CFG)
+    for la, lb in zip(jax.tree.leaves(jax.block_until_ready(a)),
+                      jax.tree.leaves(jax.block_until_ready(b))):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_v14_corrupt_staging_leaf_raises(tmp_path):
+    state = _warm_diet(3)
+    path = str(tmp_path / "diet.npz")
+    ckpt.save(path, state, DIET_CFG)
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    sg = arrays["leaf:sta_gt"].copy()
+    sg.flat[0] ^= 0x10000     # bit flip inside the staging leaf
+    arrays["leaf:sta_gt"] = sg
+    bad = str(tmp_path / "torn.npz")
+    np.savez(bad, **arrays)
+    with pytest.raises(CheckpointError):
+        ckpt.restore(bad, DIET_CFG)
+
+
+def _as_v13(src: str, dst: str, cfg) -> None:
+    """Rewrite a v14 archive of a DEFAULT-StoreConfig config as its v13
+    equivalent: the staging/digest leaves stripped, the plane-sized
+    auth/mal/sig/stats leaves re-inflated to the full width a real v13
+    writer carried, the ``store=`` fingerprint component stripped, and
+    the version stamp set to 13 (the established repr-strip pattern)."""
+    n = cfg.n_peers
+    with np.load(src) as z:
+        arrays = {k: z[k] for k in z.files}
+    for name in ("sta_gt", "sta_member", "sta_meta", "sta_payload",
+                 "sta_aux", "sta_flags", "digest"):
+        arrays.pop(f"leaf:{name}", None)
+        arrays.pop(f"crc:{name}", None)
+    inflate = {
+        "auth_member": np.full((n, cfg.k_authorized), EMPTY_U32,
+                               np.uint32),
+        "auth_mask": np.zeros((n, cfg.k_authorized), np.uint32),
+        "auth_gt": np.zeros((n, cfg.k_authorized), np.uint32),
+        "auth_rev": np.zeros((n, cfg.k_authorized), bool),
+        "auth_issuer": np.full((n, cfg.k_authorized), EMPTY_U32,
+                               np.uint32),
+        "mal_member": np.full((n, cfg.k_malicious), EMPTY_U32,
+                              np.uint32),
+        "sig_target": np.full((n,), -1, np.int32),
+        "sig_meta": np.zeros((n,), np.uint32),
+        "sig_payload": np.zeros((n,), np.uint32),
+        "sig_gt": np.zeros((n,), np.uint32),
+        "sig_since": np.zeros((n,), np.uint32),
+        **{f"stats/{nm}": np.zeros((n,), np.uint32)
+           for nm, on in S.stats_gates(cfg).items() if not on},
+    }
+    for name, wide in inflate.items():
+        arrays[f"leaf:{name}"] = wide
+        arrays[f"crc:{name}"] = np.asarray(ckpt._crc(wide), np.uint32)
+    arrays["meta:version"] = np.asarray(13)
+    arrays["meta:config"] = np.frombuffer(
+        ckpt._want_fingerprint(cfg, 13).encode(), dtype=np.uint8)
+    np.savez_compressed(dst, **arrays)
+
+
+def test_v13_archive_loads_through_plane_resize(tmp_path):
+    """A synthesized v13 archive (full-width-but-empty auth/blacklist/
+    sig-cache/stats leaves) restores under the v14 plane-sized layout
+    and equals its v14 twin leaf-for-leaf."""
+    cfg = BASE.replace(packet_loss=0.05)     # default StoreConfig
+    state = E.seed_overlay(S.init_state(cfg, jax.random.PRNGKey(4)),
+                           cfg, 4)
+    for _ in range(3):
+        state = E.step(state, cfg)
+    state = jax.block_until_ready(state)
+    v14 = str(tmp_path / "v14.npz")
+    v13 = str(tmp_path / "v13.npz")
+    ckpt.save(v14, state, cfg)
+    _as_v13(v14, v13, cfg)
+    rst13 = ckpt.restore(v13, cfg)
+    rst14 = ckpt.restore(v14, cfg)
+    for la, lb in zip(jax.tree.leaves(rst13), jax.tree.leaves(rst14)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    # a v13 leaf that actually CARRIES plane data for a compiled-out
+    # feature must refuse, not silently truncate
+    with np.load(v13) as z:
+        arrays = {k: z[k] for k in z.files}
+    dirty = arrays["leaf:mal_member"].copy()
+    dirty[0, 0] = 5
+    arrays["leaf:mal_member"] = dirty
+    arrays["crc:mal_member"] = np.asarray(ckpt._crc(dirty), np.uint32)
+    bad = str(tmp_path / "v13_dirty.npz")
+    np.savez_compressed(bad, **arrays)
+    with pytest.raises(CheckpointError, match="plane-sized"):
+        ckpt.restore(bad, cfg)
+
+
+def test_pre_v14_archive_refuses_diet_config(tmp_path):
+    """A v13 archive predates the store plane: restoring it under a
+    non-default StoreConfig is refused (the overload/recovery/telemetry
+    precedent)."""
+    cfg = BASE
+    state = jax.block_until_ready(
+        E.step(S.init_state(cfg, jax.random.PRNGKey(5)), cfg))
+    v14 = str(tmp_path / "v14.npz")
+    v13 = str(tmp_path / "v13.npz")
+    ckpt.save(v14, state, cfg)
+    _as_v13(v14, v13, cfg)
+    with pytest.raises(CheckpointError, match="StoreConfig"):
+        ckpt.restore(v13, DIET_CFG)
+
+
+# ---- fleet -------------------------------------------------------------
+
+
+def test_diet_fleet_matches_sequential_singles():
+    """A 2-replica diet fleet (dynamic cadence cond under vmap) advances
+    bit-identically to the two sequential single runs."""
+    from dispersy_tpu import fleet as F
+
+    cfg = BASE.replace(store=StoreConfig(staging=8, compact_every=3))
+    s0 = E.seed_overlay(S.init_state(cfg, jax.random.PRNGKey(11)), cfg, 4)
+    s1 = E.seed_overlay(S.init_state(cfg, jax.random.PRNGKey(12)), cfg, 4)
+    fstate = S.stack_states([s0, s1])
+    for r in range(4):
+        fstate = F.fleet_step(fstate, cfg)
+        s0 = E.step(s0, cfg)
+        s1 = E.step(s1, cfg)
+    for i, single in enumerate((jax.block_until_ready(s0),
+                                jax.block_until_ready(s1))):
+        rep = S.index_state(jax.block_until_ready(fstate), i)
+        for la, lb in zip(jax.tree.leaves(rep), jax.tree.leaves(single)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))

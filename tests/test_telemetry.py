@@ -244,10 +244,13 @@ def test_disabled_step_cost_identical_to_pr4_baseline():
     from dispersy_tpu import profiling
     with open("artifacts/step_cost_1M_baseline.json") as f:
         base = json.load(f)
-    out = profiling.step_cost(profiling.bench_config(1_000_000,
-                                                     platform="tpu"))
-    assert out["bytes_accessed"] == base["bytes_accessed"]
-    assert out["flops"] == base["flops"]
+    # Amortized form since the byte diet (PR 12): the bench config's
+    # quiet and compaction round kinds are priced separately and pinned
+    # individually — a leak into EITHER kind fails.
+    out = profiling.step_cost_amortized(
+        profiling.bench_config(1_000_000, platform="tpu"))
+    for k in ("bytes_accessed", "flops", "bytes_quiet", "bytes_sync"):
+        assert out[k] == base[k], k
 
 
 # ---- checkpoint v10 ----------------------------------------------------

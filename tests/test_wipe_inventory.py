@@ -38,6 +38,19 @@ def _pollute(state):
     return state.replace(**updates)
 
 
+def _wipeable(state, n_peers):
+    """Inventory leaves that exist under this config — plane-sized
+    zero-width leaves (feature compiled out, e.g. the [0]-shaped sig
+    cache when double_meta_mask is 0) have nothing to wipe and cannot
+    take the per-peer mask; wipe_instance_memory skips them the same
+    way."""
+    for name, kind in S.INSTANCE_MEMORY_FIELDS:
+        arr = np.asarray(getattr(state, name))
+        if arr.ndim >= 1 and arr.shape[0] != n_peers:
+            continue
+        yield name, kind
+
+
 def test_rebirth_wipes_every_instance_memory_leaf():
     cfg = CFG.replace(churn_rate=1.0)   # every member reborn this round
     fresh = S.init_state(cfg, jax.random.PRNGKey(0))
@@ -45,7 +58,7 @@ def test_rebirth_wipes_every_instance_memory_leaf():
     members = np.arange(cfg.n_peers) >= cfg.n_trackers
     assert np.asarray(out.session)[members].min() >= 1, \
         "churn_rate=1.0 must rebirth every member"
-    for name, _ in S.INSTANCE_MEMORY_FIELDS:
+    for name, _ in _wipeable(fresh, cfg.n_peers):
         got = np.asarray(getattr(out, name))[members]
         want = np.asarray(getattr(fresh, name))[members]
         assert (got == want).all(), \
@@ -57,7 +70,7 @@ def test_unload_wipes_every_instance_memory_leaf():
     out = E.unload_members(_pollute(fresh), CFG,
                            np.arange(CFG.n_peers) >= CFG.n_trackers)
     members = np.arange(CFG.n_peers) >= CFG.n_trackers
-    for name, _ in S.INSTANCE_MEMORY_FIELDS:
+    for name, _ in _wipeable(fresh, CFG.n_peers):
         got = np.asarray(getattr(out, name))[members]
         want = np.asarray(getattr(fresh, name))[members]
         assert (got == want).all(), name

@@ -156,10 +156,12 @@ def cmd_roofline(args) -> int:
                 f"{pe['flops']:,.0f} |")
         lines.append("")
     lines.append("### Roofline projection (rounds/s; fullfuse = one "
-                 "read+write pass over resident state, nofuse = raw "
-                 "cost-analysis bytes)")
+                 "pass over the round's ACTIVE state — "
+                 "costmodel.active_floor's amortized per-leaf model — "
+                 "nofuse = raw cost-analysis bytes, cadence-amortized "
+                 "for byte-diet cells)")
     lines.append("")
-    lines.append("| cell | B/peer/round | state r+w B/peer | "
+    lines.append("| cell | B/peer/round | floor B/peer | "
                  + " | ".join(
                      f"{hw}_x{c}"
                      for hw, spec in doc["hardware_model"].items()
@@ -174,8 +176,11 @@ def cmd_roofline(args) -> int:
                 r = cell["roofline"].get(f"{hw}_x{c}", {})
                 cols.append(f"{r.get('rounds_per_sec_nofuse', 0):,.0f}–"
                             f"{r.get('rounds_per_sec_fullfuse', 0):,.0f}")
+        floor = cell.get("floor", {}).get(
+            "floor_bytes_per_peer_round",
+            cell["state"]["state_rw_per_peer_round"])
         lines.append(f"| {key} | {cell['bytes_per_peer_round']:,.1f} | "
-                     f"{cell['state']['state_rw_per_peer_round']:,.1f} | "
+                     f"{floor:,.1f} | "
                      + " | ".join(cols) + " |")
     text = "\n".join(lines)
     print(text)

@@ -258,7 +258,9 @@ def _aggregate_trace(trace_dir: str, top: int = 25) -> list:
 # ledger's phase table uses the same names, so trace time and
 # cost-analysis bytes join on one key).
 PHASE_SCOPES = ("churn", "walk", "deliver_request", "deliver_push",
-                "bloom_build", "store_merge", "telemetry_row")
+                "bloom_build", "store_merge", "store_stage",
+                "store_compact", "digest_update", "digest_rebuild",
+                "telemetry_row")
 
 
 def _phase_scope_totals(trace_dir: str) -> dict:

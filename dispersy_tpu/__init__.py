@@ -42,6 +42,8 @@ __all__ = ["CommunityConfig", "Community"]
 #   dispersy_tpu.recovery    RecoveryConfig / mttr_report (RECOVERY.md)
 #   dispersy_tpu.overload    OverloadConfig / overload_report /
 #                            shed_report (OVERLOAD.md)
+#   dispersy_tpu.traceplane  TraceConfig / trace_report / channel codes
+#                            (OBSERVABILITY.md "Dissemination tracing")
 #   dispersy_tpu.binlog      packed binary round logs (ldecoder analogue)
 #   dispersy_tpu.scenario    Scenario / run + event types
 #   dispersy_tpu.parallel    make_mesh / shard_state

@@ -105,7 +105,7 @@ from dispersy_tpu.telemetry import TelemetryConfig
 #     the ``overload=...`` repr component first, then the older
 #     planes').  v11/v12 FLEET archives load through ``restore_fleet``
 #     the same way.
-FORMAT_VERSION = 14  # v14: the byte-diet store-plane leaves (sta_* +
+# v14: the byte-diet store-plane leaves (sta_* +
 #     digest, knob-sized — dispersy_tpu/storediet.py; the STORE section
 #     in README) plus the PLANE-SIZED community-feature leaves: the
 #     auth table / blacklist / signature cache and ~13 feature-gated
@@ -119,8 +119,21 @@ FORMAT_VERSION = 14  # v14: the byte-diet store-plane leaves (sta_* +
 #     archive's FULL-width auth/mal/sig/stats leaves for a plane the
 #     config compiles out are CRC-verified, asserted empty, and sized
 #     down (_resize_plane_leaf).
-_ACCEPTED_VERSIONS = (7, 8, 9, 10, 11, 12, 13, FORMAT_VERSION)
-_FLEET_VERSIONS = (11, 12, 13, FORMAT_VERSION)
+FORMAT_VERSION = 15  # v15: the dissemination-tracing leaves (the
+#     trace_member/trace_gt key registry, per-peer trace_first/
+#     trace_chan/trace_dups lineage, the trace_latch coverage
+#     percentiles, and the stats trace_delivered/trace_dup channel
+#     counters, knob-sized — dispersy_tpu/traceplane.py;
+#     OBSERVABILITY.md "Dissemination tracing").  v7-v14 archives
+#     still load: their missing trace leaves default to the template's
+#     (zero-width) values and their config fingerprint predates the
+#     ``trace`` field (declared sixth-to-last, directly before
+#     ``store``) — restoring one under a non-default TraceConfig is
+#     refused (_want_fingerprint strips the ``trace=...`` repr
+#     component first, then the older planes').  v11-v14 FLEET
+#     archives load through ``restore_fleet`` the same way.
+_ACCEPTED_VERSIONS = (7, 8, 9, 10, 11, 12, 13, 14, FORMAT_VERSION)
+_FLEET_VERSIONS = (11, 12, 13, 14, FORMAT_VERSION)
 
 # Leaves whose dtype narrowed u32 -> u8 at v8; a v7 archive's u32 arrays
 # convert by truncation (0xFFFFFFFF -> 0xFF, real values < 256 unchanged).
@@ -159,6 +172,15 @@ _NEW_V13 = frozenset(
 _NEW_V14 = frozenset(
     {"sta_gt", "sta_member", "sta_meta", "sta_payload", "sta_aux",
      "sta_flags", "digest"})
+
+# Leaves that did not exist before v15 (the dissemination-tracing
+# plane).  Older archives only restore under a default TraceConfig
+# (enforced by _want_fingerprint), where every one of these is
+# zero-width.
+_NEW_V15 = frozenset(
+    {"trace_member", "trace_gt", "trace_first", "trace_chan",
+     "trace_dups", "trace_latch",
+     "stats/trace_delivered", "stats/trace_dup"})
 
 # Leaves v14 PLANE-SIZED (zero-width when their community feature is
 # compiled out — state.py init_state / stats_gates): a pre-v14 archive
@@ -247,15 +269,29 @@ def _want_fingerprint(cfg: CommunityConfig, version: int) -> str:
     before ``faults`` (declared LAST) — every repr component strips
     cleanly, but only default models can possibly match what the old
     writer simulated."""
-    if version >= 14:
+    if version >= 15:
         return _fingerprint(cfg)
+    from dispersy_tpu.traceplane import TraceConfig
+    if cfg.trace != TraceConfig():
+        raise CheckpointError(
+            f"checkpoint format {version} predates the dissemination-"
+            "tracing plane; it can only restore under the default "
+            "TraceConfig (cfg.trace must be TraceConfig())")
+    full = repr(cfg)
+    trcomp = f", trace={cfg.trace!r}"
+    if full.count(trcomp) != 1:
+        raise CheckpointError(
+            "cannot derive pre-v15 fingerprint: trace is no longer a "
+            "direct config field directly before store")
+    full = full.replace(trcomp, "", 1)
+    if version >= 14:
+        return full
     from dispersy_tpu.storediet import StoreConfig
     if cfg.store != StoreConfig():
         raise CheckpointError(
             f"checkpoint format {version} predates the byte-diet store "
             "plane; it can only restore under the default StoreConfig "
             "(cfg.store must be StoreConfig())")
-    full = repr(cfg)
     scomp = f", store={cfg.store!r}"
     if full.count(scomp) != 1:
         raise CheckpointError(
@@ -417,7 +453,8 @@ def restore(path: str, cfg: CommunityConfig,
                         or (version < 10 and n in _NEW_V10) \
                         or (version < 12 and n in _NEW_V12) \
                         or (version < 13 and n in _NEW_V13) \
-                        or (version < 14 and n in _NEW_V14):
+                        or (version < 14 and n in _NEW_V14) \
+                        or (version < 15 and n in _NEW_V15):
                     # pre-chaos-harness / pre-telemetry / pre-recovery
                     # / pre-overload / pre-byte-diet archive: the leaf
                     # starts at its template default (zero-width /
@@ -537,7 +574,8 @@ def restore_fleet(path: str, cfg: CommunityConfig):
                 if key not in z:
                     if (version < 12 and n in _NEW_V12) \
                             or (version < 13 and n in _NEW_V13) \
-                            or (version < 14 and n in _NEW_V14):
+                            or (version < 14 and n in _NEW_V14) \
+                            or (version < 15 and n in _NEW_V15):
                         # pre-recovery / pre-overload / pre-byte-diet
                         # fleet archive: only accepted under the
                         # default Recovery/Overload/StoreConfig
@@ -792,7 +830,9 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
         elif ((version < 9 and name in _NEW_V9)
               or (version < 10 and name in _NEW_V10)
               or (version < 12 and name in _NEW_V12)
-              or (version < 13 and name in _NEW_V13)) \
+              or (version < 13 and name in _NEW_V13)
+              or (version < 14 and name in _NEW_V14)
+              or (version < 15 and name in _NEW_V15)) \
                 and not covered[name].any():
             # pre-chaos-harness / pre-telemetry archive: template
             # default (state.py)

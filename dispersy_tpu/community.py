@@ -409,3 +409,22 @@ class Community:
                  payload: int):
         return engine.coverage(state, member, gt, self.meta_id(name),
                                payload)
+
+    # ---- dissemination tracing (dispersy_tpu/traceplane.py;
+    # OBSERVABILITY.md "Dissemination tracing") ----
+    def track_record(self, state: PeerState, author: int,
+                     gt: int) -> tuple[PeerState, int]:
+        """Register ``(author, gt)`` for on-device lineage tracing —
+        per-peer first-arrival rounds, first-delivery channels, and
+        duplicate-delivery counters, updated inside the fused step.
+        Call right after the ``create`` that authored the record (the
+        author's copy is attributed to the create channel).  Requires
+        ``trace.enabled`` (TraceConfig); returns ``(state, slot)``."""
+        return engine.track_record(state, self.config, author, gt)
+
+    def trace_totals(self, state: PeerState) -> dict:
+        """The trace plane's current coverage/latch/channel totals
+        (traceplane.trace_totals) — the host-side snapshot of what the
+        telemetry row surfaces per round."""
+        from dispersy_tpu.traceplane import trace_totals
+        return trace_totals(state, self.config)

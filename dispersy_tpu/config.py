@@ -24,6 +24,7 @@ from dispersy_tpu.overload import OverloadConfig
 from dispersy_tpu.recovery import RecoveryConfig
 from dispersy_tpu.storediet import StoreConfig
 from dispersy_tpu.telemetry import MAX_TELEMETRY_PEERS, TelemetryConfig
+from dispersy_tpu.traceplane import TraceConfig
 
 # Sentinel for "empty slot" in uint32 record fields: sorts after every real
 # global_time, so ascending sort pushes holes to the end of the store ring.
@@ -513,6 +514,20 @@ class CommunityConfig:
     # -1 = auto: the first non-tracker peer (index n_trackers).
     founder_member: int = -1
 
+    # ---- dissemination-tracing plane (dispersy_tpu/traceplane.py:
+    #      on-device record lineage — per-peer first-arrival rounds,
+    #      first-delivery channel codes, duplicate-delivery counters,
+    #      coverage-percentile latches; OBSERVABILITY.md "Dissemination
+    #      tracing").  All defaults compile to exactly the trace-free
+    #      step.  MUST stay the SIXTH-TO-LAST field, directly before
+    #      ``store`` (then ``overload``, ``recovery``, ``telemetry``,
+    #      ``faults``): checkpoint.py reconstructs pre-v15 config
+    #      fingerprints by stripping the trailing ``trace=...`` repr
+    #      component (then ``store=`` pre-v14, ``overload=`` pre-v13,
+    #      ``recovery=`` pre-v12, ``telemetry=`` pre-v10, ``faults=``
+    #      pre-v9). ----
+    trace: TraceConfig = TraceConfig()
+
     # ---- byte-diet store plane (dispersy_tpu/storediet.py: staging
     #      buffer + amortized compaction, cadenced sync, incremental
     #      Bloom digest — the ROADMAP item 1 byte diet).  All defaults
@@ -866,6 +881,33 @@ class CommunityConfig:
             if self.push_inbox < 1:
                 raise ConfigError("flooding rides the push channel: "
                                   "push_inbox must be >= 1")
+        tr = self.trace
+        if not isinstance(tr, TraceConfig):
+            raise ConfigError("trace must be a TraceConfig")
+        if tr.enabled:
+            # The lineage channel table covers exactly create /
+            # walk-sync / push / flood (traceplane.CHANNEL_NAMES), so
+            # the plane refuses configs that open OTHER intake
+            # segments or create sites — attribution would silently
+            # have no code for them (traceplane.py scope gate).
+            for flag, why in (
+                    (self.delay_enabled,
+                     "the delay pen re-enters records through its own "
+                     "intake segment (and carries the proof/seq/msg/"
+                     "identity request channels)"),
+                    (bool(self.double_meta_mask),
+                     "double-signed completions arrive through the "
+                     "signature segment"),
+                    (self.malicious_gossip,
+                     "eyewitness proofs are authored inside the fused "
+                     "step, a create site the lineage fold cannot "
+                     "attribute")):
+                if flag:
+                    raise ConfigError(
+                        "trace.enabled (the dissemination-tracing "
+                        f"plane) is incompatible with this knob: {why}; "
+                        "its channel table covers create/walk-sync/"
+                        "push/flood only")
         sd = self.store
         if not isinstance(sd, StoreConfig):
             raise ConfigError("store must be a StoreConfig")

@@ -57,14 +57,16 @@ HARDWARE = {
 # artifact speaks in: the 1M-peer TPU roofline shape and the 64k CPU
 # fallback rung (profiling.bench_config).  Planes are the compiled-in
 # feature sets whose overhead BENCH.md tracks — defaults, telemetry,
-# chaos+health, recovery, overload (each plane supersets the previous,
-# mirroring how the overhead artifacts were measured), plus a 2-replica
-# fleet of the default plane.
+# trace (the dissemination-tracing plane on top of telemetry — its
+# row words and lineage folds ride the fused round), chaos+health,
+# recovery, overload (the fault planes superset each other, mirroring
+# how the overhead artifacts were measured), plus a 2-replica fleet of
+# the default plane.
 SHAPES = {
     "1M_tpu": (1_000_000, "tpu"),
     "64k_cpu": (65_536, "cpu"),
 }
-PLANES = ("default", "telemetry", "faults_health", "recovery",
+PLANES = ("default", "telemetry", "trace", "faults_health", "recovery",
           "overload", "fleet_r2")
 LEDGER_PATH = "artifacts/cost_ledger.json"
 LEDGER_SCHEMA = 1
@@ -92,6 +94,16 @@ def plane_config(shape: str, plane: str):
     if plane == "telemetry":
         return cfg.replace(telemetry=TelemetryConfig(
             enabled=True, history=64, histograms=True)), 1
+    if plane == "trace":
+        # The dissemination-tracing plane prices ON TOP of the
+        # telemetry plane (its coverage/latch/channel words ride the
+        # fused row): the cell's delta over `telemetry` is the
+        # lineage folds + row growth at the default 4 tracked slots.
+        from dispersy_tpu.traceplane import TraceConfig
+        return cfg.replace(
+            telemetry=TelemetryConfig(enabled=True, history=64,
+                                      histograms=True),
+            trace=TraceConfig(enabled=True)), 1
     faults = FaultModel(
         ge_p_bad=0.05, ge_p_good=0.3, ge_loss_good=0.01, ge_loss_bad=0.5,
         dup_rate=0.02, corrupt_rate=0.02,

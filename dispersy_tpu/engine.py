@@ -78,7 +78,9 @@ from dispersy_tpu.ops import overload as ovl
 from dispersy_tpu.ops import recovery as rcv
 from dispersy_tpu.recovery import NUM_HEALTH_BITS
 from dispersy_tpu import storediet as sdiet
+from dispersy_tpu import traceplane as trp
 from dispersy_tpu.ops import telemetry as tele
+from dispersy_tpu.ops import trace as trc
 from dispersy_tpu.ops import timeline as tl
 from dispersy_tpu.ops.hashing import record_hash
 from dispersy_tpu.state import (FLAG_UNDONE, NEVER, PeerState,
@@ -662,7 +664,8 @@ def counter_matrix(stats, n: int) -> jnp.ndarray:
 
 def _telemetry_row(cfg: CommunityConfig, *, rnd, new_time, members, stats,
                    stc, health, store_cnt, cand_cnt, hists,
-                   bucket=None) -> jnp.ndarray:
+                   bucket=None, trace_cov=None,
+                   trace_latch=None) -> jnp.ndarray:
     """Pack the fused per-round telemetry row (u32[row_width]).
 
     Every ``metrics.snapshot`` aggregate, reduced on device and laid out
@@ -704,6 +707,35 @@ def _telemetry_row(cfg: CommunityConfig, *, rnd, new_time, members, stats,
     asum = tele.col_sum_u64(stats.accepted_by_meta)          # [2, K+1]
     for i in range(cfg.n_meta + 1):
         vals[f"accepted_by_meta_{i}"] = asum[:, i]
+    if cfg.trace.enabled:
+        # Dissemination-tracing words (traceplane.py; conditional
+        # schema words so a trace-off row stays byte-identical):
+        # per-slot coverage counts + percentile latches, per-channel
+        # useful/duplicate totals, and the redundancy ratio.  The f32
+        # ratio is computed op-for-op as traceplane.redundancy_f32 so
+        # the oracle's host mirror is bit-exact.
+        for k in range(cfg.trace.tracked_slots):
+            vals[f"trace_cov_{k}"] = w(trace_cov[k])
+            for i, pct in enumerate(trp.LATCH_PCTS):
+                vals[f"trace_r{pct}_{k}"] = w(trace_latch[k, i])
+        usum = tele.col_sum_u64(stats.trace_delivered)       # [2, 4]
+        dsum = tele.col_sum_u64(stats.trace_dup)
+        two32 = jnp.float32(4294967296.0)
+        useful_f = jnp.float32(0.0)
+        dup_f = jnp.float32(0.0)
+        for c, nm in enumerate(trp.CHANNEL_NAMES):
+            vals[f"trace_delivered_{nm}"] = usum[:, c]
+            vals[f"trace_dup_{nm}"] = dsum[:, c]
+            useful_f = useful_f + (usum[0, c].astype(jnp.float32)
+                                   + usum[1, c].astype(jnp.float32)
+                                   * two32)
+            dup_f = dup_f + (dsum[0, c].astype(jnp.float32)
+                             + dsum[1, c].astype(jnp.float32) * two32)
+        ratio = jnp.where(useful_f > jnp.float32(0.0),
+                          (useful_f + dup_f) / useful_f,
+                          jnp.float32(0.0))
+        vals["trace_redundancy"] = jnp.reshape(
+            lax.bitcast_convert_type(ratio, jnp.uint32), (1,))
     if cfg.overload.enabled:
         # Ingress-protection words (overload.py; conditional schema
         # words so an overload-off row stays byte-identical): the two
@@ -847,6 +879,17 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
         INTRO_REQUEST_BASE_BYTES + 4 * cfg.bloom_words
         if sync_on else INTRO_REQUEST_BASE_BYTES - 20)
 
+    # Dissemination-tracing plane (dispersy_tpu/traceplane.py): every
+    # branch below is gated on the STATIC TraceConfig, so the default
+    # (disabled) plane compiles to the identical trace-free round.
+    # Lineage is disk-like state — the per-peer rows wipe with the
+    # store at BOTH rebirth sites (churn, quarantine escalation).
+    trace_on = cfg.trace.enabled
+    tr_first = state.trace_first
+    tr_chan = state.trace_chan
+    tr_dups = state.trace_dups
+    tr_latch = state.trace_latch
+
     # ---- phase 0: churn -------------------------------------------------
     # A churned peer restarts with a wiped disk: empty store, empty
     # candidate table, reset clock.  Trackers never churn (the reference's
@@ -876,6 +919,13 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
                 sta=_staging(state) if diet else None,
                 dig=(state.digest if diet and cfg.sync_enabled
                      else None))
+        if trace_on:
+            # Lineage wipes with the store: a reborn peer's disk — and
+            # therefore its arrival history — is gone (traceplane.py).
+            rb1 = reborn[:, None]
+            tr_first = jnp.where(rb1, jnp.uint32(0), tr_first)
+            tr_chan = jnp.where(rb1, jnp.uint8(0), tr_chan)
+            tr_dups = jnp.where(rb1, jnp.uint32(0), tr_dups)
     else:
         tab, stc = _tab(state), _store(state)
         fwd = (state.fwd_gt, state.fwd_member, state.fwd_meta,
@@ -2634,6 +2684,49 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
                 + ins.n_dropped.astype(jnp.uint32)
                 + ins.n_evicted.astype(jnp.uint32))
 
+        if trace_on:
+            # ---- dissemination lineage (traceplane.py) -------------
+            # Fold this batch into each tracked slot: the channel is
+            # static per batch SEGMENT (the config gate guarantees the
+            # only populated segments are sync pulls, pushes, and their
+            # fault duplicates — flood junk never survives the hash
+            # check, so CH_FLOOD stays structurally zero).  Landing is
+            # staging-aware: under the byte diet an arrival counts
+            # where it took a staging slot (store_stage's landed mask);
+            # the legacy path counts accepted-fresh arrivals (a ring-
+            # capacity drop at insert still counts — arrival history).
+            ln_landed = stg.landed if diet else fresh
+            import numpy as np
+            seg_codes = [0, trp.CH_WALK_SYNC, trp.CH_PUSH,
+                         0, 0, 0, 0, 0]
+            if kn.dup_on:
+                seg_codes += [trp.CH_WALK_SYNC, trp.CH_PUSH]
+            chan_code = jnp.asarray(np.concatenate(
+                [np.full(seg.shape[1], code, np.uint8)
+                 for seg, code in zip(segs_gt, seg_codes)]), jnp.uint8)
+            with jax.named_scope("trace_lineage"):
+                tf_cols, tc_cols, td_cols = [], [], []
+                u_acc = jnp.zeros((n, trp.NUM_CHANNELS), jnp.uint32)
+                d_acc = jnp.zeros((n, trp.NUM_CHANNELS), jnp.uint32)
+                for k in range(cfg.trace.tracked_slots):
+                    match = ((in_member == state.trace_member[k])
+                             & (in_gt == state.trace_gt[k]))
+                    f_k, c_k, d_k, ubc, dbc = trc.slot_lineage(
+                        tr_first[:, k], tr_chan[:, k], tr_dups[:, k],
+                        match, ln_landed, accept_store, chan_code,
+                        rnd + jnp.uint32(1))
+                    tf_cols.append(f_k)
+                    tc_cols.append(c_k)
+                    td_cols.append(d_k)
+                    u_acc = u_acc + ubc
+                    d_acc = d_acc + dbc
+                tr_first = jnp.stack(tf_cols, axis=1)
+                tr_chan = jnp.stack(tc_cols, axis=1)
+                tr_dups = jnp.stack(td_cols, axis=1)
+                stats = stats.replace(
+                    trace_delivered=stats.trace_delivered + u_acc,
+                    trace_dup=stats.trace_dup + d_acc)
+
         if cfg.timeline_enabled:
             # Apply this batch's accepted undo records to the (post-insert)
             # store, so an undo and its target landing together still mark
@@ -2983,6 +3076,14 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
                 last_walk=jnp.where(qbad, NEVER, tab.last_walk),
                 last_stumble=jnp.where(qbad, NEVER, tab.last_stumble),
                 last_intro=jnp.where(qbad, NEVER, tab.last_intro))
+        if trace_on and rc.quarantine_rounds > 0:
+            # A quarantine escalation is a wiped-disk rebirth: the
+            # lineage rows wipe with the store (traceplane.py; the
+            # churn block's rule, mirrored by the oracle's esc branch).
+            em = esc[:, None]
+            tr_first = jnp.where(em, jnp.uint32(0), tr_first)
+            tr_chan = jnp.where(em, jnp.uint8(0), tr_chan)
+            tr_dups = jnp.where(em, jnp.uint32(0), tr_dups)
         stats = stats.replace(
             recov_soft=stats.recov_soft + rep.astype(jnp.uint32),
             recov_backoff=stats.recov_backoff + bump.astype(jnp.uint32),
@@ -2996,6 +3097,21 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
     stats = stats.replace(bytes_up=stats.bytes_up + bup,
                           bytes_down=stats.bytes_down + bdown)
     new_time = now + jnp.float32(cfg.walk_interval)
+
+    # ---- dissemination coverage + percentile latches (traceplane.py;
+    # AFTER the recovery wipes so the counts reflect the returned
+    # state, BEFORE the telemetry row packs them) --------------------
+    if trace_on:
+        with jax.named_scope("trace_coverage"):
+            tr_members = alive & ~state.is_tracker
+            tr_cov = trc.coverage_counts(tr_first, tr_members)
+            tr_latch = trc.latch_update(
+                tr_latch, tr_cov,
+                state.trace_member != jnp.uint32(EMPTY_U32),
+                jnp.sum(tr_members, dtype=jnp.int32).astype(jnp.uint32),
+                rnd + jnp.uint32(1))
+    else:
+        tr_cov = None
 
     # ---- telemetry wrap-up (dispersy_tpu/telemetry.py; every branch is
     # gated on static TelemetryConfig knobs, so disabled telemetry
@@ -3041,7 +3157,9 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
                                       stc=stc, health=health,
                                       store_cnt=store_cnt,
                                       cand_cnt=cand_cnt, hists=hists,
-                                      bucket=bucket_new)
+                                      bucket=bucket_new,
+                                      trace_cov=tr_cov,
+                                      trace_latch=tr_latch)
         if cfg.telemetry.history:
             # Post-step round r+1 lands at slot r % H; the row's own
             # round word identifies the slot at drain time.
@@ -3091,6 +3209,9 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
             "sta_meta": sta.meta, "sta_payload": sta.payload,
             "sta_aux": sta.aux, "sta_flags": sta.flags,
             **({} if dig is None else {"digest": dig})}),
+        **({} if not trace_on else {
+            "trace_first": tr_first, "trace_chan": tr_chan,
+            "trace_dups": tr_dups, "trace_latch": tr_latch}),
         fwd_gt=fwd[0], fwd_member=fwd[1], fwd_meta=fwd[2], fwd_payload=fwd[3],
         fwd_aux=fwd[4],
         dly_gt=dly[0], dly_member=dly[1], dly_meta=dly[2], dly_payload=dly[3],
@@ -3313,6 +3434,32 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
                 cfg.bloom_hashes, salt=ep)
         sta_updates["digest"] = dig
     create_stored = ins.n_inserted.astype(jnp.uint32)
+    if cfg.trace.enabled:
+        # Dissemination lineage at the create site (traceplane.py):
+        # an authored record matching an already-registered tracked
+        # key stamps the author's lineage with the CH_CREATE channel.
+        # (Registration AFTER creation instead scans holders —
+        # track_record; the two orders commute.)  Like the legacy
+        # intake rule, a capacity-dropped insert still counts:
+        # lineage is arrival history, not residency.
+        newly_any = jnp.zeros((n,), bool)
+        tf_cols, tc_cols = [], []
+        for k in range(cfg.trace.tracked_slots):
+            m_k = (store_mask & (idx == state.trace_member[k])
+                   & (gt_new == state.trace_gt[k])
+                   & (state.trace_first[:, k] == jnp.uint32(0)))
+            tf_cols.append(jnp.where(
+                m_k, state.round_index + jnp.uint32(1),
+                state.trace_first[:, k]))
+            tc_cols.append(jnp.where(m_k, jnp.uint8(trp.CH_CREATE),
+                                     state.trace_chan[:, k]))
+            newly_any = newly_any | m_k
+        sta_updates["trace_first"] = jnp.stack(tf_cols, axis=1)
+        sta_updates["trace_chan"] = jnp.stack(tc_cols, axis=1)
+        trace_delivered = state.stats.trace_delivered.at[
+            :, trp.CH_CREATE - 1].add(newly_any.astype(jnp.uint32))
+    else:
+        trace_delivered = None
 
     retro_unw = retro_rm = None
     fold_dropped = None
@@ -3383,6 +3530,8 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
             accepted_by_meta=state.stats.accepted_by_meta
             .at[:, min(meta, cfg.n_meta)]
             .add(author_mask.astype(jnp.uint32)),
+            **({} if trace_delivered is None else {
+                "trace_delivered": trace_delivered}),
             **({} if fold_dropped is None else {
                 "msgs_dropped": state.stats.msgs_dropped
                 + fold_dropped.astype(jnp.uint32)}),
@@ -3551,6 +3700,78 @@ def _holds_record(state: PeerState, member: int, gt: int, meta: int,
         has = has | _in(state.sta_gt, state.sta_member, state.sta_meta,
                         state.sta_payload)
     return has
+
+
+def _track_record_impl(state: PeerState, cfg: CommunityConfig,
+                       author: jnp.ndarray, gt: jnp.ndarray,
+                       slot: jnp.ndarray) -> PeerState:
+    """The traced half of :func:`track_record`: write the (author, gt)
+    key into tracked slot ``slot`` and stamp lineage for every peer
+    already HOLDING the record in its logical store (ring ∪ staging) —
+    attributed to the create channel, the registration-at-creation
+    contract (traceplane.py).  ``slot`` is traced, so one compile per
+    config serves every registration."""
+    t = cfg.trace.tracked_slots
+    col = jnp.arange(t, dtype=jnp.uint32) == slot            # bool[T]
+    holds = jnp.any((state.store_member == author)
+                    & (state.store_gt == gt), axis=1)
+    if state.sta_gt.shape[1]:
+        holds = holds | jnp.any((state.sta_member == author)
+                                & (state.sta_gt == gt), axis=1)
+    newly = (holds[:, None] & col[None, :]
+             & (state.trace_first == jnp.uint32(0)))         # [N, T]
+    rnd_reg = state.round_index + jnp.uint32(1)
+    return state.replace(
+        trace_member=jnp.where(col, author, state.trace_member),
+        trace_gt=jnp.where(col, gt, state.trace_gt),
+        trace_first=jnp.where(newly, rnd_reg, state.trace_first),
+        trace_chan=jnp.where(newly, jnp.uint8(trp.CH_CREATE),
+                             state.trace_chan),
+        stats=state.stats.replace(
+            trace_delivered=state.stats.trace_delivered
+            .at[:, trp.CH_CREATE - 1].add(
+                jnp.any(newly, axis=1).astype(jnp.uint32))))
+
+
+_track_record_jit = functools.partial(
+    jax.jit, static_argnums=(1,),
+    static_argnames=("cfg",))(_track_record_impl)
+
+
+def track_record(state: PeerState, cfg: CommunityConfig, author: int,
+                 gt: int) -> tuple[PeerState, int]:
+    """Register record ``(author, gt)`` for dissemination tracing
+    (traceplane.py; the ``scenario.TrackRecord`` event and
+    ``Community.track_record`` route here).
+
+    Assigns the first free tracked slot (idempotent: re-registering an
+    already-tracked key returns its existing slot untouched) and stamps
+    lineage for peers already holding the record — at the intended
+    call time, registration at creation, that is exactly the author,
+    attributed to the create channel.  Returns ``(state, slot)``;
+    raises when the plane is disabled or every slot is taken (slots
+    are never freed — size ``trace.tracked_slots`` for the run).
+    """
+    import numpy as np
+    if not cfg.trace.enabled:
+        raise ValueError(
+            "track_record needs cfg.trace.enabled (the dissemination-"
+            "tracing plane; dispersy_tpu/traceplane.py)")
+    keys_m = np.asarray(state.trace_member)
+    keys_g = np.asarray(state.trace_gt)
+    for k in range(cfg.trace.tracked_slots):
+        if int(keys_m[k]) == author and int(keys_g[k]) == gt:
+            return state, k
+    free = [k for k in range(cfg.trace.tracked_slots)
+            if int(keys_m[k]) == EMPTY_U32]
+    if not free:
+        raise ValueError(
+            f"all {cfg.trace.tracked_slots} tracked slots are taken "
+            "(trace.tracked_slots); slots are never freed")
+    slot = free[0]
+    state = _track_record_jit(state, cfg, jnp.uint32(author),
+                              jnp.uint32(gt), jnp.uint32(slot))
+    return state, slot
 
 
 def coverage_by_community(state: PeerState, cfg: CommunityConfig,

@@ -109,6 +109,13 @@ def snapshot(state: PeerState, cfg: CommunityConfig) -> dict:
             int(x) for x in
             np.asarray(s.accepted_by_meta, dtype=np.uint64).sum(axis=0)],
     }
+    if cfg.trace.enabled:
+        # Dissemination-tracing totals — the SAME key set (and shared
+        # definitions, traceplane.trace_totals) the fused row surfaces
+        # via telemetry.row_to_snapshot, so the two paths stay
+        # schema-identical (dump_binary's contract).
+        from dispersy_tpu.traceplane import trace_totals
+        out.update(trace_totals(state, cfg))
     if cfg.overload.enabled:
         # Ingress-protection totals — the SAME key set (and shared
         # definitions, overload.shed_totals) the fused row surfaces via

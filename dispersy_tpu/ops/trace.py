@@ -1,0 +1,103 @@
+"""Dissemination-tracing kernels: per-slot lineage folds, coverage
+counts, and coverage-percentile latches.
+
+The jit-traced half of the trace plane (:mod:`dispersy_tpu.traceplane`
+declares the static :class:`~dispersy_tpu.traceplane.TraceConfig` and
+the channel-code table; the engine composes these into the fused round
+only when ``trace.enabled``, so a disabled plane compiles to the
+identical step).  Every op mirrors bit-for-bit in the oracle
+(:mod:`dispersy_tpu.oracle.sim` walks its intake batch sequentially —
+the first same-key occurrence is the only one that can land, so the
+set-based folds here and the oracle's in-order walk agree exactly), the
+same lockstep discipline as every other ops module.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dispersy_tpu.ops.contracts import Spec, contract
+from dispersy_tpu.traceplane import NUM_CHANNELS
+
+_CODES = tuple(range(1, NUM_CHANNELS + 1))   # CH_CREATE..CH_FLOOD
+
+
+@contract(out=(Spec("uint32", ("N",)), Spec("uint8", ("N",)),
+               Spec("uint32", ("N",)),
+               Spec("uint32", ("N", NUM_CHANNELS)),
+               Spec("uint32", ("N", NUM_CHANNELS))),
+          first=Spec("uint32", ("N",)), chan=Spec("uint8", ("N",)),
+          dups=Spec("uint32", ("N",)), match=Spec("bool", ("N", "B")),
+          landed=Spec("bool", ("N", "B")),
+          arrived=Spec("bool", ("N", "B")),
+          chan_code=Spec("uint8", ("B",)), round_post=Spec("uint32", ()),
+          dims={"N": 15})
+def slot_lineage(first: jnp.ndarray, chan: jnp.ndarray,
+                 dups: jnp.ndarray, match: jnp.ndarray,
+                 landed: jnp.ndarray, arrived: jnp.ndarray,
+                 chan_code: jnp.ndarray, round_post):
+    """Fold one intake batch into one tracked slot's lineage columns.
+
+    ``match`` marks batch entries carrying the slot's (author, gt) key;
+    ``landed`` the entries that entered the logical store this round
+    (staging append under the byte diet, accepted-fresh on the legacy
+    path); ``arrived`` every entry that passed intake (``accept_store``
+    — the delivery boundary); ``chan_code`` the per-entry channel
+    (static per batch segment, traceplane.CH_*).  The USEFUL entry is a
+    landed match on a peer with no lineage yet — at most one per batch
+    (in-batch dedup keeps only the first same-key occurrence fresh), so
+    its channel is exact; every other arrived match is a duplicate
+    delivery.  Returns the updated ``(first, chan, dups)`` columns plus
+    per-channel useful/duplicate counts (u32[N, 4], channel order
+    ``traceplane.CHANNEL_NAMES``).
+    """
+    useful_e = match & landed & (first == jnp.uint32(0))[:, None]
+    any_u = jnp.any(useful_e, axis=1)
+    # Exactly one useful entry per row (batch dedup), so max-select
+    # recovers its channel code.
+    ch_new = jnp.max(jnp.where(useful_e, chan_code[None, :],
+                               jnp.uint8(0)), axis=1)
+    first = jnp.where(any_u, round_post, first)
+    chan = jnp.where(any_u, ch_new, chan)
+    dup_e = (match & arrived) & ~useful_e
+    dups = dups + jnp.sum(dup_e, axis=1, dtype=jnp.uint32)
+    useful_by = jnp.stack(
+        [(any_u & (ch_new == jnp.uint8(c))).astype(jnp.uint32)
+         for c in _CODES], axis=1)
+    dup_by = jnp.stack(
+        [jnp.sum(dup_e & (chan_code == jnp.uint8(c))[None, :], axis=1,
+                 dtype=jnp.uint32)
+         for c in _CODES], axis=1)
+    return first, chan, dups, useful_by, dup_by
+
+
+@contract(out=Spec("uint32", ("T",)),
+          first=Spec("uint32", ("N", "T")), members=Spec("bool", ("N",)),
+          dims={"T": 5})
+def coverage_counts(first: jnp.ndarray,
+                    members: jnp.ndarray) -> jnp.ndarray:
+    """Per-slot coverage numerators: alive non-tracker peers whose
+    first-arrival round is set — exactly ``engine.coverage``'s count,
+    reduced on device."""
+    return jnp.sum((first != jnp.uint32(0)) & members[:, None], axis=0,
+                   dtype=jnp.uint32)
+
+
+@contract(out=Spec("uint32", ("T", 3)),
+          latch=Spec("uint32", ("T", 3)), cov=Spec("uint32", ("T",)),
+          registered=Spec("bool", ("T",)),
+          alive_cnt=Spec("uint32", ()), round_post=Spec("uint32", ()),
+          dims={"T": 5})
+def latch_update(latch: jnp.ndarray, cov: jnp.ndarray,
+                 registered: jnp.ndarray, alive_cnt,
+                 round_post) -> jnp.ndarray:
+    """Latch rounds-to-{50,90,99}%-coverage per slot: once a registered
+    slot's coverage first reaches ``pct`` percent of the alive members
+    (integer math: ``cov * 100 >= pct * alive``), the post-step round
+    latches and never moves.  Column order = traceplane.LATCH_PCTS."""
+    pcts = jnp.asarray((50, 90, 99), jnp.uint32)
+    reach = (cov[:, None] * jnp.uint32(100)
+             >= pcts[None, :] * alive_cnt)
+    cond = ((latch == jnp.uint32(0)) & registered[:, None]
+            & (alive_cnt > jnp.uint32(0)) & reach)
+    return jnp.where(cond, round_post, latch)

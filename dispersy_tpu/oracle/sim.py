@@ -47,6 +47,9 @@ from dispersy_tpu.oracle.bloom import OracleBloom, record_hash
 from dispersy_tpu.recovery import NUM_HEALTH_BITS
 from dispersy_tpu.state import stats_gates as _stats_gates
 from dispersy_tpu.storediet import epoch_of, sync_round_of
+from dispersy_tpu.traceplane import (CH_CREATE, CH_PUSH, CH_WALK_SYNC,
+                                     CHANNEL_NAMES, LATCH_PCTS,
+                                     NUM_CHANNELS, redundancy_f32)
 from dispersy_tpu.ops import rng as _jrng
 
 FLAG_UNDONE = 1
@@ -220,6 +223,17 @@ class OraclePeer:
         # like ge_bad it survives churn rebirth.
         self.bucket = 0
         self.msgs_shed_rate = self.msgs_shed_priority = 0
+        # dissemination-tracing plane (engine trace_first/trace_chan/
+        # trace_dups per-peer lineage + the stats trace_delivered/
+        # trace_dup channel counters; dispersy_tpu/traceplane.py).
+        # Lineage is disk-like: wiped with the store on churn/
+        # quarantine rebirth; the counters survive like every stat.
+        t_w = (cfg.trace.tracked_slots if cfg.trace.enabled else 0)
+        self.trace_first = [0] * t_w
+        self.trace_chan = [0] * t_w
+        self.trace_dups = [0] * t_w
+        self.trace_delivered = [0] * NUM_CHANNELS
+        self.trace_dup = [0] * NUM_CHANNELS
         self.proof_requests = self.proof_records = 0
         self.seq_requests = self.seq_records = 0
         self.mm_requests = self.mm_records = 0
@@ -263,9 +277,42 @@ class OracleSim:
         self.fr_ring = np.zeros(
             (cfg.telemetry.flight_recorder, tlm.FLIGHT_WIDTH), np.uint32)
         self.fr_pos = 0
+        # Dissemination-tracing plane (engine trace_member/trace_gt key
+        # registry + trace_latch coverage percentiles;
+        # dispersy_tpu/traceplane.py).
+        t_w = cfg.trace.tracked_slots if cfg.trace.enabled else 0
+        self.trace_member = [EMPTY_U32] * t_w
+        self.trace_gt = [EMPTY_U32] * t_w
+        self.trace_latch = [[0, 0, 0] for _ in range(t_w)]
         # Multi-community layout (engine._layout_cols mirror, same source).
         (self.community, self.boot_base, self.boot_count,
          self.mem_base, self.mem_count) = cfg.layout()
+
+    def track_record(self, author: int, gt: int) -> int:
+        """engine.track_record mirror: register (author, gt) into the
+        first free tracked slot (idempotent) and stamp current holders'
+        lineage as create-channel arrivals."""
+        assert self.cfg.trace.enabled
+        for k, (km, kg) in enumerate(zip(self.trace_member,
+                                         self.trace_gt)):
+            if km == author and kg == gt:
+                return k
+        free = [k for k, km in enumerate(self.trace_member)
+                if km == EMPTY_U32]
+        assert free, "all tracked slots taken"
+        k = free[0]
+        self.trace_member[k] = author
+        self.trace_gt[k] = gt
+        for p in self.peers:
+            holds = any(r.member == author and r.gt == gt
+                        for r in p.store) \
+                or any(r.member == author and r.gt == gt
+                       for r in p.staging)
+            if holds and p.trace_first[k] == 0:
+                p.trace_first[k] = self.rnd + 1
+                p.trace_chan[k] = CH_CREATE
+                p.trace_delivered[CH_CREATE - 1] += 1
+        return k
 
     def _founder(self, owner: int) -> int:
         """The founder row the owner's community answers to
@@ -988,6 +1035,18 @@ class OracleSim:
                           if r.member == i and r.meta == meta), default=0) + 1
             rec = Record(gt, i, meta, pv, av)
             if not (meta < cfg.n_meta and (cfg.direct_meta_mask >> meta) & 1):
+                if cfg.trace.enabled:
+                    # engine create_messages' lineage stamp: a created
+                    # record matching a pre-registered tracked key is a
+                    # create-channel arrival (capacity drops still
+                    # count — arrival history, traceplane.py).
+                    for k, (km, kg) in enumerate(zip(self.trace_member,
+                                                     self.trace_gt)):
+                        if (km == i and kg == gt
+                                and p.trace_first[k] == 0):
+                            p.trace_first[k] = self.rnd + 1
+                            p.trace_chan[k] = CH_CREATE
+                            p.trace_delivered[CH_CREATE - 1] += 1
                 self._store_insert(i, [rec], count_drops=False)
                 if p.digest is not None:
                     # Byte-diet: the digest learns the authored record
@@ -1150,6 +1209,12 @@ class OracleSim:
                     p.sig_target = NO_PEER
                     p.sig_meta = p.sig_payload = p.sig_gt = p.sig_since = 0
                     p.mal = []
+                    if cfg.trace.enabled:
+                        # lineage wipes with the store (traceplane.py)
+                        t_w = cfg.trace.tracked_slots
+                        p.trace_first = [0] * t_w
+                        p.trace_chan = [0] * t_w
+                        p.trace_dups = [0] * t_w
                     p.global_time = 1
                     p.session += 1
                     # rebirth = new participant; its join IS an explicit
@@ -1919,14 +1984,18 @@ class OracleSim:
             # are receiver-local and never travel (engine sends 5 columns).
             # Each batch entry carries the record, the round it (first)
             # arrived (pen entries keep their parking round — engine
-            # in_since), and its deliverer (engine in_src; the future
-            # missing-proof target should it park).
-            batch: list[tuple[Record, int, int]] = []
-            sy_dups: list[tuple[Record, int, int]] = []
-            ph_dups: list[tuple[Record, int, int]] = []
+            # in_since), its deliverer (engine in_src; the future
+            # missing-proof target should it park), and its delivery-
+            # channel code (engine chan_code — static per segment;
+            # traceplane.CH_*, 0 for segments the trace plane's config
+            # gate excludes).
+            batch: list[tuple[Record, int, int, int]] = []
+            sy_dups: list[tuple[Record, int, int, int]] = []
+            ph_dups: list[tuple[Record, int, int, int]] = []
             if delay_on and p.alive and p.loaded:
                 # pen first (engine: dl segment leads the concat)
-                batch.extend(p.delay)
+                batch.extend((drec, ds, dsc, 0)
+                             for drec, ds, dsc in p.delay)
             if sync_on and p.alive and p.loaded \
                     and req_slot[i] >= 0:
                 recs = outbox.get((targets[i], req_slot[i]), [])
@@ -1943,13 +2012,13 @@ class OracleSim:
                         continue
                     batch.append((Record(r.gt, r.member, r.meta,
                                          r.payload, r.aux), rnd,
-                                  targets[i]))
+                                  targets[i], CH_WALK_SYNC))
                     if fm.dup_rate > 0.0 and rand_uniform(
                             seed, rnd, i, P_DUP,
                             j + _FAULT_SYNC) < np.float32(fm.dup_rate):
                         sy_dups.append((Record(r.gt, r.member, r.meta,
                                                r.payload, r.aux), rnd,
-                                        targets[i]))
+                                        targets[i], CH_WALK_SYNC))
                         p.bytes_down += RECORD_BYTES
             if p.alive and p.loaded:
                 for slot, (r, src, junk) in enumerate(push_inbox[i]):
@@ -1965,28 +2034,30 @@ class OracleSim:
                         p.msgs_corrupt_dropped += 1
                         continue
                     batch.append((Record(r.gt, r.member, r.meta,
-                                         r.payload, r.aux), rnd, src))
+                                         r.payload, r.aux), rnd, src,
+                                  CH_PUSH))
                     if fm.dup_rate > 0.0 and rand_uniform(
                             seed, rnd, i, P_DUP,
                             slot + _FAULT_PUSH) < np.float32(fm.dup_rate):
                         ph_dups.append((Record(r.gt, r.member, r.meta,
                                                r.payload, r.aux), rnd,
-                                        src))
+                                        src, CH_PUSH))
                         p.bytes_down += RECORD_BYTES
             if sig_completed[i] is not None:
                 # the record's aux IS the countersigner it came back from
-                batch.append((sig_completed[i], rnd, sig_completed[i].aux))
-            batch.extend((rec, rnd, src) for rec, src in pr_batch[i])
-            batch.extend((rec, rnd, src) for rec, src in mq_batch[i])
-            batch.extend((rec, rnd, src) for rec, src in sm_batch[i])
-            batch.extend((rec, rnd, src) for rec, src in si_batch[i])
+                batch.append((sig_completed[i], rnd,
+                              sig_completed[i].aux, 0))
+            batch.extend((rec, rnd, src, 0) for rec, src in pr_batch[i])
+            batch.extend((rec, rnd, src, 0) for rec, src in mq_batch[i])
+            batch.extend((rec, rnd, src, 0) for rec, src in sm_batch[i])
+            batch.extend((rec, rnd, src, 0) for rec, src in si_batch[i])
             # delivery duplicates ride at the batch tail, sync then push
             # (engine: segs_* += [sy_dup, ph_dup])
             batch.extend(sy_dups)
             batch.extend(ph_dups)
             # clock-jump defense (engine: post-walk-fold clock), plus the
             # structural countersigner check for double-signed metas
-            ok_pairs = [(rec, s, sc) for rec, s, sc in batch
+            ok_pairs = [(rec, s, sc, ch) for rec, s, sc, ch in batch
                         if rec.gt <= (p.global_time
                                       + cfg.acceptable_global_time_range)
                         and self._dbl_struct_ok(i, rec)]
@@ -2035,11 +2106,13 @@ class OracleSim:
                 n_black = sum(1 for rec, *_ in ok_pairs
                               if rec.member in p.mal)
                 p.msgs_rejected += n_black
-                ok_pairs = [(rec, s, sc) for rec, s, sc in ok_pairs
+                ok_pairs = [(rec, s, sc, ch)
+                            for rec, s, sc, ch in ok_pairs
                             if rec.member not in p.mal]
             ok_batch = [rec for rec, *_ in ok_pairs]
-            ok_since = [s for _, s, _ in ok_pairs]
-            ok_src = [sc for *_, sc in ok_pairs]
+            ok_since = [s for _, s, *_ in ok_pairs]
+            ok_src = [sc for _, _, sc, _ in ok_pairs]
+            ok_chan = [ch for *_, ch in ok_pairs]
             # freshness: not stored yet, not a dup of an earlier batch entry
             store_keys = {(r.gt, r.member) for r in p.store}
             if diet and cfg.sync_enabled:
@@ -2216,7 +2289,10 @@ class OracleSim:
                 # Digest adds are DEFERRED past the batch (engine
                 # updates the digest leaf once, at the wrap-up).
                 landed_hashes: list[int] = []
-                for rec, a, f0 in zip(ok_batch, accept_store, fresh0):
+                landed_flags = [False] * len(ok_batch)
+                for e, (rec, a, f0) in enumerate(zip(ok_batch,
+                                                     accept_store,
+                                                     fresh0)):
                     if not a:
                         continue
                     if not f0:
@@ -2226,6 +2302,7 @@ class OracleSim:
                                                 rec.meta, rec.payload,
                                                 self._aux_store(rec.aux)))
                         landed_hashes.append(rec.hash())
+                        landed_flags[e] = True
                     else:
                         p.msgs_dropped += 1
                 if (cfg.sync_enabled and not compact_now
@@ -2241,6 +2318,36 @@ class OracleSim:
                 self._store_insert(i, ins_batch)
                 self._fold_gt(i, [rec.gt for rec, a in zip(ok_batch, accept)
                                   if a])
+            if not diet:
+                # Legacy landing flags for the lineage fold below:
+                # accepted-fresh counts as landed even when the ring's
+                # capacity drop kills it at insert (arrival history —
+                # engine ln_landed = fresh; traceplane.py).
+                landed_flags = [a and f0 for a, f0 in
+                                zip(accept_store, fresh0)]
+            if cfg.trace.enabled:
+                # engine trace_lineage mirror (ops/trace.slot_lineage):
+                # the first same-key occurrence is the only one that
+                # can land, so this in-order walk equals the engine's
+                # set-based fold bit-for-bit.  Keys are unique across
+                # slots (track_record is idempotent), so an entry
+                # matches at most one slot.
+                for rec, a, ld, ch in zip(ok_batch, accept_store,
+                                          landed_flags, ok_chan):
+                    if not a:
+                        continue
+                    for k, (km, kg) in enumerate(zip(self.trace_member,
+                                                     self.trace_gt)):
+                        if km != rec.member or kg != rec.gt:
+                            continue
+                        if ld and p.trace_first[k] == 0:
+                            p.trace_first[k] = rnd + 1
+                            p.trace_chan[k] = ch
+                            p.trace_delivered[ch - 1] += 1
+                        else:
+                            p.trace_dups[k] += 1
+                            p.trace_dup[ch - 1] += 1
+                        break
             if cfg.timeline_enabled:
                 # Post-insert: this batch's accepted undo records mark their
                 # targets (now possibly just inserted).
@@ -2397,6 +2504,13 @@ class OracleSim:
                     p.sig_meta = p.sig_payload = 0
                     p.sig_gt = p.sig_since = 0
                     p.mal = []
+                    if cfg.trace.enabled:
+                        # lineage wipes with the store (traceplane.py;
+                        # the churn-wipe rule)
+                        t_w = cfg.trace.tracked_slots
+                        p.trace_first = [0] * t_w
+                        p.trace_chan = [0] * t_w
+                        p.trace_dups = [0] * t_w
                     p.global_time = 1
                     p.session += 1
                     p.backoff = 0
@@ -2425,6 +2539,23 @@ class OracleSim:
                         if s.peer != NO_PEER and quar[s.peer]:
                             s.peer = NO_PEER
                             s.walk = s.stumble = s.intro = NEVER
+
+        # engine wrap-up dissemination coverage + percentile latches
+        # (trace_coverage scope: AFTER the recovery wipes, BEFORE the
+        # telemetry row packs the counts — traceplane.py)
+        if cfg.trace.enabled:
+            members_tr = [p.alive and i >= t
+                          for i, p in enumerate(self.peers)]
+            alive_cnt = sum(members_tr)
+            for k in range(cfg.trace.tracked_slots):
+                cov = sum(1 for i, p in enumerate(self.peers)
+                          if members_tr[i] and p.trace_first[k] != 0)
+                for j, pct in enumerate(LATCH_PCTS):
+                    if (self.trace_latch[k][j] == 0
+                            and self.trace_member[k] != EMPTY_U32
+                            and alive_cnt > 0
+                            and cov * 100 >= pct * alive_cnt):
+                        self.trace_latch[k][j] = rnd + 1
 
         # engine wrap-up telemetry (engine._telemetry_row + ring + flight
         # recorder; rows packed through the SAME schema via pack_row_host)
@@ -2493,6 +2624,25 @@ class OracleSim:
         for i in range(cfg.n_meta + 1):
             vals[f"accepted_by_meta_{i}"] = sum(
                 p.accepted_by_meta[i] & M32 for p in self.peers)
+        if cfg.trace.enabled:
+            # dissemination-tracing words (engine _telemetry_row's
+            # trace block; redundancy via the SHARED
+            # traceplane.redundancy_f32 f32 sequence)
+            for k in range(cfg.trace.tracked_slots):
+                vals[f"trace_cov_{k}"] = sum(
+                    1 for i, p in enumerate(self.peers)
+                    if members[i] and p.trace_first[k] != 0)
+                for j, pct in enumerate(LATCH_PCTS):
+                    vals[f"trace_r{pct}_{k}"] = self.trace_latch[k][j]
+            delivered = [sum(p.trace_delivered[c] & M32
+                             for p in self.peers)
+                         for c in range(NUM_CHANNELS)]
+            dup = [sum(p.trace_dup[c] & M32 for p in self.peers)
+                   for c in range(NUM_CHANNELS)]
+            for c, nm in enumerate(CHANNEL_NAMES):
+                vals[f"trace_delivered_{nm}"] = delivered[c]
+                vals[f"trace_dup_{nm}"] = dup[c]
+            vals["trace_redundancy"] = redundancy_f32(delivered, dup)
         if cfg.overload.enabled:
             vals["msgs_shed_rate"] = sum(p.msgs_shed_rate & M32
                                          for p in self.peers)
@@ -2695,6 +2845,34 @@ class OracleSim:
                                             np.uint32)
                                    if cfg.overload.enabled
                                    else np.zeros((0,), np.uint32)),
+            # dissemination-tracing leaves + counters (knob-sized,
+            # state.py; dispersy_tpu/traceplane.py)
+            "trace_member": np.array(self.trace_member, np.uint32),
+            "trace_gt": np.array(self.trace_gt, np.uint32),
+            "trace_first": (np.array(
+                [p.trace_first for p in self.peers], np.uint32)
+                if cfg.trace.enabled
+                else np.zeros((0, 0), np.uint32)),
+            "trace_chan": (np.array(
+                [p.trace_chan for p in self.peers], np.uint8)
+                if cfg.trace.enabled
+                else np.zeros((0, 0), np.uint8)),
+            "trace_dups": (np.array(
+                [p.trace_dups for p in self.peers], np.uint32)
+                if cfg.trace.enabled
+                else np.zeros((0, 0), np.uint32)),
+            "trace_latch": (np.array(self.trace_latch, np.uint32)
+                            .reshape(len(self.trace_latch), 3)
+                            if cfg.trace.enabled
+                            else np.zeros((0, 3), np.uint32)),
+            "trace_delivered": (np.array(
+                [p.trace_delivered for p in self.peers], np.uint32)
+                if cfg.trace.enabled
+                else np.zeros((0, NUM_CHANNELS), np.uint32)),
+            "trace_dup": (np.array(
+                [p.trace_dup for p in self.peers], np.uint32)
+                if cfg.trace.enabled
+                else np.zeros((0, NUM_CHANNELS), np.uint32)),
             # telemetry-plane leaves (knob-sized, state.py)
             "walk_streak": (np.array(self.walk_streak, np.uint32)
                             if cfg.telemetry.histograms

@@ -83,6 +83,23 @@ class Create:
 
 
 @dataclasses.dataclass
+class TrackRecord:
+    """Register an existing record ``(author, gt)`` for on-device
+    dissemination tracing (dispersy_tpu/traceplane.py;
+    ``engine.track_record``).  Requires ``cfg.trace.enabled``; peers
+    already holding the record at registration are attributed to the
+    create channel, so schedule it at (or right after) the record's
+    creation — ``Create(track=...)`` does exactly that automatically
+    when the trace plane is on.  Unlike ``Create.track``'s host-query
+    fallback, a TrackRecord label's coverage curve always comes from
+    the telemetry rows (``trace_cov_<slot> / alive_members``), so
+    tracked runs keep the batched ring fast path."""
+    label: str
+    author: int
+    gt: int
+
+
+@dataclasses.dataclass
 class SignatureRequest:
     """Open double-signed drafts author -> counterparty."""
     meta: int
@@ -336,8 +353,20 @@ class Scenario:
 
 
 def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
-           ctx: dict):
+           ctx: dict, trace_slots: dict | None = None, rnd: int = 0):
+    trace_slots = trace_slots if trace_slots is not None else {}
     founder = cfg.founder
+    if isinstance(ev, TrackRecord):
+        # On-device lineage registration (traceplane.py): the label's
+        # coverage rides the telemetry rows, never a host store query.
+        if not cfg.trace.enabled:
+            raise ValueError(
+                f"TrackRecord({ev.label!r}) requires cfg.trace.enabled "
+                "(the dissemination-tracing plane)")
+        state, slot = engine.track_record(state, cfg, int(ev.author),
+                                          int(ev.gt))
+        trace_slots[ev.label] = (slot, rnd)
+        return state, cfg
     if isinstance(ev, Create):
         m = _mask(cfg, ev.authors)
         authors = np.flatnonzero(np.asarray(m))
@@ -362,6 +391,28 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
                     f"creation of meta {ev.meta} was refused by the "
                     "timeline gate — reorder the scenario's events")
             tracked[ev.track] = (author, gt_after, ev.meta, ev.payload)
+            if cfg.trace.enabled:
+                # With the trace plane on, the label's coverage comes
+                # from the on-device lineage (registration stamps the
+                # author as the create-channel arrival) and the run
+                # keeps the ring fast path — the host-query spec above
+                # stays only as the cross-check the parity tests use.
+                # SLOT EXHAUSTION degrades gracefully: the overflow
+                # label falls back to the legacy host-query path the
+                # runner still supports for unregistered labels (the
+                # run slows, it does not abort mid-scenario); the
+                # explicit TrackRecord event stays strict.
+                try:
+                    state, slot = engine.track_record(state, cfg,
+                                                      author, gt_after)
+                except ValueError:
+                    logger.warning(
+                        "Create(track=%r): all %d trace.tracked_slots "
+                        "taken — label falls back to per-round host "
+                        "store queries (off the ring fast path)",
+                        ev.track, cfg.trace.tracked_slots)
+                else:
+                    trace_slots[ev.track] = (slot, rnd)
     elif isinstance(ev, SignatureRequest):
         state = engine.create_signature_request_jit(
             state, cfg, _mask(cfg, ev.authors), ev.meta,
@@ -432,7 +483,8 @@ def _apply(state: PeerState, cfg: CommunityConfig, ev, tracked: dict,
 def _autosave(dirpath: str, next_round: int, state: PeerState,
               cfg: CommunityConfig, tracked: dict, log: MetricsLog,
               recovery_hist: list | None = None,
-              overload_hist: list | None = None) -> None:
+              overload_hist: list | None = None,
+              trace_slots: dict | None = None) -> None:
     """One crash-resume snapshot: CRC-protected state archive + a JSON
     sidecar carrying everything the runner itself holds (metrics rows,
     tracked-record specs, the round to resume at, and the applied
@@ -445,6 +497,8 @@ def _autosave(dirpath: str, next_round: int, state: PeerState,
     ckpt.save(base + ".npz", state, cfg)
     doc = {"next_round": next_round,
            "tracked": {k: list(v) for k, v in tracked.items()},
+           "trace_slots": {k: list(v)
+                           for k, v in (trace_slots or {}).items()},
            "recovery_history": list(recovery_hist or ()),
            "overload_history": list(overload_hist or ()),
            "meta": log.meta, "rows": log.rows}
@@ -530,18 +584,24 @@ def _load_latest_autosave(dirpath: str, cfg0: CommunityConfig,
 
 
 def _ring_chunk(cfg: CommunityConfig, scenario: Scenario, by_round: dict,
-                tracked: dict, rnd: int) -> int:
+                tracked: dict, rnd: int,
+                trace_slots: dict | None = None) -> int:
     """Rounds safely batchable through ``engine.multi_step`` + one ring
     drain, starting at ``rnd`` (1 = take the per-round path).
 
     Batchable only when the ring is deep enough to hold every skipped
-    round, per-round logging is the plain snapshot (snapshot_every=1,
-    no tracked coverage curves — those need host-side store queries
+    round, per-round logging is the plain snapshot (snapshot_every=1),
+    every tracked coverage curve is served on-device (its label is
+    registered with the trace plane, so ``cov_<label>`` derives from
+    the row's ``trace_cov_<slot>`` word — traceplane.py; a label
+    WITHOUT a trace slot still needs the legacy host-side store query
     each round), and the span crosses no scheduled event.  An autosave
     boundary only bounds the chunk (the snapshot happens at its exact
     round either way)."""
     h = cfg.telemetry.history
-    if h <= 1 or scenario.snapshot_every != 1 or tracked:
+    host_tracked = [lbl for lbl in tracked
+                    if lbl not in (trace_slots or {})]
+    if h <= 1 or scenario.snapshot_every != 1 or host_tracked:
         return 1
     limit = min(h, scenario.rounds - rnd)
     for k in range(1, limit):
@@ -552,6 +612,29 @@ def _ring_chunk(cfg: CommunityConfig, scenario: Scenario, by_round: dict,
         limit = min(limit,
                     scenario.autosave_every - rnd % scenario.autosave_every)
     return max(limit, 1)
+
+
+def _attach_trace_covs(row: dict, trace_slots: dict) -> None:
+    """Derive ``cov_<label>`` for every trace-registered label from the
+    row's on-device coverage words: ``trace_cov_<slot> /
+    max(alive_members, 1)`` in float32 — the same f32 division
+    ``engine.coverage``'s host query computes, so the two paths emit
+    identical curves as long as no tracked record is ever EVICTED from
+    a ring (lineage is arrival history, the host query is current
+    residency — traceplane.py; a LastSync/capacity eviction would keep
+    the trace curve high where the host query dips).  Pinned
+    round-for-round equal at non-evicting capacity in
+    tests/test_trace.py.  Rows from before a label's registration
+    round carry no key for it, exactly like the legacy per-round
+    path."""
+    for label, (slot, reg_rnd) in trace_slots.items():
+        if int(row.get("round", 0)) <= int(reg_rnd):
+            continue
+        cov = row.get(f"trace_cov_{slot}")
+        if cov is None:
+            continue
+        alive = max(int(row.get("alive_members", 0)), 1)
+        row[f"cov_{label}"] = float(np.float32(cov) / np.float32(alive))
 
 
 def run(cfg: CommunityConfig, scenario: Scenario, key=None,
@@ -590,6 +673,7 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
     if scenario.autosave_every and not scenario.autosave_dir:
         raise ValueError("autosave_every requires autosave_dir")
     tracked: dict[str, tuple] = {}
+    trace_slots: dict[str, tuple] = {}   # label -> (slot, reg round)
     ctx: dict = {}
     recovery_hist: list = []   # applied SetRecovery flips: [round, kw]
     overload_hist: list = []   # applied SetOverload flips: [round, kw]
@@ -602,6 +686,8 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
         if got is not None:
             state, cfg, start_round, doc = got
             tracked = {k: tuple(v) for k, v in doc["tracked"].items()}
+            trace_slots = {k: (int(v[0]), int(v[1])) for k, v in
+                           doc.get("trace_slots", {}).items()}
             recovery_hist = [[int(r), dict(kw)] for r, kw in
                              doc.get("recovery_history", ())]
             overload_hist = [[int(r), dict(kw)] for r, kw in
@@ -619,7 +705,8 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
     rnd = start_round
     while rnd < scenario.rounds:
         for ev in by_round.get(rnd, ()):
-            state, cfg = _apply(state, cfg, ev, tracked, ctx)
+            state, cfg = _apply(state, cfg, ev, tracked, ctx,
+                                trace_slots, rnd)
             if isinstance(ev, SetRecovery):
                 # Record the applied flip for the autosave sidecar so a
                 # resume that straddles it replays the same config.
@@ -632,19 +719,28 @@ def run(cfg: CommunityConfig, scenario: Scenario, key=None,
         # whole event-free spans run as ONE multi_step dispatch and the
         # per-round metrics history drains from the ring in a single
         # transfer — rounds never cross the host at all in between.
-        chunk = _ring_chunk(cfg, scenario, by_round, tracked, rnd)
+        chunk = _ring_chunk(cfg, scenario, by_round, tracked, rnd,
+                            trace_slots)
         if chunk > 1:
             state = engine.multi_step(state, cfg, chunk)
-            log.extend_from_ring(state, cfg)
+            for row in log.extend_from_ring(state, cfg):
+                _attach_trace_covs(row, trace_slots)
             rnd += chunk
         else:
             state = engine.step(state, cfg)
             if rnd % scenario.snapshot_every == 0:
+                # Host-side store queries only for labels WITHOUT an
+                # on-device trace slot (traceplane.py moved tracked
+                # coverage into the fused step; _attach_trace_covs
+                # derives those labels' curves from the row words).
                 covs = {f"cov_{label}": float(engine.coverage(state, *spec))
-                        for label, spec in tracked.items()}
-                log.append(state, cfg, **covs)
+                        for label, spec in tracked.items()
+                        if label not in trace_slots}
+                row = log.append(state, cfg, **covs)
+                _attach_trace_covs(row, trace_slots)
             rnd += 1
         if scenario.autosave_every and rnd % scenario.autosave_every == 0:
             _autosave(scenario.autosave_dir, rnd, state, cfg,
-                      tracked, log, recovery_hist, overload_hist)
+                      tracked, log, recovery_hist, overload_hist,
+                      trace_slots)
     return jax.block_until_ready(state), log

@@ -75,6 +75,17 @@ class Stats:
     msgs_shed_priority: jnp.ndarray  # u32[N] packets shed from this
     #   RECEIVER's push inbox by class-ordered admission under overflow
     #   (the drops that used to blame the flooded victim)
+    # Dissemination-tracing delivery accounting (dispersy_tpu/
+    # traceplane.py; OBSERVABILITY.md "Dissemination tracing").
+    # Zero-width unless cfg.trace.enabled — the `health` idiom.
+    # Receiver-side counts over the TRACKED records only, by delivery
+    # channel (columns = traceplane.CHANNEL_NAMES order):
+    trace_delivered: jnp.ndarray  # u32[N, 4] useful (first-landing)
+    #   deliveries this peer received, by channel — ROADMAP item 3's
+    #   per-channel usefulness signal
+    trace_dup: jnp.ndarray        # u32[N, 4] duplicate deliveries of
+    #   tracked records (already known / in-batch dup / digest FP /
+    #   staging overflow), by channel — the redundancy numerator
     # Recovery-plane action counters (dispersy_tpu/recovery.py;
     # RECOVERY.md).  All zero-width unless cfg.recovery.enabled — the
     # `health` idiom:
@@ -211,6 +222,28 @@ class PeerState:
     fr_pos: jnp.ndarray       # u32[1] flight records ever written (the
     #   decoder's wrap cursor); zero-width with the recorder off.
 
+    # ---- dissemination-tracing plane (dispersy_tpu/traceplane.py;
+    #      OBSERVABILITY.md "Dissemination tracing").  Every leaf is
+    #      zero-width unless cfg.trace.enabled — the `health` idiom.
+    #      Lineage is DISK-like state: it rides checkpoints (v15),
+    #      survives unload/load and app restarts, and the per-peer
+    #      rows wipe with the store on churn / quarantine rebirth.
+    #      The key registry and latches are overlay-global (one row
+    #      per tracked slot, not per peer). ----
+    trace_member: jnp.ndarray  # u32[T] tracked record's author;
+    #   EMPTY_U32 = free slot (engine.track_record assigns)
+    trace_gt: jnp.ndarray      # u32[T] tracked record's global_time
+    trace_first: jnp.ndarray   # u32[N, T] first-arrival round (the
+    #   post-step round the record first landed in this peer's logical
+    #   store; 0 = not yet)
+    trace_chan: jnp.ndarray    # u8[N, T] first-delivery channel code
+    #   (traceplane.CH_*; 0 = none yet)
+    trace_dups: jnp.ndarray    # u32[N, T] duplicate deliveries of the
+    #   slot's record at this peer
+    trace_latch: jnp.ndarray   # u32[T, 3] first post-step round
+    #   coverage reached {50, 90, 99}% of alive members
+    #   (traceplane.LATCH_PCTS order; 0 = not reached)
+
     # ---- candidate table [N, K] ----
     cand_peer: jnp.ndarray         # i32, NO_PEER = empty
     cand_last_walk: jnp.ndarray    # f32 sim-seconds of last successful walk to it
@@ -344,11 +377,14 @@ def init_stats(config: CommunityConfig) -> Stats:
     # (Execute() rejects the same buffer donated twice).
     from dispersy_tpu.recovery import NUM_HEALTH_BITS
 
+    from dispersy_tpu.traceplane import NUM_CHANNELS
+
     n, n_meta = config.n_peers, config.n_meta
     n_corrupt = n if (config.faults.corrupt_rate > 0.0
                       or config.faults.flood_enabled) else 0
     n_recov = n if config.recovery.enabled else 0
     n_overload = n if config.overload.enabled else 0
+    n_trace = n if config.trace.enabled else 0
     gates = stats_gates(config)
 
     def z():
@@ -367,6 +403,10 @@ def init_stats(config: CommunityConfig) -> Stats:
                  msgs_corrupt_dropped=jnp.zeros((n_corrupt,), jnp.uint32),
                  msgs_shed_rate=jnp.zeros((n_overload,), jnp.uint32),
                  msgs_shed_priority=jnp.zeros((n_overload,), jnp.uint32),
+                 trace_delivered=jnp.zeros((n_trace, NUM_CHANNELS),
+                                           jnp.uint32),
+                 trace_dup=jnp.zeros((n_trace, NUM_CHANNELS),
+                                     jnp.uint32),
                  recov_soft=jnp.zeros((n_recov,), jnp.uint32),
                  recov_backoff=jnp.zeros((n_recov,), jnp.uint32),
                  recov_quarantine=jnp.zeros((n_recov,), jnp.uint32),
@@ -485,6 +525,9 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
     s_w = config.store.staging
     d_w = config.bloom_words if (config.store_diet
                                  and config.sync_enabled) else 0
+    # Dissemination-tracing slots (zero-width when the plane is
+    # compiled out — the `health` idiom; traceplane.py).
+    t_w = config.trace.tracked_slots if config.trace.enabled else 0
     aux_dt = config.aux_dtype
 
     def never():  # distinct buffers: aliasing breaks donation
@@ -525,6 +568,12 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
             jnp.uint32),
         fr_pos=jnp.zeros(
             (1 if config.telemetry.flight_recorder else 0,), jnp.uint32),
+        trace_member=jnp.full((t_w,), EMPTY_U32, jnp.uint32),
+        trace_gt=jnp.full((t_w,), EMPTY_U32, jnp.uint32),
+        trace_first=jnp.zeros((n if t_w else 0, t_w), jnp.uint32),
+        trace_chan=jnp.zeros((n if t_w else 0, t_w), jnp.uint8),
+        trace_dups=jnp.zeros((n if t_w else 0, t_w), jnp.uint32),
+        trace_latch=jnp.zeros((t_w, 3), jnp.uint32),
         cand_peer=jnp.full((n, k), NO_PEER, jnp.int32),
         cand_last_walk=never(),
         cand_last_stumble=never(),

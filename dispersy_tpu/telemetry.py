@@ -51,6 +51,7 @@ import numpy as np
 
 from dispersy_tpu.exceptions import ConfigError
 from dispersy_tpu.faults import HEALTH_BIT_NAMES
+from dispersy_tpu.traceplane import CHANNEL_NAMES, LATCH_PCTS
 
 _M32 = 0xFFFFFFFF
 
@@ -180,6 +181,22 @@ def row_schema(cfg) -> tuple:
     entries += [(f"health_{nm}", "u32") for nm in HEALTH_NAMES]
     entries += [(f"accepted_by_meta_{i}", "u64")
                 for i in range(cfg.n_meta + 1)]
+    if cfg.trace.enabled:
+        # Dissemination-tracing words (dispersy_tpu/traceplane.py;
+        # OBSERVABILITY.md "Dissemination tracing").  CONDITIONAL on
+        # the master knob so a trace-off row stays byte-identical —
+        # the recovery/overload rule.  Declared BEFORE the overload
+        # block, matching the config field order (trace precedes
+        # store/overload/recovery).
+        t = cfg.trace.tracked_slots
+        entries += [(f"trace_cov_{k}", "u32") for k in range(t)]
+        for k in range(t):
+            entries += [(f"trace_r{pct}_{k}", "u32")
+                        for pct in LATCH_PCTS]
+        entries += [(f"trace_delivered_{nm}", "u64")
+                    for nm in CHANNEL_NAMES]
+        entries += [(f"trace_dup_{nm}", "u64") for nm in CHANNEL_NAMES]
+        entries += [("trace_redundancy", "f32")]
     if cfg.overload.enabled:
         # Ingress-protection words (dispersy_tpu/overload.py;
         # OVERLOAD.md).  CONDITIONAL on the master knob so an
@@ -420,6 +437,19 @@ def row_to_snapshot(row: np.ndarray, cfg) -> dict:
         out[f"health_{nm}"] = raw[f"health_{nm}"]
     out["accepted_by_meta"] = [raw[f"accepted_by_meta_{i}"]
                                for i in range(cfg.n_meta + 1)]
+    if cfg.trace.enabled:
+        # Dissemination-tracing surfacing (traceplane.py): per-slot
+        # coverage counts + percentile latches, per-channel delivery
+        # accounting, and the redundancy ratio — key-identical to the
+        # legacy snapshot path's trace block (traceplane.trace_totals).
+        for k in range(cfg.trace.tracked_slots):
+            out[f"trace_cov_{k}"] = raw[f"trace_cov_{k}"]
+            for pct in LATCH_PCTS:
+                out[f"trace_r{pct}_{k}"] = raw[f"trace_r{pct}_{k}"]
+        for nm in CHANNEL_NAMES:
+            out[f"trace_delivered_{nm}"] = raw[f"trace_delivered_{nm}"]
+            out[f"trace_dup_{nm}"] = raw[f"trace_dup_{nm}"]
+        out["trace_redundancy"] = raw["trace_redundancy"]
     if cfg.overload.enabled:
         # Ingress-protection surfacing (overload.py; OVERLOAD.md): the
         # shed streams + exhausted-bucket count, key-identical to the

@@ -1,0 +1,268 @@
+"""The dissemination-tracing plane: on-device record lineage.
+
+The repo can see its own *cost* (the PR-11 ledger) and *health* (the
+telemetry/recovery planes), but until this plane it could not see the
+protocol's actual product — how a record spreads.  Tracked-record
+coverage was a host-side store query per round (`engine.coverage`),
+which kicked `scenario.run` off its batched ring fast path, and nothing
+measured first-arrival latency, which delivery channel actually carried
+each record, or how many duplicate deliveries the overlay paid per
+useful one — the quantities *The Algorithm of Pipelined Gossiping*
+makes first-class (dissemination latency under sustained traffic) and
+*Verification of GossipSub in ACL2s* formalizes per channel
+(delivery/duplicate accounting) — PAPERS.md.
+
+Up to ``TraceConfig.tracked_slots`` records, registered by
+``(author, global_time)`` key (``engine.track_record`` /
+``scenario.TrackRecord`` / ``Community.track_record``), get per-peer
+on-device lineage leaves, updated inside the fused step at every
+delivery site:
+
+- ``PeerState.trace_first`` — u32[N, T] first-arrival round (the
+  post-step round index the record first LANDED in this peer's logical
+  store; 0 = not yet).  Staging-aware: under the byte-diet store plane
+  an arrival landing in the staging buffer counts at ARRIVAL, not at
+  compaction; a staging-overflow drop does not land and therefore does
+  not count as a first arrival (it counts as a duplicate-side delivery
+  — the overlay paid for it).  On the legacy every-round-merge path a
+  ring-capacity drop at insert still counts: lineage is ARRIVAL
+  history, not residency (a LastSync/capacity eviction does not
+  un-arrive a record).
+- ``PeerState.trace_chan`` — u8[N, T] first-delivery channel code
+  (:data:`CH_CREATE` / :data:`CH_WALK_SYNC` / :data:`CH_PUSH` /
+  :data:`CH_FLOOD`; 0 = none yet).
+- ``PeerState.trace_dups`` — u32[N, T] duplicate-delivery counter: the
+  tracked record's arrivals at this peer that were NOT its first
+  landing (already stored, in-batch duplicates, digest false
+  positives, staging overflow, digest-FN re-stages).
+
+plus the global latches/counters the telemetry row surfaces as
+CONDITIONAL words (trace-off rows stay byte-identical; the
+recovery/overload rule):
+
+- per-slot coverage counts (alive non-tracker peers whose lineage is
+  set — exactly ``engine.coverage``'s numerator),
+- per-slot rounds-to-{50,90,99}%-coverage latches
+  (``PeerState.trace_latch``, u32[T, 3]; 0 = not reached),
+- per-channel useful-delivery and duplicate-delivery totals
+  (``Stats.trace_delivered`` / ``Stats.trace_dup``, u32[N, 4]),
+- a redundancy ratio (total tracked deliveries / useful ones, f32).
+
+Channel attribution note: byzantine flood junk (FAULTS.md) never
+decodes — it always fails the intake hash re-check — so a real record
+can never be DELIVERED by the flood channel under this wire model.
+:data:`CH_FLOOD` exists so the channel table (and the row schema) is
+stable and the structural zero is *measured*, not assumed; the flood's
+real cost shows up in the victims' duplicate/drop accounting instead.
+
+Lineage is disk-like state: it rides checkpoints (v15), survives
+unload/load and app restarts, and is WIPED with the store by a churn
+rebirth or a recovery quarantine escalation (a wiped-disk restart
+forgets what it held) — the oracle mirrors every path bit-exactly.
+
+Scope gate (config.validate): the plane's channel table covers exactly
+create/walk-sync/push/flood, so ``trace.enabled`` refuses configs that
+open other intake segments — the delay pen (``delay_inbox`` and the
+request channels riding it), double-signed completions
+(``double_meta_mask``), and the in-step eyewitness-proof create of
+``malicious_gossip``.  This module is host-side and import-light (no
+jax) like :mod:`dispersy_tpu.telemetry`; the traced kernels live in
+:mod:`dispersy_tpu.ops.trace` and the registration helpers in
+:mod:`dispersy_tpu.engine`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from dispersy_tpu.exceptions import ConfigError
+
+# First-delivery channel codes (PeerState.trace_chan values; 0 = no
+# delivery yet).  Code c maps to CHANNEL_NAMES[c - 1].
+CH_CREATE = 1      # authored locally (engine.create_messages /
+#                    holders at engine.track_record registration)
+CH_WALK_SYNC = 2   # pulled through the Bloom-sync response on the
+#                    walk edge (the `sy` intake segment)
+CH_PUSH = 3        # pushed by a forwarding peer (the `ph` segment)
+CH_FLOOD = 4       # the byzantine flood blast — structurally zero
+#                    under the junk-flood wire model (module doc)
+CHANNEL_NAMES = ("create", "walk_sync", "push", "flood")
+NUM_CHANNELS = len(CHANNEL_NAMES)
+
+# Coverage-latch percentiles, in trace_latch column order.
+LATCH_PCTS = (50, 90, 99)
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceConfig:
+    """Static dissemination-tracing knobs, composed into
+    ``CommunityConfig.trace`` (sixth-to-last field, directly before
+    ``store`` — checkpoint fingerprint compat).
+
+    Frozen + hashable (a static jit argument).  All defaults off
+    compile to exactly the trace-free step; every leaf the plane adds
+    (``trace_*`` and the ``Stats.trace_*`` counters) is zero-width
+    while ``enabled`` is off.
+    """
+
+    # Master switch: compose the lineage updates, coverage counts,
+    # latches, and channel accounting into the fused round.
+    enabled: bool = False
+    # Tracked-record slots (the T axis of every lineage leaf).  Slots
+    # are assigned by registration order and never freed — size for
+    # the records one run tracks, not for churn.
+    tracked_slots: int = 4
+
+    def __post_init__(self) -> None:
+        if not (1 <= self.tracked_slots <= 16):
+            raise ConfigError(
+                f"trace.tracked_slots must be in [1, 16], got "
+                f"{self.tracked_slots} (each slot is a u32+u8+u32 "
+                "per-peer lineage column)")
+
+    def replace(self, **kw) -> "TraceConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def redundancy_f32(delivered, dup) -> float:
+    """The row's redundancy ratio from per-channel useful/duplicate
+    totals — float32 op-for-op (the engine computes the identical
+    sequence on device, the oracle calls THIS): per channel,
+    lo + hi * 2^32 in f32, accumulated in channel order; ratio =
+    (useful + dup) / useful, or 0 with no useful delivery yet."""
+    two32 = np.float32(4294967296.0)
+    useful_f = np.float32(0.0)
+    dup_f = np.float32(0.0)
+    for c in range(NUM_CHANNELS):
+        d = int(delivered[c])
+        u = int(dup[c])
+        useful_f = np.float32(
+            useful_f + np.float32(
+                np.float32(d & 0xFFFFFFFF) + np.float32(d >> 32) * two32))
+        dup_f = np.float32(
+            dup_f + np.float32(
+                np.float32(u & 0xFFFFFFFF) + np.float32(u >> 32) * two32))
+    if not useful_f > 0:
+        return 0.0
+    return float(np.float32((useful_f + dup_f) / useful_f))
+
+
+def trace_totals(state, cfg) -> dict:
+    """The trace plane's snapshot keys from a materialized state — the
+    legacy (telemetry-off) ``metrics.snapshot`` path's source, emitting
+    the SAME key set ``telemetry.row_to_snapshot`` derives from the
+    fused row so the two paths stay schema-identical (the dump_binary
+    contract).  Cheap: a few [N, T] / [N, 4] transfers."""
+    t = cfg.trace.tracked_slots
+    first = np.asarray(state.trace_first)
+    members = np.asarray(state.alive) & ~np.asarray(state.is_tracker)
+    latch = np.asarray(state.trace_latch)
+    out: dict = {}
+    for k in range(t):
+        cov = int(((first[:, k] != 0) & members).sum()) if first.size \
+            else 0
+        out[f"trace_cov_{k}"] = cov
+        for i, pct in enumerate(LATCH_PCTS):
+            out[f"trace_r{pct}_{k}"] = (int(latch[k, i])
+                                        if latch.size else 0)
+    delivered = (np.asarray(state.stats.trace_delivered, np.uint64)
+                 .sum(axis=0) if np.asarray(
+                     state.stats.trace_delivered).size
+                 else np.zeros(NUM_CHANNELS, np.uint64))
+    dup = (np.asarray(state.stats.trace_dup, np.uint64).sum(axis=0)
+           if np.asarray(state.stats.trace_dup).size
+           else np.zeros(NUM_CHANNELS, np.uint64))
+    for c, nm in enumerate(CHANNEL_NAMES):
+        out[f"trace_delivered_{nm}"] = int(delivered[c])
+        out[f"trace_dup_{nm}"] = int(dup[c])
+    out["trace_redundancy"] = redundancy_f32(delivered, dup)
+    return out
+
+
+def slots_in_rows(rows) -> list:
+    """Tracked-slot indices present in a row log (``trace_cov_<k>``
+    keys), sorted."""
+    slots: set[int] = set()
+    for row in rows:
+        for key in row:
+            if key.startswith("trace_cov_"):
+                try:
+                    slots.add(int(key[len("trace_cov_"):]))
+                except ValueError:
+                    pass
+    return sorted(slots)
+
+
+def coverage_curve(rows, slot: int) -> list:
+    """``(round, covered, alive_members)`` triples for one slot, rounds
+    ascending — the dissemination curve the reference's experiment
+    pipeline mined from its logs."""
+    out = []
+    for row in sorted(rows, key=lambda r: int(r.get("round", 0))):
+        if f"trace_cov_{slot}" not in row:
+            continue
+        out.append((int(row["round"]), int(row[f"trace_cov_{slot}"]),
+                    int(row.get("alive_members", 0))))
+    return out
+
+
+def latency_percentiles(rows, slot: int,
+                        pcts=(10, 25, 50, 75, 90, 99)) -> dict:
+    """First-arrival latency percentiles for one tracked record, in
+    ROUNDS after its first appearance, derived from the coverage curve
+    (the p-th percentile of per-peer first-arrival latency is the first
+    round where coverage reaches p% of the alive members).  ``None``
+    for percentiles the log's window never reached."""
+    curve = coverage_curve(rows, slot)
+    start = next((rnd for rnd, cov, _ in curve if cov > 0), None)
+    out: dict = {"start_round": start}
+    for p in pcts:
+        hit = next((rnd for rnd, cov, alive in curve
+                    if alive > 0 and cov * 100 >= p * alive), None)
+        out[f"p{p}"] = None if (hit is None or start is None) \
+            else hit - start
+    return out
+
+
+def channel_table(rows) -> dict:
+    """Per-channel useful/duplicate totals and useful-delivery shares
+    from a row log's LAST row (the counters are cumulative)."""
+    last = max(rows, key=lambda r: int(r.get("round", 0)), default={})
+    out: dict = {}
+    total = 0
+    for nm in CHANNEL_NAMES:
+        d = int(last.get(f"trace_delivered_{nm}", 0))
+        out[f"delivered_{nm}"] = d
+        out[f"dup_{nm}"] = int(last.get(f"trace_dup_{nm}", 0))
+        total += d
+    for nm in CHANNEL_NAMES:
+        out[f"share_{nm}"] = (out[f"delivered_{nm}"] / total
+                              if total else 0.0)
+    out["delivered_total"] = total
+    return out
+
+
+def trace_report(rows) -> dict:
+    """Dissemination summary of a run log — the trace analogue of
+    ``overload.shed_report`` / ``recovery.mttr_report``, consumed by
+    ``tools/telemetry.py gate --trace`` against the committed
+    ``artifacts/golden_trace.json`` and by ``tools/trace.py report``.
+
+    All scalar fields (the gate compares field-for-field): per-slot
+    final coverage counts and rounds-to-{50,90,99}% latches, per-channel
+    delivered/dup totals and shares, and the redundancy ratio.
+    """
+    rows = [r for r in rows if isinstance(r, dict)]
+    out: dict = {"rounds": len(rows)}
+    if not rows:
+        return out
+    last = max(rows, key=lambda r: int(r.get("round", 0)))
+    for k in slots_in_rows(rows):
+        out[f"slot{k}_cov"] = int(last.get(f"trace_cov_{k}", 0))
+        for pct in LATCH_PCTS:
+            out[f"slot{k}_r{pct}"] = int(last.get(f"trace_r{pct}_{k}", 0))
+    out.update(channel_table(rows))
+    out["redundancy"] = float(last.get("trace_redundancy", 0.0))
+    return out

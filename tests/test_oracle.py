@@ -24,6 +24,8 @@ BASE = CommunityConfig(n_peers=32, n_trackers=2, msg_capacity=32,
 
 FIELDS = ["alive", "loaded", "session", "global_time", "health", "ge_bad",
           "backoff", "quar_until", "repair_round", "bucket",
+          "trace_member", "trace_gt", "trace_first", "trace_chan",
+          "trace_dups", "trace_latch",
           "cand_peer", "cand_last_walk", "cand_last_stumble", "cand_last_intro",
           "store_gt", "store_member", "store_meta", "store_payload",
           "store_aux", "store_flags",
@@ -38,6 +40,7 @@ STAT_FIELDS = ["walk_success", "walk_fail", "msgs_stored", "msgs_dropped",
                "msgs_rejected", "msgs_direct", "msgs_delayed",
                "msgs_corrupt_dropped",
                "msgs_shed_rate", "msgs_shed_priority",
+               "trace_delivered", "trace_dup",
                "recov_soft", "recov_backoff", "recov_quarantine",
                "recov_cleared",
                "proof_requests", "proof_records", "seq_requests", "seq_records",

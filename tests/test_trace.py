@@ -1,0 +1,610 @@
+"""The dissemination-tracing plane (dispersy_tpu/traceplane.py;
+OBSERVABILITY.md "Dissemination tracing").
+
+Coverage:
+
+- config scope gates and zero-cost-when-disabled (zero-width leaves,
+  unchanged row schema);
+- oracle-vs-engine bit-exact lineage parity — first-arrival rounds,
+  channel precedence, duplicate counters, coverage latches — under
+  GE loss + dup + corrupt + flood + churn, and under the byte-diet
+  staging store with recovery quarantine wipes clearing lineage;
+- channel attribution invariants (create for the author, flood
+  structurally zero, chan set iff first set);
+- registration semantics (idempotent, slot exhaustion, disabled
+  refusal) and the scenario TrackRecord event;
+- the scenario fast path: a tracked 20-round run with
+  snapshot_every=1 produces the same cov_<label> curve as the legacy
+  host-query path, round for round, without a single host store query;
+- checkpoint v15 round-trips + pre-v15 compat; 2-replica fleet ==
+  sequential singles lineage;
+- the committed artifacts/golden_trace.json gate
+  (tools/telemetry.py gate --trace) and the tools/trace.py CLI, with
+  the oracle reproducing the golden summary bit-exactly;
+- the +trace cost-ledger cells.
+"""
+
+import json
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dispersy_tpu import checkpoint as ckpt
+from dispersy_tpu import engine as E
+from dispersy_tpu import metrics
+from dispersy_tpu import scenario as SC
+from dispersy_tpu import state as S
+from dispersy_tpu import telemetry as tlm
+from dispersy_tpu import traceplane as trp
+from dispersy_tpu.config import EMPTY_U32, CommunityConfig
+from dispersy_tpu.exceptions import CheckpointError, ConfigError
+from dispersy_tpu.faults import FaultModel
+from dispersy_tpu.oracle import sim as O
+from dispersy_tpu.recovery import RecoveryConfig
+from dispersy_tpu.storediet import StoreConfig
+from dispersy_tpu.telemetry import TelemetryConfig
+from dispersy_tpu.traceplane import TraceConfig
+
+from test_oracle import assert_match
+
+BASE = CommunityConfig(n_peers=32, n_trackers=2, msg_capacity=32,
+                       bloom_capacity=16, k_candidates=8, request_inbox=4,
+                       tracker_inbox=8, response_budget=4,
+                       trace=TraceConfig(enabled=True, tracked_slots=2))
+
+TRACE_FIELDS = ("trace_member", "trace_gt", "trace_first", "trace_chan",
+                "trace_dups", "trace_latch")
+
+
+def _run_pair(cfg, seed=0, warm=4, authors=(5,)):
+    """(state, oracle) with one tracked record per author, registered
+    at creation on both sides."""
+    state = S.init_state(cfg, jax.random.PRNGKey(seed))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    if warm:
+        state = E.seed_overlay(state, cfg, degree=warm)
+        oracle.seed_overlay(degree=warm)
+    for j, author in enumerate(authors):
+        mask = np.arange(cfg.n_peers) == author
+        payload = np.full(cfg.n_peers, 42 + j, np.uint32)
+        state = E.create_messages(state, cfg, jnp.asarray(mask), meta=1,
+                                  payload=jnp.asarray(payload))
+        oracle.create_messages(mask, meta=1, payload=payload)
+        gt = int(state.global_time[author])
+        state, slot = E.track_record(state, cfg, author, gt)
+        assert oracle.track_record(author, gt) == slot
+    assert_match(state, oracle, "setup")
+    return state, oracle
+
+
+# ---- config / zero-cost gates ------------------------------------------
+
+
+def test_trace_scope_gates():
+    with pytest.raises(ConfigError, match="delay pen"):
+        BASE.replace(timeline_enabled=True, delay_inbox=4)
+    with pytest.raises(ConfigError, match="double-signed"):
+        BASE.replace(double_meta_mask=0b10, n_meta=4)
+    with pytest.raises(ConfigError, match="eyewitness"):
+        BASE.replace(malicious_enabled=True, malicious_gossip=True)
+    with pytest.raises(ConfigError, match="tracked_slots"):
+        TraceConfig(enabled=True, tracked_slots=0)
+    with pytest.raises(ConfigError, match="tracked_slots"):
+        TraceConfig(tracked_slots=99)
+    # malicious detection WITHOUT gossip stays compatible
+    BASE.replace(malicious_enabled=True)
+
+
+def test_trace_off_is_zero_width():
+    cfg = BASE.replace(trace=TraceConfig())
+    state = S.init_state(cfg, jax.random.PRNGKey(0))
+    for f in TRACE_FIELDS:
+        assert np.asarray(getattr(state, f)).size == 0, f
+    assert np.asarray(state.stats.trace_delivered).shape == (0, 4)
+    assert np.asarray(state.stats.trace_dup).shape == (0, 4)
+    # the packed-row schema is untouched by the disabled plane
+    tcfg = cfg.replace(telemetry=TelemetryConfig(enabled=True))
+    names = [nm for nm, _ in tlm.row_schema(tcfg)]
+    assert not any(nm.startswith("trace_") for nm in names)
+    with pytest.raises(ValueError, match="trace.enabled"):
+        E.track_record(state, cfg, 5, 2)
+
+
+def test_row_schema_grows_conditionally():
+    tcfg = BASE.replace(telemetry=TelemetryConfig(enabled=True))
+    names = [nm for nm, _ in tlm.row_schema(tcfg)]
+    for k in range(2):
+        assert f"trace_cov_{k}" in names
+        for pct in (50, 90, 99):
+            assert f"trace_r{pct}_{k}" in names
+    for nm in trp.CHANNEL_NAMES:
+        assert f"trace_delivered_{nm}" in names
+        assert f"trace_dup_{nm}" in names
+    assert "trace_redundancy" in names
+    off = tcfg.replace(trace=TraceConfig())
+    assert tlm.row_width(tcfg) > tlm.row_width(off)
+
+
+# ---- oracle parity ------------------------------------------------------
+
+
+def test_oracle_parity_trace_chaos():
+    """GE loss + dup + corrupt + flood + churn: first-arrival rounds,
+    channel precedence, dup counters, and latches bit-exact (the
+    assert_match FIELDS/STAT_FIELDS now include every trace leaf)."""
+    cfg = BASE.replace(
+        churn_rate=0.03, packet_loss=0.08,
+        telemetry=TelemetryConfig(enabled=True, history=8,
+                                  histograms=True),
+        faults=FaultModel(ge_p_bad=0.1, ge_p_good=0.4,
+                          ge_loss_good=0.02, ge_loss_bad=0.5,
+                          dup_rate=0.1, corrupt_rate=0.05,
+                          flood_senders=(9,), flood_fanout=3,
+                          health_checks=True, health_drop_limit=6))
+    state, oracle = _run_pair(cfg, seed=3, authors=(5, 7))
+    for rnd in range(12):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+
+
+def test_oracle_parity_trace_diet_recovery_wipes():
+    """Byte-diet staging (arrival counts at staging, not compaction)
+    plus recovery quarantine escalations wiping lineage with the
+    store — bit-exact across compaction windows and wipes."""
+    cfg = BASE.replace(
+        packet_loss=0.05, push_inbox=2,
+        store=StoreConfig(staging=6, compact_every=3),
+        recovery=RecoveryConfig(enabled=True, backoff_limit=2,
+                                quarantine_rounds=4,
+                                requarantine_window=6),
+        telemetry=TelemetryConfig(enabled=True, history=8),
+        faults=FaultModel(dup_rate=0.1,
+                          flood_senders=(9, 21), flood_fanout=12,
+                          health_checks=True, health_drop_limit=2))
+    state, oracle = _run_pair(cfg, seed=5, authors=(5,))
+    saw_wipe = False
+    for rnd in range(16):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+        saw_wipe = saw_wipe or any(p.recov_quarantine for p in
+                                   oracle.peers)
+    assert saw_wipe, "scenario never escalated — weaken the flood knobs"
+
+
+def test_mid_registration_and_late_arrivals():
+    """A record registered mid-run: holders at registration attribute
+    to the create channel; later spread attributes to real channels."""
+    cfg = BASE
+    state, oracle = _run_pair(cfg, seed=1, authors=(5,))
+    for _ in range(3):
+        state = E.step(state, cfg)
+        oracle.step()
+    # register a SECOND record that has already spread a few rounds
+    mask = np.arange(cfg.n_peers) == 8
+    payload = np.full(cfg.n_peers, 99, np.uint32)
+    state = E.create_messages(state, cfg, jnp.asarray(mask), meta=1,
+                              payload=jnp.asarray(payload))
+    oracle.create_messages(mask, meta=1, payload=payload)
+    gt = int(state.global_time[8])
+    for _ in range(2):
+        state = E.step(state, cfg)
+        oracle.step()
+    state, slot = E.track_record(state, cfg, 8, gt)
+    assert oracle.track_record(8, gt) == slot
+    assert_match(state, oracle, "mid-registration")
+    first = np.asarray(state.trace_first)[:, slot]
+    chan = np.asarray(state.trace_chan)[:, slot]
+    assert (first != 0).sum() >= 1
+    # every pre-registration holder is attributed to create
+    assert set(chan[first != 0].tolist()) <= {trp.CH_CREATE}
+    for rnd in range(4):
+        state = E.step(state, cfg)
+        oracle.step()
+        assert_match(jax.block_until_ready(state), oracle, rnd)
+    chan = np.asarray(state.trace_chan)[:, slot]
+    assert {trp.CH_WALK_SYNC, trp.CH_PUSH} & set(chan.tolist())
+
+
+# ---- channel attribution invariants ------------------------------------
+
+
+def test_channel_attribution_invariants():
+    cfg = BASE.replace(
+        faults=FaultModel(dup_rate=0.15, flood_senders=(9,),
+                          flood_fanout=4))
+    state, _ = _run_pair(cfg, seed=2, authors=(5,))
+    author_chan = int(np.asarray(state.trace_chan)[5, 0])
+    assert author_chan == trp.CH_CREATE
+    for _ in range(10):
+        state = E.step(state, cfg)
+    first = np.asarray(state.trace_first)
+    chan = np.asarray(state.trace_chan)
+    # chan set exactly where first set; valid codes only
+    assert ((chan != 0) == (first != 0)).all()
+    assert set(np.unique(chan[first != 0]).tolist()) <= {
+        trp.CH_CREATE, trp.CH_WALK_SYNC, trp.CH_PUSH}
+    delivered = np.asarray(state.stats.trace_delivered, np.uint64).sum(0)
+    dup = np.asarray(state.stats.trace_dup, np.uint64).sum(0)
+    # flood junk never decodes: the flood channel is structurally zero
+    assert delivered[trp.CH_FLOOD - 1] == 0
+    assert dup[trp.CH_FLOOD - 1] == 0
+    # every useful delivery is a lineage entry and vice versa
+    assert delivered.sum() == (first != 0).sum()
+    assert dup.sum() == np.asarray(state.trace_dups, np.uint64).sum()
+    assert dup.sum() > 0, "dup_rate=0.15 produced no duplicate?"
+    # per-peer lineage rounds never precede the creation round
+    assert (first[first != 0] >= 1).all()
+
+
+def test_latches_and_coverage_words():
+    cfg = BASE.replace(telemetry=TelemetryConfig(enabled=True,
+                                                 history=32))
+    state, _ = _run_pair(cfg, seed=0, authors=(5,))
+    log = metrics.MetricsLog()
+    state = E.multi_step(state, cfg, 16)
+    rows = log.extend_from_ring(jax.block_until_ready(state), cfg)
+    latch = np.asarray(state.trace_latch)
+    r50, r90, r99 = (int(latch[0, i]) for i in range(3))
+    assert 0 < r50 <= r90 <= r99, (r50, r90, r99)
+    # the latch equals the first row whose coverage word reaches pct%
+    for pct, want in (("50", r50), ("90", r90), ("99", r99)):
+        hit = next(r["round"] for r in rows
+                   if r["trace_cov_0"] * 100
+                   >= int(pct) * r["alive_members"])
+        assert hit == want, (pct, hit, want)
+        assert all(int(r[f"trace_r{pct}_0"]) in (0, want)
+                   for r in rows)
+    # unregistered slot stays unlatched / uncovered
+    assert (latch[1] == 0).all()
+    assert all(r["trace_cov_1"] == 0 for r in rows)
+
+
+# ---- registration semantics --------------------------------------------
+
+
+def test_track_record_idempotent_and_exhaustion():
+    cfg = BASE
+    state = S.init_state(cfg, jax.random.PRNGKey(0))
+    state, s0 = E.track_record(state, cfg, 5, 2)
+    state, again = E.track_record(state, cfg, 5, 2)
+    assert (s0, again) == (0, 0)
+    state, s1 = E.track_record(state, cfg, 6, 2)
+    assert s1 == 1
+    with pytest.raises(ValueError, match="tracked slots are taken"):
+        E.track_record(state, cfg, 7, 2)
+
+
+# ---- scenario integration (the fast-path satellite) ---------------------
+
+
+def _fastpath_cfg(trace_on: bool) -> CommunityConfig:
+    return CommunityConfig(
+        n_peers=48, n_trackers=2, msg_capacity=32, bloom_capacity=16,
+        k_candidates=8, request_inbox=4, tracker_inbox=16,
+        response_budget=4, packet_loss=0.05,
+        trace=TraceConfig(enabled=True) if trace_on else TraceConfig(),
+        telemetry=TelemetryConfig(enabled=True, history=32))
+
+
+def test_scenario_fastpath_cov_curve_matches_host_query(monkeypatch):
+    """The satellite pin: with on-device coverage the tracked run rides
+    the ring fast path (engine.coverage must never be called) and its
+    20-round cov_<label> curve equals the legacy host-query path's,
+    round for round."""
+    sc = SC.Scenario(rounds=20, events=[
+        (0, SC.Create(meta=1, authors=[5], payload=42, track="post"))])
+    monkeypatch.setattr(
+        E, "coverage",
+        lambda *a, **k: pytest.fail("host store query on the fast path"))
+    _, log_fast = SC.run(_fastpath_cfg(True), sc)
+    monkeypatch.undo()
+    _, log_slow = SC.run(_fastpath_cfg(False), sc)
+    fast = {r["round"]: r["cov_post"] for r in log_fast.rows}
+    slow = {r["round"]: r["cov_post"] for r in log_slow.rows}
+    assert len(fast) == 20 and set(fast) == set(slow)
+    for rnd in sorted(fast):
+        assert fast[rnd] == slow[rnd], rnd
+    assert fast[max(fast)] == 1.0
+
+
+def test_scenario_slot_overflow_falls_back_to_host_query(caplog):
+    """Create(track=) beyond tracked_slots degrades to the legacy
+    host-query path (warning, correct curve) instead of aborting the
+    run mid-scenario; the explicit TrackRecord event stays strict."""
+    import logging
+    cfg = _fastpath_cfg(True).replace(
+        trace=TraceConfig(enabled=True, tracked_slots=1))
+    sc = SC.Scenario(rounds=8, events=[
+        (0, SC.Create(meta=1, authors=[5], payload=42, track="a")),
+        (0, SC.Create(meta=1, authors=[7], payload=43, track="b"))])
+    with caplog.at_level(logging.WARNING, "dispersy_tpu.scenario"):
+        _, log = SC.run(cfg, sc)
+    assert any("tracked_slots" in r.message for r in caplog.records)
+    # both curves present: "a" on-device, "b" via host queries
+    assert all("cov_a" in r and "cov_b" in r for r in log.rows)
+    assert log.rows[-1]["cov_a"] > 0 and log.rows[-1]["cov_b"] > 0
+
+
+def test_scenario_trackrecord_event_and_resume(tmp_path):
+    """TrackRecord registers by key mid-scenario; an autosave resume
+    straddling the registration replays the identical rows."""
+    cfg = _fastpath_cfg(True)
+    # seeded overlay: author 5's create at round 0 claims gt=2
+    events = [(0, SC.Create(meta=1, authors=[5], payload=42)),
+              (0, SC.TrackRecord(label="post", author=5, gt=2))]
+    sc = SC.Scenario(rounds=12, events=events, autosave_every=5,
+                     autosave_dir=str(tmp_path / "as"))
+    _, log_a = SC.run(cfg, sc)
+    sc2 = SC.Scenario(rounds=12, events=events, autosave_every=5,
+                      autosave_dir=str(tmp_path / "as"))
+    _, log_b = SC.run(cfg, sc2, resume=True)
+    assert log_a.rows == log_b.rows
+    assert all("cov_post" in r for r in log_a.rows)
+    with pytest.raises(ValueError, match="trace.enabled"):
+        SC.run(_fastpath_cfg(False),
+               SC.Scenario(rounds=2, events=[
+                   (0, SC.TrackRecord(label="x", author=5, gt=2))]))
+
+
+# ---- snapshot key parity ------------------------------------------------
+
+
+def test_snapshot_key_parity_fused_vs_legacy():
+    cfg = BASE.replace(telemetry=TelemetryConfig(enabled=True))
+    state, _ = _run_pair(cfg, seed=4, authors=(5,))
+    state = jax.block_until_ready(E.multi_step(state, cfg, 6))
+    fused = metrics.snapshot(state, cfg)
+    legacy = metrics.snapshot(state,
+                              cfg.replace(telemetry=TelemetryConfig()))
+    fkeys = {k for k in fused if k.startswith("trace_")}
+    lkeys = {k for k in legacy if k.startswith("trace_")}
+    assert fkeys == lkeys and fkeys
+    for k in sorted(fkeys):
+        if isinstance(legacy[k], float):
+            assert fused[k] == pytest.approx(legacy[k], abs=0.0), k
+        else:
+            assert fused[k] == legacy[k], k
+
+
+# ---- checkpoint v15 -----------------------------------------------------
+
+
+def _warm_trace_state(cfg, rounds=5):
+    state, _ = _run_pair(cfg, seed=0, authors=(5,))
+    for _ in range(rounds):
+        state = E.step(state, cfg)
+    return jax.block_until_ready(state)
+
+
+def test_v15_roundtrip_resumes_bit_identically(tmp_path):
+    cfg = BASE
+    state = _warm_trace_state(cfg)
+    path = str(tmp_path / "t.npz")
+    ckpt.save(path, state, cfg)
+    restored = ckpt.restore(path, cfg)
+    for f in TRACE_FIELDS:
+        np.testing.assert_array_equal(np.asarray(getattr(state, f)),
+                                      np.asarray(getattr(restored, f)),
+                                      err_msg=f)
+    a = E.step(state, cfg)
+    b = E.step(jax.tree_util.tree_map(jnp.asarray, restored), cfg)
+    for f in TRACE_FIELDS + ("store_gt", "round_index"):
+        np.testing.assert_array_equal(np.asarray(getattr(a, f)),
+                                      np.asarray(getattr(b, f)),
+                                      err_msg=f)
+
+
+def _as_v14(src: str, dst: str, cfg) -> None:
+    """Downgrade a default-trace v15 archive to a faithful v14 one."""
+    z = dict(np.load(src))
+    drop = ("trace_member", "trace_gt", "trace_first", "trace_chan",
+            "trace_dups", "trace_latch", "stats/trace_delivered",
+            "stats/trace_dup")
+    z = {k: v for k, v in z.items()
+         if not any(k.endswith(d) for d in
+                    [f"leaf:{d2}" for d2 in drop]
+                    + [f"crc:{d2}" for d2 in drop])}
+    z["meta:version"] = np.asarray(14)
+    z["meta:config"] = np.frombuffer(
+        ckpt._want_fingerprint(cfg, 14).encode(), np.uint8)
+    np.savez(dst, **z)
+
+
+def test_v14_archive_loads_and_refuses_trace_config(tmp_path):
+    cfg = BASE.replace(trace=TraceConfig())
+    state = S.init_state(cfg, jax.random.PRNGKey(0))
+    for _ in range(3):
+        state = E.step(state, cfg)
+    v15 = str(tmp_path / "v15.npz")
+    ckpt.save(v15, jax.block_until_ready(state), cfg)
+    v14 = str(tmp_path / "v14.npz")
+    _as_v14(v15, v14, cfg)
+    restored = ckpt.restore(v14, cfg)
+    for f in TRACE_FIELDS:
+        assert np.asarray(getattr(restored, f)).size == 0, f
+    np.testing.assert_array_equal(np.asarray(state.store_gt),
+                                  np.asarray(restored.store_gt))
+    with pytest.raises(CheckpointError, match="predates"):
+        ckpt.restore(v14, cfg.replace(trace=TraceConfig(enabled=True)))
+
+
+def test_v15_torn_trace_leaf_raises(tmp_path):
+    cfg = BASE
+    state = _warm_trace_state(cfg, rounds=2)
+    path = str(tmp_path / "t.npz")
+    ckpt.save(path, state, cfg)
+    z = dict(np.load(path))
+    arr = np.array(z["leaf:trace_first"])
+    arr.flat[0] ^= 1
+    z["leaf:trace_first"] = arr      # CRC now stale
+    np.savez(str(tmp_path / "torn.npz"), **z)
+    with pytest.raises(CheckpointError, match="CRC"):
+        ckpt.restore(str(tmp_path / "torn.npz"), cfg)
+
+
+# ---- fleet --------------------------------------------------------------
+
+
+def test_fleet_trace_matches_sequential_singles():
+    from dispersy_tpu import fleet as F
+    cfg = BASE.replace(packet_loss=0.1,
+                       telemetry=TelemetryConfig(enabled=True))
+    singles = []
+    for seed in (0, 1):
+        st, _ = _run_pair(cfg, seed=seed, authors=(5,))
+        singles.append(jax.tree_util.tree_map(np.asarray, st))
+    fstate = S.stack_states(singles)
+    singles = [jax.tree_util.tree_map(jnp.asarray, s) for s in singles]
+    for _ in range(6):
+        fstate = F.fleet_step(fstate, cfg)
+        singles = [E.step(s, cfg) for s in singles]
+    for i in range(2):
+        rep = S.index_state(fstate, i)
+        for f in TRACE_FIELDS + ("tele_row",):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(rep, f)),
+                np.asarray(getattr(singles[i], f)),
+                err_msg=f"replica {i} {f}")
+        np.testing.assert_array_equal(
+            np.asarray(S.index_state(fstate, i).stats.trace_delivered),
+            np.asarray(singles[i].stats.trace_delivered))
+    band = F.band_snapshot(fstate, cfg)
+    covs = [int(np.sum((np.asarray(s.trace_first)[:, 0] != 0)
+                       & np.asarray(s.alive)
+                       & ~np.asarray(s.is_tracker))) for s in singles]
+    assert band["trace_cov_0"]["sum"] == sum(covs)
+    assert band["trace_cov_0"]["min"] == min(covs)
+
+
+# ---- the committed golden chaos run ------------------------------------
+
+GOLDEN_CFG = CommunityConfig(
+    n_peers=40, n_trackers=2, msg_capacity=48, bloom_capacity=16,
+    k_candidates=8, request_inbox=4, tracker_inbox=16,
+    response_budget=4, push_inbox=8, packet_loss=0.05,
+    trace=TraceConfig(enabled=True, tracked_slots=2),
+    telemetry=TelemetryConfig(enabled=True, history=32),
+    faults=FaultModel(ge_p_bad=0.1, ge_p_good=0.4, ge_loss_good=0.02,
+                      ge_loss_bad=0.5, dup_rate=0.1, corrupt_rate=0.05,
+                      flood_senders=(9,), flood_fanout=8))
+GOLDEN_ROUNDS = 20
+
+
+def _golden_setup():
+    """(creates, tracks) the golden run applies before its rounds."""
+    return ((5, 42), (7, 43))
+
+
+def golden_trace_log() -> metrics.MetricsLog:
+    """The committed artifacts/golden_trace.json run, regenerated
+    deterministically (fixed seed, fixed config)."""
+    cfg = GOLDEN_CFG
+    state = S.init_state(cfg, jax.random.PRNGKey(11))
+    state = E.seed_overlay(state, cfg, degree=6)
+    for author, payload in _golden_setup():
+        mask = np.arange(cfg.n_peers) == author
+        state = E.create_messages(
+            state, cfg, jnp.asarray(mask), meta=1,
+            payload=jnp.full(cfg.n_peers, payload, jnp.uint32))
+        state, _ = E.track_record(state, cfg, author,
+                                  int(state.global_time[author]))
+    log = metrics.MetricsLog(meta={"n_peers": cfg.n_peers,
+                                   "rounds": GOLDEN_ROUNDS})
+    state = E.multi_step(state, cfg, GOLDEN_ROUNDS)
+    log.extend_from_ring(jax.block_until_ready(state), cfg)
+    return log
+
+
+def test_golden_trace_gate(tmp_path):
+    """Re-run the committed golden chaos scenario and gate BOTH the
+    coverage curve and the derived dissemination summary (coverage
+    latches, channel shares, redundancy) against
+    artifacts/golden_trace.json via the CLI (gate --trace) — the
+    acceptance pin: rounds-to-90%-coverage, per-channel delivery
+    shares, and the redundancy ratio are contract numbers."""
+    log = golden_trace_log()
+    path = str(tmp_path / "run.json")
+    log.dump(path)
+    out = subprocess.run(
+        [sys.executable, "tools/telemetry.py", "gate", path,
+         "artifacts/golden_trace.json", "--key", "trace_cov_0",
+         "--rtol", "0", "--atol", "0", "--min-rounds", "15",
+         "--trace"],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "dissemination summary" in out.stdout
+    # the golden summary really reports the headline quantities
+    golden = json.load(open("/root/repo/artifacts/golden_trace.json"))
+    rep = trp.trace_report(golden["rounds"])
+    assert rep["slot0_r90"] > 0 and rep["slot1_r90"] > 0
+    assert rep["redundancy"] > 1.0
+    assert 0.0 < rep["share_push"] < 1.0
+    assert rep["share_flood"] == 0.0
+    # and the tools/trace.py CLI renders every report form
+    for args, needle in ((["report", path], "redundancy"),
+                         (["coverage", path], "slot 0"),
+                         (["latency", path, "--slot", "0"], "p90"),
+                         (["channels", path], "walk_sync"),
+                         (["redundancy", path], "dup_total")):
+        out = subprocess.run(
+            [sys.executable, "tools/trace.py"] + args,
+            capture_output=True, text=True, cwd="/root/repo")
+        assert out.returncode == 0, (args, out.stdout + out.stderr)
+        assert needle in out.stdout, (args, out.stdout)
+
+
+def test_golden_trace_oracle_bit_exact():
+    """The oracle reproduces the committed golden run's trace words —
+    coverage counts, latches, channel totals, redundancy — bit-exactly
+    (the acceptance criterion's oracle half)."""
+    cfg = GOLDEN_CFG
+    state = S.init_state(cfg, jax.random.PRNGKey(11))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    oracle.seed_overlay(degree=6)
+    gts = {5: 0, 7: 0}
+    for author, payload in _golden_setup():
+        mask = np.arange(cfg.n_peers) == author
+        oracle.create_messages(mask, meta=1,
+                               payload=np.full(cfg.n_peers, payload,
+                                               np.uint32))
+        gts[author] = oracle.peers[author].global_time
+        oracle.track_record(author, gts[author])
+    for _ in range(GOLDEN_ROUNDS):
+        oracle.step()
+    rows = tlm.ring_rows(oracle.tele_ring, cfg)
+    golden = json.load(open("/root/repo/artifacts/golden_trace.json"))
+    want = {r["round"]: r for r in golden["rounds"]}
+    assert len(rows) == len(want)
+    trace_keys = [k for k in rows[0] if k.startswith("trace_")]
+    assert trace_keys
+    for row in rows:
+        ref = want[row["round"]]
+        for k in trace_keys:
+            assert row[k] == ref[k], (row["round"], k)
+    assert trp.trace_report(rows) == trp.trace_report(golden["rounds"])
+
+
+# ---- ledger -------------------------------------------------------------
+
+
+def test_ledger_has_trace_cells():
+    """The committed cost ledger carries the +trace plane cell for both
+    shapes, with budgets, and the trace cell prices above its telemetry
+    base (the lineage folds + row growth are real work)."""
+    from dispersy_tpu import costmodel
+    ledger = costmodel.load_ledger("/root/repo/artifacts/cost_ledger.json")
+    for shape in ("1M_tpu", "64k_cpu"):
+        cell = ledger["cells"][f"{shape}/trace"]
+        base = ledger["cells"][f"{shape}/telemetry"]
+        assert "bytes_accessed" in cell["budget"]
+        assert "flops" in cell["budget"]
+        assert cell["bytes_accessed"] > base["bytes_accessed"]
+    assert "trace" in costmodel.PLANES
+    cfg, replicas = costmodel.plane_config("64k_cpu", "trace")
+    assert replicas == 1 and cfg.trace.enabled

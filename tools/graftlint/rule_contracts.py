@@ -28,7 +28,7 @@ OPS_PACKAGE = "dispersy_tpu.ops"
 # decorators and checker — its public surface is not ops).
 OPS_MODULES = ("bloom", "candidates", "faults", "fleet", "hashing",
                "inbox", "intake", "overload", "recovery", "rng",
-               "store", "telemetry", "timeline")
+               "store", "telemetry", "timeline", "trace")
 
 
 def public_functions(mod):

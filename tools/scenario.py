@@ -39,6 +39,7 @@ from dispersy_tpu.config import CommunityConfig
 
 EVENT_TYPES = {
     "create": S.Create,
+    "track_record": S.TrackRecord,
     "signature_request": S.SignatureRequest,
     "authorize": S.Authorize,
     "revoke": S.Revoke,
@@ -77,11 +78,15 @@ def load(path: str) -> tuple[CommunityConfig, S.Scenario]:
     from dispersy_tpu.faults import FaultModel
     from dispersy_tpu.overload import OverloadConfig
     from dispersy_tpu.recovery import RecoveryConfig
+    from dispersy_tpu.storediet import StoreConfig
     from dispersy_tpu.telemetry import TelemetryConfig
+    from dispersy_tpu.traceplane import TraceConfig
     _sub("faults", FaultModel)
     _sub("overload", OverloadConfig)
     _sub("recovery", RecoveryConfig)
+    _sub("store", StoreConfig)
     _sub("telemetry", TelemetryConfig)
+    _sub("trace", TraceConfig)
     cfg = CommunityConfig(**ckw)
     events = []
     for e in doc.get("events", ()):

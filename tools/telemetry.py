@@ -16,7 +16,7 @@ binary log (``dispersy_tpu/binlog.py``, DTPL magic) — and:
         trace-comparison harness for "did this change behavior?").
     python tools/telemetry.py gate run.json golden.json --key cov_post
                                   [--rtol R] [--atol A] [--min-rounds N]
-                                  [--recovery]
+                                  [--recovery] [--overload] [--trace]
         regression gate against a committed golden curve: the run's
         curve must track the golden one point-for-point within
         tolerance over their shared rounds.  Exit 2 on regression —
@@ -209,6 +209,32 @@ def _mttr_summary(meta: dict, rows: list,
     return mttr_report(rows, n_peers=int(n_peers) if n_peers else None)
 
 
+def _gate_summary(label: str, ok_line: str, sa: dict, sg: dict,
+                  args) -> int:
+    """Hold a run's derived summary dict to the golden one,
+    field-for-field within the gate tolerances (the shared body of
+    --overload / --trace / --recovery; None-vs-None agrees).  Returns
+    the exit code (0 ok, 2 regressed)."""
+    bad = []
+    for k in sorted(set(sa) | set(sg)):
+        va, vg = sa.get(k), sg.get(k)
+        if va is None and vg is None:
+            continue
+        if not (isinstance(va, (int, float))
+                and isinstance(vg, (int, float))
+                and _within(va, vg, args.rtol, args.atol)):
+            bad.append((k, va, vg))
+    if bad:
+        print(f"gate: {label} summary REGRESSED vs {args.golden} "
+              f"on {len(bad)} field(s):")
+        for k, va, vg in bad[:12]:
+            print(f"  {k}: run={_fmt(va) if va is not None else None}"
+                  f" golden={_fmt(vg) if vg is not None else None}")
+        return 2
+    print(f"gate: {ok_line} ({len(sa)} fields)")
+    return 0
+
+
 def cmd_gate(args) -> int:
     meta_a, rows = load_rows(args.run)
     meta_g, gold = load_rows(args.golden)
@@ -240,24 +266,26 @@ def cmd_gate(args) -> int:
         # buckets, flagged mass) must agree field-for-field within the
         # tolerances over the SHARED rounds.
         from dispersy_tpu.overload import shed_report
-        sa = shed_report([a[r] for r in shared])
-        sg = shed_report([g[r] for r in shared])
-        bad = []
-        for k in sorted(set(sa) | set(sg)):
-            va, vg = sa.get(k), sg.get(k)
-            if not (isinstance(va, (int, float))
-                    and isinstance(vg, (int, float))
-                    and _within(va, vg, args.rtol, args.atol)):
-                bad.append((k, va, vg))
-        if bad:
-            print(f"gate: overload summary REGRESSED vs {args.golden} "
-                  f"on {len(bad)} field(s):")
-            for k, va, vg in bad[:12]:
-                print(f"  {k}: run={_fmt(va) if va is not None else None}"
-                      f" golden={_fmt(vg) if vg is not None else None}")
-            return 2
-        print(f"gate: overload shed summary tracks the golden one "
-              f"({len(sa)} fields)")
+        rc = _gate_summary(
+            "overload", "overload shed summary tracks the golden one",
+            shed_report([a[r] for r in shared]),
+            shed_report([g[r] for r in shared]), args)
+        if rc:
+            return rc
+    if args.trace:
+        # The dissemination-tracing gate (--trace): both logs' derived
+        # trace summaries (traceplane.trace_report — per-slot coverage
+        # + rounds-to-{50,90,99}% latches, per-channel delivery totals
+        # and shares, redundancy ratio) must agree field-for-field
+        # within the tolerances over the SHARED rounds.
+        from dispersy_tpu.traceplane import trace_report
+        rc = _gate_summary(
+            "trace",
+            "trace dissemination summary tracks the golden one",
+            trace_report([a[r] for r in shared]),
+            trace_report([g[r] for r in shared]), args)
+        if rc:
+            return rc
     if args.recovery:
         # The MTTR/availability gate: both logs' derived recovery
         # summaries must agree field-for-field within the tolerances
@@ -269,26 +297,14 @@ def cmd_gate(args) -> int:
         # scenario), so a log dumped without meta cannot fail the gate
         # on a missing-availability artifact.
         n_peers = meta_a.get("n_peers") or meta_g.get("n_peers")
-        sa = _mttr_summary(meta_a, [a[r] for r in shared], n_peers)
-        sg = _mttr_summary(meta_g, [g[r] for r in shared], n_peers)
-        bad = []
-        for k in sorted(set(sa) | set(sg)):
-            va, vg = sa.get(k), sg.get(k)
-            if va is None and vg is None:
-                continue
-            if not (isinstance(va, (int, float))
-                    and isinstance(vg, (int, float))
-                    and _within(va, vg, args.rtol, args.atol)):
-                bad.append((k, va, vg))
-        if bad:
-            print(f"gate: recovery summary REGRESSED vs {args.golden} "
-                  f"on {len(bad)} field(s):")
-            for k, va, vg in bad[:12]:
-                print(f"  {k}: run={_fmt(va) if va is not None else None}"
-                      f" golden={_fmt(vg) if vg is not None else None}")
-            return 2
-        print(f"gate: recovery MTTR/availability summary tracks the "
-              f"golden one ({len(sa)} fields)")
+        rc = _gate_summary(
+            "recovery",
+            "recovery MTTR/availability summary tracks the golden one",
+            _mttr_summary(meta_a, [a[r] for r in shared], n_peers),
+            _mttr_summary(meta_g, [g[r] for r in shared], n_peers),
+            args)
+        if rc:
+            return rc
     print(f"gate: {args.key} tracks the golden curve over "
           f"{len(shared)} rounds (rtol={args.rtol}, atol={args.atol})")
     return 0
@@ -335,6 +351,10 @@ def main(argv=None) -> int:
                    help="additionally gate the derived ingress-"
                         "protection shed summary "
                         "(overload.shed_report)")
+    p.add_argument("--trace", action="store_true",
+                   help="additionally gate the derived dissemination "
+                        "summary (traceplane.trace_report: coverage "
+                        "latches, channel shares, redundancy)")
     p.set_defaults(fn=cmd_gate)
     p = sub.add_parser("mttr",
                        help="recovery-plane MTTR/availability summary")

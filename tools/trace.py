@@ -1,0 +1,161 @@
+"""Dissemination-tracing reports over telemetry run logs (the offline
+half of the trace plane — dispersy_tpu/traceplane.py; OBSERVABILITY.md
+"Dissemination tracing").
+
+Reads any of the repo's three log forms (MetricsLog JSON / JSONL /
+DTPL binary — tools/telemetry.py load_rows) whose rows carry the trace
+plane's conditional words (``trace_cov_<k>`` / ``trace_r{50,90,99}_<k>``
+/ ``trace_delivered_<ch>`` / ``trace_dup_<ch>`` / ``trace_redundancy``):
+
+    python tools/trace.py report run.json
+        the full trace_report summary as JSON — per-slot final
+        coverage + rounds-to-{50,90,99}% latches, per-channel
+        delivered/dup totals and shares, redundancy ratio (the same
+        summary ``tools/telemetry.py gate --trace`` holds to the
+        committed artifacts/golden_trace.json).
+    python tools/trace.py coverage run.json [--slot K]
+        per-round coverage curves (count / alive fraction) with an
+        ASCII sparkline per tracked slot.
+    python tools/trace.py latency run.json [--slot K] [--pcts 50,90,99]
+        first-arrival latency percentiles in rounds after the record's
+        first appearance, derived from the coverage curve (the p-th
+        latency percentile is the first round coverage reaches p% of
+        the alive members).
+    python tools/trace.py channels run.json
+        the channel-attribution table: useful deliveries, duplicates,
+        and useful-delivery share per channel (create / walk_sync /
+        push / flood — flood is structurally zero under the junk-flood
+        wire model, FAULTS.md; printing it keeps the zero measured).
+    python tools/trace.py redundancy run.json
+        duplicate-delivery accounting: per-channel dup counts, the
+        overlay-wide redundancy ratio, and dup-per-useful by channel.
+
+Exit codes: 0 ok, 1 IO/value error, 2 no trace data in the log (and,
+per argparse, 2 for malformed invocations).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dispersy_tpu import traceplane as trp  # noqa: E402
+from tools.telemetry import load_rows, sparkline  # noqa: E402
+
+
+def _rows_or_die(path: str):
+    _, rows = load_rows(path)
+    if not trp.slots_in_rows(rows):
+        print(f"trace: {path} carries no trace_cov_* words — was the "
+              "run's config trace.enabled?", file=sys.stderr)
+        raise SystemExit(2)
+    return rows
+
+
+def cmd_report(args) -> int:
+    rows = _rows_or_die(args.path)
+    print(json.dumps(trp.trace_report(rows), indent=1))
+    return 0
+
+
+def cmd_coverage(args) -> int:
+    rows = _rows_or_die(args.path)
+    slots = [args.slot] if args.slot is not None \
+        else trp.slots_in_rows(rows)
+    for k in slots:
+        curve = trp.coverage_curve(rows, k)
+        if not curve:
+            print(f"slot {k}: no data")
+            continue
+        fracs = [cov / alive if alive else 0.0
+                 for _, cov, alive in curve]
+        rnd0, rnd1 = curve[0][0], curve[-1][0]
+        print(f"slot {k}: rounds {rnd0}..{rnd1}  "
+              f"final {curve[-1][1]}/{curve[-1][2]} "
+              f"({fracs[-1]:.3f})  {sparkline(fracs)}")
+        if args.table:
+            for rnd, cov, alive in curve:
+                print(f"  round {rnd:5d}  {cov:6d}/{alive}")
+    return 0
+
+
+def cmd_latency(args) -> int:
+    rows = _rows_or_die(args.path)
+    pcts = tuple(int(p) for p in args.pcts.split(","))
+    slots = [args.slot] if args.slot is not None \
+        else trp.slots_in_rows(rows)
+    out = {f"slot{k}": trp.latency_percentiles(rows, k, pcts)
+           for k in slots}
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def cmd_channels(args) -> int:
+    rows = _rows_or_die(args.path)
+    tab = trp.channel_table(rows)
+    print(f"{'channel':<10} {'useful':>8} {'dup':>8} {'share':>7}")
+    for nm in trp.CHANNEL_NAMES:
+        print(f"{nm:<10} {tab[f'delivered_{nm}']:>8} "
+              f"{tab[f'dup_{nm}']:>8} {tab[f'share_{nm}']:>7.3f}")
+    print(f"{'total':<10} {tab['delivered_total']:>8}")
+    return 0
+
+
+def cmd_redundancy(args) -> int:
+    rows = _rows_or_die(args.path)
+    tab = trp.channel_table(rows)
+    last = max(rows, key=lambda r: int(r.get("round", 0)))
+    out = {"redundancy": float(last.get("trace_redundancy", 0.0)),
+           "useful_total": tab["delivered_total"],
+           "dup_total": sum(tab[f"dup_{nm}"]
+                            for nm in trp.CHANNEL_NAMES)}
+    for nm in trp.CHANNEL_NAMES:
+        d, u = tab[f"dup_{nm}"], tab[f"delivered_{nm}"]
+        out[f"dup_{nm}"] = d
+        out[f"dup_per_useful_{nm}"] = round(d / u, 4) if u else None
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tools/trace.py",
+                                 description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("report", help="full trace summary (JSON)")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_report)
+    p = sub.add_parser("coverage", help="per-slot coverage curves")
+    p.add_argument("path")
+    p.add_argument("--slot", type=int, default=None)
+    p.add_argument("--table", action="store_true",
+                   help="print every round, not just the sparkline")
+    p.set_defaults(fn=cmd_coverage)
+    p = sub.add_parser("latency",
+                       help="first-arrival latency percentiles")
+    p.add_argument("path")
+    p.add_argument("--slot", type=int, default=None)
+    p.add_argument("--pcts", default="10,25,50,75,90,99")
+    p.set_defaults(fn=cmd_latency)
+    p = sub.add_parser("channels", help="channel-attribution table")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_channels)
+    p = sub.add_parser("redundancy",
+                       help="duplicate-delivery accounting")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_redundancy)
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except SystemExit as e:
+        return int(e.code or 0)
+    except (OSError, ValueError) as e:
+        print(f"trace: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,21 +119,24 @@ from dispersy_tpu.telemetry import TelemetryConfig
 #     archive's FULL-width auth/mal/sig/stats leaves for a plane the
 #     config compiles out are CRC-verified, asserted empty, and sized
 #     down (_resize_plane_leaf).
-FORMAT_VERSION = 15  # v15: the dissemination-tracing leaves (the
+FORMAT_VERSION = 16  # v16: the parallel plane (the cross-shard shed
+#     counter ``stats/xshard_shed``, knob-sized — the ragged-exchange
+#     backpressure stream of dispersy_tpu/shardplane.py; PARALLEL.md).
+#     v7-v15 archives still load: the missing counter defaults to the
+#     template's (zero-width) value and their config fingerprint
+#     predates the ``parallel`` field (declared seventh-to-last,
+#     directly before ``trace``) — restoring one under a non-default
+#     ParallelConfig is refused (_want_fingerprint strips the
+#     ``parallel=...`` repr component first, then the older planes').
+#     v15: the dissemination-tracing leaves (the
 #     trace_member/trace_gt key registry, per-peer trace_first/
 #     trace_chan/trace_dups lineage, the trace_latch coverage
 #     percentiles, and the stats trace_delivered/trace_dup channel
 #     counters, knob-sized — dispersy_tpu/traceplane.py;
-#     OBSERVABILITY.md "Dissemination tracing").  v7-v14 archives
-#     still load: their missing trace leaves default to the template's
-#     (zero-width) values and their config fingerprint predates the
-#     ``trace`` field (declared sixth-to-last, directly before
-#     ``store``) — restoring one under a non-default TraceConfig is
-#     refused (_want_fingerprint strips the ``trace=...`` repr
-#     component first, then the older planes').  v11-v14 FLEET
+#     OBSERVABILITY.md "Dissemination tracing").  v11-v15 FLEET
 #     archives load through ``restore_fleet`` the same way.
-_ACCEPTED_VERSIONS = (7, 8, 9, 10, 11, 12, 13, 14, FORMAT_VERSION)
-_FLEET_VERSIONS = (11, 12, 13, 14, FORMAT_VERSION)
+_ACCEPTED_VERSIONS = (7, 8, 9, 10, 11, 12, 13, 14, 15, FORMAT_VERSION)
+_FLEET_VERSIONS = (11, 12, 13, 14, 15, FORMAT_VERSION)
 
 # Leaves whose dtype narrowed u32 -> u8 at v8; a v7 archive's u32 arrays
 # convert by truncation (0xFFFFFFFF -> 0xFF, real values < 256 unchanged).
@@ -181,6 +184,11 @@ _NEW_V15 = frozenset(
     {"trace_member", "trace_gt", "trace_first", "trace_chan",
      "trace_dups", "trace_latch",
      "stats/trace_delivered", "stats/trace_dup"})
+
+# Leaves that did not exist before v16 (the parallel plane).  Older
+# archives only restore under a default ParallelConfig (enforced by
+# _want_fingerprint), where this counter is zero-width.
+_NEW_V16 = frozenset({"stats/xshard_shed"})
 
 # Leaves v14 PLANE-SIZED (zero-width when their community feature is
 # compiled out — state.py init_state / stats_gates): a pre-v14 archive
@@ -269,15 +277,30 @@ def _want_fingerprint(cfg: CommunityConfig, version: int) -> str:
     before ``faults`` (declared LAST) — every repr component strips
     cleanly, but only default models can possibly match what the old
     writer simulated."""
-    if version >= 15:
+    if version >= 16:
         return _fingerprint(cfg)
+    from dispersy_tpu.shardplane import ParallelConfig
+    if cfg.parallel != ParallelConfig():
+        raise CheckpointError(
+            f"checkpoint format {version} predates the parallel plane; "
+            "it can only restore under the default ParallelConfig "
+            "(cfg.parallel must be ParallelConfig())")
+    full16 = repr(cfg)
+    pcomp = f", parallel={cfg.parallel!r}"
+    if full16.count(pcomp) != 1:
+        raise CheckpointError(
+            "cannot derive pre-v16 fingerprint: parallel is no longer "
+            "a direct config field directly before trace")
+    full16 = full16.replace(pcomp, "", 1)
+    if version >= 15:
+        return full16
     from dispersy_tpu.traceplane import TraceConfig
     if cfg.trace != TraceConfig():
         raise CheckpointError(
             f"checkpoint format {version} predates the dissemination-"
             "tracing plane; it can only restore under the default "
             "TraceConfig (cfg.trace must be TraceConfig())")
-    full = repr(cfg)
+    full = full16
     trcomp = f", trace={cfg.trace!r}"
     if full.count(trcomp) != 1:
         raise CheckpointError(
@@ -454,7 +477,8 @@ def restore(path: str, cfg: CommunityConfig,
                         or (version < 12 and n in _NEW_V12) \
                         or (version < 13 and n in _NEW_V13) \
                         or (version < 14 and n in _NEW_V14) \
-                        or (version < 15 and n in _NEW_V15):
+                        or (version < 15 and n in _NEW_V15) \
+                        or (version < 16 and n in _NEW_V16):
                     # pre-chaos-harness / pre-telemetry / pre-recovery
                     # / pre-overload / pre-byte-diet archive: the leaf
                     # starts at its template default (zero-width /
@@ -575,7 +599,8 @@ def restore_fleet(path: str, cfg: CommunityConfig):
                     if (version < 12 and n in _NEW_V12) \
                             or (version < 13 and n in _NEW_V13) \
                             or (version < 14 and n in _NEW_V14) \
-                            or (version < 15 and n in _NEW_V15):
+                            or (version < 15 and n in _NEW_V15) \
+                            or (version < 16 and n in _NEW_V16):
                         # pre-recovery / pre-overload / pre-byte-diet
                         # fleet archive: only accepted under the
                         # default Recovery/Overload/StoreConfig
@@ -731,8 +756,17 @@ def save_sharded(dirpath: str, state: PeerState,
             "meta:config": np.frombuffer(_fingerprint(cfg).encode(),
                                          dtype=np.uint8)}
     per_dev: dict[int, dict] = {}
+    from dispersy_tpu.parallel import partition_kind
     for name, leaf in zip(names, leaves):
-        peer_sharded = (hasattr(leaf, "addressable_shards")
+        # The partition-rule registry (parallel/mesh.py) decides the
+        # shard-vs-meta split by leaf NAME — the old shape heuristic
+        # (leading dim == n_peers) would misfile a replicated leaf
+        # whose width happens to equal n_peers (e.g. trace_member at
+        # n_peers == tracked_slots).  Zero-width plane leaves and
+        # host-side saves (no addressable_shards) stay in meta.npz:
+        # there is nothing to split.
+        peer_sharded = (partition_kind(name) == "peers"
+                        and hasattr(leaf, "addressable_shards")
                         and getattr(leaf, "ndim", 0) >= 1
                         and leaf.shape[0] == n and n > 2)
         if not peer_sharded:
@@ -832,7 +866,8 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
               or (version < 12 and name in _NEW_V12)
               or (version < 13 and name in _NEW_V13)
               or (version < 14 and name in _NEW_V14)
-              or (version < 15 and name in _NEW_V15)) \
+              or (version < 15 and name in _NEW_V15)
+              or (version < 16 and name in _NEW_V16)) \
                 and not covered[name].any():
             # pre-chaos-harness / pre-telemetry archive: template
             # default (state.py)

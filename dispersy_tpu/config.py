@@ -22,6 +22,7 @@ from dispersy_tpu.exceptions import ConfigError
 from dispersy_tpu.faults import FaultModel
 from dispersy_tpu.overload import OverloadConfig
 from dispersy_tpu.recovery import RecoveryConfig
+from dispersy_tpu.shardplane import ParallelConfig
 from dispersy_tpu.storediet import StoreConfig
 from dispersy_tpu.telemetry import MAX_TELEMETRY_PEERS, TelemetryConfig
 from dispersy_tpu.traceplane import TraceConfig
@@ -514,6 +515,19 @@ class CommunityConfig:
     # -1 = auto: the first non-tracker peer (index n_trackers).
     founder_member: int = -1
 
+    # ---- parallel plane (dispersy_tpu/shardplane.py: shard-count +
+    #      cross-shard exchange budget + chunked bloom scatters for the
+    #      sharding-clean multichip step; PARALLEL.md).  All defaults
+    #      compile to exactly the legacy single-device step.  MUST stay
+    #      the SEVENTH-TO-LAST field, directly before ``trace`` (then
+    #      ``store``, ``overload``, ``recovery``, ``telemetry``,
+    #      ``faults``): checkpoint.py reconstructs pre-v16 config
+    #      fingerprints by stripping the trailing ``parallel=...`` repr
+    #      component (then ``trace=`` pre-v15, ``store=`` pre-v14,
+    #      ``overload=`` pre-v13, ``recovery=`` pre-v12, ``telemetry=``
+    #      pre-v10, ``faults=`` pre-v9). ----
+    parallel: ParallelConfig = ParallelConfig()
+
     # ---- dissemination-tracing plane (dispersy_tpu/traceplane.py:
     #      on-device record lineage — per-peer first-arrival rounds,
     #      first-delivery channel codes, duplicate-delivery counters,
@@ -956,6 +970,14 @@ class CommunityConfig:
             raise ConfigError(
                 "recovery.enabled maps latched health-sentinel bits to "
                 "repair actions — it requires faults.health_checks=True")
+        pl = self.parallel
+        if not isinstance(pl, ParallelConfig):
+            raise ConfigError("parallel must be a ParallelConfig")
+        if pl.shards > 1 and self.n_peers % pl.shards != 0:
+            raise ConfigError(
+                f"parallel.shards={pl.shards} must divide n_peers "
+                f"({self.n_peers}): the ragged exchange addresses "
+                "destination shards as key // (n_peers // shards)")
         tl = self.telemetry
         if not isinstance(tl, TelemetryConfig):
             raise ConfigError("telemetry must be a TelemetryConfig")

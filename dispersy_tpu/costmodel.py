@@ -68,6 +68,14 @@ SHAPES = {
 }
 PLANES = ("default", "telemetry", "trace", "faults_health", "recovery",
           "overload", "fleet_r2")
+# Sharded cells: a third cell component naming a mesh shape.  A mesh
+# cell prices the SAME fused round compiled peer-sharded over that mesh
+# (profiling.sharded_step_cost_amortized — the zero-SPMD-warning HLO the
+# tier-1 gate pins), keyed "shape/plane/meshN".  Under the explicit
+# partition rules the peer axis splits the state evenly, so the cell
+# additionally records bytes_per_chip_round = bytes / chips — ROADMAP
+# item 2's "per-chip bytes ~ bytes/8" as a gated number.
+MESHES = {"mesh8": 8}
 LEDGER_PATH = "artifacts/cost_ledger.json"
 LEDGER_SCHEMA = 1
 
@@ -257,23 +265,40 @@ def roofline(cost_bytes: float, floor_bytes: float,
     return out
 
 
-def cell_cost(shape: str, plane: str) -> dict:
+def cell_cost(shape: str, plane: str, mesh: str | None = None) -> dict:
     """One ledger cell: cost-analyze the REAL fused step (or vmapped
-    fleet step) at the cell's config; abstract shapes only, so the 1M
-    cells run on any host."""
+    fleet step, or the peer-sharded step when ``mesh`` names a
+    :data:`MESHES` entry) at the cell's config; abstract shapes only,
+    so the 1M cells run on any host (mesh cells need the virtual-device
+    count, tools/ledger.py's cpu_env(8))."""
     from dispersy_tpu import profiling
 
     cfg, replicas = plane_config(shape, plane)
-    cost = (profiling.fleet_step_cost_amortized(cfg, replicas)
-            if replicas > 1 else profiling.step_cost_amortized(cfg))
+    if mesh is not None:
+        if replicas > 1:
+            raise ValueError("mesh cells price the single-community "
+                             "sharded step; fleet planes have no mesh "
+                             "variant")
+        cost = profiling.sharded_step_cost_amortized(cfg, MESHES[mesh])
+    else:
+        cost = (profiling.fleet_step_cost_amortized(cfg, replicas)
+                if replicas > 1 else profiling.step_cost_amortized(cfg))
     sb = state_byte_report(cfg)
     fl = active_floor(cfg)
     n = cfg.n_peers
+    chips = 1
+    if mesh is not None:
+        d = MESHES[mesh]
+        chips = int(math.prod(d)) if isinstance(d, tuple) else int(d)
     cell = {
         "shape": shape,
         "plane": plane,
         "n_peers": n,
         "replicas": replicas,
+        **({"mesh": mesh, "chips": chips,
+            "bytes_per_chip_round": round(
+                cost["bytes_accessed"] / chips, 1)}
+           if mesh is not None else {}),
         # Cadence-amortized mean over one compaction window for
         # byte-diet configs (profiling.step_cost_amortized); the plain
         # per-round cost otherwise.  The quiet/sync split is recorded
@@ -326,12 +351,14 @@ def shape_phases(shape: str) -> dict:
     return out
 
 
-def cell_key(shape: str, plane: str) -> str:
-    return f"{shape}/{plane}"
+def cell_key(shape: str, plane: str, mesh: str | None = None) -> str:
+    return (f"{shape}/{plane}/{mesh}" if mesh else f"{shape}/{plane}")
 
 
 def default_cells() -> list:
-    return [(s, p) for s in SHAPES for p in PLANES]
+    cells = [(s, p) for s in SHAPES for p in PLANES]
+    cells.append(("1M_tpu", "default", "mesh8"))
+    return cells
 
 
 def build_ledger(cells=None, with_phases: bool = True,
@@ -353,7 +380,7 @@ def build_ledger(cells=None, with_phases: bool = True,
         "shapes": {},
         "cells": {},
     }
-    for shape in sorted({s for s, _ in cells}):
+    for shape in sorted({c[0] for c in cells}):
         if with_phases:
             if progress:
                 progress(f"[ledger] phases @ {shape}")
@@ -362,10 +389,13 @@ def build_ledger(cells=None, with_phases: bool = True,
                 "platform_shape": SHAPES[shape][1],
                 "phases": shape_phases(shape),
             }
-    for shape, plane in cells:
+    for cell in cells:
+        shape, plane = cell[0], cell[1]
+        mesh = cell[2] if len(cell) > 2 else None
         if progress:
-            progress(f"[ledger] cell {cell_key(shape, plane)}")
-        doc["cells"][cell_key(shape, plane)] = cell_cost(shape, plane)
+            progress(f"[ledger] cell {cell_key(shape, plane, mesh)}")
+        doc["cells"][cell_key(shape, plane, mesh)] = cell_cost(
+            shape, plane, mesh)
     return doc
 
 

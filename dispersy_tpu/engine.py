@@ -76,6 +76,7 @@ from dispersy_tpu.ops import faults as flt
 from dispersy_tpu.ops import intake as ik
 from dispersy_tpu.ops import overload as ovl
 from dispersy_tpu.ops import recovery as rcv
+from dispersy_tpu.parallel import mesh as par
 from dispersy_tpu.recovery import NUM_HEALTH_BITS
 from dispersy_tpu import storediet as sdiet
 from dispersy_tpu import traceplane as trp
@@ -460,6 +461,34 @@ def _priority_vec(cfg: CommunityConfig, meta: jnp.ndarray) -> jnp.ndarray:
                      jnp.where(meta == jnp.uint32(META_IDENTITY),
                                jnp.uint32(IDENTITY_PRIORITY),
                                jnp.uint32(CONTROL_PRIORITY)))
+
+
+def _deliver(cfg: CommunityConfig, *, dst, cols, valid, n_peers, inbox_size,
+             cls=None, need_receipts=True, capped=False):
+    """Route one full-population delivery through the kernel the config
+    asks for: the global ``lax.sort`` scatter when the parallel plane is
+    off (``parallel.shards <= 1``), the shard-local ragged exchange
+    (:func:`dispersy_tpu.ops.inbox.deliver_ragged`) when it is on.
+
+    ``capped=True`` marks the one channel that rides the capped exchange
+    (the push blast — the only channel whose edge count is
+    sender-chosen, so the only one a flooder can use to blow up the
+    cross-shard buffers); every other channel's worst case is bounded by
+    config shapes and uses the exact (budget=0, never-sheds) exchange.
+    Returns ``(Delivery, shed)`` where ``shed`` is the bool[E]
+    sender-side overflow stream (None unless the cap is armed).
+    """
+    pp = cfg.parallel
+    if pp.shards <= 1:
+        return inbox.deliver(dst=dst, cols=cols, valid=valid,
+                             n_peers=n_peers, inbox_size=inbox_size,
+                             cls=cls), None
+    budget = pp.cross_shard_budget if capped else 0
+    rd = inbox.deliver_ragged(dst=dst, cols=cols, valid=valid,
+                              n_peers=n_peers, inbox_size=inbox_size,
+                              shards=pp.shards, budget=budget, cls=cls,
+                              need_receipts=need_receipts)
+    return rd.delivery, (rd.shed if budget > 0 else None)
 
 
 # DynamicResolution flip replay: one definition (ops/intake.flip_best)
@@ -1073,8 +1102,9 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
             rec_probes = bloom.probe_bits(rec_h, cfg.bloom_bits,
                                           cfg.bloom_hashes, salt=rnd)
             with jax.named_scope("bloom_build"):
-                my_bloom = bloom.bloom_build_from(rec_probes, in_slice,
-                                                  cfg.bloom_bits)
+                my_bloom = bloom.bloom_build_from(
+                    rec_probes, in_slice, cfg.bloom_bits,
+                    chunks=cfg.parallel.scatter_chunks)
         else:
             rec_probes = None
             with jax.named_scope("bloom_build"):
@@ -1247,10 +1277,28 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
         else:
             push_cls = None
         with jax.named_scope("deliver_push"):
-            push = inbox.deliver(
-                dst=jnp.concatenate(e_dst), cols=push_cols,
+            push, px_shed = _deliver(
+                cfg, dst=jnp.concatenate(e_dst), cols=push_cols,
                 valid=jnp.concatenate(e_valid), n_peers=n,
-                inbox_size=cfg.push_inbox, cls=push_cls)
+                inbox_size=cfg.push_inbox, cls=push_cls,
+                need_receipts=False, capped=True)
+        if px_shed is not None:
+            # cross_shard_budget overflow: shed edges left the sender's
+            # NIC (bytes_up already paid above) and died in the
+            # exchange — a modeled loss, attributed to the SENDER as
+            # backpressure (stats.xshard_shed), segment by segment.
+            sh = px_shed.astype(jnp.uint32)
+            off = 0
+            if cfg.forward_fanout > 0:
+                stats = stats.replace(
+                    xshard_shed=stats.xshard_shed
+                    + jnp.sum(sh[:n * f * c].reshape(n, f * c), axis=1))
+                off = n * f * c
+            if fm.flood_enabled:
+                stats = stats.replace(
+                    xshard_shed=stats.xshard_shed.at[fsrc].add(
+                        jnp.sum(sh[off:off + fl * ff].reshape(fl, ff),
+                                axis=1), mode="drop"))
         ph_gt, ph_member, ph_meta, ph_payload, ph_aux = push.inbox[:5]
         if fm.flood_enabled:
             ph_junk = push.inbox[-1]                              # bool[N, Q]
@@ -1348,8 +1396,8 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
     # byte-diet quiet round) the request is just (src, clock) — the
     # sync tuple would never be served, so it never rides the wire.
     with jax.named_scope("deliver_request"):
-        req = inbox.deliver(
-            dst=target,
+        req, _ = _deliver(
+            cfg, dst=target,
             cols=([idx.astype(jnp.uint32), sl.time_low, sl.time_high,
                    sl.modulo, sl.offset, gt_at_send, my_bloom]
                   if sync_on else [idx.astype(jnp.uint32), gt_at_send]),
@@ -1391,8 +1439,18 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
             dst=target, cols=[idx.astype(jnp.uint32), gt_at_send],
             valid=send_ok & to_tracker, n_peers=t, inbox_size=rt)
         tq_src, tq_gt = treq.inbox                           # [T, Rt]
-        tq_ok = treq.inbox_valid & act[:t][:, None]
-        tq_src_i = jnp.where(tq_ok, tq_src.astype(jnp.int32), NO_PEER)
+        # Partition-rule pin (parallel/mesh.py): the tracker-row
+        # tensors carry NO peer axis — without the explicit replication
+        # pin, SPMD partitioning picks a [8,1] layout for some of them
+        # and a [2,4] layout for others and bridges the two with
+        # involuntary full rematerializations (the exact warnings
+        # tests/test_ledger.py used to pin as PRESENT).  Identity when
+        # unsharded.
+        tq_src = par.pin_replicated(tq_src)
+        tq_gt = par.pin_replicated(tq_gt)
+        tq_ok = par.pin_replicated(treq.inbox_valid & act[:t][:, None])
+        tq_src_i = par.pin_replicated(
+            jnp.where(tq_ok, tq_src.astype(jnp.int32), NO_PEER))
 
         # Recent-contact ring in the tracker's candidate rows: up to K
         # stumbles per round land in rotating unique slots (a tracker's
@@ -1441,9 +1499,10 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
         intro_ring = cand.sample_introductions(
             ttab, now, cfg, seed, rnd, tidx, exclude=tq_src_i,
             salt_base=_TRACKER_INTRO_SALT,
-            req_sym=None if nat_sym is None else sym_of(tq_src_i),
+            req_sym=None if nat_sym is None
+            else par.pin_replicated(sym_of(tq_src_i)),
             slot_sym=None if nat_sym is None
-            else sym_of(ttab.peer))                          # [T, Rt]
+            else par.pin_replicated(sym_of(ttab.peer)))      # [T, Rt]
         # Under a bootstrap flash-crowd the tracker's richest candidate pool
         # is this round's own inbox: introduce requester s to another
         # requester j != s (both just proved their addresses by knocking).
@@ -1460,9 +1519,15 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
             # The inbox-introduction path is an introduction too: never
             # pair two symmetric-NAT requesters (fall through to the
             # filtered ring pick instead).
-            intro_inbox = jnp.where(sym_of(tq_src_i) & sym_of(intro_inbox),
-                                    NO_PEER, intro_inbox)
-        intro_t = jnp.where(intro_inbox != NO_PEER, intro_inbox, intro_ring)
+            # sym_of gathers from the peer-sharded nat_sym — pin the
+            # tracker-row result replicated like every [T, Rt] tensor
+            # here, or SPMD bridges the gather's layout with
+            # involuntary remats (MULTICHIP_r06 select/and warnings).
+            intro_inbox = jnp.where(
+                par.pin_replicated(sym_of(tq_src_i) & sym_of(intro_inbox)),
+                NO_PEER, intro_inbox)
+        intro_t = par.pin_replicated(
+            jnp.where(intro_inbox != NO_PEER, intro_inbox, intro_ring))
         global_time = global_time.at[:t].set(
             _fold_gt(global_time[:t], tq_gt, tq_ok,
                      cfg.acceptable_global_time_range))
@@ -1509,7 +1574,8 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
         salt_rt = jnp.arange(rt)[None, :] + _TRACKER_SALT
         tpr_lost = _lost(seed, rnd, tidx[:, None], _LOSS_PUNCTURE_REQ, salt_rt,
                          kn, ge_bad)
-        tpr_ok_send = tq_ok & (intro_t != NO_PEER) & ~tpr_lost
+        tpr_ok_send = par.pin_replicated(
+            tq_ok & (intro_t != NO_PEER) & ~tpr_lost)
         if fm.partitions:
             tpr_ok_send = tpr_ok_send & ~flt.partition_blocked(
                 jnp.broadcast_to(tidx[:, None], intro_t.shape), intro_t,
@@ -1518,10 +1584,10 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
         pr_target.append(tq_src_i.reshape(-1).astype(jnp.uint32))
         pr_valid.append(tpr_ok_send.reshape(-1))
 
-    punc_req = inbox.deliver(
-        dst=jnp.concatenate(pr_dst), cols=[jnp.concatenate(pr_target)],
+    punc_req, _ = _deliver(
+        cfg, dst=jnp.concatenate(pr_dst), cols=[jnp.concatenate(pr_target)],
         valid=jnp.concatenate(pr_valid), n_peers=n,
-        inbox_size=cfg.request_inbox)
+        inbox_size=cfg.request_inbox, need_receipts=False)
     (pq_target,) = punc_req.inbox                             # [N, P]
     arrivals = arrivals | jnp.any(punc_req.inbox_valid, axis=1)
     pq_ok = punc_req.inbox_valid & act[:, None]
@@ -1552,11 +1618,12 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
         # rare, this gate makes it impossible).
         pu_ok_send = pu_ok_send & ~(nat_sym[:, None] & sym_of(pq_target))
     pu_valid = pu_ok_send.reshape(-1)
-    punc = inbox.deliver(
-        dst=pq_target.reshape(-1).astype(jnp.int32),
+    punc, _ = _deliver(
+        cfg, dst=pq_target.reshape(-1).astype(jnp.int32),
         cols=[jnp.broadcast_to(idx[:, None].astype(jnp.uint32),
                                (n, p)).reshape(-1)],
-        valid=pu_valid, n_peers=n, inbox_size=cfg.request_inbox)
+        valid=pu_valid, n_peers=n, inbox_size=cfg.request_inbox,
+        need_receipts=False)
     (pu_from,) = punc.inbox
     arrivals = arrivals | jnp.any(punc.inbox_valid, axis=1)
     pu_ok = punc.inbox_valid & act[:, None]
@@ -1647,8 +1714,8 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
         if fm.partitions:
             sig_send_ok = sig_send_ok & ~flt.partition_blocked(
                 idx, sg_target, fm.partitions)
-        sreq = inbox.deliver(
-            dst=jnp.where(sending, sg_target, NO_PEER),
+        sreq, _ = _deliver(
+            cfg, dst=jnp.where(sending, sg_target, NO_PEER),
             cols=[idx.astype(jnp.uint32), sg_meta, sg_payload, sg_gt],
             valid=sig_send_ok, n_peers=n, inbox_size=s_sz)
         sq_src, sq_meta, sq_payload, sq_gt = sreq.inbox          # [N, S]
@@ -1882,8 +1949,8 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
             pen_send = pen_send & ~flt.partition_blocked(
                 jnp.broadcast_to(idx[:, None], dl_src.shape), dl_src,
                 fm.partitions)
-        preq = inbox.deliver(
-            dst=dl_src.reshape(-1), cols=[dl_member.reshape(-1)],
+        preq, _ = _deliver(
+            cfg, dst=dl_src.reshape(-1), cols=[dl_member.reshape(-1)],
             valid=pen_send.reshape(-1), n_peers=n,
             inbox_size=cfg.proof_inbox)
         (pq_author,) = preq.inbox                               # [N, Pi]
@@ -1984,8 +2051,8 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
             seq_send = seq_send & ~flt.partition_blocked(
                 jnp.broadcast_to(idx[:, None], dl_src.shape), dl_src,
                 fm.partitions)
-        qreq = inbox.deliver(
-            dst=dl_src.reshape(-1),
+        qreq, _ = _deliver(
+            cfg, dst=dl_src.reshape(-1),
             cols=[dl_member.reshape(-1), dl_meta.reshape(-1),
                   sq_low.reshape(-1), sq_high.reshape(-1)],
             valid=seq_send.reshape(-1), n_peers=n,
@@ -2080,8 +2147,8 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
             mm_send = mm_send & ~flt.partition_blocked(
                 jnp.broadcast_to(idx[:, None], dl_src.shape), dl_src,
                 fm.partitions)
-        mreq = inbox.deliver(
-            dst=dl_src.reshape(-1),
+        mreq, _ = _deliver(
+            cfg, dst=dl_src.reshape(-1),
             cols=[dl_payload.reshape(-1), dl_aux.reshape(-1)],
             valid=mm_send.reshape(-1), n_peers=n,
             inbox_size=cfg.proof_inbox)
@@ -2165,8 +2232,8 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
             id_send = id_send & ~flt.partition_blocked(
                 jnp.broadcast_to(idx[:, None], dl_src.shape), dl_src,
                 fm.partitions)
-        ireq = inbox.deliver(
-            dst=dl_src.reshape(-1), cols=[dl_member.reshape(-1)],
+        ireq, _ = _deliver(
+            cfg, dst=dl_src.reshape(-1), cols=[dl_member.reshape(-1)],
             valid=id_send.reshape(-1), n_peers=n,
             inbox_size=cfg.proof_inbox)
         (iq_member,) = ireq.inbox                                # [N, Ii]
@@ -2886,7 +2953,8 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
                         bloom.probe_bits(rh_n, cfg.bloom_bits,
                                          cfg.bloom_hashes,
                                          salt=ep + jnp.uint32(1)),
-                        in_sl_n, cfg.bloom_bits)
+                        in_sl_n, cfg.bloom_bits,
+                        chunks=cfg.parallel.scatter_chunks)
                 else:
                     dig = bloom.bloom_build(rh_n, in_sl_n,
                                             cfg.bloom_bits,

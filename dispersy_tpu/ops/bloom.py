@@ -114,12 +114,24 @@ def gather_backend(impl: str | None = None) -> bool:
 
 @contract(out=Spec("uint32", ("N", "W")),
           probes=Spec("int32", ("N", "M", "H")),
-          mask=Spec("bool", ("N", "M")), n_bits=_N_BITS)
+          mask=Spec("bool", ("N", "M")), n_bits=_N_BITS, chunks=1)
 def bloom_build_from(probes: jnp.ndarray, mask: jnp.ndarray,
-                     n_bits: int) -> jnp.ndarray:
+                     n_bits: int, chunks: int = 1) -> jnp.ndarray:
     """Gather-form build from precomputed ``probes`` (:func:`probe_bits`,
     ``[..., M, K]`` i32): ONE flat scatter sets every probed bit, then the
-    bitmap packs to words.  Bit-identical to :func:`bloom_build`."""
+    bitmap packs to words.  Bit-identical to :func:`bloom_build`.
+
+    ``chunks > 1`` splits the row axis into that many row-block scatters
+    (a Python loop — static, so it just unrolls into the jit).  Two
+    DIFFERENT int32 walls make this necessary at fleet scale, and the
+    flat/2-D branch below only dodges the first: (a) the flat index
+    *value* ``row * stride`` overflows past 2^31 elements; (b) XLA's
+    scatter lowering caps the COUNT of update indices in one op at 2^31
+    — a vmapped fleet build at R x N x M x K = 8 x 1M x 48 x 7 is ~2.7e9
+    updates and refuses to lower no matter how the indices are encoded.
+    Chunking divides both.  Bit-identical for any ``chunks`` (row blocks
+    are independent); config knob: ``parallel.scatter_chunks``.
+    """
     assert n_bits % 32 == 0, "n_bits must pack into uint32 words"
     w = n_bits // 32
     lead = probes.shape[:-2]
@@ -128,22 +140,32 @@ def bloom_build_from(probes: jnp.ndarray, mask: jnp.ndarray,
         flat *= d
     stride = n_bits + 1
     tgt = jnp.where(mask[..., None], probes,
-                    jnp.int32(n_bits))                     # [..., M, K]
-    if flat * stride < 2 ** 31:
-        # Flat one-component indices (cheapest scatter layout)...
-        row0 = (jnp.arange(flat, dtype=jnp.int32) * stride)[:, None]
-        flat_ix = (row0 + tgt.reshape(flat, -1)).reshape(-1)
-        bits = (jnp.zeros((flat * stride,), jnp.bool_)
-                .at[flat_ix].set(True, mode="drop")
-                .reshape(flat, stride))
-    else:
-        # ...but row*stride overflows int32 past 2^31 elements (e.g. the
-        # default 2464-bit filter above ~870k rows), so large shapes keep
-        # the 2-D (row, bit) index form; x64 is off, so no int64 escape.
-        rows = jnp.arange(flat, dtype=jnp.int32)[:, None]
-        bits = (jnp.zeros((flat, stride), jnp.bool_)
-                .at[rows, tgt.reshape(flat, -1)].set(True, mode="drop"))
-    return pack_bits(bits[:, :n_bits]).reshape(*lead, w)
+                    jnp.int32(n_bits)).reshape(flat, -1)   # [flat, M*K]
+
+    def scatter_rows(sub):
+        fc = sub.shape[0]
+        if fc * stride < 2 ** 31:
+            # Flat one-component indices (cheapest scatter layout)...
+            row0 = (jnp.arange(fc, dtype=jnp.int32) * stride)[:, None]
+            bits = (jnp.zeros((fc * stride,), jnp.bool_)
+                    .at[(row0 + sub).reshape(-1)].set(True, mode="drop")
+                    .reshape(fc, stride))
+        else:
+            # ...but row*stride overflows int32 past 2^31 elements (e.g.
+            # the default 2464-bit filter above ~870k rows), so large
+            # shapes keep the 2-D (row, bit) index form; x64 is off, so
+            # no int64 escape.
+            rows = jnp.arange(fc, dtype=jnp.int32)[:, None]
+            bits = (jnp.zeros((fc, stride), jnp.bool_)
+                    .at[rows, sub].set(True, mode="drop"))
+        return pack_bits(bits[:, :n_bits])
+    if chunks <= 1:
+        return scatter_rows(tgt).reshape(*lead, w)
+    block = -(-flat // chunks)
+    words = jnp.concatenate(
+        [scatter_rows(tgt[lo:min(lo + block, flat)])
+         for lo in range(0, flat, block)], axis=0)
+    return words.reshape(*lead, w)
 
 
 @contract(out=Spec("bool", ("N", "M")),

@@ -162,20 +162,248 @@ def deliver(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
     edge_slot = (jnp.zeros((e,), jnp.int32)
                  .at[spos].set(jnp.where(keep, slot, -1), mode="drop"))
     kept_e = edge_slot >= 0
-    flat = jnp.where(kept_e, key * inbox_size + edge_slot,
-                     n_peers * inbox_size)
-
-    inbox = tuple(
-        jnp.zeros((n_peers * inbox_size,) + c.shape[1:], c.dtype)
-        .at[flat].set(c, mode="drop")
-        .reshape((n_peers, inbox_size) + c.shape[1:])
-        for c in cols)
-    inbox_valid = (jnp.zeros((n_peers * inbox_size,), bool)
-                   .at[flat].set(True, mode="drop")
-                   .reshape(n_peers, inbox_size))
+    if (n_peers + 1) * inbox_size < 2 ** 31:
+        # One flat int32 scatter per column...
+        flat = jnp.where(kept_e, key * inbox_size + edge_slot,
+                         n_peers * inbox_size)
+        inbox = tuple(
+            jnp.zeros((n_peers * inbox_size,) + c.shape[1:], c.dtype)
+            .at[flat].set(c, mode="drop")
+            .reshape((n_peers, inbox_size) + c.shape[1:])
+            for c in cols)
+        inbox_valid = (jnp.zeros((n_peers * inbox_size,), bool)
+                       .at[flat].set(True, mode="drop")
+                       .reshape(n_peers, inbox_size))
+    else:
+        # ...but key*inbox_size overflows int32 past 2^31 elements, so
+        # giant populations scatter in the two-coordinate (key, slot)
+        # form — same bits, one extra index operand (the ops/bloom.py /
+        # ops/store.py two-form rule; graftlint R6).
+        sl = jnp.where(kept_e, edge_slot, inbox_size)
+        inbox = tuple(
+            jnp.zeros((n_peers, inbox_size) + c.shape[1:], c.dtype)
+            .at[key, sl].set(c, mode="drop")
+            for c in cols)
+        inbox_valid = (jnp.zeros((n_peers, inbox_size), bool)
+                       .at[key, sl].set(True, mode="drop"))
     overflow = ok & ~kept_e
     n_dropped = (jnp.zeros((n_peers,), jnp.int32)
                  .at[jnp.where(overflow, key, n_peers)]
                  .add(1, mode="drop"))
     return Delivery(inbox=inbox, inbox_valid=inbox_valid, n_dropped=n_dropped,
                     edge_slot=edge_slot)
+
+
+class RaggedDelivery(NamedTuple):
+    delivery: Delivery        # inbox/inbox_valid/n_dropped/edge_slot,
+    #                           exactly the global kernel's contract
+    shed: jnp.ndarray         # bool[E] edge lost to a full send bucket
+    #                           (cross_shard_budget overflow) — the
+    #                           SENDER-side attribution stream
+
+
+@contract(out=RaggedDelivery(
+              delivery=Delivery(inbox=(Spec("uint32", ("N", "Q")),
+                                       Spec("uint32", ("N", "Q", "W"))),
+                                inbox_valid=Spec("bool", ("N", "Q")),
+                                n_dropped=Spec("int32", ("N",)),
+                                edge_slot=Spec("int32", ("E",))),
+              shed=Spec("bool", ("E",))),
+          dst=Spec("int32", ("E",)),
+          cols=[Spec("uint32", ("E",)), Spec("uint32", ("E", "W"))],
+          valid=Spec("bool", ("E",)),
+          n_peers=lambda d: d["N"], inbox_size=lambda d: d["Q"],
+          shards=2, budget=0, cls=None, need_receipts=True)
+def deliver_ragged(dst: jnp.ndarray, cols: Sequence[jnp.ndarray],
+                   valid: jnp.ndarray, n_peers: int, inbox_size: int,
+                   shards: int, budget: int = 0,
+                   cls: jnp.ndarray | None = None,
+                   need_receipts: bool = True) -> RaggedDelivery:
+    """:func:`deliver`, restructured for a peer axis sharded ``shards``
+    ways: shard-local sort + capped send buckets + ONE explicit
+    all-to-all exchange + shard-local landing scatter.
+
+    The global kernel's single ``lax.sort`` over every edge makes XLA
+    materialize the full edge list on every chip before it can split
+    the scatter.  Here each shard handles only its own slice:
+
+    1. The edge list (padded to ``S * ceil(E/S)``) is viewed as
+       ``[S, El]`` — row ``r`` is the slice shard ``r`` produced (push
+       edges are peer-major, so row == sender shard up to padding).
+    2. Each row sorts SHARD-LOCALLY by ``(destination[, class], local
+       position)`` — identical order to the global sort restricted to
+       the row, since global position is monotone in local position.
+    3. Entries bucket by destination shard (``dst // (N/S)``); each
+       ``(row, destination-shard)`` bucket holds at most ``B`` entries
+       — ``budget`` if > 0, else the exact worst case ``El``.  The
+       first ``B`` of a bucket in sorted order win; the rest are SHED
+       at the sender (``shed``, counted by the caller into
+       ``stats.xshard_shed``) — bounded-inbox backpressure, the
+       ``store_stage`` overflow contract.  With ``budget=0`` nothing
+       ever sheds and the result is bit-identical to :func:`deliver`.
+    4. The ``[S, S, B]`` bucket buffers transpose source<->destination
+       axes — THE one collective (an all-to-all over ICI when the peer
+       axis is mesh-sharded; a transpose on one device).
+    5. Each destination shard merges its ``S * B`` arrivals with one
+       LOCAL sort by ``(destination[, class], global position)`` —
+       the same admission order as the global kernel — and lands them
+       with a SHARD-LOCAL two-coordinate scatter (local destination,
+       slot): indices stay < ``(N/S) * Q`` per shard, which is what
+       breaks the 2^31 global-flat-index ceiling (graftlint R6).
+    6. ``need_receipts``: the ``edge_slot`` receipt needs the reverse
+       transpose (a second collective).  One-way channels (push) pass
+       False and get ``edge_slot = -1`` everywhere for free.
+
+    Drop accounting is unchanged: ``n_dropped`` counts per-destination
+    inbox overflow only; bucket sheds are the sender's loss, reported
+    separately in ``shed`` (never both for one edge).
+    """
+    s = shards
+    e = dst.shape[0]
+    nl = n_peers // s
+    el = -(-e // s)
+    ep = el * s
+    if ep != e:
+        padn = ep - e
+        dst = jnp.concatenate([dst, jnp.zeros((padn,), dst.dtype)])
+        valid = jnp.concatenate([valid, jnp.zeros((padn,), bool)])
+        if cls is not None:
+            cls = jnp.concatenate([cls, jnp.zeros((padn,), cls.dtype)])
+        cols = [jnp.concatenate(
+                    [c, jnp.zeros((padn,) + c.shape[1:], c.dtype)])
+                for c in cols]
+    b = el if budget <= 0 else min(budget, el)
+
+    ok = valid & (dst >= 0) & (dst < n_peers)
+    key = jnp.where(ok, dst, n_peers).astype(jnp.int32).reshape(s, el)
+    lpos = jnp.broadcast_to(jnp.arange(el, dtype=jnp.int32), (s, el))
+    cls_bits = 8 if cls is not None else 0
+    scls = None
+
+    # -- 2. shard-local source sort ------------------------------------
+    pos_bits = packed_key_bits(n_peers, el, cls_bits)
+    if pos_bits is not None:
+        packed = (key.astype(jnp.uint32) << (cls_bits + pos_bits)) \
+            | lpos.astype(jnp.uint32)
+        if cls is not None:
+            packed = packed | (cls.astype(jnp.uint32).reshape(s, el)
+                               << pos_bits)
+        (sp,) = lax.sort((packed,), dimension=1, is_stable=False,
+                         num_keys=1)
+        skey = (sp >> (cls_bits + pos_bits)).astype(jnp.int32)
+        slpos = (sp & jnp.uint32((1 << pos_bits) - 1)).astype(jnp.int32)
+        if cls is not None:
+            scls = (sp >> pos_bits).astype(jnp.uint32) & jnp.uint32(0xFF)
+    elif cls is None:
+        skey, slpos = lax.sort((key, lpos), dimension=1,
+                               is_stable=False, num_keys=2)
+    else:
+        skey, scls, slpos = lax.sort(
+            (key, cls.astype(jnp.uint32).reshape(s, el), lpos),
+            dimension=1, is_stable=False, num_keys=3)
+
+    # -- 3. destination-shard buckets, budget-capped -------------------
+    dsh = jnp.where(skey < n_peers, skey // nl, s)
+    iota = lpos  # arange(el) per row
+    is_start = jnp.concatenate(
+        [jnp.ones((s, 1), bool), dsh[:, 1:] != dsh[:, :-1]], axis=1)
+    first = lax.cummax(jnp.where(is_start, iota, 0), axis=1)
+    rank = iota - first
+    keep_src = (dsh < s) & (rank < b)
+    shed_sorted = (dsh < s) & (rank >= b)
+    rows = jnp.arange(s, dtype=jnp.int32)[:, None]
+    # Bucket position of each sorted entry; s*b = "nowhere" (mode=drop).
+    bidx = jnp.where(keep_src, dsh * b + rank, s * b)
+
+    def to_bucket(val_sorted, fill, dtype):
+        init = jnp.full((s, s * b) + val_sorted.shape[2:], fill, dtype)
+        return init.at[rows, bidx].set(val_sorted, mode="drop")
+
+    gpos = rows * el + slpos  # global edge position, computed locally
+    bkey = to_bucket(skey, n_peers, jnp.int32)
+    bgpos = to_bucket(gpos, 0, jnp.int32)
+    bcls = (to_bucket(scls, 0, jnp.uint32) if cls is not None else None)
+    bcols = []
+    for c in cols:
+        cr = c.reshape((s, el) + c.shape[1:])
+        ix = slpos.reshape((s, el) + (1,) * (cr.ndim - 2))
+        csorted = jnp.take_along_axis(cr, ix, axis=1)
+        bcols.append(to_bucket(csorted, 0, c.dtype))
+
+    # -- 4. THE exchange: transpose source <-> destination shard -------
+    def exchange(buf):
+        return (buf.reshape((s, s, b) + buf.shape[2:])
+                .swapaxes(0, 1)
+                .reshape((s, s * b) + buf.shape[2:]))
+
+    xkey = exchange(bkey)
+    xgpos = exchange(bgpos)
+    xcls = exchange(bcls) if cls is not None else None
+    xcols = [exchange(bc) for bc in bcols]
+
+    # -- 5. destination merge: local sort + shard-local landing --------
+    ei = jnp.broadcast_to(jnp.arange(s * b, dtype=jnp.int32), (s, s * b))
+    gpos_bits = packed_key_bits(n_peers, ep, cls_bits)
+    if gpos_bits is not None:
+        packed2 = (xkey.astype(jnp.uint32) << (cls_bits + gpos_bits)) \
+            | xgpos.astype(jnp.uint32)
+        if cls is not None:
+            packed2 = packed2 | (xcls << gpos_bits)
+        sp2, sei = lax.sort((packed2, ei), dimension=1, is_stable=False,
+                            num_keys=1)
+        dkey = (sp2 >> (cls_bits + gpos_bits)).astype(jnp.int32)
+    elif cls is None:
+        dkey, _, sei = lax.sort((xkey, xgpos, ei), dimension=1,
+                                is_stable=True, num_keys=2)
+    else:
+        dkey, _, _, sei = lax.sort((xkey, xcls, xgpos, ei), dimension=1,
+                                   is_stable=True, num_keys=3)
+    iota2 = ei
+    is_start2 = jnp.concatenate(
+        [jnp.ones((s, 1), bool), dkey[:, 1:] != dkey[:, :-1]], axis=1)
+    first2 = lax.cummax(jnp.where(is_start2, iota2, 0), axis=1)
+    slot = iota2 - first2
+    real = dkey < n_peers
+    keep_dst = real & (slot < inbox_size)
+    # Slot of each EXCHANGE entry (sei is a per-row permutation, so
+    # every position is written; -1 = dropped/empty).
+    entry_slot = (jnp.full((s, s * b), -1, jnp.int32)
+                  .at[rows, sei].set(jnp.where(keep_dst, slot, -1),
+                                     mode="drop"))
+    # Shard-local two-coordinate landing scatter: indices bounded by
+    # (N/S) * Q per shard — never a global flat index (graftlint R6).
+    lkey = xkey - rows * nl
+    lsl = jnp.where(entry_slot >= 0, entry_slot, inbox_size)
+    lkey = jnp.where(entry_slot >= 0, lkey, nl)
+    inbox = tuple(
+        jnp.zeros((s, nl, inbox_size) + c.shape[2:], c.dtype)
+        .at[rows, lkey, lsl].set(c, mode="drop")
+        .reshape((n_peers, inbox_size) + c.shape[2:])
+        for c in xcols)
+    inbox_valid = (jnp.zeros((s, nl, inbox_size), bool)
+                   .at[rows, lkey, lsl].set(True, mode="drop")
+                   .reshape(n_peers, inbox_size))
+    ovf = real & (slot >= inbox_size)
+    ldst_sorted = jnp.where(ovf, dkey - rows * nl, nl)
+    n_dropped = (jnp.zeros((s, nl), jnp.int32)
+                 .at[rows, ldst_sorted].add(1, mode="drop")
+                 .reshape(n_peers))
+
+    # -- 6. receipts + sender-side shed, back in edge order ------------
+    shed_rows = (jnp.zeros((s, el), bool)
+                 .at[rows, slpos].set(shed_sorted, mode="drop"))
+    shed = shed_rows.reshape(ep)[:e]
+    if need_receipts:
+        rslot = exchange(entry_slot)  # reverse transpose: same permute
+        got = jnp.take_along_axis(
+            rslot, jnp.where(keep_src, bidx, 0), axis=1)
+        sslot = jnp.where(keep_src, got, -1)
+        edge_slot = (jnp.full((s, el), -1, jnp.int32)
+                     .at[rows, slpos].set(sslot, mode="drop")
+                     .reshape(ep)[:e])
+    else:
+        edge_slot = jnp.full((e,), -1, jnp.int32)
+    return RaggedDelivery(
+        delivery=Delivery(inbox=inbox, inbox_valid=inbox_valid,
+                          n_dropped=n_dropped, edge_slot=edge_slot),
+        shed=shed)

@@ -223,6 +223,11 @@ class OraclePeer:
         # like ge_bad it survives churn rebirth.
         self.bucket = 0
         self.msgs_shed_rate = self.msgs_shed_priority = 0
+        # parallel plane (engine stats.xshard_shed): push edges this
+        # sender lost to a full cross-shard send bucket
+        # (parallel.cross_shard_budget overflow) — exchange
+        # backpressure, not inbox overflow.
+        self.xshard_shed = 0
         # dissemination-tracing plane (engine trace_first/trace_chan/
         # trace_dups per-peer lineage + the stats trace_delivered/
         # trace_dup channel counters; dispersy_tpu/traceplane.py).
@@ -1300,6 +1305,17 @@ class OracleSim:
         ovc = cfg.overload
         ov_on = ovc.enabled and (cfg.forward_fanout > 0
                                  or fm.flood_enabled)
+        # Every SENT push/flood packet collects here as
+        # (pos, cls, record, sender, dst, junk) — pos is the engine's
+        # flat edge-list position (forward segment i*F*C + fi*C + ci,
+        # flood segment appended after), cls the admission class (0
+        # when priority admission is off — pure arrival order).  The
+        # cross-shard exchange cap and the inbox admission both run
+        # over this list AFTER enumeration, because the cap keeps
+        # bucket winners by (dst, cls, pos) — a later edge with a
+        # smaller destination can displace an earlier one, so shedding
+        # cannot be decided inline.
+        push_edges: list[tuple] = []
         if ov_on:
             ratef = np.float32(ovc.bucket_rate)
             whole = int(np.floor(ratef))
@@ -1346,18 +1362,11 @@ class OracleSim:
                                               fi * cc + ci) \
                                     and not self._blocked(i, tc):
                                 sent += 1
-                                if ov_on:
-                                    push_pend[tc].append(
-                                        (self._admission_class(rec.meta),
-                                         rec, i, False))
-                                elif len(push_inbox[tc]) < cfg.push_inbox:
-                                    push_inbox[tc].append((rec, i, False))
-                                    arrivals[tc] = True
-                                    qc = self.peers[tc]
-                                    if qc.alive and qc.loaded:
-                                        qc.bytes_down += RECORD_BYTES
-                                else:
-                                    self.peers[tc].msgs_dropped += 1
+                                push_edges.append(
+                                    ((i * cfg.forward_buffer + fi) * cc
+                                     + ci,
+                                     self._admission_class(rec.meta),
+                                     rec, i, tc, False))
                 p.msgs_forwarded += sent
         if fm.flood_enabled:
             # Byzantine junk blast (engine phase 1f flood segment): junk
@@ -1366,7 +1375,9 @@ class OracleSim:
             # overload plane the blasts spend the SAME bucket, ordinals
             # continuing after the flooder's real-push attempts.
             ff = fm.flood_fanout
-            for fs in fm.flood_senders:
+            fbase = (n * cfg.forward_buffer * cfg.forward_fanout
+                     if cfg.forward_fanout > 0 else 0)
+            for fs_ix, fs in enumerate(fm.flood_senders):
                 fp = self.peers[fs]
                 if fp.alive:
                     # the flooder's NIC moves every blast, pre-loss
@@ -1393,19 +1404,56 @@ class OracleSim:
                                  j + (3 << 12)) & 0xFF,
                         rand_u32(seed, rnd, fs, P_FLOOD, j + (4 << 12)),
                         rand_u32(seed, rnd, fs, P_FLOOD, j + (5 << 12)))
-                    if ov_on:
-                        push_pend[victim].append(
-                            (self._admission_class(rec.meta), rec, fs,
-                             True))
-                    elif len(push_inbox[victim]) < cfg.push_inbox:
+                    push_edges.append(
+                        (fbase + fs_ix * ff + j,
+                         self._admission_class(rec.meta), rec, fs,
+                         victim, True))
+        pp = cfg.parallel
+        if pp.shards > 1 and pp.cross_shard_budget > 0 and push_edges:
+            # Ragged-exchange cap mirror (engine _deliver capped=True;
+            # ops/inbox.deliver_ragged): the edge list pads to
+            # `shards` rows of ceil(E/S) positions; each (source row,
+            # destination shard) send bucket keeps the first
+            # `cross_shard_budget` edges in the kernel's bucket sort
+            # order (dst, cls, pos), the rest shed IN the exchange —
+            # bytes_up already paid, never reaching any inbox, counted
+            # at the SENDER (stats.xshard_shed backpressure, the
+            # store_stage bounded-inbox idiom).
+            etot = fbase if fm.flood_enabled else (
+                n * cfg.forward_buffer * cfg.forward_fanout)
+            if fm.flood_enabled:
+                etot += len(fm.flood_senders) * ff
+            el = -(-etot // pp.shards)
+            nl = n // pp.shards
+            kept: list[tuple] = []
+            bucket_fill: dict[tuple[int, int], int] = {}
+            for e in sorted(push_edges,
+                            key=lambda e: (e[4], e[1], e[0])):
+                bkt = (e[0] // el, e[4] // nl)
+                if bucket_fill.get(bkt, 0) < pp.cross_shard_budget:
+                    bucket_fill[bkt] = bucket_fill.get(bkt, 0) + 1
+                    kept.append(e)
+                else:
+                    self.peers[e[3]].xshard_shed += 1
+            push_edges = sorted(kept, key=lambda e: e[0])
+        if not ov_on:
+            # unbounded-rate path: first-come (edge-position) admission
+            # into the bounded push inbox, overflow to the RECEIVER's
+            # msgs_dropped
+            for _, _, rec, src, dst, junk in push_edges:
+                if len(push_inbox[dst]) < cfg.push_inbox:
+                    push_inbox[dst].append((rec, src, junk))
+                    if not junk:
                         # junk never decodes: no auto-load arrival
-                        push_inbox[victim].append((rec, fs, True))
-                        qv = self.peers[victim]
-                        if qv.alive and qv.loaded:
-                            qv.bytes_down += RECORD_BYTES
-                    else:
-                        self.peers[victim].msgs_dropped += 1
-        if ov_on:
+                        arrivals[dst] = True
+                    qv = self.peers[dst]
+                    if qv.alive and qv.loaded:
+                        qv.bytes_down += RECORD_BYTES
+                else:
+                    self.peers[dst].msgs_dropped += 1
+        else:
+            for _, cls_, rec, src, dst, junk in push_edges:
+                push_pend[dst].append((cls_, rec, src, junk))
             # Priority admission + flood-fair attribution: per victim,
             # the inbox admits the lowest-class packets (ties by edge
             # position — the pend list is already in global edge order,
@@ -2845,6 +2893,10 @@ class OracleSim:
                                             np.uint32)
                                    if cfg.overload.enabled
                                    else np.zeros((0,), np.uint32)),
+            # parallel-plane backpressure counter (state.stats_gates:
+            # materialized only when the capped exchange is armed)
+            "xshard_shed": gated("xshard_shed",
+                                 [p.xshard_shed for p in self.peers]),
             # dissemination-tracing leaves + counters (knob-sized,
             # state.py; dispersy_tpu/traceplane.py)
             "trace_member": np.array(self.trace_member, np.uint32),

@@ -1,2 +1,5 @@
 from dispersy_tpu.parallel.mesh import (  # noqa: F401
-    PEER_AXIS, make_mesh, shard_state, state_sharding)
+    CHIP_AXIS, PARTITION_RULES, PEER_AXIS, ambient_mesh, make_mesh,
+    partition_kind, partition_table, peer_spec, pin_peers,
+    pin_replicated, shard_state, sharded_shape_structs, sharded_step,
+    state_sharding)

@@ -1,35 +1,48 @@
-"""Device-mesh sharding for the peer axis.
+"""Device-mesh sharding for the peer axis: the partition-rule registry.
 
 The reference scales by running one OS process per peer over real UDP
 networks (reference: endpoint.py ``StandaloneEndpoint``; tool/scenarioscript.py
 drives DAS4-cluster deployments) — its "distributed backend" is hand-rolled
 datagrams, no NCCL/MPI (SURVEY.md §5.8).  The TPU rebuild's distribution
 model is SPMD instead: the leading *peer axis* of every ``PeerState`` array
-is sharded over a 1-D ``jax.sharding.Mesh``, the whole round ``step`` runs
-under jit on that sharded state, and XLA inserts the collectives where data
-crosses shards:
+is sharded over a ``jax.sharding.Mesh``, the whole round ``step`` runs
+under jit on that sharded state, and the ONLY data that crosses shards is
+the delivery exchange (:mod:`dispersy_tpu.ops.inbox`) — exactly where the
+reference's UDP fan-out sat.
 
-- the delivery kernel's global ``lax.sort`` by destination
-  (:mod:`dispersy_tpu.ops.inbox`) lowers to an all-to-all style exchange over
-  ICI — exactly where the reference's UDP fan-out sat;
-- everything else in the step (bloom build/query, store merge, candidate
-  bookkeeping) is embarrassingly row-parallel and stays shard-local.
+**Partition rules** (the SNIPPETS.md [2]/[3] idiom: regex rules over leaf
+names → ``PartitionSpec``): every ``PeerState`` leaf is classified BY NAME,
+first match wins — :data:`PARTITION_RULES`.  Peer-axis leaves shard their
+leading dim over every mesh axis; the round-synchronous scalars (clock,
+round counter), the replicated RNG key, and the tracker-/host-indexed
+observability leaves (``trace_member``/``trace_gt``/``trace_latch``,
+``tele_*``, ``fr_*``) replicate.  Zero-width plane leaves (the ``health``
+idiom) shard like their full-width selves — 0 rows split 8 ways is still
+0 rows.  A NEW leaf that matches no replicated rule must carry the peer
+axis, or :func:`state_sharding` refuses loudly — which is the point: the
+old length-heuristic silently replicated any leaf whose leading dim
+happened not to equal ``n_peers``, and would have silently *sharded*
+host-indexed leaves whose dim happened to match.
 
-No TP/PP is warranted: the model is 1M+ independent peer rows, so
-peer-sharding *is* the data parallelism (SURVEY.md §2, "Parallelism
-strategies").  Multi-host: the same mesh spans hosts via
-``jax.distributed.initialize``; DCN traffic only occurs inside the one sort,
-at the round boundary — matching the design rule that cross-slice hops ride
-DCN once per round.
+**Pins**: :func:`pin_peers` / :func:`pin_replicated` are
+``with_sharding_constraint`` wrappers the engine drops at phase
+boundaries so XLA never invents an [8,1] <-> [2,4] reshard or an
+involuntary rematerialization mid-round (profiling.sharded_step_cost
+gates both mesh shapes at ZERO warnings, tests/test_ledger.py).  Outside
+an ambient mesh (``with mesh:``) they are identity — the single-device
+step's HLO stays byte-identical.
 
 Caveat (virtual CPU meshes only): XLA's in-process CPU communicator can
-deadlock when several async-dispatched sharded executions overlap — call
-``jax.block_until_ready`` between steps when looping on a
-``xla_force_host_platform_device_count`` mesh.  Real TPU streams order
+deadlock when several async-dispatched sharded executions overlap — use
+:func:`sharded_step`, which blocks between rounds, when looping on a
+``xla_force_host_platform_device_count`` mesh (the satellite fix for the
+footgun this docstring used to merely document).  Real TPU streams order
 collectives correctly and need no such serialization.
 """
 
 from __future__ import annotations
+
+import re
 
 import jax
 import numpy as np
@@ -38,40 +51,120 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dispersy_tpu.state import PeerState
 
 PEER_AXIS = "peers"
+# Second mesh axis for 2-D meshes (make_mesh((2, 4))): the peer axis is
+# sharded over BOTH, modeling a pod slice whose chips are reached via
+# two interconnect dimensions.  Name only — the partition rules place
+# every peer leaf over all mesh axes, whatever their count.
+CHIP_AXIS = "chips"
+
+# (leaf-name regex, placement) — FIRST match wins; placement is
+# "replicated" or "peers".  Leaf names are the checkpoint's path names
+# ("stats/walk_success" style, checkpoint._leaves_with_paths).  The
+# table is deliberately exhaustive about what replicates; everything
+# else MUST be peer-axis (validated against the leaf's leading dim).
+PARTITION_RULES: tuple[tuple[str, str], ...] = (
+    (r"^key$", "replicated"),            # RNG key uint32[2]: one shared
+    #   counter-based stream — every shard derives identical per-peer
+    #   streams from it (ops/rng.py), so sharding it would be wrong, not
+    #   just slow
+    (r"^time$", "replicated"),           # round-synchronous sim clock
+    (r"^round_index$", "replicated"),    # round-synchronous counter
+    (r"^trace_(member|gt|latch)$", "replicated"),  # tracked-record
+    #   registry + coverage latches: [tracked_slots, ...] — indexed by
+    #   record, not peer (traceplane.py)
+    (r"^tele_(row|ring)$", "replicated"),  # telemetry row/history:
+    #   [row_words] / [history, row_words] community-wide sums
+    (r"^fr_(ring|pos)$", "replicated"),  # flight recorder: [depth, W]
+    #   host-diagnostic ring + its scalar cursor
+    (r".*", "peers"),                    # EVERYTHING else carries the
+    #   peer axis in dim 0 (zero-width plane leaves included)
+)
 
 
-def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
-    """A 1-D mesh over the first ``n_devices`` available devices."""
+def partition_kind(name: str) -> str:
+    """``"peers"`` or ``"replicated"`` for one leaf name — the registry
+    lookup, shared with checkpoint.save_sharded's shard-vs-meta split."""
+    for pat, kind in PARTITION_RULES:
+        if re.match(pat, name):
+            return kind
+    raise ValueError(f"no partition rule matches leaf {name!r}")
+
+
+def _named_leaves(tree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    named = [("/".join(str(getattr(k, "name", k)) for k in path), leaf)
+             for path, leaf in flat]
+    return named, treedef
+
+
+def _check_peer_leaf(name: str, leaf, n_peers: int) -> None:
+    if leaf.ndim < 1 or leaf.shape[0] not in (0, n_peers):
+        raise ValueError(
+            f"leaf {name!r} matched the peer-axis rule but its shape is "
+            f"{tuple(leaf.shape)} (n_peers={n_peers}) — add a "
+            "PARTITION_RULES entry for it "
+            "(dispersy_tpu/parallel/mesh.py)")
+
+
+def partition_table(state, n_peers: int) -> dict:
+    """leaf name -> (placement, shape, dtype) for a state/shape pytree —
+    the registry applied and VALIDATED (docs + tests; PARALLEL.md's
+    partition-rule table is generated from this)."""
+    named, _ = _named_leaves(state)
+    out = {}
+    for name, leaf in named:
+        kind = partition_kind(name)
+        if kind == "peers":
+            _check_peer_leaf(name, leaf, n_peers)
+        out[name] = (kind, tuple(leaf.shape), str(leaf.dtype))
+    return out
+
+
+def make_mesh(shape: int | tuple | None = None, devices=None) -> Mesh:
+    """A peer-axis mesh over the available devices.
+
+    ``shape``: an int (1-D mesh over the first n devices, the common
+    case), a tuple like ``(2, 4)`` (a 2-D ``(peers, chips)`` mesh — the
+    peer axis shards over both axes), or None (all devices, 1-D).
+    """
     if devices is None:
         devices = jax.devices()
-    if n_devices is not None:
-        if n_devices > len(devices):
-            raise ValueError(
-                f"requested {n_devices} devices, only {len(devices)} present")
-        devices = devices[:n_devices]
-    return Mesh(np.asarray(devices), (PEER_AXIS,))
+    if shape is None:
+        shape = len(devices)
+    if isinstance(shape, int):
+        shape = (shape,)
+    if len(shape) > 2:
+        raise ValueError(f"mesh shape {shape}: at most 2 axes supported")
+    need = int(np.prod(shape))
+    if need > len(devices):
+        raise ValueError(
+            f"requested {need} devices, only {len(devices)} present")
+    axes = (PEER_AXIS, CHIP_AXIS)[:len(shape)]
+    return Mesh(np.asarray(devices[:need]).reshape(shape), axes)
+
+
+def peer_spec(mesh: Mesh, ndim: int) -> P:
+    """The peer-leaf PartitionSpec on ``mesh``: dim 0 sharded over every
+    mesh axis, trailing dims replicated."""
+    axes = tuple(mesh.axis_names)
+    lead = axes[0] if len(axes) == 1 else axes
+    return P(lead, *([None] * (ndim - 1)))
 
 
 def state_sharding(state: PeerState, mesh: Mesh, n_peers: int):
-    """A ``PeerState``-shaped pytree of NamedShardings.
-
-    Every leaf whose leading dimension is the peer axis is sharded over the
-    mesh; scalars and the RNG key are replicated.  The peer axis is
-    recognized by its length, so ``n_peers`` must differ from the small
-    fixed dims (the uint32[2] key — guaranteed for any real population).
-    """
-    if n_peers <= 2:
-        # The peer axis is detected by leading-dim length; n_peers <= 2
-        # collides with fixed dims (the uint32[2] RNG key) and would shard
-        # scalars.  No real population is this small.
-        raise ValueError(f"n_peers={n_peers} is too small to shard "
-                         "unambiguously (collides with fixed-size leaves)")
-
-    def spec(leaf):
-        if leaf.ndim >= 1 and leaf.shape[0] == n_peers:
-            return NamedSharding(mesh, P(PEER_AXIS, *([None] * (leaf.ndim - 1))))
-        return NamedSharding(mesh, P())
-    return jax.tree.map(spec, state)
+    """A ``PeerState``-shaped pytree of NamedShardings, from the
+    partition-rule registry (:data:`PARTITION_RULES`) — name-classified,
+    leading dims validated, unknown scalars refused."""
+    named, treedef = _named_leaves(state)
+    shardings = []
+    for name, leaf in named:
+        if partition_kind(name) == "peers":
+            _check_peer_leaf(name, leaf, n_peers)
+            shardings.append(
+                NamedSharding(mesh, peer_spec(mesh, leaf.ndim)))
+        else:
+            shardings.append(NamedSharding(mesh, P()))
+    return jax.tree_util.tree_unflatten(treedef, shardings)
 
 
 def shard_state(state: PeerState, mesh: Mesh, n_peers: int) -> PeerState:
@@ -92,3 +185,54 @@ def sharded_shape_structs(shapes, mesh: Mesh, n_peers: int):
     return jax.tree.map(
         lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
         shapes, shardings)
+
+
+def ambient_mesh() -> Mesh | None:
+    """The mesh this trace runs under (``with mesh:``), or None.
+
+    The engine's phase-boundary pins key off this: no ambient mesh ->
+    every pin is identity and the single-device HLO stays byte-identical
+    (the step_cost_1M_baseline.json guarantee)."""
+    from jax._src import mesh as _mesh_internal
+
+    m = _mesh_internal.thread_resources.env.physical_mesh
+    return None if m.empty else m
+
+
+def pin_peers(x):
+    """Pin dim 0 of ``x`` to the peer-axis layout of the ambient mesh
+    (identity when unsharded).  Dropped at the engine's phase
+    boundaries so XLA propagates ONE layout through the round instead
+    of inventing [8,1] <-> [2,4] transitions."""
+    mesh = ambient_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, peer_spec(mesh, x.ndim)))
+
+
+def pin_replicated(x):
+    """Pin ``x`` fully replicated on the ambient mesh (identity when
+    unsharded) — for tracker-row and reduction intermediates whose
+    tensors carry no peer axis."""
+    mesh = ambient_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+
+
+def sharded_step(state: PeerState, cfg, mesh: Mesh):
+    """ONE round of ``engine.step`` under ``mesh``, fully synchronized.
+
+    The supported way to loop a sharded step host-side: runs the jitted
+    step inside the mesh context (arming the partition pins) and calls
+    ``jax.block_until_ready`` on the result — virtual CPU meshes
+    deadlock without the barrier (module docstring), and on real chips
+    a host-side loop gains nothing from async dispatch because round
+    r+1's donation aliases round r's buffers anyway.
+    """
+    from dispersy_tpu import engine
+
+    with mesh:
+        out = engine.step(state, cfg)
+    return jax.block_until_ready(out)

@@ -173,26 +173,56 @@ def step_cost_amortized(cfg: CommunityConfig) -> dict:
                      cfg.store.compact_every)
 
 
-def sharded_step_cost(cfg: CommunityConfig, n_devices: int) -> dict:
-    """Compile the fused round peer-sharded over an ``n_devices`` 1-D
-    mesh (virtual CPU devices suffice) and return the flops/bytes dict
-    with costs SUMMED across devices (see ``_extract_cost`` — taking
-    one device's share used to under-report an 8-way mesh by 8x).
-    Abstract shapes only; the multichip datapoint for the cost ledger.
+def sharded_step_cost(cfg: CommunityConfig,
+                      n_devices: int | tuple = 8,
+                      phase: str | None = None) -> dict:
+    """Compile the fused round peer-sharded over an ``n_devices`` mesh
+    (an int for 1-D, a tuple like ``(2, 4)`` for 2-D; virtual CPU
+    devices suffice) and return the flops/bytes dict with costs SUMMED
+    across devices (see ``_extract_cost`` — taking one device's share
+    used to under-report an 8-way mesh by 8x).  Abstract shapes only;
+    the multichip datapoint for the cost ledger.
+
+    The compile runs INSIDE the mesh context so the engine's
+    partition-rule pins (parallel/mesh.py) are armed — the same HLO a
+    real ``sharded_step`` loop executes, which is what lets
+    tests/test_ledger.py gate this compile at ZERO involuntary-remat /
+    resharding warnings on both mesh shapes.
     """
     import jax
 
     from dispersy_tpu import engine
     from dispersy_tpu.parallel.mesh import make_mesh, sharded_shape_structs
 
-    shapes = sharded_shape_structs(state_shapes(cfg),
-                                   make_mesh(n_devices), cfg.n_peers)
+    mesh = make_mesh(n_devices)
+    shapes = sharded_shape_structs(state_shapes(cfg), mesh, cfg.n_peers)
     t0 = time.perf_counter()
-    compiled = (jax.jit(engine.step.__wrapped__, static_argnums=1)
-                .lower(shapes, cfg).compile())
+    with mesh:
+        compiled = (jax.jit(engine.step.__wrapped__,
+                            static_argnums=(1, 3))
+                    .lower(shapes, cfg, None, phase).compile())
     out = _extract_cost(compiled)
-    out["devices"] = n_devices
+    out["devices"] = (list(n_devices) if isinstance(n_devices, tuple)
+                      else n_devices)
     out["compile_seconds"] = round(time.perf_counter() - t0, 2)
+    return out
+
+
+def sharded_step_cost_amortized(cfg: CommunityConfig,
+                                n_devices: int | tuple = 8) -> dict:
+    """:func:`step_cost_amortized` compiled peer-sharded: the quiet and
+    sync round kinds each priced under the mesh (same zero-warning HLO
+    the SPMD gate pins) and cadence-averaged — the mesh cell's number
+    in the cost ledger."""
+    if not cfg.store_diet:
+        out = sharded_step_cost(cfg, n_devices)
+        out["compact_every"] = 1
+        return out
+    out = _amortize(
+        lambda ph: sharded_step_cost(cfg, n_devices, phase=ph),
+        cfg.store.compact_every)
+    out["devices"] = (list(n_devices) if isinstance(n_devices, tuple)
+                      else n_devices)
     return out
 
 

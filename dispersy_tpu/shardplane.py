@@ -1,0 +1,93 @@
+"""Parallel plane: static multichip knobs for the sharding-clean step.
+
+ROADMAP item 1 (multi-chip scale-out): the PR-11/PR-12 ledger projects
+6,264 r/s on 8 chips, but three compiler-level obstacles stood between
+the projection and a measured number:
+
+1. **Involuntary resharding** — ``jit(step)`` over a peer-sharded mesh
+   let XLA invent [8,1] <-> [2,4] layout transitions and full
+   rematerializations around the tracker fast path.  Fixed by the
+   partition-rule registry + ``with_sharding_constraint`` pins in
+   :mod:`dispersy_tpu.parallel.mesh` (no knob here: the pins are
+   free-standing and engage whenever an ambient mesh is present).
+2. **Cross-shard delivery** — the delivery kernel's single global
+   ``lax.sort`` by destination makes XLA materialize every edge on
+   every chip before the exchange.  ``shards > 1`` switches every
+   full-population delivery to the *ragged exchange*
+   (:func:`dispersy_tpu.ops.inbox.deliver_ragged`): shard-local sort,
+   per-(shard, destination-shard) send buckets, ONE explicit
+   all-to-all (a [S, S, B] transpose), then a shard-local landing
+   scatter.  ``cross_shard_budget`` caps the bucket depth; overflow is
+   shed at the SENDER and counted (``stats.xshard_shed``) — the same
+   bounded-inbox backpressure contract as ``store_stage`` overflow,
+   and the oracle mirrors the shed set bit-exactly.
+3. **The 2^31 scatter-index cap** — XLA refuses scatters with more
+   than 2^31-1 scatter indices, which is what the R-replica fleet hits
+   building R x N x M x K bloom probe bits in one scatter (FLEET.md
+   "scale ceiling": R=7 at 1M peers was the wall).  ``scatter_chunks``
+   splits that one scatter into ``chunks`` row-chunk scatters so each
+   stays under the cap; an 8 x 1M fleet lowers with
+   ``scatter_chunks=8``.
+
+The plane composes like store/overload/telemetry: all defaults
+(``shards=0``) compile to exactly the legacy single-device HLO, the
+oracle mirrors the armed paths bit-for-bit, checkpoint v16 carries the
+fingerprint, and the sharded==unsharded identity is pinned in
+tests/test_parallel.py.  See PARALLEL.md for the wire format and the
+scale-ceiling math.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from dispersy_tpu.exceptions import ConfigError
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Static multichip knobs, composed into ``CommunityConfig.parallel``.
+
+    Frozen + hashable (a static jit argument, like ``StoreConfig``).
+    All defaults compile to exactly the legacy step; ``shards`` and
+    ``cross_shard_budget`` change *which* HLO is emitted, never which
+    bits come out — the ragged exchange is pinned bit-identical to the
+    global sort whenever nothing sheds, and deterministic (lowest
+    (class, edge) first per bucket) when something does.
+    """
+
+    # Number of peer-axis shards the delivery kernels assume.  0 or 1 =
+    # plane off: every delivery is the legacy global sort.  > 1 requires
+    # n_peers % shards == 0 and switches full-population deliveries to
+    # the ragged cross-shard exchange.  Purely static — the same value
+    # must be used for the mesh (``make_mesh(shards)``) for the exchange
+    # transpose to lower to the one all-to-all.
+    shards: int = 0
+    # Per-(source-shard, destination-shard) send-bucket depth for the
+    # PUSH exchange, in edges per round.  0 = exact (worst-case bucket =
+    # ceil(E / shards), never sheds — bit-identical to the global sort).
+    # > 0 = bounded: within each bucket the lowest (admission class,
+    # global edge index) entries win, overflow is shed at the sender and
+    # counted in ``stats.xshard_shed`` (bounded-inbox backpressure; the
+    # bloom pull repairs the loss, exactly like staging overflow).
+    cross_shard_budget: int = 0
+    # Row-chunk count for the bloom probe-bit build scatter
+    # (ops/bloom.bloom_build_from).  XLA caps one scatter at 2^31-1
+    # scatter indices; the R-replica fleet's vmapped build scatters
+    # R x N x M x K indices and hits the cap at R=7 for the 1M-peer
+    # bench shape.  chunks=c splits the build into c scatters over row
+    # chunks (identical bits; c-1 extra scatter ops).  1 = legacy single
+    # scatter.
+    scatter_chunks: int = 1
+
+    def __post_init__(self) -> None:
+        if self.shards < 0:
+            raise ConfigError("parallel.shards must be >= 0")
+        if self.cross_shard_budget < 0:
+            raise ConfigError("parallel.cross_shard_budget must be >= 0")
+        if self.cross_shard_budget > 0 and self.shards <= 1:
+            raise ConfigError(
+                "parallel.cross_shard_budget caps the cross-shard "
+                "exchange — set parallel.shards > 1 too")
+        if self.scatter_chunks < 1:
+            raise ConfigError("parallel.scatter_chunks must be >= 1")

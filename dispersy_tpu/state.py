@@ -75,6 +75,15 @@ class Stats:
     msgs_shed_priority: jnp.ndarray  # u32[N] packets shed from this
     #   RECEIVER's push inbox by class-ordered admission under overflow
     #   (the drops that used to blame the flooded victim)
+    # Cross-shard exchange backpressure (dispersy_tpu/shardplane.py;
+    # PARALLEL.md).  Zero-width unless the parallel plane caps the
+    # exchange (state.stats_gates) — the `health` idiom.  Like the
+    # overload sheds, deliberately outside the msgs_dropped family:
+    # a full send bucket must never trip anyone's health sentinel.
+    xshard_shed: jnp.ndarray      # u32[N] push edges this SENDER lost
+    #   to a full per-destination-shard send bucket (ragged-exchange
+    #   overflow, ops/inbox.deliver_ragged; repaired by the bloom pull
+    #   like staging overflow)
     # Dissemination-tracing delivery accounting (dispersy_tpu/
     # traceplane.py; OBSERVABILITY.md "Dissemination tracing").
     # Zero-width unless cfg.trace.enabled — the `health` idiom.
@@ -369,6 +378,8 @@ def stats_gates(config: CommunityConfig) -> dict:
         "convictions_rx": config.malicious_enabled,
         "auth_unwound": config.timeline_enabled,
         "msgs_retro": config.timeline_enabled,
+        "xshard_shed": (config.parallel.shards > 1
+                        and config.parallel.cross_shard_budget > 0),
     }
 
 
@@ -403,6 +414,7 @@ def init_stats(config: CommunityConfig) -> Stats:
                  msgs_corrupt_dropped=jnp.zeros((n_corrupt,), jnp.uint32),
                  msgs_shed_rate=jnp.zeros((n_overload,), jnp.uint32),
                  msgs_shed_priority=jnp.zeros((n_overload,), jnp.uint32),
+                 xshard_shed=g("xshard_shed"),
                  trace_delivered=jnp.zeros((n_trace, NUM_CHANNELS),
                                            jnp.uint32),
                  trace_dup=jnp.zeros((n_trace, NUM_CHANNELS),

@@ -460,3 +460,31 @@ def test_convergence_fleet_band_schema():
     for p10, p50, p90 in zip(out["curve_p10"], out["curve"],
                              out["curve_p90"]):
         assert p10 <= p50 <= p90
+
+
+def test_8x1M_fleet_compiles_with_chunked_bloom_scatter():
+    """ROADMAP item 2's scale ceiling, pinned from both sides: the
+    8-replica 1M-peer fleet's vmapped bloom build scatters
+    R x N x M x K ~ 2.7e9 probe bits, past XLA's hard 2^31-1
+    scatter-index cap — the legacy single scatter must REFUSE to
+    compile (this exact error killed the R=7+ fleet runs, FLEET.md),
+    and parallel.scatter_chunks=8 must lift it by splitting the build
+    into row chunks (bit-identical output; tests/test_storediet.py
+    covers the equality at small shapes).  Abstract shapes only —
+    nothing materializes; ~15 s of XLA compile total."""
+    from dispersy_tpu import profiling
+    from dispersy_tpu.shardplane import ParallelConfig
+
+    R = 8
+    cfg = profiling.bench_config(1_000_000, "tpu")
+    shapes = profiling.state_shapes(cfg)
+    fshapes = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct((R,) + tuple(s.shape), s.dtype),
+        shapes)
+    with pytest.raises(Exception, match="2147483647 scatter indices"):
+        (jax.jit(FL.fleet_step, static_argnums=(1,))
+         .lower(fshapes, cfg).compile())
+    ccfg = cfg.replace(parallel=ParallelConfig(scatter_chunks=R))
+    compiled = (jax.jit(FL.fleet_step, static_argnums=(1,))
+                .lower(fshapes, ccfg).compile())
+    assert compiled is not None

@@ -16,7 +16,8 @@ from tools.graftlint import apply_waivers, report_json, unwaived
 from tools.graftlint.core import Module
 from tools.graftlint.registry import default_rules, rules_by_id
 from tools.graftlint.rule_contracts import ContractRule
-from tools.graftlint.rules_ast import (HostSyncRule, KeyReuseRule,
+from tools.graftlint.rules_ast import (GlobalIndexScatterRule,
+                                       HostSyncRule, KeyReuseRule,
                                        RecompileRule, ScatterModeRule)
 
 
@@ -424,6 +425,71 @@ def test_shim_surfaces_hot_path_parse_failures(tmp_path):
 
 def test_rules_by_id_selects_and_rejects():
     assert [r.rule_id for r in rules_by_id(["R1", "R4"])] == ["R1", "R4"]
-    assert len(default_rules()) == 5
+    assert len(default_rules()) == 6
     with pytest.raises(KeyError):
         rules_by_id(["R9"])
+
+
+# ------------------------------------------------------------------ R6
+
+
+R6_BAD = (
+    "def land(vals, n, w, flat_idx):\n"
+    "    out = jnp.zeros((n * w,), vals.dtype)\n"
+    "    return out.at[flat_idx].set(vals, mode='drop').reshape(n, w)\n"
+)
+
+R6_GOOD_GUARDED = (
+    "def land(vals, n, w, flat_idx, rows, cols):\n"
+    "    if n * w < 2 ** 31:\n"
+    "        out = jnp.zeros((n * w,), vals.dtype)\n"
+    "        return out.at[flat_idx].set(vals, mode='drop')\n"
+    "    return jnp.zeros((n, w), vals.dtype).at[rows, cols].set(\n"
+    "        vals, mode='drop')\n"
+)
+
+
+def test_r6_flags_unguarded_flat_scatters_only():
+    rule = GlobalIndexScatterRule()
+    bad = unwaived(run_rule(rule, R6_BAD))
+    assert len(bad) == 1 and "2 ** 31" in bad[0].message
+    assert unwaived(run_rule(rule, R6_GOOD_GUARDED)) == []
+    # multi-coordinate indices ARE the fix — never flagged
+    src = ("def land(vals, n, w, rows, cols):\n"
+           "    return (jnp.zeros((n * w,), vals.dtype)\n"
+           "            .at[rows, cols].set(vals, mode='drop'))\n")
+    assert unwaived(run_rule(rule, src)) == []
+    # non-product extents (a plain [E] scratch buffer) are exempt
+    src = ("def slots(e, spos, slot):\n"
+           "    return jnp.zeros((e,), 'int32')"
+           ".at[spos].set(slot, mode='drop')\n")
+    assert unwaived(run_rule(rule, src)) == []
+
+
+def test_r6_guard_inherits_into_nested_helper_scopes():
+    """ops/store.py's idiom: the two-form branch closes over a nested
+    helper — the enclosing guard must clear the helper's scatters."""
+    src = (
+        "def merge(n, w, flat_s, rows, cols):\n"
+        "    if n * w < 2 ** 31:\n"
+        "        def interleave(col):\n"
+        "            out = jnp.zeros((n * w,), col.dtype)\n"
+        "            return out.at[flat_s].set(col, mode='drop')\n"
+        "        return interleave\n"
+        "    def interleave2(col):\n"
+        "        return (jnp.zeros((n, w), col.dtype)\n"
+        "                .at[rows, cols].set(col, mode='drop'))\n"
+        "    return interleave2\n"
+    )
+    assert unwaived(run_rule(GlobalIndexScatterRule(), src)) == []
+
+
+def test_r6_inline_waiver_applies():
+    src = (
+        "def land(vals, n, w, flat_idx):\n"
+        "    out = jnp.zeros((n * w,), vals.dtype)\n"
+        "    return out.at[flat_idx].set(vals, mode='drop')"
+        "  # graftlint: ok[R6] extent proven < 2^31 by config validation\n"
+    )
+    findings = run_rule(GlobalIndexScatterRule(), src)
+    assert len(findings) == 1 and findings[0].waived

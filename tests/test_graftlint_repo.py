@@ -1,7 +1,8 @@
 """Tier-1 gate: the FULL graftlint suite over dispersy_tpu/.
 
-Runs all five rules (R1 host-sync, R2 recompile hazards, R3 dtype
-contracts, R4 scatter modes, R5 key reuse) against the real tree —
+Runs all six rules (R1 host-sync, R2 recompile hazards, R3 dtype
+contracts, R4 scatter modes, R5 key reuse, R6 global-index scatters)
+against the real tree —
 every perf PR lands against these machine-enforced invariants instead
 of review convention (LINTING.md).  Waived findings are tolerated by
 the gate but must carry a justification; the contract completeness
@@ -66,7 +67,8 @@ def test_every_public_op_declares_a_contract():
 
 def test_rule_catalog_is_complete():
     rules = default_rules()
-    assert [r.rule_id for r in rules] == ["R1", "R2", "R3", "R4", "R5"]
+    assert [r.rule_id for r in rules] == ["R1", "R2", "R3", "R4",
+                                          "R5", "R6"]
     for r in rules:
         assert r.name and r.summary
         assert inspect.signature(r.scan).parameters.keys() == {
@@ -83,7 +85,7 @@ def test_baseline_artifact_schema_and_freshness(repo_findings):
     with open(_BASELINE) as f:
         doc = json.load(f)
     assert doc["tool"] == "graftlint"
-    assert set(doc["rules"]) == {"R1", "R2", "R3", "R4", "R5"}
+    assert set(doc["rules"]) == {"R1", "R2", "R3", "R4", "R5", "R6"}
     assert doc["summary"]["unwaived"] == 0
     assert all(f["waiver"] for f in doc["findings"] if f["waived"])
     live = {(f.rule, f.path, f.source, f.waived) for f in repo_findings}

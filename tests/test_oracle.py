@@ -47,7 +47,8 @@ STAT_FIELDS = ["walk_success", "walk_fail", "msgs_stored", "msgs_dropped",
                "mm_requests", "mm_records", "id_requests", "id_records",
                "sig_signed", "sig_done", "sig_expired", "conflicts",
                "convictions_rx", "auth_unwound", "msgs_retro",
-               "bytes_up", "bytes_down", "accepted_by_meta"]
+               "bytes_up", "bytes_down", "accepted_by_meta",
+               "xshard_shed"]
 
 
 def assert_match(state, oracle, rnd):
@@ -80,6 +81,7 @@ def run_both(cfg, rounds, seed=0, author=None, warm=None):
         state = E.step(state, cfg)
         oracle.step()
         assert_match(jax.block_until_ready(state), oracle, rnd)
+    return state, oracle
 
 
 def test_rng_mirror():
@@ -142,3 +144,48 @@ def test_create_overflow_displaces_newest():
         assert_match(jax.block_until_ready(state), oracle, rnd)
     # the displaced-in record (payload 105) actually spread
     assert np.sum(np.asarray(state.store_payload) == 105) > 1
+
+
+def test_trace_capped_cross_shard_exchange():
+    """The ragged exchange's sender-side cap, oracle-mirrored: per
+    (source shard, destination shard) bucket only the first
+    ``cross_shard_budget`` push edges in (destination, class, edge)
+    order cross; overflow is charged to the SENDER as
+    ``stats.xshard_shed`` and the record simply doesn't arrive (the
+    bloom pull repairs it, like any bounded-inbox drop)."""
+    from dispersy_tpu.config import FaultModel, ParallelConfig
+    cfg = BASE.replace(
+        churn_rate=0.05, packet_loss=0.1, forward_fanout=2,
+        forward_buffer=2, push_inbox=3,
+        faults=FaultModel(flood_senders=(3, 5), flood_fanout=6),
+        parallel=ParallelConfig(shards=4, cross_shard_budget=1))
+    state, _ = run_both(cfg, rounds=8, seed=3, author=5, warm=4)
+    assert int(np.sum(np.asarray(state.stats.xshard_shed))) > 0, \
+        "budget never engaged — the capped path went untested"
+
+
+def test_trace_capped_exchange_under_priority_admission():
+    """With overload's priority admission armed, the cap and the
+    per-victim class-sorted admission compose: the cap picks bucket
+    winners by (class, edge), then admission re-sorts survivors per
+    victim.  Both orderings must mirror the oracle exactly."""
+    from dispersy_tpu.config import FaultModel, OverloadConfig, ParallelConfig
+    cfg = BASE.replace(
+        packet_loss=0.1, forward_fanout=2, forward_buffer=2, push_inbox=2,
+        faults=FaultModel(flood_senders=(3, 5), flood_fanout=6),
+        overload=OverloadConfig(enabled=True),
+        parallel=ParallelConfig(shards=4, cross_shard_budget=2))
+    state, _ = run_both(cfg, rounds=8, seed=1, author=5, warm=4)
+    assert int(np.sum(np.asarray(state.stats.xshard_shed))) > 0
+
+
+def test_trace_uncapped_shards_are_invisible():
+    """shards > 1 with budget 0 switches every delivery to the ragged
+    kernel but sizes buckets to the worst case: the oracle (which knows
+    nothing about sharding until the cap engages) must still match
+    bit-for-bit, and nothing sheds."""
+    from dispersy_tpu.config import ParallelConfig
+    cfg = BASE.replace(packet_loss=0.1, forward_fanout=2,
+                       forward_buffer=2, push_inbox=3,
+                       parallel=ParallelConfig(shards=4))
+    run_both(cfg, rounds=8, seed=0, author=5, warm=4)

@@ -387,7 +387,10 @@ def _as_v13(src: str, dst: str, cfg) -> None:
         "sig_gt": np.zeros((n,), np.uint32),
         "sig_since": np.zeros((n,), np.uint32),
         **{f"stats/{nm}": np.zeros((n,), np.uint32)
-           for nm, on in S.stats_gates(cfg).items() if not on},
+           for nm, on in S.stats_gates(cfg).items()
+           # a real v13 writer predates post-v13 counters entirely
+           # (e.g. the v16 xshard_shed) — never synthesize those
+           if not on and f"stats/{nm}" not in ckpt._NEW_V16},
     }
     for name, wide in inflate.items():
         arrays[f"leaf:{name}"] = wide

@@ -1,7 +1,7 @@
 """graftlint: the repo's multi-rule JAX hot-path analyzer.
 
 Grown from PR 1's single-purpose ``tools/check_host_sync.py`` into the
-codebase's correctness-tooling layer: five rules that machine-check the
+codebase's correctness-tooling layer: six rules that machine-check the
 performance contracts every perf PR lands against, wired into tier-1
 (tests/test_graftlint_repo.py) and runnable standalone:
 
@@ -18,6 +18,9 @@ Rules (catalog + waiver syntax + how-to-add: LINTING.md):
                         @contract declaration under jax.eval_shape
   R4 scatter-mode     — advanced-index scatters declare mode= explicitly
   R5 key-reuse        — no jax.random key consumed twice without a split
+  R6 global-index-scatter — flat product-extent scatters carry the
+                        2^31 two-form guard (int32 overflow + the
+                        XLA scatter-index cap on sharded fleets)
 
 Exit code: non-zero iff any unwaived finding exists.
 """

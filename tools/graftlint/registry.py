@@ -1,4 +1,4 @@
-"""The rule registry: one place that knows all five rules.
+"""The rule registry: one place that knows all six rules.
 
 Adding a rule (LINTING.md walks through this): implement an object with
 ``rule_id`` / ``name`` / ``summary`` / ``scan(modules, repo_root)``,
@@ -10,13 +10,13 @@ tests/test_graftlint.py.
 from __future__ import annotations
 
 from .rule_contracts import ContractRule
-from .rules_ast import (HostSyncRule, KeyReuseRule, RecompileRule,
-                        ScatterModeRule)
+from .rules_ast import (GlobalIndexScatterRule, HostSyncRule,
+                        KeyReuseRule, RecompileRule, ScatterModeRule)
 
 
 def default_rules() -> list:
     return [HostSyncRule(), RecompileRule(), ContractRule(),
-            ScatterModeRule(), KeyReuseRule()]
+            ScatterModeRule(), KeyReuseRule(), GlobalIndexScatterRule()]
 
 
 def rules_by_id(ids) -> list:

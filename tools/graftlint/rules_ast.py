@@ -536,3 +536,161 @@ class KeyReuseRule:
                     source=mod.line(lineno).strip()))
             last[name] = kind
         return findings
+
+
+# --------------------------------------------------------------- R6
+
+
+class GlobalIndexScatterRule:
+    """R6: flat global-index scatters without the ``2 ** 31`` guard.
+
+    The cheapest scatter layout flattens ``[rows, width]`` into one
+    buffer and scatters single-component indices ``row * width + col``.
+    That layout hits TWO hard walls the call site cannot see:
+
+    * the flat index itself overflows int32 once ``rows * width``
+      crosses 2^31 (x64 is off, so there is no int64 escape) — silently,
+      as mode="drop" OOB masking;
+    * XLA refuses to compile any one scatter with more than 2^31 - 1
+      scatter indices (``Scatter operations with more than 2147483647
+      scatter indices``) — on a peer-axis-sharded mesh the GLOBAL index
+      space keeps growing with fleet size even though each shard only
+      touches its own rows, which is exactly how the R-replica 1M-peer
+      fleet died at R = 7 (FLEET.md).
+
+    The repo idiom (ops/inbox.py, ops/bloom.py, ops/store.py) is the
+    two-form guard: ``if rows * width < 2 ** 31:`` flat form, else the
+    two-coordinate ``(row, col)`` form — shard-local row indices whose
+    extent XLA sees as bounded.  The rule: a single-component scatter
+    into a product-extent flat buffer (``jnp.zeros((a * b,) ...)``,
+    directly or via a name bound in the same scope) must sit in a scope
+    that tests ``2 ** 31`` (or the literal int32 bound).  Scope: every
+    module — host-built scatters hit the same wall.
+    """
+
+    rule_id = "R6"
+    name = "global-index-scatter"
+    summary = ("single-component scatters into flattened product-extent "
+               "buffers with no 2^31 two-form guard (int32 overflow + "
+               "the XLA scatter-index cap)")
+
+    SCATTER_METHODS = ScatterModeRule.SCATTER_METHODS
+    BUILDERS = {"zeros", "ones", "empty", "full"}
+    BOUND_CONSTANTS = {2 ** 31, 2 ** 31 - 1}
+
+    # -- guard detection ----------------------------------------------
+
+    def _is_bound_literal(self, node: ast.AST) -> bool:
+        if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Pow)
+                and isinstance(node.left, ast.Constant)
+                and node.left.value == 2
+                and isinstance(node.right, ast.Constant)
+                and node.right.value == 31):
+            return True
+        return (isinstance(node, ast.Constant)
+                and node.value in self.BOUND_CONSTANTS)
+
+    def _has_guard(self, scope: ast.AST) -> bool:
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Compare):
+                for side in [node.left] + node.comparators:
+                    for sub in ast.walk(side):
+                        if self._is_bound_literal(sub):
+                            return True
+        return False
+
+    # -- flat-buffer detection ----------------------------------------
+
+    def _product_extent(self, shape: ast.AST) -> bool:
+        """Does this shape expression start with an ``a * b`` extent?
+        Covers ``n * w``, ``(n * w,)``, and the column-append idiom
+        ``(n * w,) + c.shape[1:]``."""
+        if isinstance(shape, ast.Tuple):
+            return bool(shape.elts) and self._product_extent(shape.elts[0])
+        if isinstance(shape, ast.BinOp):
+            if isinstance(shape.op, ast.Mult):
+                return True
+            if isinstance(shape.op, ast.Add):    # tuple concatenation
+                return self._product_extent(shape.left)
+        return False
+
+    def _is_flat_builder(self, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in self.BUILDERS
+                and bool(node.args)
+                and self._product_extent(node.args[0]))
+
+    # -- scope scan ---------------------------------------------------
+
+    def scan(self, modules, repo_root) -> list:
+        findings = []
+        for mod in modules:
+            findings += self._scan_scope(mod, mod.tree, guarded=False)
+        return findings
+
+    def _scan_scope(self, mod, scope, guarded: bool) -> list:
+        # Each scope judges only its OWN statements, and nested function
+        # scopes INHERIT the guard: the two-form branch often closes
+        # over a helper (ops/store.py's ``interleave``) whose
+        # ``2 ** 31`` test sits in the enclosing function.  _has_guard
+        # walks the whole subtree, so a guard anywhere in the lexical
+        # nest (enclosing OR nested, like bloom's chunked scatter_rows)
+        # clears it.
+        def own_nodes(root):
+            """The scope's own nodes: stop at nested function defs —
+            they are judged as their own scopes."""
+            for child in ast.iter_child_nodes(root):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                yield child
+                yield from own_nodes(child)
+
+        guarded = guarded or self._has_guard(scope)
+        flat_names = set()
+        findings = []
+        def child_scopes(root):
+            for child in ast.iter_child_nodes(root):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    yield child
+                else:
+                    yield from child_scopes(child)
+
+        for child in child_scopes(scope):
+            findings += self._scan_scope(mod, child, guarded)
+        for node in own_nodes(scope):
+            if isinstance(node, ast.Assign) and \
+                    self._is_flat_builder(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        flat_names.add(t.id)
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self.SCATTER_METHODS):
+                continue
+            sub = node.func.value
+            if not (isinstance(sub, ast.Subscript)
+                    and isinstance(sub.value, ast.Attribute)
+                    and sub.value.attr == "at"):
+                continue
+            if isinstance(sub.slice, ast.Tuple):
+                continue        # multi-coordinate form — the fix
+            recv = sub.value.value
+            flat = (self._is_flat_builder(recv)
+                    or (isinstance(recv, ast.Name)
+                        and recv.id in flat_names))
+            if flat and not guarded:
+                findings.append(Finding(
+                    rule=self.rule_id, path=mod.rel,
+                    lineno=node.lineno,
+                    message="single-component scatter into a "
+                            "flattened product-extent buffer with no "
+                            "2 ** 31 guard in scope — the flat index "
+                            "overflows int32 and the XLA "
+                            "scatter-index cap kills sharded-fleet "
+                            "compiles; use the two-form idiom "
+                            "(ops/bloom.py scatter_rows)",
+                    source=mod.line(node.lineno).strip()))
+        return findings

@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import subprocess
 import sys
@@ -61,13 +62,19 @@ def _parse_cells(spec: str | None) -> list | None:
         return None
     cells = []
     for token in spec.split(","):
-        token = token.strip()
-        shape, _, plane = token.partition("/")
-        if shape not in costmodel.SHAPES or plane not in costmodel.PLANES:
-            raise SystemExit(f"unknown cell {token!r}; shapes="
+        parts = token.strip().split("/")
+        shape, plane = parts[0], parts[1] if len(parts) > 1 else ""
+        mesh = parts[2] if len(parts) > 2 else None
+        if (shape not in costmodel.SHAPES
+                or plane not in costmodel.PLANES
+                or len(parts) > 3
+                or (mesh is not None and mesh not in costmodel.MESHES)):
+            raise SystemExit(f"unknown cell {token.strip()!r}; shapes="
                              f"{sorted(costmodel.SHAPES)} "
-                             f"planes={list(costmodel.PLANES)}")
-        cells.append((shape, plane))
+                             f"planes={list(costmodel.PLANES)} "
+                             f"meshes={sorted(costmodel.MESHES)}")
+        cells.append((shape, plane) if mesh is None
+                     else (shape, plane, mesh))
     return cells
 
 
@@ -76,11 +83,22 @@ def _measure(cells, with_phases: bool) -> dict:
     argv = [sys.executable, os.path.abspath(__file__), "--worker",
             "--no-phases" if not with_phases else "--phases"]
     if cells is not None:
-        argv += ["--cells", ",".join(costmodel.cell_key(s, p)
-                                     for s, p in cells)]
+        argv += ["--cells", ",".join(costmodel.cell_key(*c)
+                                     for c in cells)]
+    # Mesh cells shard over virtual CPU devices: give the worker the
+    # largest mesh's device count.  Cost analysis of UNSHARDED compiles
+    # is device-count-independent, so mixed subsets stay comparable.
+    sizes = [1]
+    for c in (cells if cells is not None else costmodel.default_cells()):
+        if len(c) > 2:
+            d = costmodel.MESHES[c[2]]
+            sizes.append(int(d) if not isinstance(d, tuple)
+                         else int(math.prod(d)))
+    n_dev = max(sizes)
     try:
         proc = subprocess.run(
-            argv, env=cpu_env(), timeout=WORKER_TIMEOUT_S,
+            argv, env=cpu_env(n_dev if n_dev > 1 else None),
+            timeout=WORKER_TIMEOUT_S,
             capture_output=True, text=True,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     except subprocess.TimeoutExpired:

@@ -96,7 +96,8 @@ def _worker(args) -> None:
     import numpy as np
     from jax.experimental import multihost_utils
     from dispersy_tpu import engine
-    from dispersy_tpu.parallel.mesh import make_mesh, state_sharding
+    from dispersy_tpu.parallel.mesh import (make_mesh, partition_kind,
+                                            state_sharding)
     from dispersy_tpu.state import init_state
 
     def hb(msg):
@@ -160,12 +161,19 @@ def _worker(args) -> None:
             if rnd == 0:
                 hb(f"round 0 done (+{time.time() - t0:.1f}s incl. "
                    f"compiles)")
-            host = [np.asarray(x)
-                    for x in jax.tree_util.tree_leaves(local)]
+            flat, _ = jax.tree_util.tree_flatten_with_path(local)
+            # The slice-vs-replicate split must agree with the cluster
+            # ranks' ACTUAL shardings, which come from the partition-rule
+            # registry — classify by leaf name, not by the old
+            # length-equals-n heuristic.
+            host = [("/".join(str(getattr(k, "name", k)) for k in path),
+                     np.asarray(x)) for path, x in flat]
             for g in range(args.hash_groups):
                 h = _hl.sha256()
-                for arr in host:
-                    if arr.ndim >= 1 and arr.shape[0] == cfg.n_peers:
+                for name, arr in host:
+                    if (partition_kind(name) == "peers"
+                            and arr.ndim >= 1
+                            and arr.shape[0] == cfg.n_peers):
                         for d in range(DEVICES_PER_PROCESS):
                             lo = (g * DEVICES_PER_PROCESS + d) * per_dev
                             h.update(np.ascontiguousarray(
@@ -240,7 +248,15 @@ def _worker(args) -> None:
     t0 = time.time()
     curve = []
     for rnd in range(args.rounds):
-        gstate = jax.block_until_ready(step_sharded(gstate, cfg))
+        # Run under the mesh context so the engine's partition-rule pins
+        # arm (parallel/mesh.py pin_peers/pin_replicated — the
+        # zero-SPMD-warning layout), and block before the next round:
+        # overlapping async sharded dispatches can deadlock the
+        # in-process CPU communicator (parallel.sharded_step is the
+        # same recipe for single-process virtual meshes).
+        with mesh:
+            gstate = step_sharded(gstate, cfg)
+        gstate = jax.block_until_ready(gstate)
         if args.verify != "hash" and args.process_id == 0:
             # Only rank 0 pays for the full single-device replay — the
             # replicas would be bit-identical on every rank anyway

@@ -190,6 +190,45 @@ _NEW_V15 = frozenset(
 # _want_fingerprint), where this counter is zero-width.
 _NEW_V16 = frozenset({"stats/xshard_shed"})
 
+# The introduction registry, one row per format version that added
+# leaves — the machine-readable half of the version-history prose above.
+# A NEW leaf MUST be registered here under the bumped FORMAT_VERSION, or
+# restoring every older archive raises "checkpoint missing field"
+# instead of defaulting the leaf from the template (graftlint R7 checks
+# every extracted schema leaf against :func:`leaf_manifest`, and R8
+# refuses a leaf change without the version bump).
+_NEW_BY_VERSION: dict = {
+    9: _NEW_V9, 10: _NEW_V10, 12: _NEW_V12, 13: _NEW_V13,
+    14: _NEW_V14, 15: _NEW_V15, 16: _NEW_V16,
+}
+
+
+def _missing_ok(name: str, version: int) -> bool:
+    """May ``name`` be absent from a ``version`` archive (leaf introduced
+    later — restore defaults it from the config template)?"""
+    return any(version < v and name in new
+               for v, new in _NEW_BY_VERSION.items())
+
+
+def leaf_manifest(cfg: CommunityConfig | None = None) -> dict:
+    """The exported checkpoint leaf manifest: every PeerState leaf path
+    -> the format version that introduced it (leaves predating the
+    version registry map to the oldest accepted version).  Built from
+    the ABSTRACT template (``jax.eval_shape`` — no arrays materialize),
+    so it is cheap enough for lint/tooling to call freely."""
+    if cfg is None:
+        cfg = CommunityConfig()
+    template = jax.eval_shape(functools.partial(init_state, cfg),
+                              jax.ShapeDtypeStruct((2,), np.uint32))
+    names, _leaves, _ = _leaves_with_paths(template)
+    manifest = {}
+    for name in names:
+        introduced = [v for v, new in _NEW_BY_VERSION.items()
+                      if name in new]
+        manifest[name] = max(introduced) if introduced \
+            else _ACCEPTED_VERSIONS[0]
+    return manifest
+
 # Leaves v14 PLANE-SIZED (zero-width when their community feature is
 # compiled out — state.py init_state / stats_gates): a pre-v14 archive
 # carries them at full width but PROVABLY EMPTY (the engine only ever
@@ -472,17 +511,11 @@ def restore(path: str, cfg: CommunityConfig,
         for n, t in zip(names, t_leaves):
             key = f"leaf:{n}"
             if key not in z:
-                if (version < 9 and n in _NEW_V9) \
-                        or (version < 10 and n in _NEW_V10) \
-                        or (version < 12 and n in _NEW_V12) \
-                        or (version < 13 and n in _NEW_V13) \
-                        or (version < 14 and n in _NEW_V14) \
-                        or (version < 15 and n in _NEW_V15) \
-                        or (version < 16 and n in _NEW_V16):
-                    # pre-chaos-harness / pre-telemetry / pre-recovery
-                    # / pre-overload / pre-byte-diet archive: the leaf
-                    # starts at its template default (zero-width /
-                    # empty latch / all-good channels)
+                if _missing_ok(n, version):
+                    # the leaf postdates this archive's format
+                    # (_NEW_BY_VERSION): it starts at its template
+                    # default (zero-width / empty latch / all-good
+                    # channels)
                     leaves.append(np.asarray(t))
                     continue
                 raise CheckpointError(f"checkpoint missing field {n}")
@@ -596,17 +629,12 @@ def restore_fleet(path: str, cfg: CommunityConfig):
             for n, t in zip(names, t_leaves):
                 key = f"leaf:{n}"
                 if key not in z:
-                    if (version < 12 and n in _NEW_V12) \
-                            or (version < 13 and n in _NEW_V13) \
-                            or (version < 14 and n in _NEW_V14) \
-                            or (version < 15 and n in _NEW_V15) \
-                            or (version < 16 and n in _NEW_V16):
-                        # pre-recovery / pre-overload / pre-byte-diet
-                        # fleet archive: only accepted under the
-                        # default Recovery/Overload/StoreConfig
-                        # (fingerprint check above), where every such
-                        # leaf is zero-width — replicate the template
-                        # default.
+                    if _missing_ok(n, version):
+                        # the leaf postdates this fleet archive's
+                        # format (_NEW_BY_VERSION): only accepted under
+                        # the default plane config (fingerprint check
+                        # above), where every such leaf is zero-width —
+                        # replicate the template default.
                         leaves.append(np.zeros((n_rep,) + tuple(t.shape),
                                                t.dtype))
                         continue
@@ -861,16 +889,9 @@ def restore_sharded(dirpath: str, cfg: CommunityConfig,
                     f"field {name}: checkpoint {arr.shape}/{arr.dtype} vs "
                     f"config {t.shape}/{t.dtype}")
             leaves.append(arr)
-        elif ((version < 9 and name in _NEW_V9)
-              or (version < 10 and name in _NEW_V10)
-              or (version < 12 and name in _NEW_V12)
-              or (version < 13 and name in _NEW_V13)
-              or (version < 14 and name in _NEW_V14)
-              or (version < 15 and name in _NEW_V15)
-              or (version < 16 and name in _NEW_V16)) \
-                and not covered[name].any():
-            # pre-chaos-harness / pre-telemetry archive: template
-            # default (state.py)
+        elif _missing_ok(name, version) and not covered[name].any():
+            # the leaf postdates this archive's format
+            # (_NEW_BY_VERSION): template default (state.py)
             leaves.append(np.asarray(t))
         else:
             if not covered[name].all():

@@ -48,6 +48,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dispersy_tpu.ops.contracts import Spec, contract, host_helper
 from dispersy_tpu.state import PeerState
 
 PEER_AXIS = "peers"
@@ -81,6 +82,7 @@ PARTITION_RULES: tuple[tuple[str, str], ...] = (
 )
 
 
+@host_helper
 def partition_kind(name: str) -> str:
     """``"peers"`` or ``"replicated"`` for one leaf name — the registry
     lookup, shared with checkpoint.save_sharded's shard-vs-meta split."""
@@ -106,6 +108,7 @@ def _check_peer_leaf(name: str, leaf, n_peers: int) -> None:
             "(dispersy_tpu/parallel/mesh.py)")
 
 
+@host_helper
 def partition_table(state, n_peers: int) -> dict:
     """leaf name -> (placement, shape, dtype) for a state/shape pytree —
     the registry applied and VALIDATED (docs + tests; PARALLEL.md's
@@ -120,6 +123,7 @@ def partition_table(state, n_peers: int) -> dict:
     return out
 
 
+@host_helper
 def make_mesh(shape: int | tuple | None = None, devices=None) -> Mesh:
     """A peer-axis mesh over the available devices.
 
@@ -143,6 +147,7 @@ def make_mesh(shape: int | tuple | None = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices[:need]).reshape(shape), axes)
 
 
+@host_helper
 def peer_spec(mesh: Mesh, ndim: int) -> P:
     """The peer-leaf PartitionSpec on ``mesh``: dim 0 sharded over every
     mesh axis, trailing dims replicated."""
@@ -151,6 +156,7 @@ def peer_spec(mesh: Mesh, ndim: int) -> P:
     return P(lead, *([None] * (ndim - 1)))
 
 
+@host_helper
 def state_sharding(state: PeerState, mesh: Mesh, n_peers: int):
     """A ``PeerState``-shaped pytree of NamedShardings, from the
     partition-rule registry (:data:`PARTITION_RULES`) — name-classified,
@@ -167,11 +173,13 @@ def state_sharding(state: PeerState, mesh: Mesh, n_peers: int):
     return jax.tree_util.tree_unflatten(treedef, shardings)
 
 
+@host_helper
 def shard_state(state: PeerState, mesh: Mesh, n_peers: int) -> PeerState:
     """Place ``state`` on the mesh, peer axis sharded, scalars replicated."""
     return jax.device_put(state, state_sharding(state, mesh, n_peers))
 
 
+@host_helper
 def sharded_shape_structs(shapes, mesh: Mesh, n_peers: int):
     """Attach the peer-axis sharding to a ``ShapeDtypeStruct`` pytree.
 
@@ -187,6 +195,7 @@ def sharded_shape_structs(shapes, mesh: Mesh, n_peers: int):
         shapes, shardings)
 
 
+@host_helper
 def ambient_mesh() -> Mesh | None:
     """The mesh this trace runs under (``with mesh:``), or None.
 
@@ -199,6 +208,7 @@ def ambient_mesh() -> Mesh | None:
     return None if m.empty else m
 
 
+@contract(out=Spec("uint32", ("N",)), x=Spec("uint32", ("N",)))
 def pin_peers(x):
     """Pin dim 0 of ``x`` to the peer-axis layout of the ambient mesh
     (identity when unsharded).  Dropped at the engine's phase
@@ -211,6 +221,7 @@ def pin_peers(x):
         x, NamedSharding(mesh, peer_spec(mesh, x.ndim)))
 
 
+@contract(out=Spec("uint32", ("N",)), x=Spec("uint32", ("N",)))
 def pin_replicated(x):
     """Pin ``x`` fully replicated on the ambient mesh (identity when
     unsharded) — for tracker-row and reduction intermediates whose
@@ -221,6 +232,7 @@ def pin_replicated(x):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
 
 
+@host_helper
 def sharded_step(state: PeerState, cfg, mesh: Mesh):
     """ONE round of ``engine.step`` under ``mesh``, fully synchronized.
 

@@ -440,27 +440,118 @@ def init_stats(config: CommunityConfig) -> Stats:
                  accepted_by_meta=jnp.zeros((n, n_meta + 1), jnp.uint32))
 
 
-# Community-INSTANCE memory: the one inventory of fields that die when
-# the community instance goes away while the database (store) persists.
-# Consumed by engine.unload_members (Community.unload_community) and
+# The NAMED WIPE INVENTORY: every PeerState leaf classified by what a
+# wiped-disk rebirth (engine._rebirth_wipe — churn phase 0 and the
+# recovery plane's quarantine escalation) and a community unload
+# (engine.unload_members) do to it.  This is the introspectable registry
+# graftlint R7 cross-references against the extracted leaf schema
+# (tools/graftlint/schema.py) and tests/test_wipe_inventory.py iterates,
+# so a NEW leaf without a classification is a lint failure, not a
+# silently-unwiped field.  ``Stats`` counters are implicitly class
+# "stats" (accounting survives both events) and carry no entry here.
+#
+# Classes:
+#   "lifecycle" — liveness flags the churn/load machinery drives
+#                 directly (alive, loaded).
+#   "identity"  — a property of the peer's identity / router / the
+#                 overlay's opinion of it: survives BOTH rebirth and
+#                 unload (is_tracker, ge_bad, bucket, quar_until).
+#   "process"   — process memory reset by a rebirth (a restart is a new
+#                 process) but untouched by unload (health, backoff,
+#                 repair_round).
+#   "clock"     — rebirth-reset round bookkeeping: global_time restarts
+#                 at 1, session bumps.
+#   "disk"      — database state: survives unload, wiped with the store
+#                 by a wiped-disk rebirth (store/staging columns, the
+#                 epoch digest, the store-folded auth table, the
+#                 per-peer trace lineage rows).
+#   "instance"  — community-INSTANCE memory that dies when the instance
+#                 goes away while the database persists: wiped by BOTH
+#                 rebirth and unload.  Second tuple element is the fill
+#                 kind (resolved per dtype in wipe_instance_memory).
+#   "stats"     — stats-adjacent runtime state that survives both, like
+#                 the counters it derives from (walk_streak).
+#   "global"    — host-/slot-indexed leaves with no per-peer row to
+#                 wipe (trace registry + latches, telemetry rings, RNG
+#                 key, clocks).
+WIPE_INVENTORY: dict = {
+    "alive": ("lifecycle", None),
+    "loaded": ("lifecycle", None),
+    "is_tracker": ("identity", None),
+    "session": ("clock", None),
+    "global_time": ("clock", None),
+    "health": ("process", None),
+    "ge_bad": ("identity", None),
+    "backoff": ("process", None),
+    "quar_until": ("identity", None),
+    "repair_round": ("process", None),
+    "bucket": ("identity", None),
+    "walk_streak": ("stats", None),
+    "tele_row": ("global", None),
+    "tele_ring": ("global", None),
+    "fr_ring": ("global", None),
+    "fr_pos": ("global", None),
+    "trace_member": ("global", None),
+    "trace_gt": ("global", None),
+    "trace_first": ("disk", None),
+    "trace_chan": ("disk", None),
+    "trace_dups": ("disk", None),
+    "trace_latch": ("global", None),
+    "cand_peer": ("instance", "no_peer"),
+    "cand_last_walk": ("instance", "never"),
+    "cand_last_stumble": ("instance", "never"),
+    "cand_last_intro": ("instance", "never"),
+    "store_gt": ("disk", None),
+    "store_member": ("disk", None),
+    "store_meta": ("disk", None),
+    "store_payload": ("disk", None),
+    "store_aux": ("disk", None),
+    "store_flags": ("disk", None),
+    "sta_gt": ("disk", None),
+    "sta_member": ("disk", None),
+    "sta_meta": ("disk", None),
+    "sta_payload": ("disk", None),
+    "sta_aux": ("disk", None),
+    "sta_flags": ("disk", None),
+    "digest": ("disk", None),
+    "fwd_gt": ("instance", "empty"),
+    "fwd_member": ("instance", "empty"),
+    "fwd_meta": ("instance", "empty"),
+    "fwd_payload": ("instance", "empty"),
+    "fwd_aux": ("instance", "empty"),
+    "auth_member": ("disk", None),
+    "auth_mask": ("disk", None),
+    "auth_gt": ("disk", None),
+    "auth_rev": ("disk", None),
+    "auth_issuer": ("disk", None),
+    "mal_member": ("instance", "empty"),
+    "dly_gt": ("instance", "empty"),
+    "dly_member": ("instance", "empty"),
+    "dly_meta": ("instance", "empty"),
+    "dly_payload": ("instance", "empty"),
+    "dly_aux": ("instance", "zero"),
+    "dly_since": ("instance", "zero"),
+    "dly_src": ("instance", "no_peer"),
+    "sig_target": ("instance", "no_peer"),
+    "sig_meta": ("instance", "zero"),
+    "sig_payload": ("instance", "zero"),
+    "sig_gt": ("instance", "zero"),
+    "sig_since": ("instance", "zero"),
+    "key": ("global", None),
+    "time": ("global", None),
+    "round_index": ("global", None),
+}
+
+# Community-INSTANCE memory: the fields that die when the community
+# instance goes away while the database (store) persists — the
+# "instance" rows of WIPE_INVENTORY, with their fill kinds.  Consumed by
+# engine.unload_members (Community.unload_community) and
 # checkpoint._wipe_ephemeral (app-restart restore); the churn-rebirth
 # block in engine.step phase 0 wipes a SUPERSET of this (plus the store,
-# clocks, auth table, and loaded — a wiped-disk rebirth) and cross-refs
-# this list.  Fill kinds resolve per field dtype in wipe_instance_memory.
-INSTANCE_MEMORY_FIELDS: tuple = (
-    ("cand_peer", "no_peer"),
-    ("cand_last_walk", "never"),
-    ("cand_last_stumble", "never"),
-    ("cand_last_intro", "never"),
-    ("fwd_gt", "empty"), ("fwd_member", "empty"), ("fwd_meta", "empty"),
-    ("fwd_payload", "empty"), ("fwd_aux", "empty"),
-    ("sig_target", "no_peer"), ("sig_meta", "zero"),
-    ("sig_payload", "zero"), ("sig_gt", "zero"), ("sig_since", "zero"),
-    ("mal_member", "empty"),
-    ("dly_gt", "empty"), ("dly_member", "empty"), ("dly_meta", "empty"),
-    ("dly_payload", "empty"), ("dly_aux", "zero"), ("dly_since", "zero"),
-    ("dly_src", "no_peer"),
-)
+# clocks, auth table, and loaded — a wiped-disk rebirth).
+INSTANCE_MEMORY_FIELDS: tuple = tuple(
+    (name, fill) for name, (cls, fill) in WIPE_INVENTORY.items()
+    if cls == "instance")
 
 
 def wipe_instance_memory(state: PeerState, mask) -> PeerState:

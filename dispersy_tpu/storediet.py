@@ -66,6 +66,7 @@ from __future__ import annotations
 import dataclasses
 
 from dispersy_tpu.exceptions import ConfigError
+from dispersy_tpu.ops.contracts import host_helper
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,6 +106,7 @@ class StoreConfig:
                 "— set store.staging > 0 too")
 
 
+@host_helper
 def epoch_of(cfg, rnd):
     """The bloom-salt epoch of round ``rnd`` (host int or traced u32):
     ``rnd // compact_every``.  Requesters build/maintain the digest with
@@ -113,6 +115,7 @@ def epoch_of(cfg, rnd):
     return rnd // cfg.store.compact_every
 
 
+@host_helper
 def sync_round_of(cfg, rnd):
     """Cadence predicate (host int or traced u32, like ``epoch_of``):
     does round ``rnd`` run the sync exchange + compaction?  Always True
@@ -123,6 +126,7 @@ def sync_round_of(cfg, rnd):
     return (rnd % c) == c - 1
 
 
+@host_helper
 def phase_of(cfg, rnd: int) -> str:
     """The static ``engine.step`` phase for round ``rnd`` ("sync" or
     "quiet") — for drivers that know the round index host-side and want

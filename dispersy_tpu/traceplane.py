@@ -78,6 +78,7 @@ import dataclasses
 import numpy as np
 
 from dispersy_tpu.exceptions import ConfigError
+from dispersy_tpu.ops.contracts import host_helper
 
 # First-delivery channel codes (PeerState.trace_chan values; 0 = no
 # delivery yet).  Code c maps to CHANNEL_NAMES[c - 1].
@@ -126,6 +127,7 @@ class TraceConfig:
         return dataclasses.replace(self, **kw)
 
 
+@host_helper
 def redundancy_f32(delivered, dup) -> float:
     """The row's redundancy ratio from per-channel useful/duplicate
     totals — float32 op-for-op (the engine computes the identical
@@ -149,6 +151,7 @@ def redundancy_f32(delivered, dup) -> float:
     return float(np.float32((useful_f + dup_f) / useful_f))
 
 
+@host_helper
 def trace_totals(state, cfg) -> dict:
     """The trace plane's snapshot keys from a materialized state — the
     legacy (telemetry-off) ``metrics.snapshot`` path's source, emitting
@@ -181,6 +184,7 @@ def trace_totals(state, cfg) -> dict:
     return out
 
 
+@host_helper
 def slots_in_rows(rows) -> list:
     """Tracked-slot indices present in a row log (``trace_cov_<k>``
     keys), sorted."""
@@ -195,6 +199,7 @@ def slots_in_rows(rows) -> list:
     return sorted(slots)
 
 
+@host_helper
 def coverage_curve(rows, slot: int) -> list:
     """``(round, covered, alive_members)`` triples for one slot, rounds
     ascending — the dissemination curve the reference's experiment
@@ -208,6 +213,7 @@ def coverage_curve(rows, slot: int) -> list:
     return out
 
 
+@host_helper
 def latency_percentiles(rows, slot: int,
                         pcts=(10, 25, 50, 75, 90, 99)) -> dict:
     """First-arrival latency percentiles for one tracked record, in
@@ -226,6 +232,7 @@ def latency_percentiles(rows, slot: int,
     return out
 
 
+@host_helper
 def channel_table(rows) -> dict:
     """Per-channel useful/duplicate totals and useful-delivery shares
     from a row log's LAST row (the counters are cumulative)."""
@@ -244,6 +251,7 @@ def channel_table(rows) -> dict:
     return out
 
 
+@host_helper
 def trace_report(rows) -> dict:
     """Dissemination summary of a run log — the trace analogue of
     ``overload.shed_report`` / ``recovery.mttr_report``, consumed by

@@ -345,8 +345,12 @@ def test_unparseable_file_becomes_an_unwaivable_finding(tmp_path):
     mods = load_modules(str(tmp_path))
     assert any(m.parse_error for m in mods)
     findings = run(repo_root=str(tmp_path), rules=[])
-    assert len(findings) == 1
-    f = findings[0]
+    # this checkout's waivers.txt entries can't match the tmp tree, so
+    # W0 stale-waiver findings ride along — only R0 is under test here
+    r0 = [f for f in findings if f.rule == "R0"]
+    assert all(f.rule in ("R0", "W0") for f in findings)
+    assert len(r0) == 1
+    f = r0[0]
     assert (f.rule, f.path, f.waived) == ("R0", "dispersy_tpu/broken.py",
                                           False)
     assert "does not parse" in f.message
@@ -357,7 +361,8 @@ def test_r3_import_failure_is_a_finding_not_a_crash(monkeypatch):
     raw traceback — R3 reports it and the other rules still run."""
     import tools.graftlint.rule_contracts as rc
 
-    monkeypatch.setattr(rc, "OPS_MODULES", ("hashing", "nonexistent_op"))
+    monkeypatch.setattr(rc, "SURFACE_MODULES",
+                        ("ops.hashing", "ops.nonexistent_op"))
     import tools.graftlint.core as core
     findings = ContractRule().scan(core.load_modules(), core.REPO_ROOT)
     assert any(f.path == "dispersy_tpu/ops/nonexistent_op.py"
@@ -425,9 +430,9 @@ def test_shim_surfaces_hot_path_parse_failures(tmp_path):
 
 def test_rules_by_id_selects_and_rejects():
     assert [r.rule_id for r in rules_by_id(["R1", "R4"])] == ["R1", "R4"]
-    assert len(default_rules()) == 6
+    assert len(default_rules()) == 10
     with pytest.raises(KeyError):
-        rules_by_id(["R9"])
+        rules_by_id(["R99"])
 
 
 # ------------------------------------------------------------------ R6
@@ -493,3 +498,345 @@ def test_r6_inline_waiver_applies():
     )
     findings = run_rule(GlobalIndexScatterRule(), src)
     assert len(findings) == 1 and findings[0].waived
+
+
+# ------------------------------------------------------------------ R7
+# The plane-coverage checks are pure staticmethods over injected data,
+# so the injected-defect proofs never mutate the real tree.
+
+from tools.graftlint import schema as GS  # noqa: E402
+from tools.graftlint.rule_schema import (ConfigPlaneRule,  # noqa: E402
+                                         PlaneCoverageRule,
+                                         SchemaDriftRule)
+from tools.graftlint.rule_rng import RngStreamRule  # noqa: E402
+
+LEAF = {"dtype": "uint32", "shape": [4], "plane": "core",
+        "zero_width_at_defaults": False}
+
+
+def test_r7_leaf_without_oracle_mirror_fires():
+    leaves = {"cand_peer": LEAF, "stats/walk_success": LEAF,
+              "ghost_new_leaf": LEAF, "key": LEAF}  # key: ORACLE_EXEMPT
+    keys = {"cand_peer", "walk_success"}
+    findings = PlaneCoverageRule.oracle_findings(leaves, keys)
+    assert len(findings) == 1
+    assert findings[0].source == "ghost_new_leaf"
+    assert "no oracle mirror" in findings[0].message
+
+
+def test_r7_stale_oracle_key_fires():
+    leaves = {"cand_peer": LEAF}
+    findings = PlaneCoverageRule.oracle_findings(
+        leaves, {"cand_peer", "removed_leaf"})
+    assert len(findings) == 1
+    assert "stale mirror" in findings[0].message
+    assert findings[0].source == "removed_leaf"
+
+
+def test_r7_unregistered_new_leaf_fires_and_registered_is_clean():
+    leaves = {"old_leaf": LEAF, "new_leaf": LEAF}
+    artifact = {"leaves": {"old_leaf": LEAF}, "checkpoint_version": 15}
+    # registered at v16, artifact at v15, live format v16: clean
+    ok = PlaneCoverageRule.checkpoint_findings(
+        leaves, {16: ("new_leaf",)}, artifact, 16)
+    assert ok == []
+    # not registered anywhere: the restore skip-list gap is a finding
+    bad = PlaneCoverageRule.checkpoint_findings(leaves, {}, artifact, 16)
+    assert len(bad) == 1 and bad[0].source == "new_leaf"
+    assert "_NEW_BY_VERSION" in bad[0].message
+    # registered at a pre-artifact version (<= 15) is just as broken
+    bad2 = PlaneCoverageRule.checkpoint_findings(
+        leaves, {14: ("new_leaf",)}, artifact, 16)
+    assert len(bad2) == 1 and bad2[0].source == "new_leaf"
+
+
+def test_r7_ghost_version_registry_entry_fires():
+    findings = PlaneCoverageRule.checkpoint_findings(
+        {"real_leaf": LEAF}, {16: ("ghost",)}, None, 16)
+    assert len(findings) == 1 and findings[0].source == "ghost"
+    assert "not a live PeerState leaf" in findings[0].message
+
+
+def test_r7_partition_leading_dim_mismatch_fires():
+    kind_of = lambda nm: "replicated" if nm == "time" else "peers"  # noqa: E731
+    templates = ((
+        "core", 8,
+        {"good": ((8, 3), "uint32"), "zero_ok": ((0, 2), "uint8"),
+         "time": ((), "uint32"), "bad": ((5,), "uint32")},),)
+    findings = PlaneCoverageRule.partition_findings(templates, kind_of)
+    assert len(findings) == 1 and findings[0].source == "bad"
+    assert "leading dim 5" in findings[0].message
+
+
+def test_r7_wipe_inventory_totality_fires_both_directions():
+    leaves = {"cand_peer": LEAF, "stats/walk_success": LEAF,
+              "unclassified": LEAF}
+    inventory = {"cand_peer": ("instance", "no_peer"),
+                 "walk_success": ("stats", None),   # counter: wrong table
+                 "departed": ("instance", "zero")}  # stale
+    findings = PlaneCoverageRule.wipe_findings(leaves, inventory)
+    by_src = {f.source: f.message for f in findings}
+    assert set(by_src) == {"unclassified", "walk_success", "departed"}
+    assert "not classified" in by_src["unclassified"]
+    assert "Stats counter" in by_src["walk_success"]
+    assert "stale" in by_src["departed"]
+
+
+def test_r7_stale_stats_gate_fires():
+    findings = PlaneCoverageRule.gate_findings(
+        ("walk_success",), {"walk_success": True, "removed_ctr": False})
+    assert len(findings) == 1 and findings[0].source == "removed_ctr"
+
+
+# ------------------------------------------------------------------ R8
+
+
+def _schema_doc(leaves, cv=16):
+    return {"version": GS.SCHEMA_VERSION, "checkpoint_version": cv,
+            "leaves": leaves}
+
+
+def test_r8_leaf_change_without_version_bump_fires():
+    live = _schema_doc({"a": LEAF, "b": LEAF}, cv=16)
+    art = _schema_doc({"a": LEAF}, cv=16)
+    findings = SchemaDriftRule.drift_findings(live, art)
+    assert len(findings) == 1 and findings[0].source == "b"
+    assert "without a checkpoint.FORMAT_VERSION bump" in findings[0].message
+    # dtype drift on an existing leaf is the same hazard
+    wider = dict(LEAF, dtype="int32")
+    findings = SchemaDriftRule.drift_findings(
+        _schema_doc({"a": wider}, cv=16), _schema_doc({"a": LEAF}, cv=16))
+    assert len(findings) == 1
+    assert "'uint32' -> 'int32'" in findings[0].message
+
+
+def test_r8_bump_without_regeneration_and_stale_artifact_fire():
+    live = _schema_doc({"a": LEAF, "b": LEAF}, cv=17)
+    art = _schema_doc({"a": LEAF}, cv=16)
+    findings = SchemaDriftRule.drift_findings(live, art)
+    assert len(findings) == 1 and "regenerate" in findings[0].message
+    # same leaves but recorded version stale: regenerate, not per-leaf
+    findings = SchemaDriftRule.drift_findings(
+        _schema_doc({"a": LEAF}, cv=17), art)
+    assert len(findings) == 1 and "identical leaves" in findings[0].message
+
+
+def test_r8_missing_or_mismatched_artifact_fires():
+    live = _schema_doc({"a": LEAF})
+    assert ["missing" in f.message
+            for f in SchemaDriftRule.drift_findings(live, None)] == [True]
+    old = dict(_schema_doc({"a": LEAF}), version=GS.SCHEMA_VERSION + 1)
+    findings = SchemaDriftRule.drift_findings(live, old)
+    assert len(findings) == 1 and "format version" in findings[0].message
+
+
+def test_r8_identical_schema_is_clean():
+    live = _schema_doc({"a": LEAF, "stats/b": LEAF})
+    assert SchemaDriftRule.drift_findings(live, json.loads(
+        json.dumps(live))) == []
+
+
+# ------------------------------------------------------------------ R9
+
+
+def _config_src(plane_order=None, extra_after=False, drop_gate=None):
+    """A CommunityConfig skeleton in the real module's shape — the tail
+    order and the per-plane isinstance gates are what R9 reads."""
+    planes = list(plane_order if plane_order is not None else GS.PLANES)
+    lines = ["class CommunityConfig:", "    n_peers: int = 64",
+             "    churn_rate: float = 0.0"]
+    lines += [f"    {fld}: {cls} = None" for fld, cls in planes]
+    if extra_after:
+        lines.append("    straggler: int = 0")
+    lines.append("    def __post_init__(self):")
+    gates = [(f, c) for f, c in GS.PLANES if c != drop_gate]
+    for fld, cls in gates:
+        lines += [f"        if not isinstance(self.{fld}, {cls}):",
+                  f"            raise ConfigError('{fld}')"]
+    return "\n".join(lines) + "\n"
+
+
+def _config_findings(src):
+    return ConfigPlaneRule.config_findings(
+        fake_module(src, rel="dispersy_tpu/config.py"))
+
+
+def test_r9_well_formed_config_is_clean():
+    assert _config_findings(_config_src()) == []
+
+
+def test_r9_field_appended_after_plane_tail_fires():
+    findings = _config_findings(_config_src(extra_after=True))
+    msgs = [f.message for f in findings]
+    assert any("must be exactly" in m for m in msgs)
+    # the shifted-out plane field is also named individually
+    assert any("outside the fingerprint tail" in m for m in msgs)
+
+
+def test_r9_reordered_plane_tail_fires():
+    planes = list(GS.PLANES)
+    planes[-1], planes[-2] = planes[-2], planes[-1]
+    findings = _config_findings(_config_src(plane_order=planes))
+    assert len(findings) == 1
+    assert "BY POSITION" in findings[0].message
+
+
+def test_r9_missing_plane_scope_gate_fires():
+    cls_name = GS.PLANES[-1][1]
+    findings = _config_findings(_config_src(drop_gate=cls_name))
+    assert len(findings) == 1
+    assert cls_name in findings[0].message
+    assert "scope gate" in findings[0].message
+
+
+def test_r9_plane_leaf_allocating_at_defaults_fires():
+    leaves = {
+        "core_full": dict(LEAF),                       # core: allowed
+        "trace_member": dict(LEAF, plane="trace",
+                             zero_width_at_defaults=True),   # gated: fine
+        "fat_leaf": dict(LEAF, plane="store")}         # allocates: bad
+    findings = ConfigPlaneRule.gating_findings(leaves)
+    assert len(findings) == 1 and findings[0].source == "fat_leaf"
+    assert "zero width" in findings[0].message
+
+
+# ----------------------------------------------------------------- R10
+
+
+def test_r10_extra_draw_site_for_existing_stream_fires():
+    consts = {"P_GE": 10}
+    sites = {"P_GE": {"dispersy_tpu/ops/faults.py": [5, 9]}}
+    art = {"P_GE": {"value": 10,
+                    "sites": {"dispersy_tpu/ops/faults.py": 1}}}
+    findings = RngStreamRule.stream_findings(consts, {}, sites, art)
+    assert len(findings) == 1
+    f = findings[0]
+    assert (f.path, f.lineno, f.source) == ("dispersy_tpu/ops/faults.py",
+                                            9, "P_GE")
+    assert "base sequences never shift" in f.message
+
+
+def test_r10_injected_p_ge_site_fails_the_repo_gate():
+    """End to end: a module referencing P_GE at a site the committed
+    registry does not record must fail the real scan."""
+    import tools.graftlint.core as core
+
+    mods = core.load_modules() + [fake_module(
+        "from dispersy_tpu.ops.rng import P_GE, rand_u32\n"
+        "def extra_draw(seed, rnd, peer):\n"
+        "    return rand_u32(seed, rnd, peer, P_GE, salt=99)\n",
+        rel="dispersy_tpu/ops/fake_extra_site.py")]
+    findings = RngStreamRule().scan(mods, core.REPO_ROOT)
+    assert any(f.path == "dispersy_tpu/ops/fake_extra_site.py"
+               and f.source == "P_GE"
+               and "base sequences never shift" in f.message
+               for f in findings)
+
+
+def test_r10_duplicate_tag_values_fire():
+    consts = {"P_A": 3, "P_B": 3}
+    art = {"P_A": {"value": 3, "sites": {}},
+           "P_B": {"value": 3, "sites": {}}}
+    findings = RngStreamRule.stream_findings(
+        consts, {"P_A": 4, "P_B": 5}, {}, art)
+    assert len(findings) == 1 and findings[0].lineno == 5
+    assert "share tag value 3" in findings[0].message
+
+
+def test_r10_tag_value_change_and_registry_staleness_fire():
+    art = {"P_GE": {"value": 10, "sites": {"dispersy_tpu/x.py": 2}},
+           "P_GONE": {"value": 11, "sites": {}}}
+    consts = {"P_GE": 12, "P_FRESH": 13}
+    sites = {"P_GE": {"dispersy_tpu/x.py": [4]}}
+    msgs = [f.message for f in RngStreamRule.stream_findings(
+        consts, {}, sites, art)]
+    assert any("changed tag value 10 -> 12" in m for m in msgs)
+    assert any("no longer exists" in m for m in msgs)       # P_GONE
+    assert any("new purpose stream P_FRESH" in m for m in msgs)
+    assert any("stale registry" in m for m in msgs)         # 2 -> 1 refs
+    assert len(msgs) == 4
+
+
+def test_r10_missing_artifact_is_a_single_finding():
+    findings = RngStreamRule.stream_findings({"P_GE": 10}, {}, {}, None)
+    assert len(findings) == 1
+    assert findings[0].path == GS.SCHEMA_ARTIFACT
+    assert "--write-schema" in findings[0].message
+
+
+def test_r10_integer_literal_purpose_fires():
+    mod = fake_module(
+        "a = rand_u32(seed, rnd, peer, 3)\n"
+        "b = rng.rand_uniform(seed, rnd, peer, purpose=7)\n"
+        "c = rand_u32(seed, rnd, peer, P_GE)\n"
+        "d = rand_u32(seed, rnd)\n",
+        rel="dispersy_tpu/fake_host.py")
+    findings = RngStreamRule.literal_purpose_findings([mod])
+    assert [f.lineno for f in findings] == [1, 2]
+    assert all("integer-literal" in f.message for f in findings)
+    # rng.py itself defines the streams — its internals are exempt
+    assert RngStreamRule.literal_purpose_findings(
+        [fake_module("x = rand_u32(s, r, p, 1)\n",
+                     rel=GS.RNG_MODULE)]) == []
+
+
+# ----------------------------------------------- W0 stale waivers + diff
+
+
+def test_stale_waiver_detection_fires_and_respects_scope():
+    from tools.graftlint.core import stale_waiver_findings
+
+    mod = fake_module("x = arr.item()\n", rel="dispersy_tpu/ops/live.py")
+    waivers = [
+        ("R1", "dispersy_tpu/ops/live.py", "arr.item()", "matches"),
+        ("R1", "dispersy_tpu/ops/live.py", "vanished()", "rotted"),
+        ("R4", "dispersy_tpu/ops/gone.py", "whatever", "file removed"),
+    ]
+    findings = stale_waiver_findings([mod], waivers)
+    assert [f.rule for f in findings] == ["W0", "W0"]
+    assert all(f.path == "tools/graftlint/waivers.txt" for f in findings)
+    assert "no longer matches" in findings[0].message
+    assert "not in the scan scope" in findings[1].message
+    # --changed-only: a module absent from a FILTERED scan proves nothing
+    partial = stale_waiver_findings([mod], waivers, full_scope=False)
+    assert [f.message for f in partial] == [findings[0].message]
+
+
+def test_stale_waiver_findings_cannot_be_waived():
+    from tools.graftlint.core import stale_waiver_findings
+
+    waivers = [("R4", "dispersy_tpu/ops/gone.py", "whatever", "why")]
+    findings = stale_waiver_findings([], waivers)
+    assert len(findings) == 1
+    # even a waivers.txt entry targeting the W0 finding itself is inert
+    apply_waivers(findings, [], file_waivers=[
+        ("W0", "tools/graftlint/waivers.txt", "gone.py", "turtles")])
+    assert not findings[0].waived
+
+
+def test_diff_classifies_new_fixed_and_still_waived():
+    from tools.graftlint.core import diff_findings, report_diff_text
+
+    rule = ScatterModeRule()
+    findings = run_rule(rule, R4_SRC)
+    baseline = json.loads(report_json(findings, [rule]))
+    # same findings, linenos shifted: the same finding, not new+fixed
+    for f in findings:
+        f.lineno += 3
+    diff = diff_findings(findings, baseline)
+    assert diff["new"] == [] and diff["fixed"] == []
+    assert [f.waived for f in diff["still_waived"]] == [True]
+    # drop one finding, invent another: one fixed, one new
+    dropped, kept = findings[0], findings[1:]
+    from tools.graftlint.core import Finding
+    fresh = Finding(rule="R4", path="dispersy_tpu/ops/fake_op.py",
+                    lineno=99, message="brand new scatter", source="zzz")
+    diff = diff_findings(kept + [fresh], baseline)
+    assert [f.message for f in diff["new"]] == ["brand new scatter"]
+    assert [d["message"] for d in diff["fixed"]] == [dropped.message]
+    text = report_diff_text(diff, "artifacts/graftlint_baseline.json")
+    assert "new (1):" in text and "fixed (1):" in text
+    assert "1 NEW unwaived finding(s)" in text
+    clean = report_diff_text({"new": [], "fixed": [], "still_waived": []},
+                             "b.json")
+    assert "(none)" in clean and "no new unwaived" in clean

@@ -1,18 +1,23 @@
 """Tier-1 gate: the FULL graftlint suite over dispersy_tpu/.
 
-Runs all six rules (R1 host-sync, R2 recompile hazards, R3 dtype
-contracts, R4 scatter modes, R5 key reuse, R6 global-index scatters)
-against the real tree —
+Runs all ten rules (R1 host-sync, R2 recompile hazards, R3 dtype
+contracts, R4 scatter modes, R5 key reuse, R6 global-index scatters,
+R7 plane coverage, R8 schema drift, R9 config-plane discipline, R10
+RNG stream discipline) against the real tree —
 every perf PR lands against these machine-enforced invariants instead
 of review convention (LINTING.md).  Waived findings are tolerated by
 the gate but must carry a justification; the contract completeness
-check additionally pins the acceptance bar that every public op in
-``dispersy_tpu/ops/`` declares its dtypes.
+check additionally pins the acceptance bar that every public function
+on the op/helper surface (``rule_contracts.SURFACE_MODULES``) declares
+its dtypes, and the schema-freshness check pins that
+``artifacts/state_schema.json`` matches the live extraction.
 
-Cost note (tier-1 window): rules R1/R2/R4/R5 are pure AST; R3 is
-``jax.eval_shape`` tracing only — nothing compiles, nothing executes.
-The full-repo scan runs ONCE (module-scope fixture) and the CLI check
-drives ``main()`` in-process, so the whole module stays a few seconds.
+Cost note (tier-1 window): rules R1/R2/R4/R5/R6/R9/R10 are pure AST;
+R3 and the R7/R8 schema extraction are ``jax.eval_shape`` tracing only
+— nothing compiles, nothing executes.  The full-repo scan runs ONCE
+(module-scope fixture; the schema extraction is lru_cached across it)
+and the CLI check drives ``main()`` in-process, so the whole module
+stays a few seconds.
 """
 
 import importlib
@@ -23,11 +28,13 @@ import os
 import pytest
 
 from tools.graftlint import run, unwaived
+from tools.graftlint import schema as GS
 from tools.graftlint.core import REPO_ROOT
 from tools.graftlint.registry import default_rules
 
 _BASELINE = os.path.join(REPO_ROOT, "artifacts",
                          "graftlint_baseline.json")
+ALL_RULES = tuple(f"R{i}" for i in range(1, 11))
 
 
 @pytest.fixture(scope="module")
@@ -51,28 +58,33 @@ def test_waived_findings_carry_justifications(repo_findings):
 
 def test_every_public_op_declares_a_contract():
     """The acceptance bar, checked directly (not just via R3): every
-    public function in every ops module is @contract or @host_helper."""
-    from tools.graftlint.rule_contracts import (OPS_MODULES,
+    public function on the op/helper surface — ops modules plus the
+    sharding registry and the store/trace cadence helpers — is
+    @contract or @host_helper."""
+    from tools.graftlint.rule_contracts import (SURFACE_MODULES,
                                                 public_functions)
 
     missing = []
-    for modname in OPS_MODULES:
-        mod = importlib.import_module(f"dispersy_tpu.ops.{modname}")
+    for modname in SURFACE_MODULES:
+        mod = importlib.import_module(f"dispersy_tpu.{modname}")
         for name, fn in public_functions(mod):
             if not (hasattr(fn, "__graft_contract__")
                     or getattr(fn, "__graft_host_helper__", False)):
                 missing.append(f"{modname}.{name}")
-    assert not missing, f"uncontracted public ops: {missing}"
+    assert not missing, f"uncontracted public surface: {missing}"
 
 
 def test_rule_catalog_is_complete():
     rules = default_rules()
-    assert [r.rule_id for r in rules] == ["R1", "R2", "R3", "R4",
-                                          "R5", "R6"]
+    assert tuple(r.rule_id for r in rules) == ALL_RULES
     for r in rules:
         assert r.name and r.summary
         assert inspect.signature(r.scan).parameters.keys() == {
             "modules", "repo_root"}
+    # the cross-reference rules must declare whole_repo so --changed-only
+    # never hands them a filtered module list
+    whole = {r.rule_id for r in rules if getattr(r, "whole_repo", False)}
+    assert whole == {"R3", "R7", "R8", "R9", "R10"}
 
 
 def test_baseline_artifact_schema_and_freshness(repo_findings):
@@ -85,7 +97,7 @@ def test_baseline_artifact_schema_and_freshness(repo_findings):
     with open(_BASELINE) as f:
         doc = json.load(f)
     assert doc["tool"] == "graftlint"
-    assert set(doc["rules"]) == {"R1", "R2", "R3", "R4", "R5", "R6"}
+    assert set(doc["rules"]) == set(ALL_RULES)
     assert doc["summary"]["unwaived"] == 0
     assert all(f["waiver"] for f in doc["findings"] if f["waived"])
     live = {(f.rule, f.path, f.source, f.waived) for f in repo_findings}
@@ -97,6 +109,55 @@ def test_baseline_artifact_schema_and_freshness(repo_findings):
         "--output artifacts/graftlint_baseline.json\n"
         f"live-only: {live - committed}\ncommitted-only: "
         f"{committed - live}")
+
+
+def test_schema_artifact_matches_live_extraction():
+    """``artifacts/state_schema.json`` is the committed contract R8/R10
+    diff against — it must round-trip the live extraction exactly, or
+    the next PR diffs against a stale shape.  (R8 reports this too; the
+    direct check keeps the failure message actionable when graftlint
+    itself is what broke.)"""
+    import tools.graftlint.core as core
+
+    committed = GS.load_artifact(REPO_ROOT)
+    assert committed is not None, (
+        "artifacts/state_schema.json missing — regenerate with "
+        "`python -m tools.graftlint --write-schema`")
+    live = GS.extract(REPO_ROOT, core.load_modules())
+    assert live == committed, (
+        "schema drift vs artifacts/state_schema.json — bump "
+        "checkpoint.FORMAT_VERSION if leaves changed, then regenerate "
+        "with `python -m tools.graftlint --write-schema`")
+    # spot-check the invariants downstream consumers rely on
+    assert live["checkpoint_version"] > 0
+    assert all(s["sites"] for s in live["rng_streams"].values())
+
+
+def test_injected_leaf_without_mirror_or_bump_fails_the_gate():
+    """End to end against the REAL tree: a PeerState leaf that appears
+    without an oracle mirror fires R7, and one that appears without a
+    checkpoint.FORMAT_VERSION bump fires R8 — the doctored input is the
+    live extraction plus one leaf, so the checks proven here are exactly
+    the ones the repo gate runs."""
+    import tools.graftlint.core as core
+    from tools.graftlint.rule_schema import (PlaneCoverageRule,
+                                             SchemaDriftRule)
+
+    mods = core.load_modules()
+    ghost = {"dtype": "uint32", "shape": [0], "plane": "core",
+             "zero_width_at_defaults": True}
+    leaves = dict(GS.state_leaves())
+    leaves["brand_new_leaf"] = ghost
+    findings = PlaneCoverageRule.oracle_findings(
+        leaves, GS.oracle_keys(mods))
+    assert [f.source for f in findings] == ["brand_new_leaf"]
+
+    live = json.loads(json.dumps(GS.extract(REPO_ROOT, mods)))
+    live["leaves"]["brand_new_leaf"] = ghost
+    drift = SchemaDriftRule.drift_findings(
+        live, GS.load_artifact(REPO_ROOT))
+    assert [f.source for f in drift] == ["brand_new_leaf"]
+    assert "FORMAT_VERSION bump" in drift[0].message
 
 
 def test_cli_entry_point_exits_zero_on_clean_tree(capsys, tmp_path):
@@ -111,3 +172,75 @@ def test_cli_entry_point_exits_zero_on_clean_tree(capsys, tmp_path):
     doc = json.loads(capsys.readouterr().out)
     assert doc["summary"]["unwaived"] == 0
     assert json.loads(out_path.read_text())["tool"] == "graftlint"
+
+
+def test_cli_diff_against_committed_baseline_is_quiet(capsys):
+    """``--diff`` vs the committed baseline on an unchanged tree: no new
+    findings, exit 0 — the round-over-round surface PRs gate on."""
+    from tools.graftlint.__main__ import main
+
+    rc = main(["--rules", "R1,R4", "--diff", _BASELINE])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "new (0):" in out
+    assert "no new unwaived findings" in out
+
+
+def test_cli_honors_graftlint_rules_env(capsys, monkeypatch):
+    """GRAFTLINT_RULES pins the subset for quick local loops without
+    editing commands; --rules still wins when both are given."""
+    from tools.graftlint.__main__ import main
+
+    monkeypatch.setenv("GRAFTLINT_RULES", "R4")
+    rc = main(["--format=json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(doc["rules"]) - {"R0", "W0"} == {"R4"}
+    rc = main(["--format=json", "--rules", "R6"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(doc["rules"]) - {"R0", "W0"} == {"R6"}
+
+
+def test_changed_only_scopes_ast_rules_and_gates_whole_repo(monkeypatch):
+    """--changed-only: per-file rules see only the changed set; the
+    whole-repo rules run iff dispersy_tpu/ or tools/graftlint/ is in
+    it (and stale-waiver judgments about out-of-scope files are
+    suppressed — absence from a filtered scan proves nothing)."""
+    import tools.graftlint.core as core
+
+    calls = {}
+
+    class Probe:
+        rule_id = "RX"
+        name = "probe"
+        summary = "records what it is handed"
+        whole_repo = False
+
+        def scan(self, modules, repo_root):
+            calls["ast"] = sorted(m.rel for m in modules)
+            return []
+
+    class WholeProbe(Probe):
+        rule_id = "RY"
+        whole_repo = True
+
+        def scan(self, modules, repo_root):
+            calls["whole"] = sorted(m.rel for m in modules)
+            return []
+
+    # change set outside the gate paths: whole-repo rule must not run
+    monkeypatch.setattr(core, "changed_rels",
+                        lambda root: {"tests/test_engine.py"})
+    calls.clear()
+    core.run(rules=[Probe(), WholeProbe()], changed_only=True)
+    assert calls.get("ast") == [] and "whole" not in calls
+
+    # change touching the package: whole-repo rule runs over EVERYTHING
+    monkeypatch.setattr(core, "changed_rels",
+                        lambda root: {"dispersy_tpu/state.py"})
+    calls.clear()
+    core.run(rules=[Probe(), WholeProbe()], changed_only=True)
+    assert calls.get("ast") == ["dispersy_tpu/state.py"]
+    n_all = len(core.load_modules())
+    assert len(calls.get("whole", ())) == n_all

@@ -1,15 +1,17 @@
 """The three wipe paths share one inventory — pin it mechanically.
 
-``state.INSTANCE_MEMORY_FIELDS`` is consumed by ``engine.unload_members``
-and ``checkpoint._wipe_ephemeral`` by construction; the churn-rebirth
-block inside ``engine.step`` phase 0 is hand-fused for speed and only
-*promises* (engine.py comment) to wipe a superset.  These tests make the
-promise mechanical: pollute every inventory leaf, force a rebirth of the
-whole membership, and require every leaf back at its fresh-init value —
-so adding an ephemeral leaf to the inventory without teaching the rebirth
-block (or vice versa) fails a test instead of silently splitting the
-restart semantics (reference: candidates/request-cache/pen die with the
-process, SURVEY §5.4).
+``state.WIPE_INVENTORY`` classifies EVERY non-stats ``PeerState`` leaf
+by wipe behavior; ``state.INSTANCE_MEMORY_FIELDS`` (its "instance"
+rows) is consumed by ``engine.unload_members`` and
+``checkpoint._wipe_ephemeral`` by construction; the churn-rebirth block
+inside ``engine.step`` phase 0 is hand-fused for speed and only
+*promises* (engine.py comment) to wipe a superset.  These tests make
+the promise mechanical — and, since PR 18, TOTAL: the leaf list is the
+schema-extracted inventory (``tools/graftlint/schema.py``, the same
+extraction R7 lints against), so a newly added leaf that nobody
+classified fails here (and in graftlint) instead of silently splitting
+the restart semantics (reference: candidates/request-cache/pen die with
+the process, SURVEY §5.4).
 """
 
 import jax
@@ -19,6 +21,7 @@ import numpy as np
 from dispersy_tpu import engine as E
 from dispersy_tpu import state as S
 from dispersy_tpu.config import CommunityConfig
+from tools.graftlint import schema as GS
 
 CFG = CommunityConfig(
     n_peers=16, n_trackers=2, msg_capacity=8, bloom_capacity=8,
@@ -28,23 +31,66 @@ CFG = CommunityConfig(
     # a quiet round: nothing may repopulate instance memory post-wipe
     walker_enabled=False, sync_enabled=False, forward_fanout=0)
 
+WIPE_CLASSES = ("lifecycle", "identity", "process", "clock", "disk",
+                "instance", "stats", "global")
 
-def _pollute(state):
+
+def schema_leaf_names():
+    """Non-stats PeerState leaf base names from the schema extraction —
+    the authoritative iteration set (a hand-maintained list here would
+    be exactly the rot R7 exists to prevent)."""
+    return sorted({GS.base_name(p) for p in GS.state_leaves()
+                   if not GS.is_stats(p)})
+
+
+def instance_fields():
+    """The schema-derived ``(name, fill)`` instance-memory inventory —
+    must coincide with what the wipe consumers iterate."""
+    return tuple((name, S.WIPE_INVENTORY[name][1])
+                 for name in schema_leaf_names()
+                 if S.WIPE_INVENTORY[name][0] == "instance")
+
+
+def test_every_schema_leaf_is_classified():
+    names = schema_leaf_names()
+    missing = set(names) - set(S.WIPE_INVENTORY)
+    assert not missing, \
+        f"PeerState leaves without a WIPE_INVENTORY class: {sorted(missing)}"
+    stale = set(S.WIPE_INVENTORY) - set(names)
+    assert not stale, f"stale WIPE_INVENTORY entries: {sorted(stale)}"
+    for name, (cls, fill) in S.WIPE_INVENTORY.items():
+        assert cls in WIPE_CLASSES, (name, cls)
+        if cls == "instance":
+            assert fill in ("no_peer", "never", "empty", "zero"), \
+                (name, fill)
+        else:
+            assert fill is None, (name, fill)
+
+
+def test_derived_instance_fields_match_schema():
+    # INSTANCE_MEMORY_FIELDS is derived from WIPE_INVENTORY in state.py;
+    # the schema-derived view must be the same set, or the consumers
+    # (unload_members, _wipe_ephemeral) iterate something else than the
+    # classification claims.
+    assert dict(instance_fields()) == dict(S.INSTANCE_MEMORY_FIELDS)
+
+
+def _pollute(state, fields):
     """Garbage in every inventory leaf (valid dtypes, non-init values)."""
     updates = {}
-    for name, _ in S.INSTANCE_MEMORY_FIELDS:
+    for name, _ in fields:
         arr = np.asarray(getattr(state, name))
         updates[name] = jnp.asarray(np.full_like(arr, 1))
     return state.replace(**updates)
 
 
-def _wipeable(state, n_peers):
+def _wipeable(state, n_peers, fields):
     """Inventory leaves that exist under this config — plane-sized
     zero-width leaves (feature compiled out, e.g. the [0]-shaped sig
     cache when double_meta_mask is 0) have nothing to wipe and cannot
     take the per-peer mask; wipe_instance_memory skips them the same
     way."""
-    for name, kind in S.INSTANCE_MEMORY_FIELDS:
+    for name, kind in fields:
         arr = np.asarray(getattr(state, name))
         if arr.ndim >= 1 and arr.shape[0] != n_peers:
             continue
@@ -52,13 +98,14 @@ def _wipeable(state, n_peers):
 
 
 def test_rebirth_wipes_every_instance_memory_leaf():
+    fields = instance_fields()
     cfg = CFG.replace(churn_rate=1.0)   # every member reborn this round
     fresh = S.init_state(cfg, jax.random.PRNGKey(0))
-    out = E.step(_pollute(fresh), cfg)
+    out = E.step(_pollute(fresh, fields), cfg)
     members = np.arange(cfg.n_peers) >= cfg.n_trackers
     assert np.asarray(out.session)[members].min() >= 1, \
         "churn_rate=1.0 must rebirth every member"
-    for name, _ in _wipeable(fresh, cfg.n_peers):
+    for name, _ in _wipeable(fresh, cfg.n_peers, fields):
         got = np.asarray(getattr(out, name))[members]
         want = np.asarray(getattr(fresh, name))[members]
         assert (got == want).all(), \
@@ -66,11 +113,12 @@ def test_rebirth_wipes_every_instance_memory_leaf():
 
 
 def test_unload_wipes_every_instance_memory_leaf():
+    fields = instance_fields()
     fresh = S.init_state(CFG, jax.random.PRNGKey(0))
-    out = E.unload_members(_pollute(fresh), CFG,
+    out = E.unload_members(_pollute(fresh, fields), CFG,
                            np.arange(CFG.n_peers) >= CFG.n_trackers)
     members = np.arange(CFG.n_peers) >= CFG.n_trackers
-    for name, _ in _wipeable(fresh, CFG.n_peers):
+    for name, _ in _wipeable(fresh, CFG.n_peers, fields):
         got = np.asarray(getattr(out, name))[members]
         want = np.asarray(getattr(fresh, name))[members]
         assert (got == want).all(), name

@@ -1,13 +1,16 @@
 """graftlint: the repo's multi-rule JAX hot-path analyzer.
 
 Grown from PR 1's single-purpose ``tools/check_host_sync.py`` into the
-codebase's correctness-tooling layer: six rules that machine-check the
-performance contracts every perf PR lands against, wired into tier-1
-(tests/test_graftlint_repo.py) and runnable standalone:
+codebase's correctness-tooling layer: ten rules that machine-check the
+performance AND plane contracts every PR lands against, wired into
+tier-1 (tests/test_graftlint_repo.py) and runnable standalone:
 
     python -m tools.graftlint                # all rules, text report
     python -m tools.graftlint --format=json  # machine-readable report
-    python -m tools.graftlint --rules R1,R4  # a subset
+    python -m tools.graftlint --rules R1,R4  # a subset ($GRAFTLINT_RULES)
+    python -m tools.graftlint --diff artifacts/graftlint_baseline.json
+    python -m tools.graftlint --changed-only # git-scoped quick scan
+    python -m tools.graftlint --write-schema # regen state_schema.json
 
 Rules (catalog + waiver syntax + how-to-add: LINTING.md):
 
@@ -21,8 +24,20 @@ Rules (catalog + waiver syntax + how-to-add: LINTING.md):
   R6 global-index-scatter — flat product-extent scatters carry the
                         2^31 two-form guard (int32 overflow + the
                         XLA scatter-index cap on sharded fleets)
+  R7 plane-coverage   — every PeerState leaf / Stats counter present in
+                        the oracle mirror, checkpoint version registry,
+                        partition rules, and rebirth wipe inventory
+  R8 schema-drift     — extracted leaf schema vs the committed
+                        artifacts/state_schema.json; leaf changes
+                        require a checkpoint.FORMAT_VERSION bump
+  R9 config-plane     — CommunityConfig fingerprint tail order, per-
+                        plane validate scope gates, zero-width-at-
+                        defaults gating of plane-owned leaves
+  R10 rng-stream      — P_* purpose streams vs the committed draw-site
+                        registry (PR 4's base-sequences-never-shift)
 
-Exit code: non-zero iff any unwaived finding exists.
+Synthetic findings R0 (parse failure) and W0 (stale waivers.txt entry)
+are unwaivable.  Exit code: non-zero iff any unwaived finding exists.
 """
 
 from .core import (Finding, apply_waivers, load_modules, report_json,

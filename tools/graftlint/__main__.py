@@ -6,12 +6,25 @@ in LINTING.md); ``--output`` additionally writes it to a file — that is
 how the committed baseline artifact
 (``artifacts/graftlint_baseline.json``) is produced for
 round-over-round diffing, mirroring ``tools/bench_kernels.py``'s
-BENCH_r0x.json flow.
+BENCH_r0x.json flow.  The other modes:
+
+- ``--diff artifacts/graftlint_baseline.json`` — print new / fixed /
+  still-waived findings vs the committed baseline; exit 2 iff any NEW
+  unwaived finding appeared (pre-existing unwaived ones keep exit 1).
+- ``--changed-only`` — git-diff-scoped quick scan: per-file AST rules
+  see only changed files, and the whole-repo passes (R3's eval_shape,
+  R7–R10's registry cross-references) run only when the change set
+  touches ``dispersy_tpu/`` or ``tools/graftlint/``.
+- ``--write-schema`` — regenerate ``artifacts/state_schema.json`` from
+  the live tree before linting (the R8/R10 "regenerate" remedies).
+- ``GRAFTLINT_RULES`` (env) — default for ``--rules``, so CI lanes and
+  quick local loops can pin a subset without editing commands.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -28,36 +41,75 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="static analysis of dispersy_tpu/'s JAX hot path")
+        description="static analysis of dispersy_tpu/'s JAX hot path "
+                    "and plane contract")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--rules", default=None,
-                    help="comma-separated subset, e.g. R1,R4")
+                    help="comma-separated subset, e.g. R1,R4 (default: "
+                         "$GRAFTLINT_RULES, else all)")
     ap.add_argument("--output", default=None,
                     help="also write the report (in the selected "
                          "--format) to this path")
     ap.add_argument("--root", default=core.REPO_ROOT,
                     help="repo root to scan (default: this checkout)")
+    ap.add_argument("--diff", default=None, metavar="BASELINE",
+                    help="compare against a baseline JSON report; print "
+                         "new/fixed/still-waived, exit 2 on new "
+                         "unwaived findings")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="scan only files git reports changed vs HEAD; "
+                         "whole-repo rules run only when dispersy_tpu/ "
+                         "or tools/graftlint/ changed")
+    ap.add_argument("--write-schema", action="store_true",
+                    help="regenerate artifacts/state_schema.json from "
+                         "the live tree before linting")
     args = ap.parse_args(argv)
 
+    rule_spec = args.rules or os.environ.get("GRAFTLINT_RULES")
     try:
-        rules = (rules_by_id([r.strip() for r in args.rules.split(",")])
-                 if args.rules else default_rules())
+        rules = (rules_by_id([r.strip() for r in rule_spec.split(",")])
+                 if rule_spec else default_rules())
     except KeyError as e:
         # Usage error, not a lint failure: a typo'd --rules in CI must
         # not read as "unwaived findings exist" (exit 1).
         print(f"graftlint: {e.args[0]}", file=sys.stderr)
         return 2
-    if (os.path.realpath(args.root) != os.path.realpath(core.REPO_ROOT)
-            and any(r.rule_id == "R3" for r in rules)):
-        # R3 traces the IMPORTABLE dispersy_tpu (and waivers come from
-        # this checkout) — mixing that with another tree's AST scan
-        # would report a chimera of two checkouts.  Fail fast instead.
-        print("graftlint: --root points at a different checkout; rule "
-              "R3 (and waivers.txt) always follow THIS checkout. Run "
-              "graftlint from that checkout, or pass --rules without "
-              "R3.", file=sys.stderr)
+    foreign_root = (os.path.realpath(args.root)
+                    != os.path.realpath(core.REPO_ROOT))
+    if foreign_root and any(getattr(r, "whole_repo", False)
+                            for r in rules):
+        # The whole-repo rules (R3, R7-R10) import/extract from THIS
+        # checkout — Python import semantics, not the --root path,
+        # decide which tree that is — so mixing them with another
+        # tree's AST scan would report a chimera of two checkouts.
+        print("graftlint: --root points at a different checkout; the "
+              "whole-repo rules (R3, R7-R10) and waivers.txt always "
+              "follow THIS checkout. Run graftlint from that checkout, "
+              "or pass --rules with AST-only rules.", file=sys.stderr)
         return 2
-    findings = run(repo_root=args.root, rules=rules)
+    if args.write_schema:
+        from tools.graftlint import schema
+        print(f"graftlint: wrote {schema.write_artifact(args.root)}")
+    findings = run(repo_root=args.root, rules=rules,
+                   changed_only=args.changed_only)
+    if args.diff:
+        try:
+            with open(args.diff) as f:
+                baseline = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"graftlint: cannot read baseline {args.diff}: {e}",
+                  file=sys.stderr)
+            return 2
+        diff = core.diff_findings(findings, baseline)
+        report = core.report_diff_text(diff, args.diff)
+        print(report)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(report)
+                f.write("\n")
+        if any(not f.waived for f in diff["new"]):
+            return 2
+        return 1 if unwaived(findings) else 0
     report = (report_json(findings, rules) if args.format == "json"
               else report_text(findings, rules))
     print(report)

@@ -168,6 +168,36 @@ def load_file_waivers(path: str = WAIVER_FILE) -> list:
     return waivers
 
 
+def stale_waiver_findings(modules: list, file_waivers: list,
+                          full_scope: bool = True) -> list:
+    """W0: a waivers.txt entry whose path + source substring no longer
+    matches any line of the scanned tree.  Orphaned waivers rot silently
+    otherwise — the exception outlives the code it excused, and the next
+    finding that happens to contain the substring inherits a
+    justification written for something else.  ``full_scope=False``
+    (a ``--changed-only`` run) only checks waivers whose module WAS
+    loaded; absence from a filtered scan proves nothing."""
+    findings = []
+    by_rel = {m.rel: m for m in modules}
+    for rule, rel, substr, _why in file_waivers:
+        mod = by_rel.get(rel)
+        if mod is None:
+            if not full_scope:
+                continue
+            msg = (f"stale waiver: `{rel}` is not in the scan scope "
+                   f"— remove or update the {rule} entry")
+        elif substr not in mod.source:
+            msg = (f"stale waiver: substring {substr!r} no longer "
+                   f"matches any line of {rel} — remove or update the "
+                   f"{rule} entry")
+        else:
+            continue
+        findings.append(Finding(
+            rule="W0", path="tools/graftlint/waivers.txt", lineno=1,
+            message=msg, source=f'{rule} {rel} "{substr}"'))
+    return findings
+
+
 def apply_waivers(findings: list, modules: list,
                   file_waivers: list | None = None) -> list:
     """Mark waived findings in place (inline markers + waiver file)."""
@@ -175,9 +205,11 @@ def apply_waivers(findings: list, modules: list,
         file_waivers = load_file_waivers()
     by_rel = {m.rel: m for m in modules}
     for f in findings:
-        if f.rule == "R0":
+        if f.rule in ("R0", "W0"):
             continue    # a file no rule can see is never an intentional
-            #             exception — R0 has no waiver path
+            #             exception, and waiving a stale-waiver finding
+            #             with another waiver would be turtles all the
+            #             way down — neither has a waiver path
         mod = by_rel.get(f.path)
         line = mod.line(f.lineno) if mod is not None else f.source
         if f.rule == "R1" and HOST_OK_MARKER in line:
@@ -200,14 +232,49 @@ def apply_waivers(findings: list, modules: list,
 # ----------------------------------------------------------------- runner
 
 
-def run(repo_root: str = REPO_ROOT, rules: list | None = None) -> list:
-    """Run ``rules`` (default: all five) over the repo; returns findings
-    with waivers applied, sorted by (path, line, rule)."""
+def changed_rels(repo_root: str) -> set:
+    """Repo-relative paths git considers changed vs HEAD (worktree edits
+    + staged + untracked) — the ``--changed-only`` scan scope."""
+    import subprocess
+    rels = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(cmd, cwd=repo_root, capture_output=True,
+                              text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"--changed-only needs git: {' '.join(cmd)} failed: "
+                f"{proc.stderr.strip()}")
+        rels.update(line.strip() for line in proc.stdout.splitlines()
+                    if line.strip())
+    return rels
+
+
+def run(repo_root: str = REPO_ROOT, rules: list | None = None,
+        changed_only: bool = False) -> list:
+    """Run ``rules`` (default: all ten) over the repo; returns findings
+    with waivers applied, sorted by (path, line, rule).
+
+    ``changed_only=True`` restricts the per-file AST rules to files git
+    reports changed vs HEAD, and skips the ``whole_repo`` rules (R3's
+    eval_shape pass, the R7–R10 registry cross-references) entirely
+    unless the change set touches ``dispersy_tpu/`` or
+    ``tools/graftlint/`` — the quick local loop; tier-1 always runs the
+    full scan."""
     from .registry import default_rules
 
     if rules is None:
         rules = default_rules()
     modules = load_modules(repo_root)
+    scan_modules = modules
+    if changed_only:
+        rels = changed_rels(repo_root)
+        scan_modules = [m for m in modules if m.rel in rels]
+        touched_core = any(
+            r.startswith(("dispersy_tpu/", "tools/graftlint/"))
+            for r in rels)
+        rules = [r for r in rules
+                 if not getattr(r, "whole_repo", False) or touched_core]
     findings = []
     for mod in modules:
         if mod.parse_error:
@@ -218,8 +285,15 @@ def run(repo_root: str = REPO_ROOT, rules: list | None = None) -> list:
                 message=f"file does not parse ({mod.parse_error}) — "
                         "every AST rule is blind to it", source=""))
     for rule in rules:
-        findings.extend(rule.scan(modules, repo_root))
-    apply_waivers(findings, modules)
+        # whole_repo rules cross-reference registries spread over the
+        # tree, so they always see the full module list.
+        target = (modules if getattr(rule, "whole_repo", False)
+                  else scan_modules)
+        findings.extend(rule.scan(target, repo_root))
+    file_waivers = load_file_waivers()
+    findings.extend(stale_waiver_findings(modules, file_waivers,
+                                          full_scope=not changed_only))
+    apply_waivers(findings, modules, file_waivers)
     findings.sort(key=lambda f: (f.path, f.lineno, f.rule))
     return findings
 
@@ -246,13 +320,14 @@ def report_text(findings: list, rules: list) -> str:
 
 def report_json(findings: list, rules: list) -> str:
     per_rule = {}
-    r0 = [f for f in findings if f.rule == "R0"]
-    if r0:
-        # Synthetic parse-failure findings must be attributable in the
-        # per-rule table too, or the JSON is internally inconsistent
-        # (summary.unwaived > sum of rules[*].unwaived).
-        per_rule["R0"] = {"name": "parse-error", "findings": len(r0),
-                          "unwaived": len(r0)}
+    # Synthetic findings (R0 parse failures, W0 stale waivers) must be
+    # attributable in the per-rule table too, or the JSON is internally
+    # inconsistent (summary.unwaived > sum of rules[*].unwaived).
+    for rid, rname in (("R0", "parse-error"), ("W0", "stale-waiver")):
+        fr = [f for f in findings if f.rule == rid]
+        if fr:
+            per_rule[rid] = {"name": rname, "findings": len(fr),
+                             "unwaived": len(fr)}
     for r in rules:
         fr = [f for f in findings if f.rule == r.rule_id]
         per_rule[r.rule_id] = {
@@ -272,3 +347,60 @@ def report_json(findings: list, rules: list) -> str:
         "findings": [f.as_dict() for f in findings],
     }
     return json.dumps(doc, indent=2, sort_keys=True)
+
+
+# ------------------------------------------------------------------- diff
+
+
+def _finding_key(d: dict) -> tuple:
+    # Identity deliberately excludes lineno: a finding that merely moved
+    # because unrelated lines shifted above it is the same finding, not
+    # one "fixed" plus one "new".
+    return (d["rule"], d["path"], d["source"], d["message"])
+
+
+def diff_findings(findings: list, baseline_doc: dict) -> dict:
+    """Round-over-round comparison against a committed baseline report
+    (the ``--diff`` mode): ``{"new": [Finding], "fixed": [dict],
+    "still_waived": [Finding]}``."""
+    base = {_finding_key(d): d
+            for d in baseline_doc.get("findings", [])}
+    cur = {}
+    for f in findings:
+        cur.setdefault(_finding_key(f.as_dict()), f)
+    order = lambda k: (k[1], k[0], k[2])  # noqa: E731 — (path, rule, src)
+    return {
+        "new": [cur[k] for k in sorted(cur.keys() - base.keys(),
+                                       key=order)],
+        "fixed": [base[k] for k in sorted(base.keys() - cur.keys(),
+                                          key=order)],
+        "still_waived": [cur[k] for k in sorted(cur.keys() & base.keys(),
+                                                key=order)
+                         if cur[k].waived],
+    }
+
+
+def report_diff_text(diff: dict, baseline_path: str) -> str:
+    out = [f"graftlint diff vs {baseline_path}:"]
+    new_unwaived = [f for f in diff["new"] if not f.waived]
+    sections = (
+        (f"new ({len(diff['new'])})", diff["new"]),
+        (f"fixed ({len(diff['fixed'])})", diff["fixed"]),
+        (f"still waived ({len(diff['still_waived'])})",
+         diff["still_waived"]),
+    )
+    for title, items in sections:
+        out.append(f"  {title}:")
+        for item in items:
+            d = item if isinstance(item, dict) else item.as_dict()
+            tag = "  [waived]" if d.get("waived") else ""
+            out.append(f"    {d['path']}:{d['lineno']}: {d['rule']} "
+                       f"{d['message']}{tag}")
+        if not items:
+            out.append("    (none)")
+    if new_unwaived:
+        out.append(f"\ngraftlint: {len(new_unwaived)} NEW unwaived "
+                   "finding(s) vs baseline")
+    else:
+        out.append("\ngraftlint: no new unwaived findings vs baseline")
+    return "\n".join(out)

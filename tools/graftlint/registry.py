@@ -1,22 +1,27 @@
-"""The rule registry: one place that knows all six rules.
+"""The rule registry: one place that knows all ten rules.
 
 Adding a rule (LINTING.md walks through this): implement an object with
-``rule_id`` / ``name`` / ``summary`` / ``scan(modules, repo_root)``,
-import it here, append it to :func:`default_rules`, document it in
-LINTING.md, and give it known-bad/known-good/waived fixtures in
-tests/test_graftlint.py.
+``rule_id`` / ``name`` / ``summary`` / ``scan(modules, repo_root)``
+(set ``whole_repo = True`` if it cross-references the whole tree and is
+meaningless on a ``--changed-only`` file subset), import it here,
+append it to :func:`default_rules`, document it in LINTING.md, and give
+it known-bad/known-good/waived fixtures in tests/test_graftlint.py.
 """
 
 from __future__ import annotations
 
 from .rule_contracts import ContractRule
+from .rule_rng import RngStreamRule
+from .rule_schema import ConfigPlaneRule, PlaneCoverageRule, SchemaDriftRule
 from .rules_ast import (GlobalIndexScatterRule, HostSyncRule,
                         KeyReuseRule, RecompileRule, ScatterModeRule)
 
 
 def default_rules() -> list:
     return [HostSyncRule(), RecompileRule(), ContractRule(),
-            ScatterModeRule(), KeyReuseRule(), GlobalIndexScatterRule()]
+            ScatterModeRule(), KeyReuseRule(), GlobalIndexScatterRule(),
+            PlaneCoverageRule(), SchemaDriftRule(), ConfigPlaneRule(),
+            RngStreamRule()]
 
 
 def rules_by_id(ids) -> list:

@@ -1,7 +1,10 @@
 """R3: implicit dtype/shape widening against the declared op contracts.
 
-The only non-AST rule: it imports every module under
-``dispersy_tpu/ops/``, requires each public function to carry either
+The only import-and-trace rule: it imports every module under
+``dispersy_tpu/ops/`` plus the plane helper surfaces
+(``parallel/mesh.py``, ``shardplane.py``, ``storediet.py``,
+``traceplane.py`` — :data:`SURFACE_MODULES`), requires each public
+function to carry either
 ``@contract`` or ``@host_helper`` (dispersy_tpu/ops/contracts.py), and
 traces each contracted op with ``jax.eval_shape`` at its canonical
 sizes, diffing declared vs inferred output dtypes/shapes.  No array is
@@ -29,6 +32,15 @@ OPS_PACKAGE = "dispersy_tpu.ops"
 OPS_MODULES = ("bloom", "candidates", "faults", "fleet", "hashing",
                "inbox", "intake", "overload", "recovery", "rng",
                "store", "telemetry", "timeline", "trace")
+# Plane helper surfaces outside ops/ (dotted names under dispersy_tpu):
+# the sharding registry and the store/trace cadence+report helpers grew
+# public functions the same dtype discipline applies to — every public
+# symbol must declare @contract or @host_helper, or a traced helper
+# added without a declaration is invisible to R3.
+HELPER_MODULES = ("parallel.mesh", "shardplane", "storediet",
+                  "traceplane")
+# Everything R3 scans, as dotted names under the dispersy_tpu package.
+SURFACE_MODULES = tuple(f"ops.{m}" for m in OPS_MODULES) + HELPER_MODULES
 
 
 def public_functions(mod):
@@ -44,6 +56,8 @@ class ContractRule:
     name = "dtype-contract"
     summary = ("public op output dtypes/shapes diffed against their "
                "@contract declarations via jax.eval_shape")
+    whole_repo = True   # imports + traces the whole package surface —
+    #                     meaningless on a --changed-only file subset
 
     def scan(self, modules, repo_root) -> list:
         # R3 traces the IMPORTABLE dispersy_tpu package — Python import
@@ -60,23 +74,26 @@ class ContractRule:
 
         findings = []
         by_rel = {m.rel: m for m in modules}
-        for modname in OPS_MODULES:
+        for modname in SURFACE_MODULES:
             try:
-                mod = importlib.import_module(f"{OPS_PACKAGE}.{modname}")
+                mod = importlib.import_module(f"dispersy_tpu.{modname}")
             except Exception as e:  # noqa: BLE001 — the failure IS the
                 #   finding: a crash here would suppress every other
                 #   rule's report (and the R0 parse finding) with a raw
                 #   traceback naming no rule
                 findings.append(Finding(
                     rule=self.rule_id,
-                    path=f"dispersy_tpu/ops/{modname}.py", lineno=1,
-                    message=f"ops module fails to import — contracts "
+                    path="dispersy_tpu/"
+                         + modname.replace(".", "/") + ".py",
+                    lineno=1,
+                    message=f"module fails to import — contracts "
                             f"unverifiable: {type(e).__name__}: {e}",
                     source=""))
                 continue
             mod_file = os.path.abspath(mod.__file__)
-            pkg_root = os.path.dirname(os.path.dirname(
-                os.path.dirname(mod_file)))     # <root>/dispersy_tpu/ops/x.py
+            pkg_root = mod_file     # <root>/dispersy_tpu/(…/)name.py
+            for _ in range(modname.count(".") + 2):
+                pkg_root = os.path.dirname(pkg_root)
             rel = os.path.relpath(mod_file, pkg_root).replace(os.sep, "/")
             src = by_rel.get(rel)
             for name, fn in public_functions(mod):

@@ -1,0 +1,191 @@
+"""R10: RNG purpose-stream discipline.
+
+The hot path draws all randomness from counter-based streams
+(``dispersy_tpu/ops/rng.py``): ``rand_u32(seed, round, peer, purpose,
+salt)`` — no key threading, every draw addressable.  The load-bearing
+consequence (PR 4's salting scheme) is that **base sequences never
+shift**: the value a peer draws for, say, its Gilbert–Elliott channel
+transition at round *r* must not depend on which *other* features are
+compiled in, or oracle trace equality across configs (and every
+committed fault-injection baseline) silently breaks.
+
+A new draw site for an existing ``P_*`` stream is exactly that hazard:
+the extra draw itself is fine (counter streams don't advance), but a
+site that draws the SAME (round, peer, purpose, salt) coordinates as an
+existing one correlates two decisions, and a site added with a new salt
+must be re-verified against the oracle.  R10 therefore extends R5
+(key-reuse) to the counter streams:
+
+- duplicate ``P_*`` tag values (two streams that are secretly one);
+- a stream's tag value changing (shifts every sequence drawn under it);
+- a ``P_*`` stream referenced in a module / at more sites than the
+  committed registry (``artifacts/state_schema.json`` →
+  ``rng_streams``) records — re-verify trace equality, then regenerate;
+- stale registry entries (fewer or no references remain);
+- ``rand_u32``/``rand_uniform`` called with an integer-literal purpose
+  (a stream the registry cannot track).
+
+Heuristic honesty: sites are AST *references* to the constant, not
+proven draw calls — a comment-only mention never counts (strings and
+comments are invisible to AST), but passing ``P_GE`` through a helper
+counts once at the helper's call site, not per eventual draw.  That is
+the right granularity for the "did a new site appear" question.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import schema
+from .core import Finding
+
+
+class RngStreamRule:
+    rule_id = "R10"
+    name = "rng-stream"
+    summary = ("P_* purpose streams diffed against the committed "
+               "draw-site registry — a new site for an existing stream "
+               "must re-verify the base-sequences-never-shift invariant")
+    whole_repo = True   # diffs the whole tree's reference counts against
+    #                     the committed registry
+
+    def scan(self, modules, repo_root) -> list:
+        consts = schema.rng_constants(modules)
+        if not consts:
+            return [Finding(
+                rule=self.rule_id, path=schema.RNG_MODULE, lineno=1,
+                message="no P_* purpose constants found — ops/rng.py "
+                        "missing from scan scope, stream discipline "
+                        "unverifiable",
+                source="")]
+        artifact = schema.load_artifact(repo_root)
+        art_streams = (None if artifact is None
+                       else artifact.get("rng_streams", {}))
+        findings = self.stream_findings(
+            consts, self._const_lines(modules),
+            schema.rng_site_lines(modules, consts), art_streams)
+        findings += self.literal_purpose_findings(modules)
+        return findings
+
+    @staticmethod
+    def _const_lines(modules) -> dict:
+        mod = schema._find(modules, schema.RNG_MODULE)
+        lines = {}
+        if mod is None:
+            return lines
+        for node in mod.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id.startswith("P_")):
+                lines[node.targets[0].id] = node.lineno
+        return lines
+
+    @staticmethod
+    def stream_findings(consts, const_lines, sites, art_streams) -> list:
+        findings = []
+        rng = schema.RNG_MODULE
+        by_value = {}
+        for nm, val in sorted(consts.items()):
+            by_value.setdefault(val, []).append(nm)
+        for val, names in sorted(by_value.items()):
+            for nm in names[1:]:
+                findings.append(Finding(
+                    rule="R10", path=rng,
+                    lineno=const_lines.get(nm, 1),
+                    message=f"purpose streams {names[0]} and {nm} share "
+                            f"tag value {val} — their draws are the same "
+                            "counter stream, correlating randomness that "
+                            "must be independent",
+                    source=nm))
+        if art_streams is None:
+            findings.append(Finding(
+                rule="R10", path=schema.SCHEMA_ARTIFACT, lineno=1,
+                message="committed schema artifact missing — draw-site "
+                        "registry unverifiable; regenerate with `python "
+                        "-m tools.graftlint --write-schema`",
+                source=""))
+            return findings
+        for nm in sorted(set(consts) - set(art_streams)):
+            findings.append(Finding(
+                rule="R10", path=rng, lineno=const_lines.get(nm, 1),
+                message=f"new purpose stream {nm} (tag {consts[nm]}) is "
+                        "not in the committed registry — verify no "
+                        "existing stream's tag moved, then regenerate "
+                        "the schema artifact",
+                source=nm))
+        for nm in sorted(set(art_streams) - set(consts)):
+            findings.append(Finding(
+                rule="R10", path=rng, lineno=1,
+                message=f"registry lists purpose stream {nm}, which no "
+                        "longer exists in ops/rng.py — regenerate the "
+                        "schema artifact",
+                source=nm))
+        for nm in sorted(set(consts) & set(art_streams)):
+            reg = art_streams[nm]
+            if reg.get("value") != consts[nm]:
+                findings.append(Finding(
+                    rule="R10", path=rng, lineno=const_lines.get(nm, 1),
+                    message=f"purpose stream {nm} changed tag value "
+                            f"{reg.get('value')} -> {consts[nm]} — every "
+                            "sequence drawn under it shifts, breaking "
+                            "cross-version trace equality and every "
+                            "committed baseline that sampled it",
+                    source=nm))
+            reg_sites = reg.get("sites", {})
+            live_sites = sites.get(nm, {})
+            for rel in sorted(set(live_sites) | set(reg_sites)):
+                lines = live_sites.get(rel, [])
+                live_n, reg_n = len(lines), reg_sites.get(rel, 0)
+                if live_n > reg_n:
+                    lineno = lines[min(reg_n, live_n - 1)]
+                    findings.append(Finding(
+                        rule="R10", path=rel, lineno=lineno,
+                        message=f"{nm} referenced {live_n}x here but the "
+                                f"committed registry records {reg_n} — a "
+                                "new draw site for an existing stream is "
+                                "the PR 4 'base sequences never shift' "
+                                "hazard; re-verify oracle trace "
+                                "equality, then regenerate the schema "
+                                "artifact",
+                        source=nm))
+                elif live_n < reg_n:
+                    findings.append(Finding(
+                        rule="R10", path=rel,
+                        lineno=lines[0] if lines else 1,
+                        message=f"registry records {reg_n} {nm} "
+                                f"reference(s) here but {live_n} "
+                                "remain — stale registry; regenerate "
+                                "the schema artifact",
+                        source=nm))
+        return findings
+
+    @staticmethod
+    def literal_purpose_findings(modules) -> list:
+        findings = []
+        for mod in modules:
+            if mod.rel == schema.RNG_MODULE:
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = (fn.id if isinstance(fn, ast.Name)
+                        else fn.attr if isinstance(fn, ast.Attribute)
+                        else "")
+                if name not in ("rand_u32", "rand_uniform"):
+                    continue
+                purpose = node.args[3] if len(node.args) >= 4 else None
+                for kw in node.keywords:
+                    if kw.arg == "purpose":
+                        purpose = kw.value
+                if (isinstance(purpose, ast.Constant)
+                        and isinstance(purpose.value, int)):
+                    findings.append(Finding(
+                        rule="R10", path=mod.rel, lineno=node.lineno,
+                        message=f"{name}() drawn with integer-literal "
+                                f"purpose={purpose.value} — purposes "
+                                "must be named P_* streams from "
+                                "ops/rng.py so the draw-site registry "
+                                "can track them",
+                        source=mod.line(node.lineno).strip()))
+        return findings

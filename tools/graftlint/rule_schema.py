@@ -1,0 +1,457 @@
+"""R7–R9: the plane-contract cross-reference rules.
+
+Every plane PR must keep six registries in lockstep — the oracle's
+``state_arrays`` mirror, checkpoint save/restore + version bump,
+``parallel/mesh.PARTITION_RULES``, the rebirth wipe inventory
+(``state.WIPE_INVENTORY``), ``state.stats_gates``, and the
+config-fingerprint field order.  PR 12's aux-truncation oracle miss and
+PR 13's blacklist re-filter fix were both human catches of exactly this
+lockstep drifting; these rules machine-check it against the schema
+extracted by ``tools/graftlint/schema.py``:
+
+  R7 plane-coverage — every PeerState leaf / Stats counter is present
+     in the oracle mirror, the checkpoint version registry, the
+     partition rules (with a valid peers-axis leading dim under every
+     probe config), and the wipe inventory; stale entries in any
+     registry are findings too.
+  R8 schema-drift   — the extracted schema diffed against the committed
+     ``artifacts/state_schema.json``; any leaf change without a
+     matching ``checkpoint.FORMAT_VERSION`` bump fails (and a bump
+     without regeneration, or a stale artifact, is its own finding).
+  R9 config-plane   — ``CommunityConfig``'s fingerprint tail order (the
+     position-stripping contract of ``checkpoint._want_fingerprint``),
+     a per-plane ``isinstance`` scope gate in ``__post_init__``, and
+     zero-width-at-defaults gating of every plane-owned leaf.
+
+Each rule's checks are pure functions over injected data (the
+``*_findings`` staticmethods), so tests can prove they fire by
+doctoring the inputs without mutating the real tree; ``scan`` only
+gathers the live inputs (import failures become findings, never
+crashes — a raw traceback would suppress every other rule's report).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import schema
+from .core import Finding
+
+STATE_MODULE = "dispersy_tpu/state.py"
+CHECKPOINT_MODULE = "dispersy_tpu/checkpoint.py"
+MESH_MODULE = "dispersy_tpu/parallel/mesh.py"
+
+
+def _extract_failure(rule_id: str, path: str, exc: Exception) -> Finding:
+    return Finding(
+        rule=rule_id, path=path, lineno=1,
+        message=f"schema extraction failed — plane contract unverifiable: "
+                f"{type(exc).__name__}: {exc}",
+        source="")
+
+
+def _def_lineno(modules, rel: str, name: str) -> int:
+    """Line of ``def name`` / ``name = …`` in ``rel`` (1 if not found) —
+    cosmetic: points the finding at the registry it indicts."""
+    mod = schema._find(modules, rel)
+    if mod is None:
+        return 1
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node.lineno
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name):
+            return node.lineno
+        if (isinstance(node, ast.AnnAssign)
+                and isinstance(node.target, ast.Name)
+                and node.target.id == name):
+            return node.lineno
+    return 1
+
+
+class PlaneCoverageRule:
+    rule_id = "R7"
+    name = "plane-coverage"
+    summary = ("every PeerState leaf / Stats counter present in the "
+               "oracle mirror, checkpoint version registry, partition "
+               "rules, and rebirth wipe inventory")
+    whole_repo = True   # cross-references registries spread over the
+    #                     whole package — meaningless on a file subset
+
+    def scan(self, modules, repo_root) -> list:
+        import sys
+        if repo_root not in sys.path:
+            sys.path.insert(0, repo_root)
+        try:
+            import dataclasses
+
+            from dispersy_tpu import checkpoint
+            from dispersy_tpu import state as state_mod
+            from dispersy_tpu.parallel import mesh
+
+            leaves = schema.state_leaves()
+            templates = schema.probe_templates()
+            new_by_version = checkpoint._NEW_BY_VERSION
+            wipe_inventory = state_mod.WIPE_INVENTORY
+            stats_fields = tuple(
+                f.name for f in dataclasses.fields(state_mod.Stats))
+            gates = state_mod.stats_gates(schema.base_config())
+            kind_of = mesh.partition_kind
+        except Exception as e:  # noqa: BLE001 — the failure IS the finding
+            return [_extract_failure(self.rule_id, STATE_MODULE, e)]
+        artifact = schema.load_artifact(repo_root)
+        findings = []
+        findings += self.oracle_findings(
+            leaves, schema.oracle_keys(modules),
+            lineno=_def_lineno(modules, schema.ORACLE_MODULE,
+                               "state_arrays"))
+        findings += self.checkpoint_findings(
+            leaves, new_by_version, artifact, checkpoint.FORMAT_VERSION,
+            lineno=_def_lineno(modules, CHECKPOINT_MODULE,
+                               "_NEW_BY_VERSION"))
+        findings += self.partition_findings(templates, kind_of)
+        findings += self.wipe_findings(
+            leaves, wipe_inventory,
+            lineno=_def_lineno(modules, STATE_MODULE, "WIPE_INVENTORY"))
+        findings += self.gate_findings(
+            stats_fields, gates,
+            lineno=_def_lineno(modules, STATE_MODULE, "stats_gates"))
+        return findings
+
+    @staticmethod
+    def oracle_findings(leaves, keys, lineno: int = 1) -> list:
+        findings = []
+        names = {schema.base_name(p) for p in leaves}
+        for path in sorted(leaves):
+            nm = schema.base_name(path)
+            if nm in schema.ORACLE_EXEMPT or nm in keys:
+                continue
+            findings.append(Finding(
+                rule="R7", path=schema.ORACLE_MODULE, lineno=lineno,
+                message=f"leaf `{path}` has no oracle mirror — "
+                        f"state_arrays() must expose `{nm}` (or "
+                        "schema.ORACLE_EXEMPT must justify its absence) "
+                        "or bit-exact trace equality silently stops "
+                        "covering it",
+                source=path))
+        for key in sorted(keys - names):
+            findings.append(Finding(
+                rule="R7", path=schema.ORACLE_MODULE, lineno=lineno,
+                message=f"oracle state_arrays() exposes `{key}` but no "
+                        "such PeerState leaf / Stats counter exists — "
+                        "stale mirror entry",
+                source=key))
+        return findings
+
+    @staticmethod
+    def checkpoint_findings(leaves, new_by_version, artifact,
+                            format_version, lineno: int = 1) -> list:
+        findings = []
+        live = set(leaves)
+        for version, names in sorted(new_by_version.items()):
+            for name in sorted(set(names) - live):
+                findings.append(Finding(
+                    rule="R7", path=CHECKPOINT_MODULE, lineno=lineno,
+                    message=f"_NEW_BY_VERSION v{version} lists `{name}`, "
+                            "which is not a live PeerState leaf — the "
+                            "restore skip-lists must track the real tree",
+                    source=name))
+        if artifact is not None:
+            art_leaves = set(artifact.get("leaves", {}))
+            art_cv = artifact.get("checkpoint_version", 0)
+            introduced = {}
+            for version, names in new_by_version.items():
+                for n in names:
+                    introduced[n] = max(introduced.get(n, 0), version)
+            for name in sorted(live - art_leaves):
+                v = introduced.get(name)
+                if v is None or not (art_cv < v <= format_version):
+                    findings.append(Finding(
+                        rule="R7", path=CHECKPOINT_MODULE, lineno=lineno,
+                        message=f"new leaf `{name}` is not registered in "
+                                "checkpoint._NEW_BY_VERSION at a version "
+                                f"in ({art_cv}, {format_version}] — "
+                                "checkpoints from before the bump would "
+                                "fail to restore (nothing marks the leaf "
+                                "missing-ok)",
+                        source=name))
+        return findings
+
+    @staticmethod
+    def partition_findings(templates, kind_of) -> list:
+        findings = []
+        for owner, n_peers, shapes in templates:
+            for name, (shape, _dtype) in sorted(shapes.items()):
+                kind = kind_of(name)
+                if kind == "replicated":
+                    continue
+                if kind != "peers":
+                    findings.append(Finding(
+                        rule="R7", path=MESH_MODULE, lineno=1,
+                        message=f"leaf `{name}` maps to unknown placement "
+                                f"kind {kind!r} — PARTITION_RULES must "
+                                "resolve every leaf to peers/replicated",
+                        source=name))
+                elif not shape or shape[0] not in (0, n_peers):
+                    dim = shape[0] if shape else "scalar"
+                    findings.append(Finding(
+                        rule="R7", path=MESH_MODULE, lineno=1,
+                        message=f"leaf `{name}` under the `{owner}` probe "
+                                f"has leading dim {dim} but "
+                                "PARTITION_RULES places it on the peers "
+                                f"axis (needs n_peers={n_peers} or 0 "
+                                "when compiled out) — add a replicated "
+                                "rule for it or fix its width",
+                        source=name))
+        return findings
+
+    @staticmethod
+    def wipe_findings(leaves, wipe_inventory, lineno: int = 1) -> list:
+        findings = []
+        nonstats = {schema.base_name(p) for p in leaves
+                    if not schema.is_stats(p)}
+        stats = {schema.base_name(p) for p in leaves if schema.is_stats(p)}
+        for name in sorted(nonstats - set(wipe_inventory)):
+            findings.append(Finding(
+                rule="R7", path=STATE_MODULE, lineno=lineno,
+                message=f"PeerState leaf `{name}` is not classified in "
+                        "state.WIPE_INVENTORY — its rebirth "
+                        "(churn/quarantine) wipe behavior is undeclared, "
+                        "so nothing tests that a dead peer's slot comes "
+                        "back clean",
+                source=name))
+        for name in sorted(set(wipe_inventory) - nonstats):
+            if name in stats:
+                msg = (f"WIPE_INVENTORY entry `{name}` names a Stats "
+                       "counter — counters are wiped as a class by "
+                       "engine._rebirth_wipe's callers, not per-entry; "
+                       "remove it")
+            else:
+                msg = (f"stale WIPE_INVENTORY entry `{name}` — no such "
+                       "PeerState leaf")
+            findings.append(Finding(
+                rule="R7", path=STATE_MODULE, lineno=lineno,
+                message=msg, source=name))
+        return findings
+
+    @staticmethod
+    def gate_findings(stats_fields, gates, lineno: int = 1) -> list:
+        findings = []
+        for name in sorted(set(gates) - set(stats_fields)):
+            findings.append(Finding(
+                rule="R7", path=STATE_MODULE, lineno=lineno,
+                message=f"stats_gates names `{name}`, which is not a "
+                        "Stats counter — stale gate entry",
+                source=name))
+        return findings
+
+
+class SchemaDriftRule:
+    rule_id = "R8"
+    name = "schema-drift"
+    summary = ("extracted leaf schema diffed against the committed "
+               "artifact; any leaf change requires a matching "
+               "checkpoint.FORMAT_VERSION bump")
+    whole_repo = True
+
+    def scan(self, modules, repo_root) -> list:
+        import sys
+        if repo_root not in sys.path:
+            sys.path.insert(0, repo_root)
+        try:
+            live = schema.extract(repo_root, modules)
+        except Exception as e:  # noqa: BLE001 — the failure IS the finding
+            return [_extract_failure(self.rule_id, schema.SCHEMA_ARTIFACT,
+                                     e)]
+        return self.drift_findings(live, schema.load_artifact(repo_root))
+
+    @staticmethod
+    def drift_findings(live, artifact) -> list:
+        path = schema.SCHEMA_ARTIFACT
+
+        def f(message, source=""):
+            return Finding(rule="R8", path=path, lineno=1,
+                           message=message, source=source)
+
+        if artifact is None:
+            return [f("committed schema artifact missing — regenerate "
+                      "with `python -m tools.graftlint --write-schema`")]
+        if artifact.get("version") != live["version"]:
+            return [f(f"schema format version mismatch (artifact "
+                      f"v{artifact.get('version')}, extractor "
+                      f"v{live['version']}) — regenerate the artifact")]
+        live_leaves = live["leaves"]
+        art_leaves = artifact.get("leaves", {})
+        live_cv = live["checkpoint_version"]
+        art_cv = artifact.get("checkpoint_version")
+        changed = []
+        for name in sorted(set(live_leaves) | set(art_leaves)):
+            a, b = art_leaves.get(name), live_leaves.get(name)
+            if a == b:
+                continue
+            if a is None:
+                changed.append((name, "added"))
+            elif b is None:
+                changed.append((name, "removed"))
+            else:
+                diffs = ", ".join(
+                    f"{k}: {a.get(k)!r} -> {b.get(k)!r}"
+                    for k in sorted(set(a) | set(b))
+                    if a.get(k) != b.get(k))
+                changed.append((name, diffs))
+        findings = []
+        if changed and live_cv == art_cv:
+            for name, what in changed:
+                findings.append(f(
+                    f"leaf `{name}` changed ({what}) without a "
+                    f"checkpoint.FORMAT_VERSION bump (still v{live_cv}) "
+                    "— old checkpoints would restore a different tree "
+                    "with no version to gate on",
+                    source=name))
+        elif changed:
+            names = ", ".join(n for n, _ in changed[:6])
+            if len(changed) > 6:
+                names += ", …"
+            findings.append(f(
+                f"schema drift ({len(changed)} leaf change(s): {names}) "
+                f"alongside a version bump (v{art_cv} -> v{live_cv}) — "
+                "regenerate the committed artifact so the next drift "
+                "diffs against this shape"))
+        elif live_cv != art_cv:
+            findings.append(f(
+                f"checkpoint.FORMAT_VERSION is v{live_cv} but the "
+                f"committed artifact records v{art_cv} with identical "
+                "leaves — regenerate the artifact"))
+        return findings
+
+
+class ConfigPlaneRule:
+    rule_id = "R9"
+    name = "config-plane"
+    summary = ("CommunityConfig fingerprint tail order, per-plane "
+               "validate scope gates, and zero-width-at-defaults gating "
+               "of plane-owned leaves")
+    whole_repo = True
+
+    def scan(self, modules, repo_root) -> list:
+        findings = []
+        mod = schema._find(modules, schema.CONFIG_MODULE)
+        if mod is None:
+            findings.append(Finding(
+                rule=self.rule_id, path=schema.CONFIG_MODULE, lineno=1,
+                message="config module not in scan scope — fingerprint "
+                        "field order unverifiable",
+                source=""))
+        else:
+            findings += self.config_findings(mod)
+        import sys
+        if repo_root not in sys.path:
+            sys.path.insert(0, repo_root)
+        try:
+            leaves = schema.state_leaves()
+        except Exception as e:  # noqa: BLE001 — the failure IS the finding
+            findings.append(_extract_failure(self.rule_id, STATE_MODULE, e))
+            return findings
+        findings += self.gating_findings(leaves)
+        return findings
+
+    @staticmethod
+    def config_findings(mod) -> list:
+        findings = []
+        cls = None
+        for node in mod.tree.body:
+            if (isinstance(node, ast.ClassDef)
+                    and node.name == "CommunityConfig"):
+                cls = node
+                break
+        if cls is None:
+            return [Finding(
+                rule="R9", path=mod.rel, lineno=1,
+                message="CommunityConfig class not found — fingerprint "
+                        "field order unverifiable",
+                source="")]
+        fields = [(node.target.id, node) for node in cls.body
+                  if isinstance(node, ast.AnnAssign)
+                  and isinstance(node.target, ast.Name)]
+        names = [nm for nm, _ in fields]
+        want = list(schema.PLANE_FIELDS)
+        tail = names[-len(want):]
+        if tail != want:
+            anchor = (fields[-len(want)][1] if len(fields) >= len(want)
+                      else cls)
+            findings.append(Finding(
+                rule="R9", path=mod.rel, lineno=anchor.lineno,
+                message=f"CommunityConfig fingerprint tail is {tail} but "
+                        f"must be exactly {want} — "
+                        "checkpoint._want_fingerprint strips plane reprs "
+                        "BY POSITION, so a reorder or a field appended "
+                        "after the planes breaks every committed "
+                        "fingerprint; new planes go in FRONT of the tail "
+                        "(schema.PLANES) with a FORMAT_VERSION bump",
+                source=mod.line(anchor.lineno).strip()))
+        plane_classes = {cls_name for _, cls_name in schema.PLANES}
+        for i, (nm, node) in enumerate(fields):
+            ann = node.annotation
+            ann_name = (ann.id if isinstance(ann, ast.Name)
+                        else ann.attr if isinstance(ann, ast.Attribute)
+                        else "")
+            if ann_name in plane_classes and i < len(fields) - len(want):
+                findings.append(Finding(
+                    rule="R9", path=mod.rel, lineno=node.lineno,
+                    message=f"plane-typed field `{nm}: {ann_name}` sits "
+                            f"outside the fingerprint tail (the last "
+                            f"{len(want)} fields) — "
+                            "checkpoint._want_fingerprint cannot strip "
+                            "it by position",
+                    source=mod.line(node.lineno).strip()))
+        post = None
+        for node in ast.walk(cls):
+            if (isinstance(node, ast.FunctionDef)
+                    and node.name == "__post_init__"):
+                post = node
+                break
+        if post is None:
+            findings.append(Finding(
+                rule="R9", path=mod.rel, lineno=cls.lineno,
+                message="CommunityConfig has no __post_init__ — the "
+                        "per-plane validate scope gates are missing",
+                source=mod.line(cls.lineno).strip()))
+        else:
+            checked = set()
+            for node in ast.walk(post):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "isinstance"
+                        and len(node.args) == 2
+                        and isinstance(node.args[1], ast.Name)):
+                    checked.add(node.args[1].id)
+            for field, cls_name in schema.PLANES:
+                if cls_name not in checked:
+                    findings.append(Finding(
+                        rule="R9", path=mod.rel, lineno=post.lineno,
+                        message=f"__post_init__ has no isinstance(…, "
+                                f"{cls_name}) scope gate for the "
+                                f"`{field}` plane — a dict or None "
+                                "sneaking into the field would fail deep "
+                                "inside tracing instead of at "
+                                "construction",
+                        source=mod.line(post.lineno).strip()))
+        return findings
+
+    @staticmethod
+    def gating_findings(leaves) -> list:
+        findings = []
+        for path, rec in sorted(leaves.items()):
+            if (rec["plane"] != "core"
+                    and not rec["zero_width_at_defaults"]):
+                findings.append(Finding(
+                    rule="R9", path=STATE_MODULE, lineno=1,
+                    message=f"leaf `{path}` is owned by the "
+                            f"`{rec['plane']}` plane but allocates "
+                            f"{rec['dtype']} state at defaults — plane "
+                            "state must compile out to zero width when "
+                            "its config is off (the `health` idiom), or "
+                            "every community pays its bytes",
+                    source=path))
+        return findings

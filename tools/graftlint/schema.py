@@ -1,0 +1,353 @@
+"""Schema extraction: the canonical PeerState/Stats leaf inventory.
+
+The plane pattern's six registries (oracle ``state_arrays`` mirror,
+checkpoint save/restore + version bump, ``parallel/mesh.PARTITION_RULES``,
+the churn/quarantine wipe inventory, ``state.stats_gates``, and the
+config-fingerprint field order) must stay in lockstep on every plane PR —
+and nothing machine-checked that lockstep until rules R7–R10.  This
+module is their shared data layer: it extracts, by **import + AST**, one
+record per ``PeerState`` leaf and ``Stats`` counter and the RNG purpose
+streams, and round-trips them through the committed artifact
+``artifacts/state_schema.json``.
+
+Per-leaf record (keys are the checkpoint's path names,
+``stats/walk_success`` style):
+
+- ``dtype`` / ``ndim`` — under the DEFAULT config (``store_aux`` really
+  is ``uint32`` by default; the byte-diet opt-in narrowing it is config
+  drift, not schema drift).
+- ``plane`` — the owning config plane, derived by probing: one config
+  per plane/feature gate (:func:`probe_configs`, every knob deliberately
+  off-default) and the owner is the FIRST probe whose ``jax.eval_shape``
+  template changes the leaf's shape or dtype vs the defaults.  A leaf no
+  probe moves is ``"core"`` (always-on).  Heuristic honesty: a leaf
+  gated by a knob no probe toggles reads as core — when adding a plane,
+  add its probe here (R7's wipe-coverage check still forces the leaf
+  into the named inventories either way).
+- ``zero_width_at_defaults`` — the ``health`` idiom: compiled-out
+  planes must cost zero bytes (R9 enforces this for plane-owned leaves).
+- ``partition`` — ``parallel/mesh.partition_kind``'s placement for the
+  leaf name (``"peers"`` / ``"replicated"``).
+
+Everything is shape-abstract: ``jax.eval_shape`` only, no array ever
+materializes, so extraction is CPU-safe and costs milliseconds per
+probe.
+
+The RNG half (``rng_registry``) is pure AST: the ``P_*`` purpose
+constants of ``dispersy_tpu/ops/rng.py`` plus, per stream, every module
+that references it and how many times — the draw-site registry R10
+diffs, because a new draw site for an existing counter stream is
+exactly the "base sequences never shift" hazard PR 4's salting scheme
+exists to prevent.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import json
+import os
+
+from .core import REPO_ROOT
+
+SCHEMA_ARTIFACT = "artifacts/state_schema.json"
+SCHEMA_VERSION = 1
+
+RNG_MODULE = "dispersy_tpu/ops/rng.py"
+ORACLE_MODULE = "dispersy_tpu/oracle/sim.py"
+CONFIG_MODULE = "dispersy_tpu/config.py"
+
+# Leaves deliberately absent from the oracle's state_arrays() mirror:
+# the RNG key and the round clocks are the step's *inputs* — the
+# trace-equality harness advances them structurally on both sides, so
+# mirroring them would compare a value with itself — and ``is_tracker``
+# is pure static config (``peer < cfg.n_trackers`` on both sides), so
+# there is no mutable value to mirror.
+ORACLE_EXEMPT = frozenset({"key", "time", "round_index", "is_tracker"})
+
+# The plane sub-configs in CommunityConfig TAIL order (newest first,
+# oldest last) — the checkpoint fingerprint contract:
+# ``checkpoint._want_fingerprint`` reconstructs pre-plane fingerprints
+# by stripping trailing ``repr`` components BY POSITION, so these seven
+# fields must stay the last seven, in exactly this order.  A new plane
+# goes at the FRONT of this tuple (position -8 becomes -7 …) together
+# with a FORMAT_VERSION bump and a new stripper clause; R9 enforces the
+# declaration side.
+PLANES: tuple[tuple[str, str], ...] = (
+    ("parallel", "ParallelConfig"),
+    ("trace", "TraceConfig"),
+    ("store", "StoreConfig"),
+    ("overload", "OverloadConfig"),
+    ("recovery", "RecoveryConfig"),
+    ("telemetry", "TelemetryConfig"),
+    ("faults", "FaultModel"),
+)
+PLANE_FIELDS = tuple(name for name, _ in PLANES)
+
+
+def artifact_path(repo_root: str = REPO_ROOT) -> str:
+    return os.path.join(repo_root, SCHEMA_ARTIFACT)
+
+
+def load_artifact(repo_root: str = REPO_ROOT) -> dict | None:
+    path = artifact_path(repo_root)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+# ------------------------------------------------------- leaf inventory
+
+
+def base_config():
+    """The schema's "defaults": a pristine ``CommunityConfig()`` — the
+    exact config ``zero_width_at_defaults`` speaks about."""
+    from dispersy_tpu.config import CommunityConfig
+
+    return CommunityConfig()
+
+
+def probe_configs() -> list:
+    """``[(plane_name, config)]`` — one config per plane / feature gate,
+    each knob deliberately OFF-DEFAULT (structural sizes included, so a
+    leaf sized by a knob but not gated by its enable flag still moves
+    and gets claimed).  First probe that moves a leaf owns it, so the
+    seven checkpoint-fingerprint planes come first."""
+    import dataclasses
+
+    from dispersy_tpu.faults import FaultModel
+    from dispersy_tpu.overload import OverloadConfig
+    from dispersy_tpu.recovery import RecoveryConfig
+    from dispersy_tpu.shardplane import ParallelConfig
+    from dispersy_tpu.storediet import StoreConfig
+    from dispersy_tpu.telemetry import TelemetryConfig
+    from dispersy_tpu.traceplane import TraceConfig
+
+    base = base_config()
+    rep = dataclasses.replace
+    health_on = FaultModel(health_checks=True)
+    return [
+        ("parallel", rep(base, parallel=ParallelConfig(
+            shards=2, cross_shard_budget=3, scatter_chunks=2))),
+        ("trace", rep(base, trace=TraceConfig(
+            enabled=True, tracked_slots=5))),
+        ("store", rep(base, store=StoreConfig(
+            staging=3, compact_every=4))),
+        ("overload", rep(base, overload=OverloadConfig(enabled=True))),
+        ("recovery", rep(base, recovery=RecoveryConfig(enabled=True),
+                         faults=health_on)),
+        ("telemetry", rep(base, telemetry=TelemetryConfig(
+            enabled=True, history=3, histograms=True, flight_recorder=5),
+            faults=health_on)),
+        ("faults", rep(base, faults=FaultModel(
+            ge_p_bad=0.1, ge_p_good=0.2, ge_loss_good=0.01,
+            ge_loss_bad=0.5, corrupt_rate=0.01, health_checks=True))),
+        # Flat community-feature gates (not checkpoint-fingerprint
+        # planes, but they size leaves the same `health`-idiom way):
+        ("timeline", rep(base, timeline_enabled=True, k_authorized=3)),
+        ("malicious", rep(base, malicious_enabled=True, k_malicious=3)),
+        ("signature", rep(base, double_meta_mask=1)),
+        ("delay", rep(base, delay_inbox=3, timeline_enabled=True,
+                      k_authorized=3)),
+        ("direct", rep(base, direct_meta_mask=1)),
+        ("requests", rep(base, proof_requests=True, seq_requests=True,
+                         msg_requests=True, identity_requests=True,
+                         identity_required=True, identity_enabled=True,
+                         delay_inbox=3, seq_meta_mask=1,
+                         timeline_enabled=True, k_authorized=3)),
+    ]
+
+
+def template_leaves(cfg) -> dict:
+    """``{leaf path: jax.ShapeDtypeStruct}`` for one config — abstract
+    (``jax.eval_shape``), nothing materializes."""
+    import functools as _ft
+
+    import jax
+    import jax.numpy as jnp
+
+    from dispersy_tpu.checkpoint import _leaves_with_paths
+    from dispersy_tpu.state import init_state
+
+    template = jax.eval_shape(_ft.partial(init_state, cfg),
+                              jax.ShapeDtypeStruct((2,), jnp.uint32))
+    names, leaves, _ = _leaves_with_paths(template)
+    return dict(zip(names, leaves))
+
+
+def _size_of(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+@functools.lru_cache(maxsize=1)
+def probe_templates() -> tuple:
+    """``((owner, n_peers, {path: (shape, dtype)}), …)`` — the defaults
+    (owner ``"core"``) followed by every probe config's abstract leaf
+    shapes.  R7's partition check validates peers-axis leading dims
+    against every one of these."""
+    def shapes(cfg):
+        return {name: (tuple(int(d) for d in leaf.shape), str(leaf.dtype))
+                for name, leaf in template_leaves(cfg).items()}
+
+    base = base_config()
+    out = [("core", base.n_peers, shapes(base))]
+    for owner, cfg in probe_configs():
+        out.append((owner, cfg.n_peers, shapes(cfg)))
+    return tuple(out)
+
+
+@functools.lru_cache(maxsize=1)
+def state_leaves() -> dict:
+    """The leaf inventory: ``{path: record}`` (module docstring)."""
+    from dispersy_tpu.parallel import mesh
+
+    (_, _, default), *probes = probe_templates()
+    records = {}
+    for name, (shape, dtype) in default.items():
+        owner = "core"
+        for probe_owner, _n, probe_shapes in probes:
+            if probe_shapes[name] != (shape, dtype):
+                owner = probe_owner
+                break
+        records[name] = {
+            "dtype": dtype,
+            "ndim": len(shape),
+            "plane": owner,
+            "zero_width_at_defaults": _size_of(shape) == 0,
+            "partition": mesh.partition_kind(name),
+        }
+    return records
+
+
+def base_name(path: str) -> str:
+    """Leaf path -> the flat name the oracle / wipe inventory use
+    (``stats/walk_success`` -> ``walk_success``)."""
+    return path.rsplit("/", 1)[-1]
+
+
+def is_stats(path: str) -> bool:
+    return path.startswith("stats/")
+
+
+# --------------------------------------------------- AST cross-registries
+
+
+def oracle_keys(modules) -> set:
+    """The literal string keys of the oracle's ``state_arrays`` dict —
+    every name the CPU mirror exposes for bit-exact diffing.  Pure AST:
+    dict-literal keys, ``gated("name", …)`` calls, and ``out["name"]``
+    subscript stores inside the function body."""
+    mod = _find(modules, ORACLE_MODULE)
+    if mod is None:
+        return set()
+    keys = set()
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name == "state_arrays"):
+            continue
+        for n in ast.walk(node):
+            if isinstance(n, ast.Dict):
+                keys.update(k.value for k in n.keys
+                            if isinstance(k, ast.Constant)
+                            and isinstance(k.value, str))
+            elif (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id == "gated" and n.args
+                    and isinstance(n.args[0], ast.Constant)
+                    and isinstance(n.args[0].value, str)):
+                keys.add(n.args[0].value)
+            elif (isinstance(n, ast.Subscript)
+                    and isinstance(n.slice, ast.Constant)
+                    and isinstance(n.slice.value, str)):
+                keys.add(n.slice.value)
+    return keys
+
+
+def rng_constants(modules) -> dict:
+    """``{P_NAME: int}`` from ``ops/rng.py``'s module-level assignments."""
+    mod = _find(modules, RNG_MODULE)
+    if mod is None:
+        return {}
+    consts = {}
+    for node in mod.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.startswith("P_")
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            consts[node.targets[0].id] = node.value.value
+    return consts
+
+
+def rng_site_lines(modules, consts=None) -> dict:
+    """``{P_NAME: {rel: [linenos]}}`` — every AST name/attribute
+    reference to each purpose stream outside ``ops/rng.py`` itself
+    (comments and strings never count)."""
+    if consts is None:
+        consts = rng_constants(modules)
+    sites = {name: {} for name in consts}
+    for mod in modules:
+        if mod.rel == RNG_MODULE:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Name):
+                nm = node.id
+            elif isinstance(node, ast.Attribute):
+                nm = node.attr
+            else:
+                continue
+            if nm in consts:
+                sites[nm].setdefault(mod.rel, []).append(node.lineno)
+    return sites
+
+
+def rng_registry(modules) -> dict:
+    """``{P_NAME: {"value": int, "sites": {rel: count}}}`` — the
+    committed draw-site registry R10 diffs against."""
+    consts = rng_constants(modules)
+    sites = rng_site_lines(modules, consts)
+    return {nm: {"value": val,
+                 "sites": {rel: len(lines)
+                           for rel, lines in sorted(sites[nm].items())}}
+            for nm, val in sorted(consts.items())}
+
+
+def _find(modules, rel: str):
+    for mod in modules:
+        if mod.rel == rel:
+            return mod
+    return None
+
+
+# ----------------------------------------------------------- the document
+
+
+def extract(repo_root: str = REPO_ROOT, modules=None) -> dict:
+    """The full schema document (the shape committed to
+    ``artifacts/state_schema.json``)."""
+    from dispersy_tpu import checkpoint
+
+    if modules is None:
+        from .core import load_modules
+
+        modules = load_modules(repo_root)
+    return {
+        "tool": "graftlint-schema",
+        "version": SCHEMA_VERSION,
+        "checkpoint_version": checkpoint.FORMAT_VERSION,
+        "leaves": state_leaves(),
+        "rng_streams": rng_registry(modules),
+    }
+
+
+def write_artifact(repo_root: str = REPO_ROOT, modules=None) -> str:
+    """Regenerate the committed schema artifact; returns its path."""
+    path = artifact_path(repo_root)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(extract(repo_root, modules), f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path

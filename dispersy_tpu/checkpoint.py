@@ -119,7 +119,7 @@ from dispersy_tpu.telemetry import TelemetryConfig
 #     archive's FULL-width auth/mal/sig/stats leaves for a plane the
 #     config compiles out are CRC-verified, asserted empty, and sized
 #     down (_resize_plane_leaf).
-FORMAT_VERSION = 16  # v16: the parallel plane (the cross-shard shed
+# v16: the parallel plane (the cross-shard shed
 #     counter ``stats/xshard_shed``, knob-sized — the ragged-exchange
 #     backpressure stream of dispersy_tpu/shardplane.py; PARALLEL.md).
 #     v7-v15 archives still load: the missing counter defaults to the
@@ -135,8 +135,20 @@ FORMAT_VERSION = 16  # v16: the parallel plane (the cross-shard shed
 #     counters, knob-sized — dispersy_tpu/traceplane.py;
 #     OBSERVABILITY.md "Dissemination tracing").  v11-v15 FLEET
 #     archives load through ``restore_fleet`` the same way.
-_ACCEPTED_VERSIONS = (7, 8, 9, 10, 11, 12, 13, 14, 15, FORMAT_VERSION)
-_FLEET_VERSIONS = (11, 12, 13, 14, 15, FORMAT_VERSION)
+FORMAT_VERSION = 17  # v17: the cohort-staggered compaction leaves
+#     (``cohort``/``epoch``, knob-sized — zero-width unless
+#     cfg.store_stagger; storediet.cohorts, STORE.md "Cohort cadence")
+#     plus the u16 candidate round-stamp narrowing (store.cand_bits=16:
+#     the cand_last_walk/stumble/intro leaves become quantized u16).
+#     v7-v16 archives still load: the missing cohort/epoch leaves
+#     default to the template's (zero-width) values, and their config
+#     fingerprint predates StoreConfig's two NEW TRAILING fields —
+#     restoring one under non-default cohorts/cand_bits is refused
+#     (_want_fingerprint strips the ", cohorts=1, cand_bits=32" repr
+#     suffix from the store component, then the older planes').
+_ACCEPTED_VERSIONS = (7, 8, 9, 10, 11, 12, 13, 14, 15, 16,
+                      FORMAT_VERSION)
+_FLEET_VERSIONS = (11, 12, 13, 14, 15, 16, FORMAT_VERSION)
 
 # Leaves whose dtype narrowed u32 -> u8 at v8; a v7 archive's u32 arrays
 # convert by truncation (0xFFFFFFFF -> 0xFF, real values < 256 unchanged).
@@ -190,6 +202,11 @@ _NEW_V15 = frozenset(
 # _want_fingerprint), where this counter is zero-width.
 _NEW_V16 = frozenset({"stats/xshard_shed"})
 
+# Leaves that did not exist before v17 (cohort-staggered compaction).
+# Older archives only restore under default cohorts/cand_bits (enforced
+# by _want_fingerprint), where both leaves are zero-width.
+_NEW_V17 = frozenset({"cohort", "epoch"})
+
 # The introduction registry, one row per format version that added
 # leaves — the machine-readable half of the version-history prose above.
 # A NEW leaf MUST be registered here under the bumped FORMAT_VERSION, or
@@ -199,7 +216,7 @@ _NEW_V16 = frozenset({"stats/xshard_shed"})
 # refuses a leaf change without the version bump).
 _NEW_BY_VERSION: dict = {
     9: _NEW_V9, 10: _NEW_V10, 12: _NEW_V12, 13: _NEW_V13,
-    14: _NEW_V14, 15: _NEW_V15, 16: _NEW_V16,
+    14: _NEW_V14, 15: _NEW_V15, 16: _NEW_V16, 17: _NEW_V17,
 }
 
 
@@ -316,15 +333,34 @@ def _want_fingerprint(cfg: CommunityConfig, version: int) -> str:
     before ``faults`` (declared LAST) — every repr component strips
     cleanly, but only default models can possibly match what the old
     writer simulated."""
-    if version >= 16:
+    if version >= 17:
         return _fingerprint(cfg)
+    # Pre-v17 archives were written before StoreConfig grew its two
+    # TRAILING fields (cohorts / cand_bits — storediet.py pins them
+    # last for exactly this strip): only the defaults can match what
+    # the old writer simulated, and stripping their repr suffix
+    # recovers the old store component in place.
+    if cfg.store.cohorts != 1 or cfg.store.cand_bits != 32:
+        raise CheckpointError(
+            f"checkpoint format {version} predates the cohort-staggered "
+            "store fields; it can only restore under the defaults "
+            "(cfg.store.cohorts == 1 and cfg.store.cand_bits == 32)")
+    full17 = repr(cfg)
+    sfields = ", cohorts=1, cand_bits=32"
+    if full17.count(sfields) != 1:
+        raise CheckpointError(
+            "cannot derive pre-v17 fingerprint: cohorts/cand_bits are "
+            "no longer StoreConfig's two last fields")
+    full17 = full17.replace(sfields, "", 1)
+    if version >= 16:
+        return full17
     from dispersy_tpu.shardplane import ParallelConfig
     if cfg.parallel != ParallelConfig():
         raise CheckpointError(
             f"checkpoint format {version} predates the parallel plane; "
             "it can only restore under the default ParallelConfig "
             "(cfg.parallel must be ParallelConfig())")
-    full16 = repr(cfg)
+    full16 = full17
     pcomp = f", parallel={cfg.parallel!r}"
     if full16.count(pcomp) != 1:
         raise CheckpointError(
@@ -354,7 +390,9 @@ def _want_fingerprint(cfg: CommunityConfig, version: int) -> str:
             f"checkpoint format {version} predates the byte-diet store "
             "plane; it can only restore under the default StoreConfig "
             "(cfg.store must be StoreConfig())")
-    scomp = f", store={cfg.store!r}"
+    # the v17 trailing fields were already stripped from `full` above —
+    # strip them from this component's repr the same way
+    scomp = f", store={cfg.store!r}".replace(sfields, "", 1)
     if full.count(scomp) != 1:
         raise CheckpointError(
             "cannot derive pre-v14 fingerprint: store is no longer a "

@@ -625,6 +625,20 @@ class CommunityConfig:
         return "uint16" if self.store.aux_bits == 16 else "uint32"
 
     @property
+    def store_stagger(self) -> bool:
+        """Is the cohort-staggered compaction cadence compiled in?
+        (store.cohorts > 1 riding the diet; storediet.stagger_of)"""
+        return self.store.staging > 0 and self.store.cohorts > 1
+
+    @property
+    def cand_stamp_dtype(self) -> str:
+        """The persistent candidate-timestamp dtype: u16 round-stamps
+        under the byte-diet opt-in (store.cand_bits=16), f32 sim-seconds
+        otherwise.  The walker always computes on f32 seconds; the store
+        boundary (de)quantizes (engine._tab / engine's wrap-up)."""
+        return "uint16" if self.store.cand_bits == 16 else "float32"
+
+    @property
     def walk_lifetime_rounds(self) -> float:
         return self.walk_lifetime / self.walk_interval
 
@@ -960,6 +974,21 @@ class CommunityConfig:
                     "the digest covers the newest-window slice; a "
                     "modulo stripe changes per epoch and would leave "
                     "digest false negatives for out-of-stripe records")
+            if sd.cohorts > 1:
+                # The staggered cadence extracts the active cohort's
+                # rows as one reshape + dynamic-slice block
+                # (ops/store.cohort_take), which needs the mod
+                # assignment to tile the peer axis exactly.
+                if self.n_peers % sd.cohorts:
+                    raise ConfigError(
+                        "store.cohorts must divide n_peers: cohort "
+                        "blocks are extracted as equal reshape slices "
+                        f"({self.n_peers} % {sd.cohorts} != 0)")
+                if not self.sync_enabled:
+                    raise ConfigError(
+                        "store.cohorts > 1 staggers the SYNC cadence — "
+                        "meaningless with sync_enabled=False; leave "
+                        "cohorts=1")
         ov = self.overload
         if not isinstance(ov, OverloadConfig):
             raise ConfigError("overload must be an OverloadConfig")

@@ -301,17 +301,23 @@ def cell_cost(shape: str, plane: str, mesh: str | None = None) -> dict:
            if mesh is not None else {}),
         # Cadence-amortized mean over one compaction window for
         # byte-diet configs (profiling.step_cost_amortized); the plain
-        # per-round cost otherwise.  The quiet/sync split is recorded
-        # so the tier-1 amortization test can hold EACH round kind to
-        # its budget (tests/test_storediet.py).
+        # per-round cost otherwise.  The quiet/sync split AND the worst
+        # single round are recorded so the tier-1 amortization test can
+        # hold EACH round kind — and the provisioning spike the cohort
+        # staggering flattens — to its budget (tests/test_storediet.py).
         "bytes_accessed": cost["bytes_accessed"],
         "flops": cost["flops"],
         "compact_every": cost.get("compact_every", 1),
+        "cohorts": cost.get("cohorts", 1),
         **({k: cost[k] for k in ("bytes_quiet", "bytes_sync",
-                                 "flops_quiet", "flops_sync")
+                                 "flops_quiet", "flops_sync",
+                                 "bytes_worst", "flops_worst")
             if k in cost}),
         "bytes_per_peer_round": round(
             cost["bytes_accessed"] / (n * replicas), 1),
+        **({"bytes_worst_per_peer_round": round(
+                cost["bytes_worst"] / (n * replicas), 1)}
+           if "bytes_worst" in cost else {}),
         "state": sb,
         "floor": fl,
         "roofline": roofline(cost["bytes_accessed"],
@@ -324,7 +330,9 @@ def cell_cost(shape: str, plane: str, mesh: str | None = None) -> dict:
                    **({"bytes_quiet": cost["bytes_quiet"],
                        "bytes_sync": cost["bytes_sync"],
                        "flops_quiet": cost["flops_quiet"],
-                       "flops_sync": cost["flops_sync"]}
+                       "flops_sync": cost["flops_sync"],
+                       "bytes_worst": cost["bytes_worst"],
+                       "flops_worst": cost["flops_worst"]}
                       if "bytes_quiet" in cost else {})},
     }
     return cell
@@ -421,7 +429,8 @@ def compare_ledgers(measured: dict, committed: dict,
             continue
         budget = ref.get("budget", ref)
         for metric in ("bytes_accessed", "flops", "bytes_quiet",
-                       "bytes_sync", "flops_quiet", "flops_sync"):
+                       "bytes_sync", "flops_quiet", "flops_sync",
+                       "bytes_worst", "flops_worst"):
             if metric not in budget:
                 continue
             if metric not in cell:

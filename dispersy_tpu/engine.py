@@ -349,11 +349,45 @@ def _wipe_store_cols(m1, stc: st.StoreCols) -> st.StoreCols:
         flags=jnp.where(m1, jnp.uint8(0), stc.flags))
 
 
-def _tab(state: PeerState) -> cand.CandTable:
+def _cand_deq(col: jnp.ndarray, cfg: CommunityConfig) -> jnp.ndarray:
+    """Candidate-timestamp leaf -> the walker's f32 sim-seconds.
+
+    Under ``store.cand_bits == 16`` (storediet.py) the leaf is a u16
+    round-stamp: 0 is the ``never`` sentinel, stamp s is sim-second
+    ``(s - 1) * walk_interval``.  Exact for every value the walker ever
+    writes (all are some round's ``r * walk_interval``) inside the u16
+    range; identity at the default width."""
+    if col.dtype != jnp.uint16:
+        return col
+    sec = (col.astype(jnp.float32) - jnp.float32(1.0)) \
+        * jnp.float32(cfg.walk_interval)
+    return jnp.where(col == jnp.uint16(0), jnp.float32(NEVER), sec)
+
+
+def _cand_quant(col: jnp.ndarray, cfg: CommunityConfig) -> jnp.ndarray:
+    """f32 sim-seconds -> the candidate-timestamp leaf (inverse of
+    :func:`_cand_deq` on the walker's value set).
+
+    NEVER maps to stamp 0; everything else to
+    ``round(sec / walk_interval) + 1`` SATURATED into [1, 65535] — a
+    pre-epoch value (seed_overlay's negative eligibility offset) or a
+    >65534-round run degrades to a stale-but-ordered stamp, never the
+    sentinel.  Identity at the default width."""
+    if cfg.store.cand_bits != 16:
+        return col
+    q = jnp.round(col / jnp.float32(cfg.walk_interval)).astype(jnp.int32) \
+        + jnp.int32(1)
+    q = jnp.clip(q, 1, 65535)
+    return jnp.where(col == jnp.float32(NEVER), jnp.uint16(0),
+                     q.astype(jnp.uint16))
+
+
+def _tab(state: PeerState, cfg: CommunityConfig) -> cand.CandTable:
     return cand.CandTable(peer=state.cand_peer,
-                          last_walk=state.cand_last_walk,
-                          last_stumble=state.cand_last_stumble,
-                          last_intro=state.cand_last_intro)
+                          last_walk=_cand_deq(state.cand_last_walk, cfg),
+                          last_stumble=_cand_deq(state.cand_last_stumble,
+                                                 cfg),
+                          last_intro=_cand_deq(state.cand_last_intro, cfg))
 
 
 def _store(state: PeerState) -> st.StoreCols:
@@ -852,7 +886,21 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
     diet = cfg.store_diet
     sync_on = cfg.sync_enabled and (not diet or phase == "sync")
     compact_now = diet and phase == "sync"
-    if diet:
+    # Cohort staggering (PR 20, storediet.py): with ``cohorts > 1`` a
+    # sync round runs the claim/serve/compact path for ONE cohort's
+    # N/cohorts block instead of the whole fleet — ``a_coh`` is the
+    # round's active cohort, ``ep_a`` its (post-round-exclusive) salt
+    # epoch, and the per-PEER epoch leaf replaces the fleet-wide scalar
+    # everywhere a salt is derived.  ``stagger`` is static; the
+    # ``cohorts=1`` default compiles the identical PR-12 path.
+    stagger = sdiet.stagger_of(cfg)
+    if stagger:
+        # Per-peer salts: peer p's digest lives at its OWN cohort's
+        # epoch ([N,1] broadcasts against the [N,B]/[N,1] item hashes).
+        ep = state.epoch[:, None]
+        a_coh = sdiet.active_cohort(cfg, rnd)
+        ep_a = sdiet.epoch_of_cohort(cfg, rnd, a_coh)
+    elif diet:
         # Epoch salt: every round of one compaction window shares it,
         # and it rotates at the window boundary — requester digests and
         # responder queries derive it from the same round counter.
@@ -903,10 +951,19 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
     bdown = jnp.zeros((n,), jnp.uint32)
     # On byte-diet quiet rounds the request carries no sync tuple — the
     # responder would not serve it — so it is the sync-disabled request
-    # on the wire and in the byte accounting.
-    req_bytes = jnp.uint32(
-        INTRO_REQUEST_BASE_BYTES + 4 * cfg.bloom_words
-        if sync_on else INTRO_REQUEST_BASE_BYTES - 20)
+    # on the wire and in the byte accounting.  Under cohort staggering
+    # only the ACTIVE cohort's walkers carry the tuple on a sync round
+    # (a per-peer vector; the elementwise bup line below is unchanged,
+    # the responder's bdown gathers per request source).
+    if stagger and sync_on:
+        req_bytes = jnp.where(
+            state.cohort.astype(jnp.uint32) == a_coh,
+            jnp.uint32(INTRO_REQUEST_BASE_BYTES + 4 * cfg.bloom_words),
+            jnp.uint32(INTRO_REQUEST_BASE_BYTES - 20))
+    else:
+        req_bytes = jnp.uint32(
+            INTRO_REQUEST_BASE_BYTES + 4 * cfg.bloom_words
+            if sync_on else INTRO_REQUEST_BASE_BYTES - 20)
 
     # Dissemination-tracing plane (dispersy_tpu/traceplane.py): every
     # branch below is gated on the STATIC TraceConfig, so the default
@@ -934,7 +991,7 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
         with jax.named_scope("churn"):
             (tab, stc, fwd, dly, auth, sig, mal, global_time,
              session, sta, dig) = _rebirth_wipe(
-                reborn, tab=_tab(state), stc=_store(state),
+                reborn, tab=_tab(state, cfg), stc=_store(state),
                 fwd=(state.fwd_gt, state.fwd_member, state.fwd_meta,
                      state.fwd_payload, state.fwd_aux),
                 dly=(state.dly_gt, state.dly_member, state.dly_meta,
@@ -956,7 +1013,7 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
             tr_chan = jnp.where(rb1, jnp.uint8(0), tr_chan)
             tr_dups = jnp.where(rb1, jnp.uint32(0), tr_dups)
     else:
-        tab, stc = _tab(state), _store(state)
+        tab, stc = _tab(state, cfg), _store(state)
         fwd = (state.fwd_gt, state.fwd_member, state.fwd_meta,
                state.fwd_payload, state.fwd_aux)
         dly = (state.dly_gt, state.dly_member, state.dly_meta,
@@ -969,6 +1026,19 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
         global_time, session = state.global_time, state.session
         sta = _staging(state) if diet else None
         dig = state.digest if diet and cfg.sync_enabled else None
+
+    epoch = state.epoch
+    if stagger and cfg.churn_rate > 0.0:
+        # The epoch leaf is disk-like (it wipes with the store,
+        # state.WIPE_INVENTORY) and is immediately re-derived from the
+        # shared round counter + the structural cohort id — a value
+        # identity (the leaf is uniform within a cohort), kept explicit
+        # so the wiped-disk rebirth semantics stay visible.
+        epoch = jnp.where(
+            reborn,
+            sdiet.epoch_of_cohort(cfg, rnd,
+                                  state.cohort.astype(jnp.uint32)),
+            epoch)
 
     if fm.health_checks and cfg.churn_rate > 0.0:
         # A churn rebirth is a wiped-disk restart: the new process starts
@@ -1070,7 +1140,16 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
     else:
         target = jnp.full((n,), NO_PEER, jnp.int32)
 
-    if sync_on and diet:
+    if sync_on and stagger:
+        # Cohort-staggered claim (storediet.py): only the active
+        # cohort's N/cohorts block syncs this round, and the serve
+        # phase gathers everything it needs (the requester's slice AND
+        # digest) directly at the block — no fleet-wide claim arrays
+        # and no bloom on the modeled wire (the request is the 2-col
+        # quiet layout; req_bytes above still charges the active
+        # cohort's tuple).
+        sl = my_bloom = rec_h = rec_probes = None
+    elif sync_on and diet:
         # Byte-diet claim (storediet.py): the slice is recomputed from
         # the ring (unchanged since the last compaction, so this is the
         # compaction-time slice) and the bloom is the persistent DIGEST
@@ -1395,15 +1474,22 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
     # the sync exchange runs this round; without it (sync disabled, or a
     # byte-diet quiet round) the request is just (src, clock) — the
     # sync tuple would never be served, so it never rides the wire.
+    # Under cohort staggering the HBM request layout is ALWAYS the
+    # 2-col quiet form: the digest-serve responder below evaluates the
+    # requester's bloom against its own resident digest at the active
+    # block, so the [N, R, bloom_words] inbox tensor (the sync round's
+    # dominant request-side byte term) is never materialized.  The
+    # MODELED wire still carries the tuple — req_bytes above.
+    wire_sync = sync_on and not stagger
     with jax.named_scope("deliver_request"):
         req, _ = _deliver(
             cfg, dst=target,
             cols=([idx.astype(jnp.uint32), sl.time_low, sl.time_high,
                    sl.modulo, sl.offset, gt_at_send, my_bloom]
-                  if sync_on else [idx.astype(jnp.uint32), gt_at_send]),
+                  if wire_sync else [idx.astype(jnp.uint32), gt_at_send]),
             valid=send_ok & ~to_tracker, n_peers=n,
             inbox_size=cfg.request_inbox)
-    if sync_on:
+    if wire_sync:
         (rq_src, rq_tlow, rq_thigh, rq_mod, rq_off, rq_gt,
          rq_bloom) = req.inbox
     else:
@@ -1416,7 +1502,15 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
         + req.n_dropped.astype(jnp.uint32))
     n_rq = jnp.sum(rq_ok, axis=1).astype(jnp.uint32)
     # handled requests: request bytes in, one response each out
-    bdown = bdown + n_rq * req_bytes
+    if stagger and sync_on:
+        # Per-source request sizes (req_bytes is a vector): the
+        # responder's ingress charge gathers each accepted request's
+        # own size.
+        bdown = bdown + jnp.sum(
+            jnp.where(rq_ok, req_bytes[jnp.maximum(rq_src_i, 0)],
+                      jnp.uint32(0)), axis=1)
+    else:
+        bdown = bdown + n_rq * req_bytes
     bup = bup + n_rq * jnp.uint32(INTRO_RESPONSE_BYTES)
 
     # ---- phase 2: request processing at the responder ------------------
@@ -1535,7 +1629,14 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
             requests_dropped=stats.requests_dropped.at[:t].add(
                 treq.n_dropped.astype(jnp.uint32)))
         n_tq = jnp.sum(tq_ok, axis=1).astype(jnp.uint32)
-        bdown = bdown.at[:t].add(n_tq * req_bytes)
+        if stagger and sync_on:
+            # req_bytes is a per-peer vector under staggering — gather
+            # each accepted request's own size (normal-responder rule).
+            bdown = bdown.at[:t].add(jnp.sum(
+                jnp.where(tq_ok, req_bytes[jnp.maximum(tq_src_i, 0)],
+                          jnp.uint32(0)), axis=1))
+        else:
+            bdown = bdown.at[:t].add(n_tq * req_bytes)
         bup = bup.at[:t].add(n_tq * jnp.uint32(INTRO_RESPONSE_BYTES)
                              + jnp.sum(tq_ok & (intro_t != NO_PEER),
                                        axis=1).astype(jnp.uint32)
@@ -1813,7 +1914,105 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
     # requester then fetches its own outbox row by receipt — sync records
     # only ever flow back along the request edge (as in the reference,
     # where sync packets are unicast to the introduction-request sender).
-    if sync_on:
+    if sync_on and stagger:
+        # Cohort-staggered digest-serve (storediet.py, PR 20): the
+        # serve is computed PER REQUESTER on the active cohort's
+        # N/cohorts block instead of per responder-slot over the whole
+        # fleet.  Equivalence with the per-slot loop below: a request
+        # occupies responder slot ``req.edge_slot`` iff
+        # ``edge_slot >= 0`` (delivery kept it), and under that gate
+        # ``rq_ok[tgt, edge_slot] == act[tgt]`` — so gathering the
+        # responder's ring at each block requester's walk target and
+        # serving once per requester visits exactly the
+        # (requester, slot) pairs the slot loop serves.  The bloom
+        # probe runs against the requester's RESIDENT digest block at
+        # the cohort's epoch salt — the digest never rides the wire
+        # and the responder never re-probes its ring per slot.
+        b = cfg.response_budget
+        coh = cfg.store.cohorts
+        blk = n // coh
+        idx_blk = (jnp.arange(blk, dtype=jnp.int32) * coh
+                   + a_coh.astype(jnp.int32))          # true peer ids
+        tgt_blk = tgt[idx_blk]                          # responders
+        edge_ok = (req.edge_slot >= 0)[idx_blk]
+        with jax.named_scope("stagger_serve"):
+            stv_blk = _response_order(
+                st.StoreCols(*(c[tgt_blk] for c in stc)), cfg)
+            rec_h2 = record_hash(stv_blk.member, stv_blk.gt,
+                                 stv_blk.meta, stv_blk.payload)
+            q_probes = (bloom.probe_bits(rec_h2, cfg.bloom_bits,
+                                         cfg.bloom_hashes, salt=ep_a)
+                        if bloom.gather_backend() else None)
+            # The requester's claimed slice, from its own (unchanged
+            # since last compaction) ring block.
+            sl_blk = st.claim_slice_largest(
+                st.cohort_take(stc.gt, a_coh, coh), cfg.bloom_capacity)
+            in_sl = st.slice_mask(stv_blk.gt, sl_blk)     # [blk, M]
+            if cfg.timeline_enabled:
+                # Hard-killed responders serve only the destroy record.
+                in_sl = in_sl & (~killed[tgt_blk][:, None]
+                                 | (stv_blk.meta
+                                    == jnp.uint32(META_DESTROY)))
+            dig_blk = st.cohort_take(dig, a_coh, coh)
+            if q_probes is not None:
+                present = bloom.bloom_query_from(dig_blk, q_probes)
+            else:
+                present = bloom.bloom_query(dig_blk, rec_h2,
+                                            cfg.bloom_bits,
+                                            cfg.bloom_hashes, salt=ep_a)
+            if cfg.timeline_enabled:
+                present = present & ~killed[tgt_blk][:, None]
+            missing = in_sl & ~present \
+                & (edge_ok & act[tgt_blk])[:, None]
+            rank = jnp.cumsum(missing.astype(jnp.int32), axis=1) - 1
+            slot = jnp.where(missing & (rank < b), rank, b)
+            o_gt, o_member, o_meta, o_payload, o_aux, o_ok = \
+                st.rank_compact_many(
+                    [(stv_blk.gt, EMPTY_U32), (stv_blk.member, EMPTY_U32),
+                     (stv_blk.meta, EMPTY_META),
+                     (stv_blk.payload, EMPTY_U32),
+                     (stv_blk.aux, 0), (missing, False)], slot, b)
+        # Scatter the block outboxes into the full [N, b] pickup layout
+        # (zeros elsewhere — every consumer below gates on sy_ok), so
+        # the loss/corrupt/dup draws and every downstream intake line
+        # key on the requester's TRUE peer index, exactly like the
+        # per-slot path.
+        zf = jnp.zeros((n, b), jnp.uint32)
+        sy_gt = st.cohort_put(zf, o_gt, a_coh, coh)
+        sy_member = st.cohort_put(zf, o_member, a_coh, coh)
+        sy_meta = st.cohort_put(jnp.zeros((n, b), jnp.uint8), o_meta,
+                                a_coh, coh)
+        sy_payload = st.cohort_put(zf, o_payload, a_coh, coh)
+        sy_aux = st.cohort_put(jnp.zeros((n, b), stc.aux.dtype), o_aux,
+                               a_coh, coh)
+        sy_cand = st.cohort_put(jnp.zeros((n, b), bool), o_ok,
+                                a_coh, coh)
+        sync_lost = _lost(seed, rnd, idx[:, None], _LOSS_SYNC,
+                          jnp.arange(b)[None, :], kn, ge_bad)
+        sy_ok = sy_cand & act[:, None] & ~sync_lost
+        # Responder upload: served records leave the responder pre-loss
+        # (a scatter-add at the block's walk targets); requester
+        # download per accepted record, as on the per-slot path.
+        bup = bup.at[tgt_blk].add(
+            jnp.sum(o_ok, axis=1).astype(jnp.uint32)
+            * jnp.uint32(RECORD_BYTES), mode="drop")
+        bdown = bdown + jnp.sum(sy_ok, axis=1).astype(jnp.uint32) \
+            * jnp.uint32(RECORD_BYTES)
+        if kn.corrupt_on:
+            cu = rng.rand_uniform(seed, rnd, idx[:, None], rng.P_CORRUPT,
+                                  jnp.arange(b)[None, :] + _FAULT_SYNC)
+            sy_bad = sy_ok & (cu < jnp.float32(kn.corrupt_rate))
+            stats = stats.replace(
+                msgs_corrupt_dropped=stats.msgs_corrupt_dropped
+                + jnp.sum(sy_bad, axis=1).astype(jnp.uint32))
+            sy_ok = sy_ok & ~sy_bad
+        if kn.dup_on:
+            du = rng.rand_uniform(seed, rnd, idx[:, None], rng.P_DUP,
+                                  jnp.arange(b)[None, :] + _FAULT_SYNC)
+            sy_dup_ok = sy_ok & (du < jnp.float32(kn.dup_rate))
+            bdown = bdown + jnp.sum(sy_dup_ok, axis=1).astype(jnp.uint32) \
+                * jnp.uint32(RECORD_BYTES)
+    elif sync_on:
         b = cfg.response_budget
         # The responder serves from its ordered view (priority DESC, gt
         # ASC/DESC per meta); identity for default communities — in which
@@ -2719,10 +2918,15 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
                 + jnp.sum(accept_store & ~fresh,
                           axis=1).astype(jnp.uint32)
                 + stg.n_dropped.astype(jnp.uint32))
-            if cfg.sync_enabled and not compact_now:
+            if cfg.sync_enabled and (stagger or not compact_now):
                 # Incremental digest: OR the landed arrivals' probe
                 # bits in, so next round's claim (and freshness test)
                 # covers them.  Compaction rounds rebuild instead.
+                # Under cohort staggering the update runs EVERY round
+                # (salt = the per-peer epoch): the inactive cohorts
+                # must keep absorbing arrivals on another cohort's
+                # sync round, and the active cohort's rows are
+                # rebuilt—and overwritten—by its compaction below.
                 with jax.named_scope("digest_update"):
                     if in_probes is not None:
                         dig = bloom.digest_update(dig, in_probes,
@@ -2924,7 +3128,67 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
                jnp.full((n, cfg.forward_buffer),
                         st.empty_of(cfg.aux_dtype), cfg.aux_dtype))
 
-    if compact_now:
+    if compact_now and stagger:
+        # ---- cohort-staggered compaction (storediet.py, PR 20): the
+        # ACTIVE cohort's N/cohorts block — and only it — runs the
+        # PR-12 compaction verbatim: staging merges into the ring
+        # (store_insert semantics unchanged), staging clears, digest
+        # rebuilds under the cohort's NEXT epoch salt, and the
+        # cohort's epoch leaf bumps.  Block extraction is a reshape +
+        # dynamic-slice on the non-peer axis (ops/store.cohort_take) —
+        # zero cross-shard bytes, and the round's ring-rewrite cost
+        # drops to 1/cohorts of the fleet-synchronized spike. ---
+        coh = cfg.store.cohorts
+        blk = n // coh
+        with jax.named_scope("store_compact"):
+            stc_blk = st.cohort_take_cols(stc, a_coh, coh)
+            sta_blk = st.cohort_take_cols(sta, a_coh, coh)
+            ins = st.store_insert(stc_blk, sta_blk, sta_blk.valid,
+                                  history=cfg.history)
+            stc = st.cohort_put_cols(stc, ins.store, a_coh, coh)
+            sta = st.cohort_put_cols(
+                sta, st.empty_records((blk,) + sta.gt.shape[1:],
+                                      aux_dtype=sta.aux.dtype),
+                a_coh, coh)
+
+        def _coh_add(full, delta):
+            return st.cohort_put(
+                full, st.cohort_take(full, a_coh, coh)
+                + delta.astype(jnp.uint32), a_coh, coh)
+
+        stats = stats.replace(
+            msgs_stored=_coh_add(stats.msgs_stored, ins.n_inserted),
+            msgs_dropped=_coh_add(stats.msgs_dropped,
+                                  ins.n_dropped.astype(jnp.uint32)
+                                  + ins.n_evicted.astype(jnp.uint32)))
+        with jax.named_scope("digest_rebuild"):
+            sl_n = st.claim_slice_largest(ins.store.gt,
+                                          cfg.bloom_capacity)
+            in_sl_n = st.slice_mask(ins.store.gt, sl_n)
+            rh_n = record_hash(ins.store.member, ins.store.gt,
+                               ins.store.meta, ins.store.payload)
+            if bloom.gather_backend():
+                dig_blk = bloom.bloom_build_from(
+                    bloom.probe_bits(rh_n, cfg.bloom_bits,
+                                     cfg.bloom_hashes,
+                                     salt=ep_a + jnp.uint32(1)),
+                    in_sl_n, cfg.bloom_bits,
+                    chunks=cfg.parallel.scatter_chunks)
+            else:
+                dig_blk = bloom.bloom_build(rh_n, in_sl_n,
+                                            cfg.bloom_bits,
+                                            cfg.bloom_hashes,
+                                            salt=ep_a + jnp.uint32(1))
+            dig = st.cohort_put(dig, dig_blk, a_coh, coh)
+        # The compaction closes the cohort's epoch: its per-peer salt
+        # advances to the one the rebuilt digest was just built with
+        # (the round-start invariant
+        # ``epoch[p] == epoch_of_cohort(cfg, rnd, cohort[p])`` holds at
+        # rnd + 1 exactly because only the active cohort's quotient
+        # increments across this round boundary).
+        epoch = epoch + (state.cohort.astype(jnp.uint32)
+                         == a_coh).astype(jnp.uint32)
+    elif compact_now:
         # ---- byte-diet compaction (storediet.py): merge the staging
         # buffer — this round's arrivals included — into the sorted
         # ring through the unchanged store_insert (UNIQUE / LastSync /
@@ -3268,8 +3532,9 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
         walk_streak=walk_streak, tele_row=tele_row, tele_ring=tele_ring,
         fr_ring=fr_ring, fr_pos=fr_pos,
         mal_member=mal,
-        cand_peer=tab.peer, cand_last_walk=tab.last_walk,
-        cand_last_stumble=tab.last_stumble, cand_last_intro=tab.last_intro,
+        cand_peer=tab.peer, cand_last_walk=_cand_quant(tab.last_walk, cfg),
+        cand_last_stumble=_cand_quant(tab.last_stumble, cfg),
+        cand_last_intro=_cand_quant(tab.last_intro, cfg),
         store_gt=stc.gt, store_member=stc.member, store_meta=stc.meta,
         store_payload=stc.payload, store_aux=stc.aux, store_flags=stc.flags,
         **({} if not diet else {
@@ -3277,6 +3542,7 @@ def _step_impl(state: PeerState, cfg: CommunityConfig,
             "sta_meta": sta.meta, "sta_payload": sta.payload,
             "sta_aux": sta.aux, "sta_flags": sta.flags,
             **({} if dig is None else {"digest": dig})}),
+        **({} if not stagger else {"epoch": epoch}),
         **({} if not trace_on else {
             "trace_first": tr_first, "trace_chan": tr_chan,
             "trace_dups": tr_dups, "trace_latch": tr_latch}),
@@ -3488,7 +3754,14 @@ def create_messages(state: PeerState, cfg: CommunityConfig,
         # A capacity-dropped create leaves a false-positive bit that
         # the next compaction's rebuild clears — the storediet.py FP
         # argument.
-        ep = sdiet.epoch_of(cfg, state.round_index)
+        if cfg.store_stagger:
+            # Per-peer salts under cohort staggering: each author's
+            # digest lives at its OWN cohort's current epoch (the
+            # leaf equals epoch_of_cohort(cfg, round_index, cohort)
+            # between rounds — the engine's round-start invariant).
+            ep = state.epoch[:, None]
+        else:
+            ep = sdiet.epoch_of(cfg, state.round_index)
         new_h = record_hash(new.member, new.gt, new.meta, new.payload)
         if bloom.gather_backend():
             dig = bloom.digest_update(
@@ -3728,14 +4001,23 @@ def seed_overlay(state: PeerState, cfg: CommunityConfig,
     nbr = jnp.where(dup, NO_PEER, nbr)
     eligible_at = jnp.float32(0.0) - jnp.float32(cfg.eligibility_delay)
     pad = cfg.k_candidates - degree
+
+    def never_k():  # distinct buffers: aliasing breaks step's donation
+        return jnp.full((n, cfg.k_candidates), NEVER, jnp.float32)
+    # _cand_quant: identity at the default timestamp width.  Under
+    # cand_bits=16 the negative pre-epoch stamp saturates to the oldest
+    # live stamp (sim-second 0.0) — a seeded neighbor becomes eligible
+    # after eligibility_delay instead of immediately; the documented
+    # narrowing degradation (storediet.StoreConfig.cand_bits), mirrored
+    # bit-exactly by the oracle.
     return state.replace(
         cand_peer=jnp.concatenate(
             [nbr, jnp.full((n, pad), NO_PEER, jnp.int32)], axis=1),
-        cand_last_walk=jnp.concatenate(
+        cand_last_walk=_cand_quant(jnp.concatenate(
             [jnp.where(nbr == NO_PEER, jnp.float32(NEVER), eligible_at),
-             jnp.full((n, pad), NEVER, jnp.float32)], axis=1),
-        cand_last_stumble=jnp.full((n, cfg.k_candidates), NEVER, jnp.float32),
-        cand_last_intro=jnp.full((n, cfg.k_candidates), NEVER, jnp.float32))
+             jnp.full((n, pad), NEVER, jnp.float32)], axis=1), cfg),
+        cand_last_stumble=_cand_quant(never_k(), cfg),
+        cand_last_intro=_cand_quant(never_k(), cfg))
 
 
 def coverage(state: PeerState, member: int, gt: int, meta: int,

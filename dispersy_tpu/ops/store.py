@@ -187,6 +187,68 @@ def rank_compact_many(cols_fills, slot: jnp.ndarray, width: int,
             for c, f in cols_fills]
 
 
+# Cohort-staggered compaction (dispersy_tpu/storediet.py, PR 20): peer
+# idx belongs to cohort ``idx % cohorts`` — a MOD (strided) assignment,
+# so reshaping the peer axis [N, ...] -> [N//cohorts, cohorts, ...] is a
+# bitcast that groups each cohort into one slice of the NON-leading
+# axis.  The active cohort's [N//cohorts, ...] block then extracts with
+# a dynamic_slice at the TRACED cohort index — crucially on an axis the
+# mesh never shards (parallel/mesh.py shards axis 0 only), so on a
+# sharded fleet every device slices its own resident rows and the
+# extraction moves zero cross-shard bytes while each shard keeps an
+# equal share of every cohort's work.  These two are the ONE
+# block-extraction idiom the engine's sync/compact/serve path and the
+# cost model both rely on: row j of the block is full row
+# ``j * cohorts + a``.
+
+
+@contract(out=Spec("uint32", (2, "M")), col=Spec("uint32", ("N", "M")),
+          a=Spec("uint32", ()), cohorts=2)
+def cohort_take(col: jnp.ndarray, a: jnp.ndarray,
+                cohorts: int) -> jnp.ndarray:
+    """Extract cohort ``a``'s [N//cohorts, ...] row block from a full
+    [N, ...] peer-axis array (``a`` traced u32, ``cohorts`` static)."""
+    n = col.shape[0]
+    blk = n // cohorts
+    r = col.reshape((blk, cohorts) + col.shape[1:])
+    out = lax.dynamic_slice_in_dim(r, a.astype(jnp.int32), 1, axis=1)
+    return out.reshape((blk,) + col.shape[1:])
+
+
+@contract(out=Spec("uint32", ("N", "M")), col=Spec("uint32", ("N", "M")),
+          blk=Spec("uint32", (2, "M")), a=Spec("uint32", ()), cohorts=2)
+def cohort_put(col: jnp.ndarray, blk: jnp.ndarray, a: jnp.ndarray,
+               cohorts: int) -> jnp.ndarray:
+    """Write cohort ``a``'s row block back into the full [N, ...] array
+    (inverse of :func:`cohort_take`; other cohorts' rows untouched).
+    The dynamic_update_slice updates in place under donation — HLO cost
+    analysis charges it the BLOCK's bytes, not the full array's, which
+    is exactly the flattening the cohort stagger exists to buy."""
+    n = col.shape[0]
+    blk_n = n // cohorts
+    r = col.reshape((blk_n, cohorts) + col.shape[1:])
+    upd = blk.reshape((blk_n, 1) + col.shape[1:])
+    starts = (jnp.int32(0), a.astype(jnp.int32)) + tuple(
+        jnp.int32(0) for _ in col.shape[1:])
+    return lax.dynamic_update_slice(r, upd, starts).reshape(col.shape)
+
+
+@host_helper
+def cohort_take_cols(stc: StoreCols, a, cohorts: int) -> StoreCols:
+    """:func:`cohort_take` over every column of one store/staging block
+    (host_helper: a trivial per-column map, no dtype surface of its
+    own)."""
+    return StoreCols(*(cohort_take(c, a, cohorts) for c in stc))
+
+
+@host_helper
+def cohort_put_cols(stc: StoreCols, blk: StoreCols, a,
+                    cohorts: int) -> StoreCols:
+    """:func:`cohort_put` over every column of one store/staging block."""
+    return StoreCols(*(cohort_put(c, b, a, cohorts)
+                       for c, b in zip(stc, blk)))
+
+
 class InsertResult(NamedTuple):
     store: StoreCols
     n_inserted: jnp.ndarray  # i32[N] new records now in the store

@@ -46,7 +46,9 @@ from dispersy_tpu import telemetry as tlm
 from dispersy_tpu.oracle.bloom import OracleBloom, record_hash
 from dispersy_tpu.recovery import NUM_HEALTH_BITS
 from dispersy_tpu.state import stats_gates as _stats_gates
-from dispersy_tpu.storediet import epoch_of, sync_round_of
+from dispersy_tpu.storediet import (active_cohort, cohort_of,
+                                    epoch_of, epoch_of_cohort,
+                                    stagger_of, sync_round_of)
 from dispersy_tpu.traceplane import (CH_CREATE, CH_PUSH, CH_WALK_SYNC,
                                      CHANNEL_NAMES, LATCH_PCTS,
                                      NUM_CHANNELS, redundancy_f32)
@@ -188,6 +190,14 @@ class OraclePeer:
         self.staging: list[Record] = []
         self.digest = (OracleBloom(cfg.bloom_bits, cfg.bloom_hashes)
                        if cfg.store_diet and cfg.sync_enabled else None)
+        # Cohort-staggered cadence (storediet.py, engine cohort/epoch
+        # leaves): the compaction cohort is the peer identity's (i %
+        # cohorts, assigned by OracleSim) and survives churn; the epoch
+        # counts this peer's completed compactions (disk-like — churn
+        # re-derives it from the shared round counter, a value
+        # identity).  Both stay 0 when staggering is compiled out.
+        self.cohort = 0
+        self.epoch = 0
         self.fwd: list[Record] = []     # forward batch for next round
         self.auth: list[AuthRow] = []   # bounded at cfg.k_authorized
         # delayed-message pen: (record, round first parked, delivering
@@ -269,6 +279,10 @@ class OracleSim:
         self.rnd = 0
         self.now = np.float32(0.0)
         self.peers = [OraclePeer(cfg) for _ in range(cfg.n_peers)]
+        if cfg.store_stagger:
+            # strided cohort assignment (state.init_state mirror)
+            for i, p in enumerate(self.peers):
+                p.cohort = cohort_of(cfg, i)
         # Gilbert–Elliott channel state (engine: PeerState.ge_bad) —
         # the link's property, surviving churn rebirth.
         self.ge_bad = [False] * cfg.n_peers
@@ -419,17 +433,41 @@ class OracleSim:
             matches = [victim]
         for target in matches:
             if kind == KIND_WALK:
-                target.walk = self.now
+                target.walk = self._qts(self.now)
             elif kind == KIND_STUMBLE:
-                target.stumble = self.now
+                target.stumble = self._qts(self.now)
             else:
-                target.intro = self.now
+                target.intro = self._qts(self.now)
 
     def _remove(self, owner: int, peer: int) -> None:
         for s in self.peers[owner].slots:
             if s.peer == peer:
                 s.peer = NO_PEER
                 s.walk = s.stumble = s.intro = NEVER
+
+    def _cand_stamp(self, x) -> int:
+        """engine._cand_quant for one value: the u16 round-stamp the
+        leaf stores for sim-second ``x`` (0 = never; saturates into
+        [1, 65535] — storediet.StoreConfig.cand_bits)."""
+        if x == NEVER:
+            return 0
+        q = int(np.round(np.float32(x)
+                         / np.float32(self.cfg.walk_interval))) + 1
+        return min(max(q, 1), 65535)
+
+    def _qts(self, x) -> np.float32:
+        """Candidate-timestamp store round-trip (engine's wrap-up
+        ``_cand_quant`` then next-round ``_cand_deq``): under
+        cand_bits=16 every sim-second written to a slot passes through
+        the u16 round-stamp on its way into the leaf, so the oracle
+        saturates at each write exactly like the engine."""
+        if self.cfg.store.cand_bits != 16:
+            return x
+        s = self._cand_stamp(x)
+        if s == 0:
+            return NEVER
+        return _f32((np.float32(s) - np.float32(1.0))
+                    * np.float32(self.cfg.walk_interval))
 
     def _sample_walk_target(self, i: int) -> int:
         cfg = self.cfg
@@ -1056,8 +1094,10 @@ class OracleSim:
                 if p.digest is not None:
                     # Byte-diet: the digest learns the authored record
                     # under the CURRENT epoch's salt, store_mask-wide —
-                    # engine create_messages' digest_update mirror.
-                    p.digest.salt = epoch_of(cfg, self.rnd)
+                    # engine create_messages' digest_update mirror
+                    # (under staggering: the author's own epoch leaf).
+                    p.digest.salt = (p.epoch if cfg.store_stagger
+                                     else epoch_of(cfg, self.rnd))
                     p.digest.add(rec.hash())
             if cfg.timeline_enabled and meta in (META_AUTHORIZE, META_REVOKE):
                 ev = self._auth_fold(i, pv, av & user_perm_mask(cfg.n_meta),
@@ -1115,7 +1155,11 @@ class OracleSim:
     def seed_overlay(self, degree: int) -> None:
         """engine.seed_overlay mirror (per-community member blocks)."""
         cfg = self.cfg
-        eligible_at = _f32(np.float32(0.0) - np.float32(cfg.eligibility_delay))
+        # Under cand_bits=16 the pre-epoch stamp saturates to round 0
+        # (sim-second 0.0) — the documented narrowing degradation
+        # (storediet.StoreConfig.cand_bits), mirrored via _qts.
+        eligible_at = self._qts(
+            _f32(np.float32(0.0) - np.float32(cfg.eligibility_delay)))
         for i, p in enumerate(self.peers):
             base = int(self.mem_base[i])
             span = max(int(self.mem_count[i]), 1)
@@ -1170,10 +1214,17 @@ class OracleSim:
         # update the digest; sync rounds run the claim/serve exchange
         # and compact the staging into the ring.
         diet = cfg.store_diet
+        stagger = stagger_of(cfg)
         sync_round = sync_round_of(cfg, rnd) if diet else True
         ep = epoch_of(cfg, rnd)
         sync_on = cfg.sync_enabled and sync_round
         compact_now = diet and sync_round
+        # Cohort staggering (engine stagger/a_coh/ep_a): on a sync round
+        # exactly one cohort runs the claim/serve/compact path; its
+        # bloom salt is its own epoch (== every member's epoch leaf by
+        # the round-start invariant).
+        a_coh = active_cohort(cfg, rnd) if (stagger and sync_round) else 0
+        ep_a = epoch_of_cohort(cfg, rnd, a_coh) if stagger else ep
         # community packets seen by each peer this round (auto-load
         # trigger — engine `arrivals`)
         arrivals = [False] * n
@@ -1211,6 +1262,13 @@ class OracleSim:
                     p.fwd = []
                     p.auth = []
                     p.delay = []
+                    if stagger:
+                        # the epoch wipes with the store and is
+                        # immediately re-derived from the shared round
+                        # counter (engine phase 0) — a value identity
+                        # with the round-start invariant, kept explicit
+                        # for the documented rebirth semantics
+                        p.epoch = epoch_of_cohort(cfg, rnd, p.cohort)
                     p.sig_target = NO_PEER
                     p.sig_meta = p.sig_payload = p.sig_gt = p.sig_since = 0
                     p.mal = []
@@ -1252,7 +1310,21 @@ class OracleSim:
                     targets[i] = self._sample_walk_target(i)
 
         slices, blooms = [None] * n, [None] * n
-        if sync_on and diet:
+        if sync_on and stagger:
+            # Cohort-staggered claim: only the ACTIVE cohort walks with
+            # a sync tuple this round; its digest salt is the cohort's
+            # epoch ep_a (== each member's own epoch leaf by the
+            # round-start invariant).  The engine's digest-serve
+            # responder gathers the requester's slice and digest at the
+            # block during serve — the ring is unchanged until
+            # compaction, so claiming here is equivalent.  Non-active
+            # peers keep (None, None): their requests carry no claim
+            # and the serve below skips them.
+            for i, p in enumerate(self.peers):
+                if p.cohort == a_coh:
+                    p.digest.salt = ep_a
+                    slices[i], blooms[i] = self._claim_slice(i), p.digest
+        elif sync_on and diet:
             # Byte-diet claim: the slice is the ring's largest-window
             # (ring unchanged since the last compaction) and the bloom
             # is the persistent digest under the epoch salt — no
@@ -1272,14 +1344,23 @@ class OracleSim:
                         bloom.add(rec.hash())
                 slices[i], blooms[i] = sl, bloom
 
-        # byte-equivalent sizes (engine mirror)
-        req_bytes = (INTRO_REQUEST_BASE_BYTES + 4 * (cfg.bloom_bits // 32)
-                     if sync_on else INTRO_REQUEST_BASE_BYTES - 20)
+        # byte-equivalent sizes (engine mirror).  Under staggering only
+        # the active cohort's walkers carry the sync tuple — req_bytes
+        # becomes per-SENDER (engine's req_bytes vector); responders
+        # charge each accepted request's own size below.
+        full_req = INTRO_REQUEST_BASE_BYTES + 4 * (cfg.bloom_bits // 32)
+        if stagger and sync_on:
+            req_bytes_of = [full_req if p.cohort == a_coh
+                            else INTRO_REQUEST_BASE_BYTES - 20
+                            for p in self.peers]
+        else:
+            req_bytes_of = [full_req if sync_on
+                            else INTRO_REQUEST_BASE_BYTES - 20] * n
 
         send_ok = [False] * n
         for i in range(n):
             if self.peers[i].alive and targets[i] != NO_PEER:
-                self.peers[i].bytes_up += req_bytes          # sendto, pre-loss
+                self.peers[i].bytes_up += req_bytes_of[i]    # sendto, pre-loss
             send_ok[i] = (self.peers[i].alive and targets[i] != NO_PEER
                           and not self._lost(i, _LOSS_REQUEST, 0)
                           and not self._blocked(i, targets[i]))
@@ -1501,8 +1582,11 @@ class OracleSim:
         for d in range(n):
             n_rq = sum(rq_ok[d])
             tele_nrq[d] = n_rq
-            # handled requests: request bytes in, one response each out
-            self.peers[d].bytes_down += n_rq * req_bytes
+            # handled requests: request bytes in (each request's own
+            # per-sender size), one response each out
+            self.peers[d].bytes_down += sum(
+                req_bytes_of[src] for s_ix, src in enumerate(req_inbox[d])
+                if rq_ok[d][s_ix])
             self.peers[d].bytes_up += n_rq * INTRO_RESPONSE_BYTES
 
         # snapshot sender clocks as they rode the request packet
@@ -1549,7 +1633,7 @@ class OracleSim:
                         s = self.peers[d].slots[slot_ix]
                         s.peer = src
                         s.walk = s.intro = NEVER
-                        s.stumble = self.now
+                        s.stumble = self._qts(self.now)
                 # introduction picks for each served request
                 for s_ix, src in enumerate(tq_inbox[d]):
                     src_m = src if tq_ok[d][s_ix] else NO_PEER
@@ -1578,7 +1662,9 @@ class OracleSim:
                 self._fold_gt(d, [req_gt[src] for s_ix, src in
                                   enumerate(tq_inbox[d]) if tq_ok[d][s_ix]])
                 n_tq = sum(tq_ok[d])
-                self.peers[d].bytes_down += n_tq * req_bytes
+                self.peers[d].bytes_down += sum(
+                    req_bytes_of[src] for s_ix, src in
+                    enumerate(tq_inbox[d]) if tq_ok[d][s_ix])
                 self.peers[d].bytes_up += (
                     n_tq * INTRO_RESPONSE_BYTES
                     + sum(1 for s_ix in range(len(tq_inbox[d]))
@@ -1795,7 +1881,9 @@ class OracleSim:
                     view = [r for r in view if r.meta == META_DESTROY]
                 for s_ix, src in enumerate(req_inbox[d]):
                     sel: list[Record] = []
-                    if rq_ok[d][s_ix]:
+                    # under staggering a non-active requester's packet
+                    # is the 2-col quiet layout — no claim to serve
+                    if rq_ok[d][s_ix] and blooms[src] is not None:
                         sl, bl = slices[src], blooms[src]
                         for rec in view:
                             if len(sel) >= b:
@@ -2170,7 +2258,9 @@ class OracleSim:
                 # digest is only UPDATED after the whole batch is
                 # judged, so in-batch ordering matches the engine's
                 # phase order exactly (dup_earlier handles in-batch).
-                p.digest.salt = ep
+                # Under staggering the salt is the peer's OWN epoch
+                # (engine: salt = state.epoch[:, None]).
+                p.digest.salt = p.epoch if stagger else ep
                 have = [rec.hash() in p.digest for rec in ok_batch]
             elif diet:
                 union_keys = store_keys | {(r.gt, r.member)
@@ -2353,9 +2443,14 @@ class OracleSim:
                         landed_flags[e] = True
                     else:
                         p.msgs_dropped += 1
-                if (cfg.sync_enabled and not compact_now
+                if (cfg.sync_enabled and (stagger or not compact_now)
                         and landed_hashes):
-                    p.digest.salt = ep
+                    # Under staggering the incremental update runs
+                    # EVERY round at the peer's own salt — the active
+                    # cohort's digest is rebuilt (overwritten) by its
+                    # compaction just below, same as the engine's
+                    # update-then-rebuild ordering.
+                    p.digest.salt = p.epoch if stagger else ep
                     for h in landed_hashes:
                         p.digest.add(h)
                 if ok_batch:
@@ -2436,23 +2531,35 @@ class OracleSim:
                     p.fwd.append(grec.copy())
                 else:
                     p.fwd[cfg.forward_buffer - 1] = grec.copy()
-            if compact_now:
+            if compact_now and (not stagger or p.cohort == a_coh):
                 # Byte-diet compaction (engine store_compact +
                 # digest_rebuild): the staging merges through the
                 # unchanged insert semantics — msgs_stored counts here,
                 # where records actually enter the ring — and the
                 # digest rebuilds from the fresh ring under the NEXT
-                # epoch's salt.
+                # epoch's salt.  Under staggering only the ACTIVE
+                # cohort's block compacts (ep_a == ep when cohorts==1;
+                # the epoch bump itself runs for every active-cohort
+                # row, alive or not, in the wrap-up loop below).
                 self._store_insert(i, p.staging)
                 p.staging = []
                 if cfg.sync_enabled:
                     sl_n = self._claim_slice(i)
                     nb = OracleBloom(cfg.bloom_bits, cfg.bloom_hashes,
-                                     salt=ep + 1)
+                                     salt=ep_a + 1)
                     for rec in p.store:
                         if self._in_slice(rec, sl_n):
                             nb.add(rec.hash())
                     p.digest = nb
+
+        if compact_now and stagger:
+            # The active cohort's epoch advances for EVERY row — alive,
+            # unloaded or dead alike (the engine's elementwise
+            # `epoch + (cohort == a_coh)` bump) — keeping the leaf
+            # uniform per cohort and on the round-start invariant.
+            for p in self.peers:
+                if p.cohort == a_coh:
+                    p.epoch += 1
 
         if cfg.timeline_enabled and retro_trigger:
             # Retroactive re-walk — the engine's lax.cond branch taken
@@ -2757,6 +2864,15 @@ class OracleSim:
         s_w = cfg.store.staging
         aux_dt = np.dtype(cfg.aux_dtype)
         gates = _stats_gates(cfg)
+        # Narrowed candidate-timestamp leaves (storediet cand_bits=16):
+        # the device leaf holds u16 round-stamps (0 = never); the
+        # oracle's f32 sim-seconds already passed through _qts at each
+        # write, so _cand_stamp here is an exact inverse.
+        cand_u16 = cfg.store.cand_bits == 16
+        cand_dt = np.uint16 if cand_u16 else np.float32
+        cand_never = 0 if cand_u16 else NEVER
+        # Cohort-stagger leaves (zero-width when cohorts == 1; state.py)
+        st_n = n if cfg.store_stagger else 0
 
         def gated(name, vals_u32):
             return (np.array(vals_u32, np.uint32) if gates[name]
@@ -2768,9 +2884,9 @@ class OracleSim:
             "global_time": np.array([p.global_time for p in self.peers],
                                     np.uint32),
             "cand_peer": np.full((n, k), NO_PEER, np.int32),
-            "cand_last_walk": np.full((n, k), NEVER, np.float32),
-            "cand_last_stumble": np.full((n, k), NEVER, np.float32),
-            "cand_last_intro": np.full((n, k), NEVER, np.float32),
+            "cand_last_walk": np.full((n, k), cand_never, cand_dt),
+            "cand_last_stumble": np.full((n, k), cand_never, cand_dt),
+            "cand_last_intro": np.full((n, k), cand_never, cand_dt),
             "store_gt": np.full((n, m), EMPTY_U32, np.uint32),
             "store_member": np.full((n, m), EMPTY_U32, np.uint32),
             # meta/flags mirror the engine's narrowed column dtypes
@@ -2788,6 +2904,10 @@ class OracleSim:
                                 np.uint32).reshape(n, cfg.bloom_bits // 32)
                        if (cfg.store_diet and cfg.sync_enabled)
                        else np.zeros((0, 0), np.uint32)),
+            "cohort": np.array([p.cohort for p in self.peers][:st_n],
+                               np.uint16),
+            "epoch": np.array([p.epoch for p in self.peers][:st_n],
+                              np.uint32),
             "store_flags": np.zeros((n, m), np.uint8),
             "fwd_gt": np.full((n, cfg.forward_buffer), EMPTY_U32, np.uint32),
             "fwd_member": np.full((n, cfg.forward_buffer), EMPTY_U32,
@@ -2982,9 +3102,15 @@ class OracleSim:
         for i, p in enumerate(self.peers):
             for j, s in enumerate(p.slots):
                 out["cand_peer"][i, j] = s.peer
-                out["cand_last_walk"][i, j] = s.walk
-                out["cand_last_stumble"][i, j] = s.stumble
-                out["cand_last_intro"][i, j] = s.intro
+                if cand_u16:
+                    out["cand_last_walk"][i, j] = self._cand_stamp(s.walk)
+                    out["cand_last_stumble"][i, j] = \
+                        self._cand_stamp(s.stumble)
+                    out["cand_last_intro"][i, j] = self._cand_stamp(s.intro)
+                else:
+                    out["cand_last_walk"][i, j] = s.walk
+                    out["cand_last_stumble"][i, j] = s.stumble
+                    out["cand_last_intro"][i, j] = s.intro
             for j, rec in enumerate(p.store):
                 out["store_gt"][i, j] = rec.gt
                 out["store_member"][i, j] = rec.member

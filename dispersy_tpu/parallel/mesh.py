@@ -78,7 +78,13 @@ PARTITION_RULES: tuple[tuple[str, str], ...] = (
     (r"^fr_(ring|pos)$", "replicated"),  # flight recorder: [depth, W]
     #   host-diagnostic ring + its scalar cursor
     (r".*", "peers"),                    # EVERYTHING else carries the
-    #   peer axis in dim 0 (zero-width plane leaves included)
+    #   peer axis in dim 0 (zero-width plane leaves included).  The
+    #   cohort-stagger leaves (``cohort``/``epoch``, storediet.py) land
+    #   here on purpose: cohorts are assigned STRIDED (idx % cohorts),
+    #   so every shard holds an equal slice of each cohort and the
+    #   active-cohort block ops (ops/store.cohort_take/put) reshape the
+    #   peer axis to [N//C, C] and slice the trailing NON-peer axis —
+    #   no cross-shard bytes, no resharding warnings.
 )
 
 

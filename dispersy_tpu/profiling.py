@@ -50,10 +50,16 @@ def bench_config(n_peers: int, platform: str = "tpu") -> CommunityConfig:
 
     # The byte-diet store plane (PR 12; storediet.py) is ON for the
     # bench shapes: staging=8 slots, compaction/sync one round in 12,
-    # aux narrowed to u16 — the layout the committed cost ledger prices
-    # (BENCH.md "Byte diet").  Legacy-layout numbers are reproducible
-    # with cfg.replace(store=StoreConfig()).
-    diet = StoreConfig(staging=8, compact_every=12, aux_bits=16)
+    # aux narrowed to u16, candidate stamps quantized to u16, and the
+    # sync/compaction cadence staggered over 4 cohorts (PR 20) — the
+    # layout the committed cost ledger prices (BENCH.md "Byte diet").
+    # cohorts=4 is the largest value dividing both compact_every=12 and
+    # the bench populations (1M = 2^6*5^6, 64k = 2^16); it flattens the
+    # worst single round from ~4.1x to ~1.7x the quiet round at 1M.
+    # Legacy-layout numbers are reproducible with
+    # cfg.replace(store=StoreConfig()).
+    diet = StoreConfig(staging=8, compact_every=12, aux_bits=16,
+                       cohorts=4, cand_bits=16)
     if platform == "cpu":
         return CommunityConfig(
             n_peers=n_peers, n_trackers=max(2, min(4, n_peers // 1024)),
@@ -138,25 +144,54 @@ def step_cost(cfg: CommunityConfig, phase: str | None = None) -> dict:
     return out
 
 
-def _amortize(measure, c: int) -> dict:
+def _amortize(measure, store) -> dict:
     """Cadence-weighted cost over one compaction window from a
     per-phase measuring callable: quiet and sync round kinds priced
-    separately plus their ``((C-1)*quiet + sync) / C`` mean — the one
-    formula both the single-step and fleet ledgers record."""
+    separately plus their window mean AND the worst single round — the
+    one formula both the single-step and fleet ledgers record.
+
+    Without cohorts the window is ``compact_every`` rounds holding ONE
+    sync round: ``((C-1)*quiet + sync) / C``.  Under cohort staggering
+    (``store.cohorts > 1``, storediet.py) one cohort syncs every
+    ``C // cohorts`` rounds, so the window holds ``cohorts`` sync
+    rounds: ``((C-cohorts)*quiet + cohorts*sync) / C`` — each sync
+    round far cheaper than the fleet-synchronized one because the
+    claim/serve/compact path touches only the active cohort's
+    ``N/cohorts`` block.  ``bytes_worst`` is the number the staggering
+    exists to flatten: the most expensive single round in the window,
+    i.e. what the link/HBM must be provisioned for (vs the amortized
+    mean it is billed at)."""
+    c, k = store.compact_every, store.cohorts
     quiet = measure("quiet")
     sync = measure("sync")
+    bq, bs = quiet["bytes_accessed"], sync["bytes_accessed"]
+    fq, fs = quiet["flops"], sync["flops"]
     return {
         "compact_every": c,
-        "bytes_quiet": quiet["bytes_accessed"],
-        "bytes_sync": sync["bytes_accessed"],
-        "flops_quiet": quiet["flops"],
-        "flops_sync": sync["flops"],
-        "bytes_accessed": ((c - 1) * quiet["bytes_accessed"]
-                           + sync["bytes_accessed"]) / c,
-        "flops": ((c - 1) * quiet["flops"] + sync["flops"]) / c,
+        "cohorts": k,
+        "bytes_quiet": bq,
+        "bytes_sync": bs,
+        "flops_quiet": fq,
+        "flops_sync": fs,
+        "bytes_worst": max(bq, bs),
+        "flops_worst": max(fq, fs),
+        "bytes_accessed": ((c - k) * bq + k * bs) / c,
+        "flops": ((c - k) * fq + k * fs) / c,
         "compile_seconds": round(quiet["compile_seconds"]
                                  + sync["compile_seconds"], 2),
     }
+
+
+def _plain_window(out: dict) -> dict:
+    """Annotate a legacy (non-diet) per-round cost as its degenerate
+    one-round window: every round is a sync round, so the worst round
+    IS the mean — keeps the ledger's worst-vs-amortized gate uniform
+    across diet and legacy cells."""
+    out["compact_every"] = 1
+    out["cohorts"] = 1
+    out["bytes_worst"] = out["bytes_accessed"]
+    out["flops_worst"] = out["flops"]
+    return out
 
 
 def step_cost_amortized(cfg: CommunityConfig) -> dict:
@@ -166,11 +201,8 @@ def step_cost_amortized(cfg: CommunityConfig) -> dict:
     (``((C-1)*quiet + sync) / C``).  For legacy configs this is just
     :func:`step_cost` (every round is a sync round)."""
     if not cfg.store_diet:
-        out = step_cost(cfg)
-        out["compact_every"] = 1
-        return out
-    return _amortize(lambda ph: step_cost(cfg, ph),
-                     cfg.store.compact_every)
+        return _plain_window(step_cost(cfg))
+    return _amortize(lambda ph: step_cost(cfg, ph), cfg.store)
 
 
 def sharded_step_cost(cfg: CommunityConfig,
@@ -215,12 +247,10 @@ def sharded_step_cost_amortized(cfg: CommunityConfig,
     the SPMD gate pins) and cadence-averaged — the mesh cell's number
     in the cost ledger."""
     if not cfg.store_diet:
-        out = sharded_step_cost(cfg, n_devices)
-        out["compact_every"] = 1
-        return out
+        return _plain_window(sharded_step_cost(cfg, n_devices))
     out = _amortize(
         lambda ph: sharded_step_cost(cfg, n_devices, phase=ph),
-        cfg.store.compact_every)
+        cfg.store)
     out["devices"] = (list(n_devices) if isinstance(n_devices, tuple)
                       else n_devices)
     return out
@@ -233,11 +263,9 @@ def fleet_step_cost_amortized(cfg: CommunityConfig,
     lockstep, so the cadence is fleet-global) and cadence-averaged.
     Legacy configs fall through to one :func:`fleet_step_cost`."""
     if not cfg.store_diet:
-        out = fleet_step_cost(cfg, replicas)
-        out["compact_every"] = 1
-        return out
+        return _plain_window(fleet_step_cost(cfg, replicas))
     return _amortize(lambda ph: fleet_step_cost(cfg, replicas, phase=ph),
-                     cfg.store.compact_every)
+                     cfg.store)
 
 
 def fleet_step_cost(cfg: CommunityConfig, replicas: int,

@@ -254,10 +254,16 @@ class PeerState:
     #   (traceplane.LATCH_PCTS order; 0 = not reached)
 
     # ---- candidate table [N, K] ----
+    # The three timestamp columns are f32 sim-seconds by default, or
+    # quantized u16 round-stamps (``round + 1``, 0 = never) under the
+    # byte-diet opt-in ``store.cand_bits=16`` — the walker always
+    # computes on f32 seconds; engine._tab dequantizes on the way in and
+    # the wrap-up quantizes on the way out (truncating at the store
+    # boundary, the aux_bits rule).
     cand_peer: jnp.ndarray         # i32, NO_PEER = empty
-    cand_last_walk: jnp.ndarray    # f32 sim-seconds of last successful walk to it
-    cand_last_stumble: jnp.ndarray  # f32 last time it contacted us
-    cand_last_intro: jnp.ndarray   # f32 last time it was introduced to us
+    cand_last_walk: jnp.ndarray    # sim-seconds of last successful walk to it
+    cand_last_stumble: jnp.ndarray  # last time it contacted us
+    cand_last_intro: jnp.ndarray   # last time it was introduced to us
 
     # ---- message store [N, M], sorted by (gt, member, meta, payload) ----
     store_gt: jnp.ndarray      # u32, EMPTY_U32 = hole
@@ -289,6 +295,19 @@ class PeerState:
     # the ring at compaction.  Doubles as the intake freshness filter.
     # Zero-width unless the diet and sync are both on.
     digest: jnp.ndarray
+    # ---- cohort-staggered compaction (storediet.cohorts > 1; PR 20).
+    #      Both leaves are zero-width unless cfg.store_stagger — the
+    #      `health` idiom.  Checkpoint v17. ----
+    cohort: jnp.ndarray   # u16[N] compaction cohort = idx % cohorts —
+    #   structural (derived from the row index, like is_tracker):
+    #   survives churn rebirth, unload and restart; materialized so the
+    #   schema/partition/oracle machinery sees the assignment.
+    epoch: jnp.ndarray    # u32[N] the peer's CURRENT bloom-salt epoch =
+    #   its completed compaction count, +1 on the peer's own sync round.
+    #   Always equal to storediet.epoch_of_cohort(cfg, rnd, cohort) — a
+    #   reborn peer re-derives it from the shared round counter (the
+    #   overlay's cadence, not the process's), so rebirth wipes it WITH
+    #   the store and the re-derived value lands it back on cadence.
 
     # ---- forward buffer [N, F]: records to push next round -------------
     # (reference: dispersy.py store_update_forward -> _forward sends each
@@ -514,6 +533,12 @@ WIPE_INVENTORY: dict = {
     "sta_aux": ("disk", None),
     "sta_flags": ("disk", None),
     "digest": ("disk", None),
+    "cohort": ("identity", None),   # idx % cohorts — structural, like
+    #   is_tracker: rebirth/unload/restart all keep it
+    "epoch": ("disk", None),        # wiped with the store by rebirth and
+    #   immediately RE-DERIVED from (round, cohort) in the same block
+    #   (engine._rebirth_wipe): the reborn peer rejoins the fleet cadence
+    #   at the epoch every surviving peer already attributes to it
     "fwd_gt": ("instance", "empty"),
     "fwd_member": ("instance", "empty"),
     "fwd_meta": ("instance", "empty"),
@@ -575,9 +600,16 @@ def wipe_instance_memory(state: PeerState, mask) -> PeerState:
         xp = np if isinstance(arr, np.ndarray) else jnp
         m = xp.reshape(xp.asarray(mask), (n,) + (1,) * (arr.ndim - 1))
         # "empty" is the all-ones sentinel of the column's OWN dtype
-        # (EMPTY_U32 for u32 columns, EMPTY_META for narrowed u8 metas).
-        fill = (np.iinfo(np.dtype(arr.dtype)).max if kind == "empty"
-                else fills[kind])
+        # (EMPTY_U32 for u32 columns, EMPTY_META for narrowed u8 metas);
+        # "never" is the f32 NEVER sentinel, or 0 for the quantized u16
+        # round-stamp columns (store.cand_bits=16 — stamp 0 = never).
+        if kind == "empty":
+            fill = np.iinfo(np.dtype(arr.dtype)).max
+        elif kind == "never" and np.issubdtype(np.dtype(arr.dtype),
+                                               np.integer):
+            fill = 0
+        else:
+            fill = fills[kind]
         updates[name] = xp.where(m, xp.asarray(fill, dtype=arr.dtype),
                                  arr)
     return state.replace(**updates)
@@ -633,7 +665,17 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
     t_w = config.trace.tracked_slots if config.trace.enabled else 0
     aux_dt = config.aux_dtype
 
+    # Cohort-stagger leaves (zero-width when cohorts == 1): cohort is
+    # the structural idx % cohorts assignment, epoch the per-peer
+    # completed-compaction count (0 at cold start for every cohort —
+    # epoch_of_cohort(cfg, 0, k) == 0).
+    st_n = n if config.store_stagger else 0
+
     def never():  # distinct buffers: aliasing breaks donation
+        if config.store.cand_bits == 16:
+            # Quantized u16 round-stamps: 0 is the "never" sentinel
+            # (stamps are round + 1; storediet.StoreConfig.cand_bits).
+            return jnp.zeros((n, k), jnp.uint16)
         return jnp.full((n, k), NEVER, jnp.float32)
     return PeerState(
         alive=jnp.ones((n,), bool),
@@ -694,6 +736,9 @@ def init_state(config: CommunityConfig, key: jax.Array) -> PeerState:
         sta_aux=jnp.zeros((n, s_w), aux_dt),
         sta_flags=jnp.zeros((n, s_w), FLAGS_DTYPE),
         digest=jnp.zeros((n if d_w else 0, d_w), jnp.uint32),
+        cohort=(jnp.arange(n, dtype=jnp.int32)
+                % config.store.cohorts).astype(jnp.uint16)[:st_n],
+        epoch=jnp.zeros((st_n,), jnp.uint32),
         fwd_gt=jnp.full((n, f), EMPTY_U32, jnp.uint32),
         fwd_member=jnp.full((n, f), EMPTY_U32, jnp.uint32),
         fwd_meta=jnp.full((n, f), EMPTY_META, META_DTYPE),

@@ -92,6 +92,30 @@ class StoreConfig:
     # 2^16-1 silently truncate at the store boundary, so this is an
     # explicit opt-in for communities whose payloads fit.
     aux_bits: int = 32
+    # NOTE: checkpoint._want_fingerprint reconstructs pre-v17 config
+    # fingerprints by stripping ", cohorts=1, cand_bits=32" from this
+    # dataclass's repr — the two v17 fields below MUST stay last and in
+    # this order.
+    #
+    # Compaction cohorts (PR 20): stagger the sync/compaction cadence
+    # so peer p (cohort ``p % cohorts``) runs its claim/serve/compact
+    # round when ``rnd % compact_every == cohort_phase(cohort)`` instead
+    # of fleet-synchronized — each sync round touches only the active
+    # cohort's N/cohorts ring block and the per-round byte spike
+    # flattens to the amortized average.  1 = the fleet-synchronized
+    # PR-12 cadence, bit-identical to the pre-cohort path.
+    cohorts: int = 1
+    # Candidate-table timestamp width: 32 keeps the legacy f32
+    # sim-second columns (``cand_last_walk/stumble/intro``); 16 stores
+    # them as quantized u16 ROUND-stamps (``round + 1``, 0 = never) and
+    # dequantizes at the store boundary (``(stamp - 1) * walk_interval``).
+    # Quantization is exact — every timestamp the walker writes is some
+    # round's ``r * walk_interval`` — except at the u16 boundary, where
+    # the stamp SATURATES into [1, 65535] (the aux_bits narrowing rule,
+    # with saturation instead of wrap so a pre-epoch seed stamp or a
+    # >65534-round run degrades to a stale-but-ordered timestamp, never
+    # the ``never`` sentinel); an explicit opt-in for runs that fit.
+    cand_bits: int = 32
 
     def __post_init__(self) -> None:
         if self.staging < 0:
@@ -104,6 +128,23 @@ class StoreConfig:
             raise ConfigError(
                 "store.aux_bits narrowing rides the staged store layout "
                 "— set store.staging > 0 too")
+        if self.cohorts < 1:
+            raise ConfigError("store.cohorts must be >= 1")
+        if self.cohorts > 1 and self.staging == 0:
+            raise ConfigError(
+                "store.cohorts staggering rides the staged store layout "
+                "— set store.staging > 0 too")
+        if self.cohorts > 1 and self.compact_every % self.cohorts:
+            raise ConfigError(
+                "store.cohorts must divide compact_every: the cohort "
+                "phases interleave one sync round every "
+                "compact_every/cohorts rounds")
+        if self.cand_bits not in (16, 32):
+            raise ConfigError("store.cand_bits must be 16 or 32")
+        if self.cand_bits != 32 and self.staging == 0:
+            raise ConfigError(
+                "store.cand_bits narrowing rides the staged store "
+                "layout — set store.staging > 0 too")
 
 
 @host_helper
@@ -111,19 +152,73 @@ def epoch_of(cfg, rnd):
     """The bloom-salt epoch of round ``rnd`` (host int or traced u32):
     ``rnd // compact_every``.  Requesters build/maintain the digest with
     this salt and responders query with it — both sides derive it from
-    the same round counter, so the exchange stays round-synchronous."""
+    the same round counter, so the exchange stays round-synchronous.
+    Cohort 0's epoch; under staggering (``cohorts > 1``) the per-peer
+    generalization is :func:`epoch_of_cohort`."""
     return rnd // cfg.store.compact_every
+
+
+@host_helper
+def stagger_of(cfg) -> bool:
+    """Is the cohort-staggered cadence compiled in?  True exactly when
+    the diet is on AND ``cohorts > 1`` — the ``cohorts=1`` default keeps
+    the fleet-synchronized PR-12 code path bit-identical."""
+    return cfg.store.staging > 0 and cfg.store.cohorts > 1
+
+
+@host_helper
+def cohort_of(cfg, idx):
+    """Peer ``idx``'s compaction cohort: ``idx % cohorts`` (host int or
+    traced i32/u32 array).  The mod (strided) assignment keeps every
+    device shard holding an equal slice of each cohort, so the active
+    cohort's block extraction (a reshape + dynamic-slice on a NON-peer
+    axis, ops/store.cohort_take) moves no bytes across shards."""
+    return idx % cfg.store.cohorts
+
+
+@host_helper
+def cohort_phase(cfg, k):
+    """The round-within-window on which cohort ``k`` runs its sync/
+    compaction: ``compact_every - 1 - k * (compact_every // cohorts)``.
+    Cohort 0 keeps the fleet-synchronized PR-12 phase (``C - 1``); the
+    others interleave one sync round every ``C // cohorts`` rounds."""
+    c = cfg.store.compact_every
+    return c - 1 - k * (c // cfg.store.cohorts)
+
+
+@host_helper
+def active_cohort(cfg, rnd):
+    """Which cohort syncs/compacts on round ``rnd`` (host int or traced
+    u32) — the inverse of :func:`cohort_phase` on sync rounds.  Only
+    meaningful where :func:`sync_round_of` holds."""
+    c = cfg.store.compact_every
+    stride = c // cfg.store.cohorts
+    return (c - 1 - rnd % c) // stride
+
+
+@host_helper
+def epoch_of_cohort(cfg, rnd, k):
+    """Cohort ``k``'s bloom-salt epoch at round ``rnd``: the number of
+    compactions it has completed, ``(rnd + k * (C // cohorts)) // C``.
+    Zero for every cohort at round 0, +1 immediately after the cohort's
+    own sync round — cohort 0 degenerates to :func:`epoch_of`.  Works
+    for host ints or traced u32 (``k`` may be a per-peer array, giving
+    the per-peer salt vector the quiet-round digest update uses)."""
+    c = cfg.store.compact_every
+    return (rnd + k * (c // cfg.store.cohorts)) // c
 
 
 @host_helper
 def sync_round_of(cfg, rnd):
     """Cadence predicate (host int or traced u32, like ``epoch_of``):
-    does round ``rnd`` run the sync exchange + compaction?  Always True
-    without the diet."""
+    does round ``rnd`` run the sync exchange + compaction for SOME
+    cohort?  Always True without the diet; with ``cohorts > 1`` one
+    cohort syncs every ``compact_every // cohorts`` rounds (which
+    cohort: :func:`active_cohort`)."""
     if cfg.store.staging == 0:
         return True
-    c = cfg.store.compact_every
-    return (rnd % c) == c - 1
+    stride = cfg.store.compact_every // cfg.store.cohorts
+    return (rnd % stride) == stride - 1
 
 
 @host_helper

@@ -472,11 +472,19 @@ def test_8x1M_fleet_compiles_with_chunked_bloom_scatter():
     into row chunks (bit-identical output; tests/test_storediet.py
     covers the equality at small shapes).  Abstract shapes only —
     nothing materializes; ~15 s of XLA compile total."""
+    import dataclasses
+
     from dispersy_tpu import profiling
     from dispersy_tpu.shardplane import ParallelConfig
 
     R = 8
+    # The fleet-SYNCHRONIZED cadence (cohorts=1): every replica's full
+    # digest rebuilds in one scatter — the config the historic refusal
+    # came from.  The PR-20 bench default (cohorts=4) rebuilds only the
+    # active cohort's N/4 block per sync round, which compiles
+    # unchunked on purpose (the stagger shrinks the scatter too).
     cfg = profiling.bench_config(1_000_000, "tpu")
+    cfg = cfg.replace(store=dataclasses.replace(cfg.store, cohorts=1))
     shapes = profiling.state_shapes(cfg)
     fshapes = jax.tree.map(
         lambda s: jax.ShapeDtypeStruct((R,) + tuple(s.shape), s.dtype),

@@ -43,7 +43,9 @@ from dispersy_tpu.exceptions import CheckpointError, ConfigError
 from dispersy_tpu.faults import FaultModel
 from dispersy_tpu.oracle import sim as O
 from dispersy_tpu.recovery import RecoveryConfig
-from dispersy_tpu.storediet import StoreConfig, phase_of, sync_round_of
+from dispersy_tpu.storediet import (StoreConfig, active_cohort,
+                                    cohort_phase, epoch_of_cohort,
+                                    phase_of, sync_round_of)
 
 from test_oracle import BASE as ORACLE_BASE
 from test_oracle import FIELDS, STAT_FIELDS, assert_match, run_both
@@ -51,7 +53,11 @@ from test_oracle import FIELDS, STAT_FIELDS, assert_match, run_both
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DIET_FIELDS = ["sta_gt", "sta_member", "sta_meta", "sta_payload",
-               "sta_aux", "sta_flags", "digest"]
+               "sta_aux", "sta_flags", "digest",
+               # cohort-staggered compaction (PR 20): the strided
+               # cohort assignment + per-peer bloom-salt epoch —
+               # zero-width (and trivially equal) below cohorts=2
+               "cohort", "epoch"]
 
 BASE = CommunityConfig(n_peers=48, n_trackers=2, msg_capacity=24,
                        bloom_capacity=16, k_candidates=8, request_inbox=4,
@@ -271,9 +277,11 @@ def test_diet_convergence_reaches_full_coverage():
 
 def test_amortized_bytes_match_committed_budget():
     """Measure the 64k cell's quiet and compaction round kinds fresh
-    and hold them — and their cadence mean — to the committed ledger
-    budgets.  A change that re-introduces per-round ring rewrites
-    inflates bytes_quiet and fails here directly."""
+    and hold them — their cadence mean AND the worst single round — to
+    the committed ledger budgets, both directions (equality).  A change
+    that re-introduces per-round ring rewrites inflates bytes_quiet and
+    fails here directly; one that silently de-staggers the cadence
+    inflates bytes_worst."""
     from dispersy_tpu import costmodel, profiling
 
     with open(os.path.join(REPO, "artifacts", "cost_ledger.json")) as f:
@@ -281,21 +289,25 @@ def test_amortized_bytes_match_committed_budget():
     budget = committed["cells"]["64k_cpu/default"]["budget"]
     cfg = profiling.bench_config(65_536, "cpu")
     assert cfg.store_diet, "the bench shapes carry the byte diet"
+    assert cfg.store.cohorts > 1, \
+        "the bench shapes carry the staggered cadence"
     out = profiling.step_cost_amortized(cfg)
     assert out["bytes_quiet"] == budget["bytes_quiet"]
     assert out["bytes_sync"] == budget["bytes_sync"]
     assert out["bytes_accessed"] == budget["bytes_accessed"]
-    # The structural amortization claims, independent of the recorded
-    # numbers: a quiet round must stay several times cheaper than the
-    # compaction round whose work it defers, and the cadence mean must
-    # sit well under the legacy every-round-merge cost (which is >= the
-    # sync round's).
-    assert out["bytes_quiet"] * 3 < out["bytes_sync"]
-    c = cfg.store.compact_every
-    legacy_floor = out["bytes_sync"]          # >= one full-merge round
-    assert out["bytes_accessed"] < 0.5 * legacy_floor
+    assert out["bytes_worst"] == budget["bytes_worst"]
+    # The structural claims, independent of the recorded numbers.  The
+    # tentpole flattening: under staggering the sync round touches only
+    # the active cohort's block, so the WORST single round stays within
+    # ~2x a quiet round (pre-cohort it was >4x — the spike the plane
+    # exists to remove) while still costing strictly more than quiet.
+    assert out["bytes_quiet"] < out["bytes_sync"]
+    assert out["bytes_worst"] == max(out["bytes_quiet"],
+                                     out["bytes_sync"])
+    assert out["bytes_worst"] <= 2.0 * out["bytes_quiet"]
+    c, k = cfg.store.compact_every, cfg.store.cohorts
     assert out["bytes_accessed"] == pytest.approx(
-        ((c - 1) * out["bytes_quiet"] + out["bytes_sync"]) / c)
+        ((c - k) * out["bytes_quiet"] + k * out["bytes_sync"]) / c)
     # And the active-floor model keeps the documented shape: the ring
     # term is the full ring read+write amortized over the cadence.
     fl = costmodel.active_floor(cfg)
@@ -469,3 +481,361 @@ def test_diet_fleet_matches_sequential_singles():
         rep = S.index_state(jax.block_until_ready(fstate), i)
         for la, lb in zip(jax.tree.leaves(rep), jax.tree.leaves(single)):
             np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ---- cohort-staggered compaction (PR 20) --------------------------------
+
+# 48 peers / 4 cohorts / compact_every 4 -> stride 1: EVERY round is a
+# sync round for one 12-peer cohort — the fully-flattened cadence.
+COHORT_CFG = BASE.replace(
+    store=StoreConfig(staging=8, compact_every=4, cohorts=2))
+
+
+def test_cohort_validation():
+    with pytest.raises(ConfigError):
+        StoreConfig(staging=8, cohorts=0)
+    with pytest.raises(ConfigError):
+        StoreConfig(cohorts=2)              # staggering rides the diet
+    with pytest.raises(ConfigError):        # cohorts must divide C
+        StoreConfig(staging=8, compact_every=12, cohorts=5)
+    with pytest.raises(ConfigError):
+        StoreConfig(staging=8, cand_bits=8)
+    with pytest.raises(ConfigError):
+        StoreConfig(cand_bits=16)           # narrowing rides the diet
+    with pytest.raises(ConfigError):        # cohorts must divide n_peers
+        BASE.replace(store=StoreConfig(staging=8, compact_every=10,
+                                       cohorts=5))
+
+
+def test_cohort_cadence_helpers():
+    cfg = BASE.replace(store=StoreConfig(staging=8, compact_every=12,
+                                         cohorts=4))
+    stride = 3
+    # one cohort syncs every stride rounds, descending from the last
+    assert [sync_round_of(cfg, r) for r in range(6)] == \
+        [False, False, True, False, False, True]
+    assert [active_cohort(cfg, r) for r in (2, 5, 8, 11)] == [3, 2, 1, 0]
+    # cohort_phase is active_cohort's inverse on sync rounds; cohort 0
+    # keeps the fleet-synchronized PR-12 phase C-1
+    for k in range(4):
+        ph = cohort_phase(cfg, k)
+        assert ph == 11 - k * stride
+        assert active_cohort(cfg, ph) == k
+    # epoch_of_cohort counts COMPLETED compactions: 0 for everyone at
+    # round 0, +1 exactly on the round after cohort k's own sync round
+    for k in range(4):
+        ph = cohort_phase(cfg, k)
+        for r in range(30):
+            want = sum(1 for s in range(r) if s % 12 == ph % 12)
+            assert epoch_of_cohort(cfg, r, k) == want, (r, k)
+
+
+def test_cohorts1_leaves_compile_out():
+    """The cohort/epoch leaves are zero-width below cohorts=2 (the
+    plane pattern: the PR-12 path compiles literally unchanged — its
+    behavior is pinned by every pre-cohort test in this module), and
+    materialize strided at cohorts>1."""
+    s1 = S.init_state(DIET_CFG, jax.random.PRNGKey(0))
+    assert s1.cohort.shape == (0,) and s1.epoch.shape == (0,)
+    s2 = S.init_state(COHORT_CFG, jax.random.PRNGKey(0))
+    assert s2.cohort.dtype == jnp.uint16
+    assert s2.epoch.dtype == jnp.uint32
+    np.testing.assert_array_equal(
+        np.asarray(s2.cohort), np.arange(COHORT_CFG.n_peers) % 2)
+    assert int(np.asarray(s2.epoch).sum()) == 0
+
+
+def test_oracle_parity_cohorts_basic():
+    cfg = ORACLE_BASE.replace(
+        store=StoreConfig(staging=8, compact_every=4, cohorts=2))
+    run_both(cfg, rounds=10, author=5, warm=4)
+
+
+def test_oracle_parity_cohorts_stride1_chaos():
+    """cohorts == compact_every (stride 1: every round syncs one
+    cohort) under the full chaos harness — GE bursty loss + corrupt +
+    dup + flood + health sentinels + churn."""
+    cfg = ORACLE_BASE.replace(
+        churn_rate=0.04, packet_loss=0.08,
+        store=StoreConfig(staging=8, compact_every=4, cohorts=4),
+        faults=FaultModel(ge_p_bad=0.1, ge_p_good=0.3, ge_loss_good=0.02,
+                          ge_loss_bad=0.4, dup_rate=0.05,
+                          corrupt_rate=0.05, flood_senders=(3, 4),
+                          flood_fanout=5, health_checks=True))
+    run_both(cfg, rounds=17, author=5, warm=4)
+
+
+def test_oracle_parity_cohorts_cand16():
+    cfg = BASE.replace(
+        churn_rate=0.05, packet_loss=0.05,
+        store=StoreConfig(staging=8, compact_every=6, cohorts=3,
+                          cand_bits=16))
+    run_both(cfg, rounds=13, author=5, warm=4)
+
+
+def test_oracle_parity_cand16_without_cohorts():
+    cfg = ORACLE_BASE.replace(
+        store=StoreConfig(staging=8, compact_every=3, cand_bits=16))
+    run_both(cfg, rounds=9, author=5, warm=4)
+
+
+def test_churn_rebirth_mid_cohort_rederives_epoch():
+    """Churn rebirth mid-window: the reborn peer's COHORT is identity
+    (never wiped), its EPOCH is disk-like state re-derived from the
+    shared round counter — so the leaf invariant
+    ``epoch[p] == epoch_of_cohort(cfg, rnd, cohort[p])`` holds for
+    every row at every round boundary, bit-exactly vs the oracle."""
+    cfg = ORACLE_BASE.replace(
+        churn_rate=0.12,
+        store=StoreConfig(staging=8, compact_every=4, cohorts=2))
+    state, _ = run_both(cfg, rounds=11, author=5, warm=4)
+    rnd = int(np.asarray(state.round_index))
+    cohort = np.asarray(state.cohort)
+    np.testing.assert_array_equal(cohort, np.arange(cfg.n_peers) % 2)
+    want = np.array([epoch_of_cohort(cfg, rnd, int(k)) for k in cohort],
+                    np.uint32)
+    np.testing.assert_array_equal(np.asarray(state.epoch), want)
+
+
+def test_cand16_quantization_saturates():
+    """The u16 round-stamp rule: NEVER <-> 0, in-range sim-seconds
+    round-trip exactly, and out-of-range values SATURATE into
+    [1, 65535] (stale-but-ordered, never the sentinel) — seed_overlay's
+    negative eligibility offset lands on stamp 1 (sim-second 0.0)."""
+    from dispersy_tpu.state import NEVER
+
+    cfg = BASE.replace(store=StoreConfig(staging=8, cand_bits=16))
+    w = float(cfg.walk_interval)
+    col = jnp.asarray([NEVER, 0.0, w, 7 * w, -3 * w, 70_000 * w],
+                      jnp.float32)
+    q = np.asarray(E._cand_quant(col, cfg))
+    assert q.dtype == np.uint16
+    np.testing.assert_array_equal(q, [0, 1, 2, 8, 1, 65535])
+    d = np.asarray(E._cand_deq(jnp.asarray(q), cfg))
+    np.testing.assert_array_equal(
+        d, np.asarray([NEVER, 0.0, w, 7 * w, 0.0, 65534 * w],
+                      np.float32))
+    # round-trip is STABLE: dequantized values re-quantize exactly
+    np.testing.assert_array_equal(
+        np.asarray(E._cand_quant(jnp.asarray(d), cfg)), q)
+    # identity at the default width
+    cfg32 = BASE.replace(store=StoreConfig(staging=8))
+    assert E._cand_quant(col, cfg32) is col
+    # seed_overlay under cand16: every filled stamp saturates to 1
+    state = E.seed_overlay(S.init_state(cfg, jax.random.PRNGKey(0)),
+                           cfg, 4)
+    lw = np.asarray(state.cand_last_walk)
+    assert lw.dtype == np.uint16
+    assert set(np.unique(lw).tolist()) <= {0, 1}
+
+
+def test_autosave_resume_straddles_cohort_sync(tmp_path):
+    """Crash-resume from an autosave taken MID-WINDOW — after one
+    cohort's sync round, before the other's — replays bit-identically
+    to the uninterrupted run (the per-peer epoch leaf checkpoints the
+    heterogeneous salt state)."""
+    import glob
+
+    from dispersy_tpu import scenario as SC
+
+    cfg = ORACLE_BASE.replace(
+        packet_loss=0.05,
+        store=StoreConfig(staging=8, compact_every=4, cohorts=2))
+
+    def scen(d, every=0):
+        return SC.Scenario(rounds=10, events=[
+            (0, SC.Create(meta=1, authors=[5], payload=42)),
+            (4, SC.Create(meta=1, authors=[7], payload=43)),
+        ], autosave_every=every, autosave_dir=d)
+
+    ref_state, ref_log = SC.run(cfg, scen(None))
+    d = str(tmp_path / "autosaves")
+    SC.run(cfg, scen(d, every=3))
+    saves = sorted(glob.glob(os.path.join(d, "auto_*.npz")))
+    assert len(saves) == 3            # rounds 3, 6, 9
+    # The round-3 snapshot is taken BEFORE round 3 executes: cohort 1
+    # synced at round 1 (epoch 1) but cohort 0's sync IS round 3, so it
+    # is still at epoch 0 — the snapshot straddles the window with
+    # heterogeneous per-peer epochs, the state only the v17 leaf can carry
+    snap = ckpt.restore(saves[0], cfg)
+    ep = np.asarray(snap.epoch)
+    assert set(ep[np.asarray(snap.cohort) == 0]) == {0}
+    assert set(ep[np.asarray(snap.cohort) == 1]) == {1}
+    for p in saves[1:]:               # "crash" after round 3
+        os.remove(p)
+        os.remove(p[:-4] + ".json")
+    res_state, res_log = SC.run(cfg, scen(d, every=3), resume=True)
+    for la, lb in zip(jax.tree.leaves(ref_state),
+                      jax.tree.leaves(res_state)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert res_log.rows == ref_log.rows
+
+
+def test_v17_roundtrip_resumes_across_cohort_sync(tmp_path):
+    """v17 checkpoint carries the cohort/epoch leaves: save mid-window
+    under staggering, restore, step across the next cohort's sync round
+    — identical to uninterrupted; a torn epoch leaf refuses."""
+    cfg = COHORT_CFG.replace(packet_loss=0.05)
+    state = E.seed_overlay(S.init_state(cfg, jax.random.PRNGKey(9)),
+                           cfg, 4)
+    au = jnp.arange(cfg.n_peers) % 5 == 2
+    state = E.create_messages(state, cfg, au, meta=1,
+                              payload=jnp.arange(cfg.n_peers,
+                                                 dtype=jnp.uint32))
+    for _ in range(2):                # round 1 = cohort 1's sync round
+        state = E.step(state, cfg)
+    state = jax.block_until_ready(state)
+    path = str(tmp_path / "cohort.npz")
+    ckpt.save(path, state, cfg)
+    rst = ckpt.restore(path, cfg)
+    a, b = state, rst
+    for _ in range(4):                # crosses cohort 0's sync (rnd 3)
+        a = E.step(a, cfg)
+        b = E.step(b, cfg)
+    for la, lb in zip(jax.tree.leaves(jax.block_until_ready(a)),
+                      jax.tree.leaves(jax.block_until_ready(b))):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    ep = arrays["leaf:epoch"].copy()
+    ep.flat[0] ^= 1
+    arrays["leaf:epoch"] = ep
+    bad = str(tmp_path / "torn.npz")
+    np.savez(bad, **arrays)
+    with pytest.raises(CheckpointError):
+        ckpt.restore(bad, cfg)
+
+
+def _as_v16(src: str, dst: str, cfg) -> None:
+    """Rewrite a v17 archive of a default-cohort config as its v16
+    equivalent: the (zero-width) cohort/epoch leaves stripped, the
+    trailing StoreConfig fields stripped from the fingerprint, version
+    stamp 16 (the established repr-strip pattern)."""
+    with np.load(src) as z:
+        arrays = {k: z[k] for k in z.files}
+    for name in ("cohort", "epoch"):
+        arrays.pop(f"leaf:{name}", None)
+        arrays.pop(f"crc:{name}", None)
+    arrays["meta:version"] = np.asarray(16)
+    arrays["meta:config"] = np.frombuffer(
+        ckpt._want_fingerprint(cfg, 16).encode(), dtype=np.uint8)
+    np.savez_compressed(dst, **arrays)
+
+
+def test_v16_archive_loads_and_refuses_cohort_config(tmp_path):
+    """A v16 archive restores under default cohorts/cand_bits (the new
+    leaves default from the template) and equals its v17 twin; the same
+    archive under a staggered or cand-narrowed config is refused."""
+    state = _warm_diet(3)
+    v17 = str(tmp_path / "v17.npz")
+    v16 = str(tmp_path / "v16.npz")
+    ckpt.save(v17, state, DIET_CFG)
+    _as_v16(v17, v16, DIET_CFG)
+    rst16 = ckpt.restore(v16, DIET_CFG)
+    rst17 = ckpt.restore(v17, DIET_CFG)
+    for la, lb in zip(jax.tree.leaves(rst16), jax.tree.leaves(rst17)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    for store in (StoreConfig(staging=8, compact_every=4, cohorts=2),
+                  StoreConfig(staging=8, compact_every=4, cand_bits=16)):
+        with pytest.raises(CheckpointError, match="cohort-staggered"):
+            ckpt.restore(v16, DIET_CFG.replace(store=store))
+
+
+def test_trace_latches_under_cohorting():
+    """The dissemination-tracing plane's r50/r90/r99 coverage latches
+    stay well-defined and monotone under the staggered cadence, and the
+    cohorts=1 run pins the pre-cohort values (the bit-identity claim,
+    visible through the trace plane)."""
+    from dispersy_tpu.traceplane import TraceConfig
+
+    def latches(cohorts):
+        cfg = ORACLE_BASE.replace(
+            trace=TraceConfig(enabled=True, tracked_slots=2),
+            store=StoreConfig(staging=8, compact_every=4,
+                              cohorts=cohorts))
+        state = E.seed_overlay(S.init_state(cfg, jax.random.PRNGKey(0)),
+                               cfg, 4)
+        state, slot = E.track_record(state, cfg, 5, 2)
+        assert slot == 0
+        au = jnp.arange(cfg.n_peers) == 5
+        state = E.create_messages(state, cfg, au, meta=1,
+                                  payload=jnp.full((cfg.n_peers,), 42,
+                                                   jnp.uint32))
+        state = E.multi_step(state, cfg, 16)
+        latch = np.asarray(jax.block_until_ready(state).trace_latch)
+        r50, r90, r99 = (int(latch[0, i]) for i in range(3))
+        assert 0 < r50 <= r90 <= r99, (cohorts, r50, r90, r99)
+        assert (latch[1] == 0).all()
+        return r50, r90, r99
+
+    assert latches(1) == (3, 4, 8)
+    assert latches(2) == (3, 4, 8)
+
+
+# ---- the --store fuzz axis (tools/fuzz_sweep.py) ------------------------
+
+
+def run_store_draw(seed: int) -> None:
+    """One fuzz draw over the byte-diet store grid: random
+    (cohorts, compact_every, staging) cadence plus aux/cand narrowing
+    on a random small overlay with random traffic, bit-exact vs oracle
+    every round.  The ``--store`` axis of tools/fuzz_sweep.py; invalid
+    knob combinations raise ConfigError and count as sweep skips (the
+    validator rejecting them is the tested behavior)."""
+    rng = np.random.default_rng(seed)
+    cohorts = int(rng.choice([1, 2, 3, 4, 6]))
+    stride = int(rng.choice([1, 2, 3]))
+    compact_every = cohorts * stride
+    if rng.random() < 0.1:   # keep a slice of invalid cadence combos
+        compact_every = int(rng.choice([5, 7]))
+    staging = int(rng.choice([0, 2, 4, 8, 16]))
+    store = StoreConfig(
+        staging=staging, compact_every=compact_every,
+        aux_bits=int(rng.choice([16, 32])),
+        cohorts=cohorts, cand_bits=int(rng.choice([16, 32])))
+    n_peers = cohorts * int(rng.integers(8, 15))
+    cfg = CommunityConfig(
+        n_peers=n_peers, n_trackers=2,
+        k_candidates=int(rng.choice([4, 8])),
+        msg_capacity=int(rng.choice([16, 32])),
+        bloom_capacity=int(rng.choice([8, 16])),
+        request_inbox=int(rng.choice([2, 4])),
+        tracker_inbox=int(rng.choice([4, 8])),
+        response_budget=int(rng.choice([2, 6])),
+        forward_fanout=int(rng.choice([0, 2, 3])),
+        push_inbox=int(rng.choice([2, 16])),
+        churn_rate=float(rng.choice([0.0, 0.05])),
+        packet_loss=float(rng.choice([0.0, 0.15])),
+        n_meta=4, store=store)
+    state = S.init_state(cfg, jax.random.PRNGKey(seed))
+    oracle = O.OracleSim(cfg, np.asarray(state.key))
+    state = E.seed_overlay(state, cfg, degree=4)
+    oracle.seed_overlay(degree=4)
+    fields = list(dict.fromkeys(FIELDS + DIET_FIELDS))
+    for rnd in range(10):
+        author = int(rng.integers(cfg.n_trackers, n_peers))
+        meta = int(rng.integers(0, cfg.n_meta))
+        mask = np.arange(n_peers) == author
+        pl = np.full(n_peers, int(rng.integers(1, 1 << 16)), np.uint32)
+        state = E.create_messages(state, cfg, jnp.asarray(mask), meta,
+                                  jnp.asarray(pl))
+        oracle.create_messages(mask, meta, pl)
+        state = jax.block_until_ready(E.step(state, cfg))
+        oracle.step()
+        want = oracle.state_arrays()
+        for f in fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(state, f)), want[f],
+                err_msg=f"store-seed{seed}-round{rnd}: {f} cfg={cfg!r}")
+        for f in STAT_FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(state.stats, f)), want[f],
+                err_msg=f"store-seed{seed}-round{rnd}: stat {f}")
+
+
+def test_store_fuzz_draw_0():
+    run_store_draw(7001)
+
+
+def test_store_fuzz_draw_1():
+    run_store_draw(7003)

@@ -57,6 +57,14 @@ def main() -> None:
                          "(test_overload.run_overload_draw); composes "
                          "with --fleet to route liftable knobs (incl. "
                          "bucket_rate) through traced overrides")
+    ap.add_argument("--store", action="store_true",
+                    help="byte-diet store draws: random (cohorts, "
+                         "compact_every, staging) cadence grids plus "
+                         "aux/cand bit-narrowing vs oracle "
+                         "(test_storediet.run_store_draw); invalid "
+                         "cadence combos (cohorts not dividing "
+                         "compact_every / n_peers, narrowing without "
+                         "staging) count as skips")
     ap.add_argument("--fleet", action="store_true",
                     help="route --faults/--recovery/--overload draws "
                          "whose varied knobs are all traced-liftable "
@@ -71,9 +79,9 @@ def main() -> None:
                          " --adversarial)")
     args = ap.parse_args()
     if sum(map(bool, (args.adversarial, args.faults,
-                      args.recovery, args.overload))) > 1:
-        ap.error("--adversarial / --faults / --recovery / --overload "
-                 "are separate sweep axes")
+                      args.recovery, args.overload, args.store))) > 1:
+        ap.error("--adversarial / --faults / --recovery / --overload / "
+                 "--store are separate sweep axes")
     if args.fleet and not (args.faults or args.recovery or args.overload):
         ap.error("--fleet rides the --faults, --recovery, or "
                  "--overload axis (it routes draws through the fleet "
@@ -86,6 +94,7 @@ def main() -> None:
                     if args.overload
                     else "artifacts/fuzz_sweep_fleet.json" if args.fleet
                     else "artifacts/fuzz_sweep_faults.json" if args.faults
+                    else "artifacts/fuzz_sweep_store.json" if args.store
                     else "artifacts/fuzz_sweep.json")
 
     from test_fuzz_configs import run_adversarial_draw, run_draw  # noqa: E501  pulls in jax (CPU-pinned)
@@ -110,6 +119,9 @@ def main() -> None:
         from test_overload import run_overload_draw
         run_draw = (functools.partial(run_overload_draw, fleet=True)
                     if args.fleet else run_overload_draw)
+    elif args.store:
+        from test_storediet import run_store_draw
+        run_draw = run_store_draw
 
     passed, skipped, failed = [], [], []
     t0 = time.time()
@@ -119,6 +131,7 @@ def main() -> None:
         "faults": bool(args.faults),
         "recovery": bool(args.recovery),
         "overload": bool(args.overload),
+        "store": bool(args.store),
         "fleet": bool(args.fleet),
         "passed": 0, "skipped_invalid_config": 0, "failed": 0,
         "failed_seeds": [], "wall_seconds": 0.0,
@@ -155,6 +168,7 @@ def main() -> None:
             "faults": bool(args.faults),
             "recovery": bool(args.recovery),
             "overload": bool(args.overload),
+            "store": bool(args.store),
             "fleet": bool(args.fleet),
             "passed": len(passed), "skipped_invalid_config": len(skipped),
             "failed": len(failed), "failed_seeds": failed,

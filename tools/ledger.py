@@ -179,12 +179,12 @@ def cmd_roofline(args) -> int:
                  "nofuse = raw cost-analysis bytes, cadence-amortized "
                  "for byte-diet cells)")
     lines.append("")
-    lines.append("| cell | B/peer/round | floor B/peer | "
+    lines.append("| cell | B/peer/round | worst B/peer | floor B/peer | "
                  + " | ".join(
                      f"{hw}_x{c}"
                      for hw, spec in doc["hardware_model"].items()
                      for c in spec["chip_counts"]) + " |")
-    lines.append("|---|---|---|"
+    lines.append("|---|---|---|---|"
                  + "---|" * sum(len(s["chip_counts"])
                                 for s in doc["hardware_model"].values()))
     for key, cell in sorted(doc.get("cells", {}).items()):
@@ -197,8 +197,12 @@ def cmd_roofline(args) -> int:
         floor = cell.get("floor", {}).get(
             "floor_bytes_per_peer_round",
             cell["state"]["state_rw_per_peer_round"])
+        # The provisioning spike: most expensive single round in the
+        # cadence window (== the mean for legacy / pre-worst ledgers).
+        worst = cell.get("bytes_worst_per_peer_round",
+                         cell["bytes_per_peer_round"])
         lines.append(f"| {key} | {cell['bytes_per_peer_round']:,.1f} | "
-                     f"{floor:,.1f} | "
+                     f"{worst:,.1f} | {floor:,.1f} | "
                      + " | ".join(cols) + " |")
     text = "\n".join(lines)
     print(text)
